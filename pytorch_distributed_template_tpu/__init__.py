@@ -1,0 +1,23 @@
+"""pytorch_distributed_template_tpu: a TPU-native distributed training framework.
+
+A ground-up JAX/XLA/Pallas re-design of the capabilities of
+Yun-960/Pytorch-Distributed-Template (a PyTorch-DDP/NCCL experiment template):
+config-driven experiments, distributed data-parallel training over a TPU
+device mesh, checkpoint/resume, distributed evaluation, and a 3-tier
+observability stack — plus the parallelism the reference lacks (tensor,
+sequence/ring-attention, FSDP-style sharding) expressed SPMD-first with
+`jax.sharding` + `jit` and Pallas kernels for the hot ops.
+
+Layout (mirrors the reference's layer map, SURVEY.md §1, re-shaped for TPU):
+  config/        JSON experiment specs -> objects (registry DI, CLI overrides)
+  parallel/      mesh construction, sharding rules, collectives, host sync
+  data/          per-host sharded sampling, loaders, device prefetch
+  models/        flax model zoo (LeNet, ResNet, ViT, GPT-2)
+  ops/           Pallas TPU kernels (flash attention, fused ops)
+  engine/        TrainState, jitted steps, Trainer/Evaluator loops
+  checkpoint/    orbax-backed save/resume with the reference's policy
+  observability/ logging, TensorBoard writer, metric tracking, profiling
+  utils/         small host-side helpers
+"""
+
+__version__ = "0.1.0"

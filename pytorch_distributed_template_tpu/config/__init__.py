@@ -1,0 +1,11 @@
+from .registry import (
+    Registry,
+    MODELS,
+    LOSSES,
+    METRICS,
+    OPTIMIZERS,
+    SCHEDULERS,
+    LOADERS,
+    DATASETS,
+)
+from .parser import ConfigParser

@@ -1,0 +1,3 @@
+from .logging import setup_logging
+from .tb import TensorboardWriter
+from .tracker import MetricTracker

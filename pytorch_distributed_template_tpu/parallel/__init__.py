@@ -1,0 +1,18 @@
+from .dist import (
+    initialize,
+    process_index,
+    process_count,
+    is_main_process,
+    synchronize,
+    all_gather_object,
+    local_device_count,
+    global_device_count,
+)
+from .mesh import build_mesh, mesh_from_config, MESH_AXES
+from .sharding import (
+    batch_sharding,
+    replicated_sharding,
+    named_sharding,
+    make_state_sharding,
+    apply_rules,
+)

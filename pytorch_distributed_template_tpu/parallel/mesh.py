@@ -1,0 +1,83 @@
+"""Device mesh construction.
+
+The reference's only notion of topology is a flat NCCL world
+(/root/reference/train.py:23-29). TPU-native scaling instead names a
+multi-dimensional ``jax.sharding.Mesh`` whose axes carry the parallelism
+strategies (SURVEY.md §2.3): ``data`` (batch), ``fsdp`` (sharded params +
+batch), ``tensor`` (megatron-style op sharding), ``seq`` (ring-attention
+sequence parallelism), ``expert`` (MoE), ``pipe`` (pipeline stages). XLA then
+compiles collectives onto ICI/DCN from sharding annotations alone.
+
+Configs request a mesh with a ``"mesh"`` block, e.g.::
+
+    "mesh": {"axes": {"data": -1}}                      # pure DP (default)
+    "mesh": {"axes": {"data": -1, "tensor": 4}}          # DP x TP
+    "mesh": {"axes": {"data": 2, "seq": 4}}              # DP x SP
+
+``-1`` means "all remaining devices" (at most one axis).
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+# Canonical axis order: data-like axes first (slowest-varying so DP rides DCN
+# across hosts while model axes stay inside a host's ICI domain).
+MESH_AXES = ("pipe", "data", "fsdp", "seq", "expert", "tensor")
+
+
+def resolve_axis_sizes(axes: Optional[Dict[str, int]],
+                       n_devices: int) -> Dict[str, int]:
+    """Normalize an axis-size request: fill one ``-1``, validate the product."""
+    if not axes:
+        axes = {"data": -1}
+    unknown = [a for a in axes if a not in MESH_AXES]
+    if unknown:
+        raise ValueError(f"Unknown mesh axes {unknown}; valid axes: {MESH_AXES}")
+    sizes = {a: int(s) for a, s in axes.items()}
+    wild = [a for a, s in sizes.items() if s == -1]
+    if len(wild) > 1:
+        raise ValueError("At most one mesh axis may be -1")
+    fixed = int(np.prod([s for s in sizes.values() if s != -1])) if sizes else 1
+    if wild:
+        if n_devices % fixed != 0:
+            raise ValueError(
+                f"{n_devices} devices not divisible by fixed axes product {fixed}"
+            )
+        sizes[wild[0]] = n_devices // fixed
+    total = int(np.prod(list(sizes.values())))
+    if total != n_devices:
+        raise ValueError(
+            f"Mesh axes {sizes} multiply to {total} but {n_devices} devices are "
+            f"available"
+        )
+    return sizes
+
+
+def build_mesh(axes: Optional[Dict[str, int]] = None,
+               devices: Optional[Sequence] = None) -> Mesh:
+    """Build a Mesh from an axis-size dict, ordered canonically (MESH_AXES)."""
+    devices = list(devices if devices is not None else jax.devices())
+    sizes = resolve_axis_sizes(axes, len(devices))
+    ordered = [(a, sizes[a]) for a in MESH_AXES if a in sizes]
+    # Drop size-1 axes only if explicitly absent; keep requested axes even at
+    # size 1 so sharding specs stay valid when scaling down.
+    names = tuple(a for a, _ in ordered)
+    shape = tuple(s for _, s in ordered)
+    dev_array = np.asarray(devices).reshape(shape)
+    return Mesh(dev_array, names)
+
+
+def mesh_from_config(config, devices: Optional[Sequence] = None) -> Mesh:
+    """Build the mesh described by a config's ``"mesh"`` block (or pure-DP
+    default, matching the reference's DP-only world, SURVEY.md §2.3)."""
+    block = config.get("mesh", None) if hasattr(config, "get") else None
+    axes = (block or {}).get("axes") if block else None
+    return build_mesh(axes, devices)
+
+
+def axis_size(mesh: Mesh, name: str) -> int:
+    return mesh.shape[name] if name in mesh.axis_names else 1

@@ -1,0 +1,118 @@
+"""Sharding rules: how arrays lay out over the mesh.
+
+Replaces the reference's DDP wrap + DistributedSampler pair
+(/root/reference/train.py:45-52, data_loader/data_loaders.py:23-26) with
+declarative shardings: the batch is sharded over the data-like mesh axes, and
+parameters are placed by **partition rules** — ordered ``(path_regex,
+PartitionSpec)`` pairs matched against the flattened parameter path. Under
+``jit`` XLA then inserts the gradient ``psum`` (DDP's allreduce), parameter
+all-gathers (FSDP), and activation collectives (TP) automatically.
+"""
+from __future__ import annotations
+
+import re
+from typing import Iterable, Sequence, Tuple
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+# Axes that shard the batch dimension. fsdp shards batch AND params (ZeRO-3
+# style); data shards batch only.
+DATA_AXES = ("data", "fsdp")
+
+
+def _present(mesh: Mesh, names: Iterable[str]) -> Tuple[str, ...]:
+    return tuple(n for n in names if n in mesh.axis_names)
+
+
+def batch_spec(mesh: Mesh) -> P:
+    """PartitionSpec for a batch-leading array: shard dim 0 over data axes."""
+    axes = _present(mesh, DATA_AXES)
+    return P(axes if axes else None)
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, batch_spec(mesh))
+
+
+def replicated_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def named_sharding(mesh: Mesh, *spec) -> NamedSharding:
+    return NamedSharding(mesh, P(*spec))
+
+
+def path_str(path) -> str:
+    """Render a jax tree path as 'a/b/c' for regex matching."""
+    parts = []
+    for p in path:
+        if hasattr(p, "key"):
+            parts.append(str(p.key))
+        elif hasattr(p, "idx"):
+            parts.append(str(p.idx))
+        elif hasattr(p, "name"):
+            parts.append(str(p.name))
+        else:
+            parts.append(str(p))
+    return "/".join(parts)
+
+
+def apply_rules(params, mesh: Mesh,
+                rules: Sequence[Tuple[str, P]] = ()) -> object:
+    """Map each param leaf to a NamedSharding via the first matching rule.
+
+    Rules reference axis names that may be absent from the mesh (e.g. a TP
+    rule on a DP-only mesh): absent axes are dropped from the spec, so one
+    rule set serves every mesh shape. Unmatched leaves replicate — the DDP
+    default (reference train.py:46: every rank holds full params).
+
+    FSDP: when the mesh has an ``fsdp`` axis, unmatched leaves are sharded on
+    their largest divisible dimension instead of replicated.
+    """
+    compiled = [(re.compile(pat), spec) for pat, spec in rules]
+    fsdp = "fsdp" in mesh.axis_names and mesh.shape["fsdp"] > 1
+
+    def place(path, leaf):
+        name = path_str(path)
+        for pat, spec in compiled:
+            if pat.search(name):
+                return NamedSharding(mesh, _prune_spec(spec, mesh))
+        if fsdp and hasattr(leaf, "shape") and leaf.ndim >= 1:
+            ax = _largest_divisible_axis(leaf.shape, mesh.shape["fsdp"])
+            if ax is not None:
+                spec = [None] * leaf.ndim
+                spec[ax] = "fsdp"
+                return NamedSharding(mesh, P(*spec))
+        return NamedSharding(mesh, P())
+
+    return jax.tree_util.tree_map_with_path(place, params)
+
+
+def _prune_spec(spec: P, mesh: Mesh) -> P:
+    """Drop mesh axes not present in this mesh from a PartitionSpec."""
+    out = []
+    for entry in spec:
+        if entry is None:
+            out.append(None)
+        elif isinstance(entry, (tuple, list)):
+            kept = tuple(a for a in entry if a in mesh.axis_names)
+            out.append(kept if kept else None)
+        else:
+            out.append(entry if entry in mesh.axis_names else None)
+    return P(*out)
+
+
+def _largest_divisible_axis(shape, size: int):
+    order = sorted(range(len(shape)), key=lambda i: -shape[i])
+    for i in order:
+        if shape[i] % size == 0 and shape[i] >= size:
+            return i
+    return None
+
+
+def make_state_sharding(state, mesh: Mesh, rules: Sequence[Tuple[str, P]] = ()):
+    """Sharding pytree for a full TrainState: params/opt_state by rules,
+    scalars (step counters etc.) fall through to replicate inside
+    ``apply_rules`` since 0-d leaves never match an FSDP dimension."""
+    return apply_rules(state, mesh, rules)

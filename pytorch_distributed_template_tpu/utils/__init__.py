@@ -1,0 +1,1 @@
+from .util import ensure_dir, read_json, write_json, inf_loop

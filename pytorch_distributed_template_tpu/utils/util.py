@@ -1,0 +1,51 @@
+"""Host-side utility helpers.
+
+TPU-native analogue of the reference's ``utils/util.py``
+(/root/reference/utils/util.py:9-27): JSON round-trip with ordered keys,
+directory creation, and the endless-loader wrapper used for iteration-based
+training. The reference's ``prepare_device`` (utils/util.py:29-44) is dead
+code there and has no analogue here — device selection is JAX's job.
+"""
+from __future__ import annotations
+
+import json
+from collections import OrderedDict
+from itertools import repeat
+from pathlib import Path
+
+
+def ensure_dir(dirname) -> None:
+    Path(dirname).mkdir(parents=True, exist_ok=True)
+
+
+def read_json(fname) -> OrderedDict:
+    fname = Path(fname)
+    with fname.open("rt") as handle:
+        return json.load(handle, object_hook=OrderedDict)
+
+
+def write_json(content, fname) -> None:
+    fname = Path(fname)
+    with fname.open("wt") as handle:
+        json.dump(content, handle, indent=4, sort_keys=False)
+
+
+def inf_loop(data_loader):
+    """Wrap a loader so it re-iterates forever (iteration-based training).
+
+    Parity with /root/reference/utils/util.py:24-27.
+    """
+    for loader in repeat(data_loader):
+        yield from loader
+
+
+def flatten_dict(d, parent_key: str = "", sep: str = "."):
+    """Flatten a nested dict: {'a': {'b': 1}} -> {'a.b': 1}."""
+    items = {}
+    for k, v in d.items():
+        key = f"{parent_key}{sep}{k}" if parent_key else str(k)
+        if isinstance(v, dict):
+            items.update(flatten_dict(v, key, sep=sep))
+        else:
+            items[key] = v
+    return items

@@ -1,0 +1,36 @@
+"""Test environment: force an 8-device virtual CPU mesh.
+
+Must run before any jax import (SURVEY.md §4): this is the JAX-idiomatic
+"fake backend" — the analogue of running the reference without a launcher,
+where every dist helper degrades gracefully (/root/reference/utils/dist.py).
+"""
+import os
+import sys
+from pathlib import Path
+
+# Force CPU: the image presets JAX_PLATFORMS=axon (the tunneled real TPU);
+# tests must run on the virtual 8-device CPU mesh regardless. The env var
+# alone is not enough because the site hook registers the TPU plugin at
+# interpreter startup, so also override via jax.config below.
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+if str(REPO_ROOT) not in sys.path:
+    sys.path.insert(0, str(REPO_ROOT))
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture()
+def tmp_run_dir(tmp_path):
+    return tmp_path

@@ -1,0 +1,195 @@
+"""Unit tests for the config/registry layer.
+
+Covers the trickiest reference semantics (SURVEY.md §4, §7 stage 1):
+keychain overrides, resume-config rediscovery, fine-tune overlay, registry
+DI, and the run-dir layout.
+"""
+import argparse
+import collections
+import json
+from pathlib import Path
+
+import pytest
+
+from pytorch_distributed_template_tpu.config import ConfigParser, Registry
+from pytorch_distributed_template_tpu.config.parser import (
+    _get_opt_name,
+    _set_by_path,
+    _update_config,
+)
+
+
+def minimal_config(save_dir, name="UnitTest"):
+    return {
+        "name": name,
+        "arch": {"type": "Dummy", "args": {"width": 4}},
+        "trainer": {"save_dir": str(save_dir), "verbosity": 2},
+    }
+
+
+def test_keychain_override(tmp_path):
+    cfg = minimal_config(tmp_path)
+    out = _update_config(cfg, {"arch;args;width": 16, "name": "Renamed"})
+    assert out["arch"]["args"]["width"] == 16
+    assert out["name"] == "Renamed"
+
+
+def test_keychain_none_skipped(tmp_path):
+    cfg = minimal_config(tmp_path)
+    out = _update_config(cfg, {"arch;args;width": None})
+    assert out["arch"]["args"]["width"] == 4
+
+
+def test_set_by_path_nested():
+    tree = {"a": {"b": {"c": 1}}}
+    _set_by_path(tree, "a;b;c", 99)
+    assert tree["a"]["b"]["c"] == 99
+
+
+def test_get_opt_name():
+    assert _get_opt_name(["--lr", "--learning_rate"]) == "lr"
+    assert _get_opt_name(["-x"]) == "x"
+
+
+def test_run_dir_layout_and_snapshot(tmp_path):
+    cfg = minimal_config(tmp_path)
+    parser = ConfigParser(cfg, run_id="run0", training=True)
+    assert parser.save_dir == tmp_path / "UnitTest" / "train" / "run0"
+    snap = parser.save_dir / "config.json"
+    assert snap.exists()
+    assert json.loads(snap.read_text())["name"] == "UnitTest"
+
+
+def test_test_dir_layout(tmp_path):
+    parser = ConfigParser(minimal_config(tmp_path), run_id="r", training=False)
+    assert "test" in str(parser.save_dir)
+
+
+def test_init_obj_registry(tmp_path):
+    reg = Registry("test_models")
+
+    @reg.register("Dummy")
+    class Dummy:
+        def __init__(self, width, extra=0):
+            self.width = width
+            self.extra = extra
+
+    parser = ConfigParser(minimal_config(tmp_path), run_id="r")
+    obj = parser.init_obj("arch", reg, extra=7)
+    assert obj.width == 4 and obj.extra == 7
+
+    # kwarg collision with config args is rejected (reference parity,
+    # parse_config.py:90)
+    with pytest.raises(ValueError):
+        parser.init_obj("arch", reg, width=9)
+
+
+def test_init_ftn_partial(tmp_path):
+    reg = Registry("test_fns")
+
+    @reg.register("Dummy")
+    def make(width, scale):
+        return width * scale
+
+    parser = ConfigParser(minimal_config(tmp_path), run_id="r")
+    fn = parser.init_ftn("arch", reg)
+    assert fn(scale=3) == 12
+
+
+def test_init_obj_module_fallback(tmp_path):
+    import types
+
+    mod = types.SimpleNamespace(Dummy=lambda width: width + 1)
+    parser = ConfigParser(minimal_config(tmp_path), run_id="r")
+    assert parser.init_obj("arch", mod) == 5
+
+
+def test_from_args_config(tmp_path):
+    cfg_file = tmp_path / "c.json"
+    cfg_file.write_text(json.dumps(minimal_config(tmp_path)))
+    ap = argparse.ArgumentParser()
+    ap.add_argument("-c", "--config", default=None)
+    ap.add_argument("-r", "--resume", default=None)
+    ap.add_argument("-s", "--save_dir", default=None)
+    CustomArgs = collections.namedtuple("CustomArgs", "flags type target")
+    options = [CustomArgs(["--width"], type=int, target="arch;args;width")]
+    import sys
+
+    argv = sys.argv
+    sys.argv = ["prog", "-c", str(cfg_file), "--width", "32"]
+    try:
+        args, parser = ConfigParser.from_args(ap, options)
+    finally:
+        sys.argv = argv
+    assert parser["arch"]["args"]["width"] == 32
+
+
+def test_from_args_resume_rediscovery_and_finetune_overlay(tmp_path):
+    # Simulate a previous run dir with a config snapshot + checkpoint dir.
+    run_dir = tmp_path / "Exp" / "train" / "0101_000000"
+    run_dir.mkdir(parents=True)
+    base = minimal_config(tmp_path, name="Exp")
+    (run_dir / "config.json").write_text(json.dumps(base))
+    ckpt = run_dir / "checkpoint-epoch3"
+    ckpt.mkdir()
+
+    # Fine-tune overlay config: top-level key replacement (reference
+    # parse_config.py:69-71 uses dict.update => whole 'arch' block replaced).
+    ft = {"arch": {"type": "Dummy", "args": {"width": 64}}}
+    ft_file = tmp_path / "ft.json"
+    ft_file.write_text(json.dumps(ft))
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("-c", "--config", default=None)
+    ap.add_argument("-r", "--resume", default=None)
+    ap.add_argument("-s", "--save_dir", default=None)
+    import sys
+
+    argv = sys.argv
+    sys.argv = ["prog", "-r", str(ckpt), "-c", str(ft_file)]
+    try:
+        args, parser = ConfigParser.from_args(ap, ())
+    finally:
+        sys.argv = argv
+    assert parser.resume == ckpt
+    assert parser["arch"]["args"]["width"] == 64   # overlay applied
+    assert parser["name"] == "Exp"                  # base config kept
+
+
+def test_save_dir_flag_overrides(tmp_path):
+    cfg_file = tmp_path / "c.json"
+    cfg_file.write_text(json.dumps(minimal_config(tmp_path)))
+    other = tmp_path / "elsewhere"
+    ap = argparse.ArgumentParser()
+    ap.add_argument("-c", "--config", default=None)
+    ap.add_argument("-r", "--resume", default=None)
+    ap.add_argument("-s", "--save_dir", default=None)
+    import sys
+
+    argv = sys.argv
+    sys.argv = ["prog", "-c", str(cfg_file), "-s", str(other)]
+    try:
+        args, parser = ConfigParser.from_args(ap, ())
+    finally:
+        sys.argv = argv
+    assert str(parser.save_dir).startswith(str(other))
+
+
+def test_registry_duplicate_and_missing():
+    reg = Registry("r")
+    reg.register("a")(lambda: 1)
+    with pytest.raises(KeyError):
+        reg.register("a")(lambda: 2)
+    with pytest.raises(KeyError):
+        reg.get("nope")
+    assert "a" in reg and reg.names() == ["a"]
+
+
+def test_get_logger_verbosity(tmp_path):
+    import logging
+
+    parser = ConfigParser(minimal_config(tmp_path), run_id="r")
+    lg = parser.get_logger("x", verbosity=1)
+    assert lg.level == logging.INFO
+    with pytest.raises(AssertionError):
+        parser.get_logger("x", verbosity=9)
