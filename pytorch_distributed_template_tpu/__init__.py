@@ -12,8 +12,7 @@ Layout (mirrors the reference's layer map, SURVEY.md §1, re-shaped for TPU):
   config/        JSON experiment specs -> objects (registry DI, CLI overrides)
   parallel/      mesh construction, sharding rules, collectives, host sync
   data/          per-host sharded sampling, loaders, device prefetch
-  models/        flax model zoo (LeNet, ResNet, ViT, GPT-2)
-  ops/           Pallas TPU kernels (flash attention, fused ops)
+  models/        flax model zoo (see models/__init__ for what is registered)
   engine/        TrainState, jitted steps, Trainer/Evaluator loops
   checkpoint/    orbax-backed save/resume with the reference's policy
   observability/ logging, TensorBoard writer, metric tracking, profiling

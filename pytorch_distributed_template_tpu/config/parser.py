@@ -51,7 +51,8 @@ class ConfigParser:
         :param training: selects the ``train`` vs ``test`` run subdirectory.
         """
         self._config = _update_config(config, modification)
-        self.resume = Path(resume) if resume is not None else None
+        # resolve(): orbax requires absolute paths end-to-end.
+        self.resume = Path(resume).resolve() if resume is not None else None
 
         save_dir = Path(self.config["trainer"]["save_dir"])
         exper_name = self.config["name"]
@@ -59,7 +60,8 @@ class ConfigParser:
             run_id = datetime.now().strftime(r"%m%d_%H%M%S")
         self._run_id = run_id
         traindir = "train" if training else "test"
-        self._save_dir = save_dir / exper_name / traindir / run_id
+        # Absolute: orbax (tensorstore) requires absolute checkpoint paths.
+        self._save_dir = (save_dir / exper_name / traindir / run_id).resolve()
 
         # Only the main process touches the filesystem (reference races here).
         from ..parallel.dist import is_main_process

@@ -1,0 +1,3 @@
+from .sampler import ShardedSampler
+from .loader import ArrayDataLoader, prefetch_to_device
+from . import datasets  # registers DATASETS / LOADERS entries

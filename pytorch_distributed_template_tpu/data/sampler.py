@@ -1,0 +1,84 @@
+"""Deterministic sharded index sampling.
+
+Replicates ``torch.utils.data.DistributedSampler`` semantics — the
+reference's data-sharding mechanism (/root/reference/data_loader/
+data_loaders.py:23-26, base/base_data_loader.py:11-19) — without torch:
+
+- the index set is padded **by duplication** up to a multiple of the shard
+  count (parity with DistributedSampler's wraparound padding; SURVEY.md §7
+  hard-part (c)),
+- shard ``i`` takes indices ``i::num_shards`` (strided assignment),
+- shuffling permutes globally with a seed derived from ``(seed, epoch)`` so
+  every shard sees the same permutation (``set_epoch`` parity).
+
+In the TPU framework shards are **hosts** (process_index), not devices: a
+single process feeds its whole local mesh slice and ``jit`` shards the batch
+over devices. ``pad_mask()`` additionally exposes which indices are
+duplicates so evaluation can compute exact (unpadded) metrics — an option the
+reference lacks.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+
+def epoch_permutation(seed: int, epoch: int, n: int) -> np.ndarray:
+    """The framework's canonical per-epoch permutation: counter-based Philox
+    keyed by ``seed`` with ``epoch`` as the counter, so single-host loaders
+    and multi-host samplers produce the same global order from the same
+    ``(seed, epoch)``."""
+    rng = np.random.Generator(np.random.Philox(key=seed, counter=epoch))
+    return rng.permutation(n)
+
+
+class ShardedSampler:
+    def __init__(self, num_samples: int, num_shards: int = 1,
+                 shard_index: int = 0, shuffle: bool = True, seed: int = 0):
+        if not 0 <= shard_index < num_shards:
+            raise ValueError(
+                f"shard_index {shard_index} out of range for {num_shards} shards"
+            )
+        if num_samples <= 0:
+            raise ValueError("num_samples must be positive")
+        self.num_samples = num_samples
+        self.num_shards = num_shards
+        self.shard_index = shard_index
+        self.shuffle = shuffle
+        self.seed = seed
+        self.epoch = 0
+        # ceil(n / shards) * shards, like DistributedSampler
+        self.total_size = -(-num_samples // num_shards) * num_shards
+        self.shard_size = self.total_size // num_shards
+
+    def set_epoch(self, epoch: int) -> None:
+        """Reseed the per-epoch permutation (DistributedSampler.set_epoch)."""
+        self.epoch = epoch
+
+    def _global_indices(self) -> np.ndarray:
+        if self.shuffle:
+            idx = epoch_permutation(self.seed, self.epoch, self.num_samples)
+        else:
+            idx = np.arange(self.num_samples)
+        pad = self.total_size - self.num_samples
+        if pad:
+            idx = np.concatenate([idx, idx[:pad]])  # duplicate-padding
+        return idx
+
+    def indices(self) -> np.ndarray:
+        """This shard's indices for the current epoch."""
+        return self._global_indices()[self.shard_index :: self.num_shards]
+
+    def pad_mask(self) -> np.ndarray:
+        """True where this shard's index is real data (not duplicate padding).
+
+        Padding occupies the tail of the *global* order, so positions
+        >= num_samples in the global array are flagged.
+        """
+        positions = np.arange(self.shard_index, self.total_size, self.num_shards)
+        return positions < self.num_samples
+
+    def __iter__(self):
+        return iter(self.indices())
+
+    def __len__(self) -> int:
+        return self.shard_size

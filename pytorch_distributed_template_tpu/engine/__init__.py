@@ -1,0 +1,4 @@
+from . import losses, metrics, optim  # register components
+from .state import TrainState, create_train_state
+from .steps import make_train_step, make_eval_step
+from .trainer import Trainer

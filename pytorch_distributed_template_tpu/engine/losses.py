@@ -1,0 +1,46 @@
+"""Loss functions.
+
+Reference: ``model/loss.py`` — a single ``nll_loss`` over log-probabilities
+(/root/reference/model/loss.py:4-5). Here losses are **per-example** pure
+functions ``(output, target) -> [B]``; the engine applies the padding mask
+and reduces. That single convention makes every loss exact under the
+duplicate-padded final batches the sampler produces (SURVEY.md §7 hard-part
+(c)) and lets metrics/losses share reduction machinery inside jit.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import optax
+
+from ..config.registry import LOSSES
+
+
+@LOSSES.register("nll_loss")
+def nll_loss(output, target):
+    """Negative log-likelihood over log-probability outputs (reference
+    parity: the model ends in log_softmax)."""
+    return -jnp.take_along_axis(output, target[:, None], axis=-1)[:, 0]
+
+
+@LOSSES.register("cross_entropy")
+def cross_entropy(output, target):
+    """Softmax cross-entropy over raw logits."""
+    return optax.softmax_cross_entropy_with_integer_labels(output, target)
+
+
+@LOSSES.register("lm_cross_entropy")
+def lm_cross_entropy(output, target):
+    """Next-token LM loss: output [B, T, V] logits, target [B, T] tokens.
+
+    Shifts internally (predict token t+1 from position t) and returns a
+    per-sequence mean so the engine's per-example mask applies unchanged.
+    """
+    logits = output[:, :-1]
+    labels = target[:, 1:]
+    tok = optax.softmax_cross_entropy_with_integer_labels(logits, labels)
+    return tok.mean(axis=-1)
+
+
+@LOSSES.register("mse_loss")
+def mse_loss(output, target):
+    return jnp.mean((output - target) ** 2, axis=tuple(range(1, output.ndim)))

@@ -1,0 +1,36 @@
+"""Metric functions.
+
+Reference: ``model/metric.py`` — ``accuracy`` and ``top_k_acc``
+(/root/reference/model/metric.py:4-20), computed there on the full gathered
+prediction set on rank 0. Here metrics are per-example indicator functions
+``(output, target) -> [B]`` reduced **in-graph** as masked sufficient
+statistics (sum, count) — numerically identical to gathering everything, but
+the data never leaves the devices (SURVEY.md §3.5 "TPU equivalent").
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..config.registry import METRICS
+
+
+@METRICS.register("accuracy")
+def accuracy(output, target):
+    pred = jnp.argmax(output, axis=-1)
+    return (pred == target).astype(jnp.float32)
+
+
+@METRICS.register("top_k_acc")
+def top_k_acc(output, target, k: int = 3):
+    _, topk = jax.lax.top_k(output, k)
+    hit = (topk == target[..., None]).any(axis=-1)
+    return hit.astype(jnp.float32)
+
+
+@METRICS.register("lm_token_accuracy")
+def lm_token_accuracy(output, target):
+    """Next-token accuracy for LM heads: output [B,T,V], target [B,T]."""
+    pred = jnp.argmax(output[:, :-1], axis=-1)
+    hit = (pred == target[:, 1:]).astype(jnp.float32)
+    return hit.mean(axis=-1)

@@ -1,0 +1,51 @@
+"""Training state: the single pytree the jitted step transforms.
+
+The reference's mutable training state is spread across ``nn.Module``
+parameters, optimizer slots, and the trainer's Python attributes
+(/root/reference/base/base_trainer.py:14-49). TPU-natively all
+device-resident state lives in one immutable pytree ``(step, params,
+batch_stats, opt_state, rng)`` so the train step is a pure function
+``(state, batch) -> (state, metrics)`` that XLA can donate and pipeline.
+"""
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+
+@struct.dataclass
+class TrainState:
+    step: jnp.ndarray            # scalar int32, global optimizer step count
+    params: Any
+    batch_stats: Any             # {} for stateless models (e.g. no BatchNorm)
+    opt_state: Any
+    rng: jax.Array               # base PRNG key; per-step keys fold in `step`
+
+
+def create_train_state(model, tx, sample_input, seed: int = 0,
+                       init_train: bool = False) -> TrainState:
+    """Initialize params (and batch_stats if the model has them) + optimizer.
+
+    ``sample_input`` is a shape template batch (e.g.
+    ``model.batch_template()``).
+    """
+    root = jax.random.key(seed)
+    param_key, dropout_key, state_key = jax.random.split(root, 3)
+    variables = model.init(
+        {"params": param_key, "dropout": dropout_key},
+        sample_input,
+        train=init_train,
+    )
+    params = variables["params"]
+    batch_stats = variables.get("batch_stats", {})
+    opt_state = tx.init(params)
+    return TrainState(
+        step=jnp.zeros((), jnp.int32),
+        params=params,
+        batch_stats=batch_stats,
+        opt_state=opt_state,
+        rng=state_key,
+    )

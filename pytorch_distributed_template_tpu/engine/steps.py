@@ -1,0 +1,135 @@
+"""The jitted train/eval step builders — the framework's hot loop.
+
+Replaces the reference's per-batch Python sequence (H2D copy, zero_grad,
+forward, loss, ``dist.reduce``, backward, DDP allreduce, optimizer step —
+/root/reference/trainer/trainer.py:45-58) with ONE compiled SPMD program:
+
+- the batch arrives already sharded over the mesh's data axes;
+- ``jnp`` reductions over the sharded batch dimension compile to ``psum``
+  over ICI (the DDP gradient allreduce *and* the reference's per-step
+  ``reduce_loss`` collective, fused into the step instead of blocking it —
+  the reference syncs before backward, SURVEY.md §2.1 bug list);
+- masked per-example losses/metrics make duplicate-padded batches exact;
+- the optimizer update runs in-graph (optax), so there is no host round-trip
+  between micro-batches.
+
+Metrics are returned as sufficient statistics ``{name_sum, count}`` — the
+TPU-idiomatic version of the reference's gather-everything-to-rank-0 eval
+(SURVEY.md §3.5).
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, Sequence
+
+import jax
+import jax.numpy as jnp
+import optax
+
+
+def _masked_sum(per_example, mask):
+    return jnp.sum(per_example * mask)
+
+
+def make_train_step(model, tx, criterion: Callable,
+                    metric_fns: Sequence[Callable] = (),
+                    input_key: str = "image", target_key: str = "label",
+                    grad_clip_norm: float = 0.0):
+    """Build ``train_step(state, batch) -> (state, metrics)``.
+
+    ``metrics`` holds scalar sums + count; callers divide after accumulating
+    across batches (exact masked averages).
+    """
+
+    def loss_and_output(params, batch_stats, batch, dropout_rng):
+        variables = {"params": params}
+        if batch_stats:
+            variables["batch_stats"] = batch_stats
+            output, mutated = model.apply(
+                variables, batch[input_key], train=True,
+                mutable=["batch_stats"], rngs={"dropout": dropout_rng},
+            )
+            new_stats = mutated["batch_stats"]
+        else:
+            output = model.apply(
+                variables, batch[input_key], train=True,
+                rngs={"dropout": dropout_rng},
+            )
+            new_stats = batch_stats
+        per_ex = criterion(output, batch[target_key])
+        mask = batch["mask"].astype(per_ex.dtype)
+        count = jnp.maximum(mask.sum(), 1.0)
+        loss = _masked_sum(per_ex, mask) / count
+        return loss, (output, new_stats, mask, count)
+
+    def train_step(state, batch):
+        dropout_rng = jax.random.fold_in(state.rng, state.step)
+        (loss, (output, new_stats, mask, count)), grads = jax.value_and_grad(
+            loss_and_output, has_aux=True
+        )(state.params, state.batch_stats, batch, dropout_rng)
+
+        if grad_clip_norm > 0:
+            gnorm = optax.global_norm(grads)
+            scale = jnp.minimum(1.0, grad_clip_norm / (gnorm + 1e-6))
+            grads = jax.tree.map(lambda g: g * scale, grads)
+
+        updates, new_opt_state = tx.update(grads, state.opt_state, state.params)
+        new_params = optax.apply_updates(state.params, updates)
+        new_state = state.replace(
+            step=state.step + 1,
+            params=new_params,
+            batch_stats=new_stats,
+            opt_state=new_opt_state,
+        )
+        metrics = {"loss_sum": loss * count, "count": count}
+        for fn in metric_fns:
+            metrics[f"{fn.__name__}_sum"] = _masked_sum(
+                fn(output, batch[target_key]), mask
+            )
+        return new_state, metrics
+
+    return train_step
+
+
+def make_eval_step(model, criterion: Callable,
+                   metric_fns: Sequence[Callable] = (),
+                   input_key: str = "image", target_key: str = "label"):
+    """Build ``eval_step(state, batch) -> metrics`` (sufficient statistics).
+
+    Equivalent to the reference's no-grad validation forward
+    (trainer/trainer.py:94-113) + the rank-0 global metric computation
+    (trainer/trainer.py:75-88), but reduced in-graph: no pickle gathers, no
+    full prediction set on one host.
+    """
+
+    def eval_step(state, batch):
+        variables = {"params": state.params}
+        if state.batch_stats:
+            variables["batch_stats"] = state.batch_stats
+        output = model.apply(variables, batch[input_key], train=False)
+        per_ex = criterion(output, batch[target_key])
+        mask = batch["mask"].astype(per_ex.dtype)
+        metrics = {
+            "loss_sum": _masked_sum(per_ex, mask),
+            "count": mask.sum(),
+        }
+        for fn in metric_fns:
+            metrics[f"{fn.__name__}_sum"] = _masked_sum(
+                fn(output, batch[target_key]), mask
+            )
+        return metrics
+
+    return eval_step
+
+
+def finalize_metrics(sums: Dict[str, float]) -> Dict[str, float]:
+    """Convert accumulated sufficient statistics to averages."""
+    count = float(sums.get("count", 1.0)) or 1.0
+    out = {}
+    for k, v in sums.items():
+        if k == "count":
+            continue
+        if k.endswith("_sum"):
+            out[k[: -len("_sum")]] = float(v) / count
+        else:
+            out[k] = float(v)
+    return out

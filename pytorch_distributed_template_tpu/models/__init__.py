@@ -1,0 +1,2 @@
+from .base import describe, param_count
+from .lenet import LeNet

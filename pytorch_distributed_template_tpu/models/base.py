@@ -1,0 +1,26 @@
+"""Model description helpers.
+
+The reference's ``BaseModel`` adds one capability to ``nn.Module``: printing
+the trainable-parameter count (/root/reference/base/base_model.py:19-25).
+flax modules are plain pytrees of params, so this is a function of the param
+tree rather than a base class.
+"""
+from __future__ import annotations
+
+import jax
+import numpy as np
+
+
+def param_count(params) -> int:
+    return int(
+        sum(np.prod(x.shape) for x in jax.tree_util.tree_leaves(params))
+    )
+
+
+def describe(model, params) -> str:
+    """Model summary string; reference ``BaseModel.__str__``
+    (base/base_model.py:21-25)."""
+    return (
+        f"{type(model).__name__}\n"
+        f"Trainable parameters: {param_count(params)}"
+    )

@@ -1,0 +1,40 @@
+"""LeNet-style MNIST CNN.
+
+Capability parity with the reference's ``MnistModel``
+(/root/reference/model/model.py:6-22): two conv blocks with max-pool,
+dropout, two dense layers, log-softmax output. Re-designed for TPU: NHWC
+layout (XLA:TPU's native conv layout), flax.linen, explicit dropout RNG
+threading — same capacity class, not a translation.
+"""
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from ..config.registry import MODELS
+
+
+@MODELS.register("LeNet", aliases=("MnistModel",))
+class LeNet(nn.Module):
+    num_classes: int = 10
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        # x: [B, 28, 28, 1] NHWC
+        x = nn.Conv(features=10, kernel_size=(5, 5), padding="VALID")(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = nn.relu(x)
+        x = nn.Conv(features=20, kernel_size=(5, 5), padding="VALID")(x)
+        x = nn.Dropout(rate=0.5, deterministic=not train)(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = nn.relu(x)
+        x = x.reshape((x.shape[0], -1))
+        x = nn.Dense(features=50)(x)
+        x = nn.relu(x)
+        x = nn.Dropout(rate=0.5, deterministic=not train)(x)
+        x = nn.Dense(features=self.num_classes)(x)
+        return nn.log_softmax(x, axis=-1)
+
+    def batch_template(self, batch_size: int = 1):
+        """Shape template used to initialize params."""
+        return jnp.zeros((batch_size, 28, 28, 1), jnp.float32)

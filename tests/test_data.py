@@ -1,0 +1,153 @@
+"""Data layer tests: sharding math, padding, loaders, prefetch."""
+import jax
+import numpy as np
+import pytest
+
+from pytorch_distributed_template_tpu.config import LOADERS
+from pytorch_distributed_template_tpu.data import (
+    ArrayDataLoader,
+    ShardedSampler,
+    prefetch_to_device,
+)
+from pytorch_distributed_template_tpu.parallel import batch_sharding, build_mesh
+
+
+class TestShardedSampler:
+    def test_partition_covers_all_exactly_once_when_divisible(self):
+        samplers = [
+            ShardedSampler(100, 4, i, shuffle=False) for i in range(4)
+        ]
+        allidx = np.concatenate([s.indices() for s in samplers])
+        assert sorted(allidx) == list(range(100))
+
+    def test_duplicate_padding_when_not_divisible(self):
+        # 10 samples over 4 shards -> total 12, two duplicates
+        samplers = [ShardedSampler(10, 4, i, shuffle=False) for i in range(4)]
+        assert all(len(s) == 3 for s in samplers)
+        allidx = np.concatenate([s.indices() for s in samplers])
+        assert len(allidx) == 12
+        assert set(allidx) == set(range(10))
+
+    def test_pad_mask_marks_duplicates(self):
+        samplers = [ShardedSampler(10, 4, i, shuffle=False) for i in range(4)]
+        real = sum(int(s.pad_mask().sum()) for s in samplers)
+        assert real == 10
+
+    def test_epoch_reshuffle_deterministic(self):
+        s = ShardedSampler(50, 2, 0, shuffle=True, seed=7)
+        s.set_epoch(1)
+        a = s.indices().copy()
+        s.set_epoch(2)
+        b = s.indices().copy()
+        s.set_epoch(1)
+        assert np.array_equal(a, s.indices())
+        assert not np.array_equal(a, b)
+
+    def test_same_permutation_across_shards(self):
+        s0 = ShardedSampler(40, 4, 0, shuffle=True, seed=3)
+        s1 = ShardedSampler(40, 4, 1, shuffle=True, seed=3)
+        g0 = s0._global_indices()
+        g1 = s1._global_indices()
+        assert np.array_equal(g0, g1)
+
+    def test_bad_args(self):
+        with pytest.raises(ValueError):
+            ShardedSampler(10, 2, 5)
+        with pytest.raises(ValueError):
+            ShardedSampler(0, 1, 0)
+
+
+class TestArrayDataLoader:
+    def data(self, n=20):
+        return {
+            "image": np.arange(n * 2, dtype=np.float32).reshape(n, 2),
+            "label": np.arange(n, dtype=np.int32),
+        }
+
+    def test_batches_static_shape_with_mask(self):
+        dl = ArrayDataLoader(self.data(10), batch_size=4, shuffle=False)
+        batches = list(dl)
+        assert len(batches) == 3 == len(dl)
+        assert all(b["image"].shape == (4, 2) for b in batches)
+        # last batch: 2 real + 2 padded
+        assert batches[-1]["mask"].tolist() == [True, True, False, False]
+
+    def test_drop_last(self):
+        dl = ArrayDataLoader(self.data(10), batch_size=4, shuffle=False,
+                             drop_last=True)
+        assert len(list(dl)) == 2 == len(dl)
+
+    def test_epoch_shuffle(self):
+        dl = ArrayDataLoader(self.data(16), batch_size=16, shuffle=True)
+        dl.set_epoch(0)
+        a = next(iter(dl))["label"].copy()
+        dl.set_epoch(1)
+        b = next(iter(dl))["label"].copy()
+        assert not np.array_equal(a, b)
+        assert sorted(a) == sorted(b)
+
+    def test_sampler_integration(self):
+        s = ShardedSampler(20, 2, 0, shuffle=False)
+        dl = ArrayDataLoader(self.data(20), batch_size=5, sampler=s,
+                             shuffle=True)
+        assert dl.shuffle is False  # sampler forces shuffle off (parity)
+        labels = np.concatenate([b["label"] for b in dl])
+        assert np.array_equal(labels, np.arange(0, 20, 2))
+
+    def test_mismatched_arrays_rejected(self):
+        with pytest.raises(ValueError):
+            ArrayDataLoader(
+                {"a": np.zeros(3), "b": np.zeros(4)}, batch_size=2
+            )
+
+
+def test_prefetch_to_device_shards_batches():
+    mesh = build_mesh({"data": 8})
+    data = {
+        "image": np.random.randn(32, 4).astype(np.float32),
+        "label": np.arange(32, dtype=np.int32),
+    }
+    dl = ArrayDataLoader(data, batch_size=16, shuffle=False)
+    out = list(prefetch_to_device(dl, batch_sharding(mesh)))
+    assert len(out) == 2
+    assert isinstance(out[0]["image"], jax.Array)
+    assert out[0]["image"].addressable_shards[0].data.shape == (2, 4)
+    np.testing.assert_array_equal(
+        np.asarray(out[0]["label"]), data["label"][:16]
+    )
+
+
+def test_registered_loaders_fallback_synthetic(tmp_path):
+    dl = LOADERS.get("MnistDataLoader")(
+        data_dir=str(tmp_path), batch_size=32, training=True, synthetic_n=128
+    )
+    b = next(iter(dl))
+    assert b["image"].shape == (32, 28, 28, 1)
+    assert b["label"].dtype == np.int32
+
+
+def test_synthetic_data_is_learnable():
+    """Class templates must be separable: nearest-template classification on
+    clean synthetic MNIST should beat chance by a wide margin."""
+    from pytorch_distributed_template_tpu.data.datasets import (
+        _synthetic_image_classification,
+    )
+
+    x, y = _synthetic_image_classification(512, (28, 28, 1), 10, seed=0)
+    x2, y2 = _synthetic_image_classification(512, (28, 28, 1), 10, seed=0)
+    assert np.array_equal(y, y2) and np.allclose(x, x2)  # deterministic
+
+    # build per-class means from half, classify other half
+    means = np.stack([x[:256][y[:256] == c].mean(0) for c in range(10)])
+    flat = x[256:].reshape(256, -1)
+    d = ((flat[:, None, :] - means.reshape(10, -1)[None]) ** 2).sum(-1)
+    acc = (d.argmin(1) == y[256:]).mean()
+    assert acc > 0.9
+
+
+def test_synthetic_lm_bigram_structure():
+    from pytorch_distributed_template_tpu.data.datasets import synthetic_lm
+
+    d = synthetic_lm(n=64, seq_len=32, vocab_size=100, seed=1)
+    assert d["tokens"].shape == (64, 32)
+    assert d["tokens"].max() < 100
