@@ -115,9 +115,11 @@ class CheckpointManager:
         else:
             ckpt_config = meta.get("config", {})
 
-        if ckpt_config is not None and (
+        arch_mismatch = ckpt_config is not None and (
             ckpt_config.get("arch") != current_config.get("arch")
-        ):
+            or (meta.get("arch") is not None and meta["arch"] != current_arch)
+        )
+        if arch_mismatch:
             logger.warning(
                 "Warning: Architecture configuration given in config file is "
                 "different from that of checkpoint. This may yield an "

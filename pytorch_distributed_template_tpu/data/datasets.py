@@ -48,7 +48,11 @@ def _synthetic_image_classification(n: int, shape, num_classes: int,
     SAME class distribution — otherwise validation would be unlearnable.
     """
     tmpl_rng = np.random.Generator(np.random.Philox(key=seed))
-    rng = np.random.Generator(np.random.Philox(key=seed, counter=[split + 1, 0, 0, 0]))
+    # Sample stream keyed by (seed, split): templates depend only on seed
+    # (shared across splits), while train/val sample streams are independent.
+    rng = np.random.Generator(
+        np.random.Philox(np.random.SeedSequence((seed, split + 1)))
+    )
     templates = tmpl_rng.normal(0.0, 1.0, size=(num_classes, *shape)).astype(np.float32)
     # Smooth templates along spatial dims so convs have structure to find.
     for _ in range(2):
@@ -88,11 +92,15 @@ def synthetic_imagenet(n: int = 1024, image_size: int = 224, seed: int = 0,
     split = 0 if training else 1
     tmpl_rng = np.random.Generator(np.random.Philox(key=seed))
     rng = np.random.Generator(
-        np.random.Philox(key=seed, counter=[split + 1, 0, 0, 0])
+        np.random.Philox(np.random.SeedSequence((seed, split + 1)))
     )
     # Templates at full ImageNet size would be 1000*224*224*3 floats (~600MB);
     # generate low-res templates and upsample per-sample instead.
     small = 16
+    if image_size % small != 0 or image_size < small:
+        raise ValueError(
+            f"image_size must be a positive multiple of {small}, got {image_size}"
+        )
     templates = tmpl_rng.normal(0, 1, size=(num_classes, small, small, 3)).astype(
         np.float32
     )
@@ -114,7 +122,7 @@ def synthetic_lm(n: int = 2048, seq_len: int = 128, vocab_size: int = 50257,
     tmpl_rng = np.random.Generator(np.random.Philox(key=seed))
     split = 0 if training else 1
     rng = np.random.Generator(
-        np.random.Philox(key=seed, counter=[split + 1, 0, 0, 0])
+        np.random.Philox(np.random.SeedSequence((seed, split + 1)))
     )
     # Each token deterministically prefers a few successors.
     successors = tmpl_rng.integers(0, vocab_size, size=(vocab_size, 4))

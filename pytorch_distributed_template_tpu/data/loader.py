@@ -83,9 +83,10 @@ class ArrayDataLoader:
             batch_idx = idx[start:stop]
             batch_mask = mask[start:stop]
             if len(batch_idx) < self.batch_size:
-                # Pad to the static batch size by wraparound; mask the pads.
+                # Pad to the static batch size by wraparound (np.resize tiles
+                # cyclically, so even pad > n works); mask the pads.
                 pad = self.batch_size - len(batch_idx)
-                batch_idx = np.concatenate([batch_idx, idx[:pad]])
+                batch_idx = np.concatenate([batch_idx, np.resize(idx, pad)])
                 batch_mask = np.concatenate(
                     [batch_mask, np.zeros(pad, dtype=bool)]
                 )

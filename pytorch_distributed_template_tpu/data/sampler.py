@@ -23,11 +23,14 @@ import numpy as np
 
 
 def epoch_permutation(seed: int, epoch: int, n: int) -> np.ndarray:
-    """The framework's canonical per-epoch permutation: counter-based Philox
-    keyed by ``seed`` with ``epoch`` as the counter, so single-host loaders
-    and multi-host samplers produce the same global order from the same
-    ``(seed, epoch)``."""
-    rng = np.random.Generator(np.random.Philox(key=seed, counter=epoch))
+    """The framework's canonical per-epoch permutation: Philox keyed from
+    ``SeedSequence((seed, epoch))`` so (a) every host derives the same global
+    order from the same ``(seed, epoch)`` and (b) different epochs draw from
+    *independent* streams (a raw counter offset of ``epoch`` would only shift
+    the stream by one block, leaving consecutive epochs correlated)."""
+    rng = np.random.Generator(
+        np.random.Philox(np.random.SeedSequence((seed, epoch)))
+    )
     return rng.permutation(n)
 
 
