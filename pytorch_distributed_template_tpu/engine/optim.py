@@ -23,6 +23,7 @@ from __future__ import annotations
 import math
 from typing import Callable, Optional
 
+import jax.numpy as jnp
 import optax
 
 from ..config.registry import OPTIMIZERS, SCHEDULERS
@@ -89,8 +90,10 @@ def step_lr(step_size: int, gamma: float = 0.1):
 
 @SCHEDULERS.register("MultiStepLR")
 def multi_step_lr(milestones, gamma: float = 0.1):
-    ms = sorted(milestones)
-    return lambda epoch: gamma ** sum(1 for m in ms if epoch >= m)
+    # jnp arithmetic: the epoch is a traced int32 inside the jitted step
+    # (the schedule is evaluated on the optimizer's step counter in-graph).
+    ms = jnp.asarray(sorted(milestones))
+    return lambda epoch: gamma ** jnp.sum(epoch >= ms)
 
 
 @SCHEDULERS.register("ExponentialLR")
@@ -101,7 +104,7 @@ def exponential_lr(gamma: float):
 @SCHEDULERS.register("CosineAnnealingLR")
 def cosine_annealing_lr(T_max: int, eta_min_ratio: float = 0.0):
     def f(epoch):
-        cos = (1 + math.cos(math.pi * min(epoch, T_max) / T_max)) / 2
+        cos = (1 + jnp.cos(math.pi * jnp.minimum(epoch, T_max) / T_max)) / 2
         return eta_min_ratio + (1 - eta_min_ratio) * cos
 
     return f
@@ -113,11 +116,11 @@ def warmup_cosine(warmup_epochs: int, total_epochs: int,
     """TPU-idiomatic default for the big-model ladder (not in reference)."""
 
     def f(epoch):
-        if epoch < warmup_epochs:
-            return (epoch + 1) / max(warmup_epochs, 1)
+        warm = (epoch + 1) / max(warmup_epochs, 1)
         frac = (epoch - warmup_epochs) / max(total_epochs - warmup_epochs, 1)
-        cos = (1 + math.cos(math.pi * min(frac, 1.0))) / 2
-        return min_ratio + (1 - min_ratio) * cos
+        cos = (1 + jnp.cos(math.pi * jnp.clip(frac, 0.0, 1.0))) / 2
+        decayed = min_ratio + (1 - min_ratio) * cos
+        return jnp.where(epoch < warmup_epochs, warm, decayed)
 
     return f
 

@@ -169,10 +169,14 @@ class Trainer(BaseTrainer):
                  train_loader, valid_loader=None, len_epoch: Optional[int] = None,
                  mesh=None, seed: int = 0):
         super().__init__(config)
+        self.mesh = mesh if mesh is not None else mesh_from_config(config)
+        # Mesh-aware models (e.g. ring attention over the seq axis) declare a
+        # ``mesh`` field; inject the trainer's mesh when unset.
+        if getattr(model, "mesh", "absent") is None and hasattr(model, "clone"):
+            model = model.clone(mesh=self.mesh)
         self.model = model
         self.criterion = criterion
         self.metric_ftns = list(metric_ftns)
-        self.mesh = mesh if mesh is not None else mesh_from_config(config)
 
         self.train_loader = train_loader
         if len_epoch is None:

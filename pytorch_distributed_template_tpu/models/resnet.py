@@ -1,0 +1,155 @@
+"""ResNet family (He et al. 2015), TPU-native.
+
+Capability target: the reference's model zoo slot (`model/model.py` holds one
+CNN; the BASELINE.json ladder requires CIFAR ResNet-18 and ImageNet
+ResNet-50). Designed for the MXU, not translated from torchvision:
+
+- NHWC layout end-to-end (XLA:TPU's native convolution layout);
+- ``dtype`` knob for bfloat16 compute with float32 params and float32
+  BatchNorm statistics (the standard TPU mixed-precision recipe — MXU eats
+  bf16, variance stays fp32);
+- BatchNorm under ``jit`` over a sharded batch computes *global* batch
+  statistics (the batch-dim mean is a cross-device reduction XLA lowers to
+  psum) — i.e. SyncBN semantics for free, where torch DDP needs an explicit
+  ``SyncBatchNorm`` wrapper;
+- the CIFAR stem (3x3 conv, no max-pool) and ImageNet stem (7x7/2 + pool)
+  are the standard variants.
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, Sequence, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from ..config.registry import MODELS
+
+ModuleDef = Any
+
+
+class BasicBlock(nn.Module):
+    features: int
+    strides: Tuple[int, int] = (1, 1)
+    conv: ModuleDef = nn.Conv
+    norm: ModuleDef = nn.BatchNorm
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = self.conv(self.features, (3, 3), self.strides)(x)
+        y = self.norm()(y)
+        y = nn.relu(y)
+        y = self.conv(self.features, (3, 3))(y)
+        y = self.norm(scale_init=nn.initializers.zeros)(y)
+        if residual.shape != y.shape:
+            residual = self.conv(
+                self.features, (1, 1), self.strides, name="conv_proj"
+            )(residual)
+            residual = self.norm(name="norm_proj")(residual)
+        return nn.relu(residual + y)
+
+
+class BottleneckBlock(nn.Module):
+    features: int
+    strides: Tuple[int, int] = (1, 1)
+    conv: ModuleDef = nn.Conv
+    norm: ModuleDef = nn.BatchNorm
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = self.conv(self.features, (1, 1))(x)
+        y = self.norm()(y)
+        y = nn.relu(y)
+        y = self.conv(self.features, (3, 3), self.strides)(y)
+        y = self.norm()(y)
+        y = nn.relu(y)
+        y = self.conv(self.features * 4, (1, 1))(y)
+        # zero-init the last norm scale: residual branches start as identity
+        # (standard "zero-gamma" trick; improves large-batch training)
+        y = self.norm(scale_init=nn.initializers.zeros)(y)
+        if residual.shape != y.shape:
+            residual = self.conv(
+                self.features * 4, (1, 1), self.strides, name="conv_proj"
+            )(residual)
+            residual = self.norm(name="norm_proj")(residual)
+        return nn.relu(residual + y)
+
+
+class ResNet(nn.Module):
+    """Generic ResNet over NHWC inputs.
+
+    :param stage_sizes: blocks per stage, e.g. (2,2,2,2) for ResNet-18.
+    :param block_cls: BasicBlock or BottleneckBlock.
+    :param num_classes: classifier width.
+    :param cifar_stem: 3x3/1 stem without max-pool (CIFAR) vs 7x7/2 + pool.
+    :param dtype: compute dtype (bfloat16 for TPU mixed precision).
+    """
+    stage_sizes: Sequence[int]
+    block_cls: Callable
+    num_classes: int = 1000
+    num_filters: int = 64
+    cifar_stem: bool = False
+    dtype: Any = jnp.float32
+    input_shape: Tuple[int, int, int] = (224, 224, 3)
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        conv = partial(nn.Conv, use_bias=False, dtype=self.dtype)
+        norm = partial(
+            nn.BatchNorm, use_running_average=not train, momentum=0.9,
+            epsilon=1e-5, dtype=self.dtype, param_dtype=jnp.float32,
+        )
+        x = x.astype(self.dtype)
+        if self.cifar_stem:
+            x = conv(self.num_filters, (3, 3), name="conv_init")(x)
+        else:
+            x = conv(self.num_filters, (7, 7), (2, 2), name="conv_init")(x)
+        x = norm(name="bn_init")(x)
+        x = nn.relu(x)
+        if not self.cifar_stem:
+            x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        for i, block_count in enumerate(self.stage_sizes):
+            for j in range(block_count):
+                strides = (2, 2) if i > 0 and j == 0 else (1, 1)
+                x = self.block_cls(
+                    self.num_filters * 2 ** i, strides=strides,
+                    conv=conv, norm=norm,
+                )(x)
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dense(self.num_classes, dtype=jnp.float32, name="head")(x)
+        return nn.log_softmax(x.astype(jnp.float32), axis=-1)
+
+    def batch_template(self, batch_size: int = 1):
+        return jnp.zeros((batch_size, *self.input_shape), jnp.float32)
+
+
+def _register(name, stage_sizes, block_cls, **defaults):
+    @MODELS.register(name)
+    def factory(num_classes: int = defaults.pop("num_classes", 1000),
+                cifar_stem: bool = defaults.get("cifar_stem", False),
+                bfloat16: bool = False,
+                input_shape=None,
+                _stage_sizes=stage_sizes, _block=block_cls,
+                _defaults=dict(defaults)):
+        shape = tuple(input_shape) if input_shape else (
+            (32, 32, 3) if cifar_stem else (224, 224, 3)
+        )
+        return ResNet(
+            stage_sizes=_stage_sizes,
+            block_cls=_block,
+            num_classes=num_classes,
+            cifar_stem=cifar_stem,
+            dtype=jnp.bfloat16 if bfloat16 else jnp.float32,
+            input_shape=shape,
+        )
+    factory.__name__ = name
+    return factory
+
+
+ResNet18 = _register("ResNet18", (2, 2, 2, 2), BasicBlock)
+ResNet34 = _register("ResNet34", (3, 4, 6, 3), BasicBlock)
+ResNet50 = _register("ResNet50", (3, 4, 6, 3), BottleneckBlock)
+ResNet101 = _register("ResNet101", (3, 4, 23, 3), BottleneckBlock)
+ResNet152 = _register("ResNet152", (3, 8, 36, 3), BottleneckBlock)

@@ -1,0 +1,224 @@
+"""GPT-2-family causal transformer LM, TPU-native.
+
+The reference has no transformer (model zoo = one CNN, SURVEY.md §2.3); the
+BASELINE.json ladder requires GPT-2-small as the large-param gradient-
+reduction stress config. Designed for TPU:
+
+- megatron-style **tensor parallelism** expressed purely as partition rules
+  (``partition_rules()``): column-parallel QKV/up-projection, row-parallel
+  output/down-projection, vocab-sharded embedding. XLA inserts the two
+  per-block all-reduces from the shardings — no hand-written collectives;
+- **sequence parallelism** for long context: ``attn_impl='ring'`` routes
+  attention through ``ops.ring_attention`` (shard_map + ppermute over the
+  ``seq`` mesh axis) so the T×T score matrix never materializes;
+- ``remat='block'`` wraps each block in ``jax.checkpoint`` (rematerialize
+  activations in backward — HBM for FLOPs, the TPU long-seq default);
+- bf16 compute / fp32 params + fp32 softmax and layernorm accumulation;
+- weight-tied LM head (embedding transpose), GPT-2 initialization scheme
+  (normal(0.02), residual projections scaled by 1/sqrt(2L)).
+"""
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..config.registry import MODELS
+from ..ops.attention import multihead_attention, ring_attention
+
+
+def _dense_init(stddev):
+    return nn.initializers.normal(stddev=stddev)
+
+
+class MlpBlock(nn.Module):
+    d_model: int
+    d_ff: int
+    dropout: float
+    n_layer: int
+    dtype: Any
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        y = nn.Dense(self.d_ff, dtype=self.dtype,
+                     kernel_init=_dense_init(0.02), name="up")(x)
+        y = nn.gelu(y)
+        y = nn.Dense(self.d_model, dtype=self.dtype,
+                     kernel_init=_dense_init(0.02 / (2 * self.n_layer) ** 0.5),
+                     name="down")(y)
+        return nn.Dropout(self.dropout, deterministic=not train)(y)
+
+
+class SelfAttention(nn.Module):
+    d_model: int
+    n_head: int
+    dropout: float
+    n_layer: int
+    dtype: Any
+    attn_impl: str = "xla"          # 'xla' | 'ring' | 'flash'
+    mesh: Optional[Any] = None      # required for 'ring'
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        b, t, _ = x.shape
+        head_dim = self.d_model // self.n_head
+        qkv = nn.Dense(3 * self.d_model, dtype=self.dtype,
+                       kernel_init=_dense_init(0.02), name="qkv")(x)
+        qkv = qkv.reshape(b, t, 3, self.n_head, head_dim)
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        if self.attn_impl == "ring":
+            if self.mesh is None:
+                raise ValueError("attn_impl='ring' requires a mesh")
+            ctx = ring_attention(q, k, v, self.mesh, causal=True)
+        elif self.attn_impl == "flash":
+            from ..ops.flash import flash_attention
+            ctx = flash_attention(q, k, v, causal=True)
+        else:
+            ctx = multihead_attention(q, k, v, causal=True)
+        ctx = ctx.reshape(b, t, self.d_model)
+        out = nn.Dense(self.d_model, dtype=self.dtype,
+                       kernel_init=_dense_init(0.02 / (2 * self.n_layer) ** 0.5),
+                       name="out")(ctx)
+        return nn.Dropout(self.dropout, deterministic=not train)(out)
+
+
+class Block(nn.Module):
+    d_model: int
+    n_head: int
+    d_ff: int
+    dropout: float
+    n_layer: int
+    dtype: Any
+    attn_impl: str
+    mesh: Optional[Any]
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        h = nn.LayerNorm(dtype=jnp.float32, name="ln_1")(x)
+        x = x + SelfAttention(
+            self.d_model, self.n_head, self.dropout, self.n_layer,
+            self.dtype, self.attn_impl, self.mesh, name="attn",
+        )(h, train)
+        h = nn.LayerNorm(dtype=jnp.float32, name="ln_2")(x)
+        x = x + MlpBlock(
+            self.d_model, self.d_ff, self.dropout, self.n_layer,
+            self.dtype, name="mlp",
+        )(h, train)
+        return x
+
+
+class TransformerLM(nn.Module):
+    """Decoder-only causal LM (GPT-2 shape family)."""
+    vocab_size: int = 50257
+    n_layer: int = 12
+    n_head: int = 12
+    d_model: int = 768
+    d_ff: int = 0                   # 0 -> 4*d_model
+    max_len: int = 1024
+    dropout: float = 0.1
+    dtype: Any = jnp.float32
+    attn_impl: str = "xla"
+    mesh: Optional[Any] = None
+    remat: bool = False
+    tie_embeddings: bool = True
+
+    @nn.compact
+    def __call__(self, tokens, train: bool = False):
+        d_ff = self.d_ff or 4 * self.d_model
+        b, t = tokens.shape
+        embed = nn.Embed(
+            self.vocab_size, self.d_model,
+            embedding_init=_dense_init(0.02), name="wte",
+            dtype=self.dtype,
+        )
+        pos_embed = self.param(
+            "wpe", _dense_init(0.01), (self.max_len, self.d_model),
+            jnp.float32,
+        )
+        x = embed(tokens) + pos_embed[None, :t].astype(self.dtype)
+        x = nn.Dropout(self.dropout, deterministic=not train)(x)
+
+        block_cls = Block
+        if self.remat:
+            block_cls = nn.remat(
+                Block, static_argnums=(2,),
+                policy=jax.checkpoint_policies.nothing_saveable,
+            )
+        for i in range(self.n_layer):
+            x = block_cls(
+                self.d_model, self.n_head, d_ff, self.dropout,
+                self.n_layer, self.dtype, self.attn_impl, self.mesh,
+                name=f"h_{i}",
+            )(x, train)
+        x = nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x)
+        if self.tie_embeddings:
+            logits = embed.attend(x.astype(self.dtype))
+        else:
+            logits = nn.Dense(self.vocab_size, use_bias=False,
+                              dtype=self.dtype,
+                              kernel_init=_dense_init(0.02),
+                              name="lm_head")(x)
+        return logits.astype(jnp.float32)
+
+    def batch_template(self, batch_size: int = 1):
+        return jnp.zeros((batch_size, min(self.max_len, 16)), jnp.int32)
+
+    def partition_rules(self):
+        """Megatron-style TP rules over the ``tensor`` mesh axis.
+
+        Columns (output features) of QKV/up are sharded; rows (input
+        features) of out/down are sharded — one all-reduce after attention
+        and one after the MLP, inserted by XLA from these specs. The
+        embedding shards over vocab. Rules are no-ops on meshes without a
+        ``tensor`` axis (sharding.apply_rules prunes absent axes).
+        """
+        return [
+            (r"wte/embedding", P("tensor", None)),
+            (r"attn/qkv/kernel", P(None, "tensor")),
+            (r"attn/qkv/bias", P("tensor")),
+            (r"attn/out/kernel", P("tensor", None)),
+            (r"mlp/up/kernel", P(None, "tensor")),
+            (r"mlp/up/bias", P("tensor")),
+            (r"mlp/down/kernel", P("tensor", None)),
+            (r"lm_head/kernel", P(None, "tensor")),
+            (r"wpe", P()),
+        ]
+
+
+_GPT2_SIZES = {
+    "gpt2-small": dict(n_layer=12, n_head=12, d_model=768),
+    "gpt2-medium": dict(n_layer=24, n_head=16, d_model=1024),
+    "gpt2-large": dict(n_layer=36, n_head=20, d_model=1280),
+    "gpt2-xl": dict(n_layer=48, n_head=25, d_model=1600),
+}
+
+
+@MODELS.register("GPT2")
+def gpt2(size: str = "gpt2-small", vocab_size: int = 50257,
+         max_len: int = 1024, dropout: float = 0.1, bfloat16: bool = False,
+         attn_impl: str = "xla", remat: bool = False, mesh=None,
+         **overrides):
+    cfg = dict(_GPT2_SIZES[size])
+    cfg.update(overrides)
+    return TransformerLM(
+        vocab_size=vocab_size, max_len=max_len, dropout=dropout,
+        dtype=jnp.bfloat16 if bfloat16 else jnp.float32,
+        attn_impl=attn_impl, remat=remat, mesh=mesh, **cfg,
+    )
+
+
+@MODELS.register("TinyLM")
+def tiny_lm(vocab_size: int = 256, n_layer: int = 2, n_head: int = 4,
+            d_model: int = 64, max_len: int = 128, dropout: float = 0.0,
+            attn_impl: str = "xla", remat: bool = False, mesh=None,
+            bfloat16: bool = False):
+    """Small config for tests and the multi-chip dry run."""
+    return TransformerLM(
+        vocab_size=vocab_size, n_layer=n_layer, n_head=n_head,
+        d_model=d_model, max_len=max_len, dropout=dropout,
+        dtype=jnp.bfloat16 if bfloat16 else jnp.float32,
+        attn_impl=attn_impl, remat=remat, mesh=mesh,
+    )

@@ -1,0 +1,5 @@
+"""Hot-path ops: attention implementations (XLA, ring/SP, Pallas flash)."""
+from .attention import multihead_attention, ring_attention
+from .flash import flash_attention
+
+__all__ = ["multihead_attention", "ring_attention", "flash_attention"]

@@ -1,0 +1,133 @@
+"""Attention implementations.
+
+The reference has no attention anywhere (its model zoo is one MNIST CNN,
+SURVEY.md §2.3) — but the BASELINE.json ladder (ViT, GPT-2) and the
+long-context mandate require it, so attention is a first-class op family
+here with three interchangeable implementations:
+
+- ``multihead_attention``: plain XLA einsum-softmax-einsum. XLA:TPU fuses
+  the mask+softmax chain; fine up to moderate T.
+- ``ring_attention``: sequence/context parallelism over a ``seq`` mesh axis
+  via ``shard_map`` + ``lax.ppermute`` — each device holds a T/s slice of
+  Q/K/V and K/V blocks rotate around the ring while partial attention
+  accumulates with an online (flash-style) softmax. Memory per chip is
+  O(T/s · d) instead of O(T · d) and the T×T score matrix never
+  materializes globally. KV transfers ride ICI concurrently with the local
+  block's compute (XLA's latency-hiding scheduler overlaps the ppermute).
+- ``flash_attention`` (ops/flash.py): fused Pallas TPU kernel for the
+  single-device block-streaming case.
+
+All take/return ``[B, T, H, D]`` ("BTHD") and accumulate in float32
+regardless of input dtype (bf16-safe).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+NEG_INF = -1e30
+
+
+def multihead_attention(q, k, v, causal: bool = True,
+                        mask: Optional[jax.Array] = None):
+    """Reference XLA attention. q,k,v: [B, T, H, D] -> [B, T, H, D]."""
+    dtype = q.dtype
+    depth = q.shape[-1]
+    q = q.astype(jnp.float32) * (depth ** -0.5)
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k.astype(jnp.float32))
+    if causal:
+        tq, tk = scores.shape[-2], scores.shape[-1]
+        cm = jnp.tril(jnp.ones((tq, tk), bool))
+        scores = jnp.where(cm[None, None], scores, NEG_INF)
+    if mask is not None:
+        scores = jnp.where(mask, scores, NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", probs, v.astype(jnp.float32))
+    return out.astype(dtype)
+
+
+def _ring_attention_local(q, k, v, *, axis_name: str, axis_size: int,
+                          causal: bool, vary_axes: tuple = ()):
+    """Per-shard ring attention body (runs inside shard_map).
+
+    q,k,v: local [B, Tl, H, D] slices of the global [B, T, H, D] arrays,
+    sharded along T over ``axis_name``. Rotates K/V blocks around the ring
+    with an online-softmax accumulator: after ``axis_size`` steps every query
+    has attended to every (visible) key.
+    """
+    dtype = q.dtype
+    b, tl, h, d = q.shape
+    my = lax.axis_index(axis_name)
+    qf = q.astype(jnp.float32) * (d ** -0.5)
+    q_pos = my * tl + jnp.arange(tl)  # global query positions
+
+    perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+
+    def step(carry, t):
+        kb, vb, m, l, o = carry
+        src = (my - t) % axis_size  # origin shard of the current K/V block
+        k_pos = src * tl + jnp.arange(tl)
+        scores = jnp.einsum("bqhd,bkhd->bhqk", qf, kb.astype(jnp.float32))
+        if causal:
+            visible = q_pos[:, None] >= k_pos[None, :]  # [Tl_q, Tl_k]
+            scores = jnp.where(visible[None, None], scores, NEG_INF)
+        blk_max = jnp.max(scores, axis=-1)            # [B, H, Tq]
+        m_new = jnp.maximum(m, blk_max)
+        p = jnp.exp(scores - m_new[..., None])        # [B, H, Tq, Tk]
+        scale = jnp.exp(m - m_new)                    # [B, H, Tq]
+        l_new = l * scale + jnp.sum(p, axis=-1)
+        o_new = o * scale[..., None] + jnp.einsum(
+            "bhqk,bkhd->bhqd", p, vb.astype(jnp.float32)
+        )
+        kb = lax.ppermute(kb, axis_name, perm)
+        vb = lax.ppermute(vb, axis_name, perm)
+        return (kb, vb, m_new, l_new, o_new), None
+
+    m0 = jnp.full((b, h, tl), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, h, tl), jnp.float32)
+    o0 = jnp.zeros((b, h, tl, d), jnp.float32)
+    # The accumulators depend on device-varying data from step 1 on; mark
+    # them varying over the sharded mesh axes up front so the scan carry
+    # type is stable (JAX's varying-manual-axes check under shard_map).
+    if vary_axes:
+        vary = lambda x: lax.pcast(x, vary_axes, to="varying")
+        m0, l0, o0 = vary(m0), vary(l0), vary(o0)
+    (kb, vb, m, l, o), _ = lax.scan(
+        step, (k, v, m0, l0, o0), jnp.arange(axis_size)
+    )
+    out = o / jnp.maximum(l, 1e-30)[..., None]        # [B, H, Tq, D]
+    return jnp.transpose(out, (0, 2, 1, 3)).astype(dtype)
+
+
+def ring_attention(q, k, v, mesh: Mesh, causal: bool = True,
+                   seq_axis: str = "seq", data_axes=("data", "fsdp"),
+                   head_axis: str = "tensor"):
+    """Sequence-parallel attention over the mesh's ``seq`` axis.
+
+    q,k,v are global ``[B, T, H, D]`` arrays (T sharded over ``seq``); the
+    TxT score matrix never exists — only [Tl x Tl] blocks per device per
+    ring step. Composes with DP (batch over data axes) and TP (heads over
+    ``tensor``) in one shard_map.
+    """
+    if seq_axis not in mesh.axis_names or mesh.shape[seq_axis] == 1:
+        return multihead_attention(q, k, v, causal=causal)
+    axis_size = mesh.shape[seq_axis]
+
+    dp = tuple(a for a in data_axes if a in mesh.axis_names)
+    hp = head_axis if head_axis in mesh.axis_names else None
+    spec = P(dp if dp else None, seq_axis, hp, None)
+
+    vary_axes = tuple(dp) + (seq_axis,) + ((hp,) if hp else ())
+    fn = functools.partial(
+        _ring_attention_local, axis_name=seq_axis, axis_size=axis_size,
+        causal=causal, vary_axes=vary_axes,
+    )
+    return shard_map(
+        fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+    )(q, k, v)

@@ -1,0 +1,208 @@
+"""Fused flash attention: Pallas TPU forward kernel + blockwise backward.
+
+The reference delegates all kernels to cuDNN (SURVEY.md §2.2); here the one
+op XLA doesn't fuse perfectly at long sequence length — attention — gets an
+in-tree Pallas kernel (see /opt/skills/guides/pallas_guide.md):
+
+- **forward**: one grid program per (batch*head, q-block); K/V live in VMEM
+  and are consumed in BK-sized blocks with the online-softmax recurrence, so
+  the T×T score matrix never leaves VMEM (only a [BQ, BK] tile exists at a
+  time). Causal programs skip KV blocks beyond the diagonal entirely —
+  ~2× fewer FLOPs, not just masking. Outputs carry the logsumexp rows.
+- **backward**: flash-style blockwise recomputation (scan over KV blocks)
+  in plain JAX using the saved logsumexp — O(T·BK) memory, XLA-fused; a
+  Pallas backward kernel is a later optimization, the math and memory
+  behavior are already right.
+
+Accumulation is float32 throughout regardless of input dtype.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+
+NEG_INF = -1e30
+DEFAULT_BLOCK_Q = 128
+DEFAULT_BLOCK_K = 128
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale: float,
+                causal: bool, block_k: int):
+    # q_ref: [1, BQ, D]; k_ref/v_ref: [1, T, D]; o_ref: [1, BQ, D];
+    # lse_ref: [1, BQ]
+    qi = pl.program_id(1)
+    block_q = q_ref.shape[1]
+    t_kv = k_ref.shape[1]
+    d = q_ref.shape[2]
+
+    q = q_ref[0].astype(jnp.float32) * scale          # [BQ, D]
+    m0 = jnp.full((block_q,), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q,), jnp.float32)
+    acc0 = jnp.zeros((block_q, d), jnp.float32)
+
+    num_kv = t_kv // block_k
+    if causal:
+        # KV blocks strictly beyond this q block's last row are invisible.
+        num_kv = jnp.minimum(
+            num_kv, ((qi + 1) * block_q + block_k - 1) // block_k
+        )
+
+    q_pos = qi * block_q + lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+
+    def body(j, carry):
+        m, l, acc = carry
+        k_blk = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )                                              # [BQ, BK]
+        if causal:
+            k_pos = j * block_k + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=1))
+        p = jnp.exp(s - m_new[:, None])
+        corr = jnp.exp(m - m_new)
+        l_new = l * corr + jnp.sum(p, axis=1)
+        acc_new = acc * corr[:, None] + jax.lax.dot_general(
+            p, v_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return m_new, l_new, acc_new
+
+    m, l, acc = lax.fori_loop(0, num_kv, body, (m0, l0, acc0))
+    l_safe = jnp.maximum(l, 1e-30)
+    o_ref[0] = (acc / l_safe[:, None]).astype(o_ref.dtype)
+    lse_ref[0] = m + jnp.log(l_safe)
+
+
+def _flash_fwd_3d(q, k, v, *, causal: bool, block_q: int, block_k: int,
+                  interpret: bool):
+    """q,k,v: [BH, T, D] -> (out [BH, T, D], lse [BH, T])."""
+    bh, t, d = q.shape
+    scale = d ** -0.5
+    block_q = min(block_q, t)
+    block_k = min(block_k, t)
+    if t % block_q or t % block_k:
+        raise ValueError(
+            f"sequence length {t} must be divisible by block sizes "
+            f"({block_q}, {block_k}); pad the sequence"
+        )
+    grid = (bh, t // block_q)
+    kernel = functools.partial(
+        _fwd_kernel, scale=scale, causal=causal, block_k=block_k
+    )
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, t, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, t, d), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q), lambda b, i: (b, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, t, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, t), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v)
+    return out, lse
+
+
+def _bwd_3d(causal, block_k, residuals, g):
+    """Blockwise flash backward over KV blocks (plain JAX, O(T*BK) memory)."""
+    q, k, v, out, lse = residuals
+    bh, t, d = q.shape
+    scale = d ** -0.5
+    block_k = min(block_k, t)
+    num_kv = t // block_k
+
+    qf = q.astype(jnp.float32)
+    g = g.astype(jnp.float32)
+    out = out.astype(jnp.float32)
+    delta = jnp.sum(g * out, axis=-1)                 # [BH, T]
+    q_pos = jnp.arange(t)
+
+    def per_block(j):
+        sl = lambda x: lax.dynamic_slice_in_dim(x, j * block_k, block_k, 1)
+        k_blk = sl(k).astype(jnp.float32)             # [BH, BK, D]
+        v_blk = sl(v).astype(jnp.float32)
+        s = jnp.einsum("bqd,bkd->bqk", qf, k_blk) * scale
+        if causal:
+            k_pos = j * block_k + jnp.arange(block_k)
+            s = jnp.where((q_pos[:, None] >= k_pos[None, :])[None], s, NEG_INF)
+        p = jnp.exp(s - lse[..., None])               # [BH, T, BK]
+        dv = jnp.einsum("bqk,bqd->bkd", p, g)
+        dp = jnp.einsum("bqd,bkd->bqk", g, v_blk)
+        ds = p * (dp - delta[..., None]) * scale
+        dq_j = jnp.einsum("bqk,bkd->bqd", ds, k_blk)
+        dk = jnp.einsum("bqk,bqd->bkd", ds, qf)
+        return dq_j, dk, dv
+
+    def body(dq, j):
+        dq_j, dk_j, dv_j = per_block(j)
+        return dq + dq_j, (dk_j, dv_j)
+
+    dq, (dk_blocks, dv_blocks) = lax.scan(
+        body, jnp.zeros_like(qf), jnp.arange(num_kv)
+    )
+    dk = jnp.moveaxis(dk_blocks, 0, 1).reshape(bh, t, d)
+    dv = jnp.moveaxis(dv_blocks, 0, 1).reshape(bh, t, d)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash_3d(q, k, v, causal, block_q, block_k, interpret):
+    out, _ = _flash_fwd_3d(q, k, v, causal=causal, block_q=block_q,
+                           block_k=block_k, interpret=interpret)
+    return out
+
+
+def _flash_3d_fwd(q, k, v, causal, block_q, block_k, interpret):
+    out, lse = _flash_fwd_3d(q, k, v, causal=causal, block_q=block_q,
+                             block_k=block_k, interpret=interpret)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_3d_bwd(causal, block_q, block_k, interpret, residuals, g):
+    del block_q, interpret
+    return _bwd_3d(causal, block_k, residuals, g)
+
+
+_flash_3d.defvjp(_flash_3d_fwd, _flash_3d_bwd)
+
+
+def _on_tpu() -> bool:
+    try:
+        return jax.devices()[0].platform == "tpu"
+    except Exception:
+        return False
+
+
+def flash_attention(q, k, v, causal: bool = True,
+                    block_q: int = DEFAULT_BLOCK_Q,
+                    block_k: int = DEFAULT_BLOCK_K,
+                    interpret: bool | None = None):
+    """Fused attention. q,k,v: [B, T, H, D] -> [B, T, H, D].
+
+    ``interpret=None`` auto-selects: compiled on TPU, interpreter elsewhere
+    (CPU tests). Sequence length must divide by the block sizes (clamped to
+    T for short sequences).
+    """
+    if interpret is None:
+        interpret = not _on_tpu()
+    b, t, h, d = q.shape
+    fold = lambda x: jnp.moveaxis(x, 2, 1).reshape(b * h, t, d)
+    out = _flash_3d(fold(q), fold(k), fold(v), causal, block_q, block_k,
+                    interpret)
+    return jnp.moveaxis(out.reshape(b, h, t, d), 1, 2)

@@ -1,0 +1,100 @@
+"""Model-zoo tests: shapes, batch_stats plumbing, learnability, registries.
+
+The reference has no tests at all (SURVEY.md §4); these cover the expanded
+model zoo the BASELINE.json ladder requires (ResNet / ViT / GPT-2) on the
+8-device CPU mesh.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from pytorch_distributed_template_tpu.config.registry import (
+    LOSSES, METRICS, MODELS,
+)
+import pytorch_distributed_template_tpu.engine  # noqa: F401  (registers)
+import pytorch_distributed_template_tpu.models  # noqa: F401
+from pytorch_distributed_template_tpu.engine.state import create_train_state
+from pytorch_distributed_template_tpu.engine.steps import make_train_step
+from pytorch_distributed_template_tpu.parallel.mesh import build_mesh
+from pytorch_distributed_template_tpu.parallel.sharding import (
+    apply_rules, batch_sharding,
+)
+
+
+def _image_batch(rng, n, shape, num_classes):
+    return {
+        "image": rng.normal(size=(n, *shape)).astype(np.float32),
+        "label": rng.integers(0, num_classes, size=n).astype(np.int32),
+        "mask": np.ones(n, bool),
+    }
+
+
+class TestResNet:
+    def test_forward_shapes_cifar(self):
+        model = MODELS.get("ResNet18")(num_classes=10, cifar_stem=True)
+        state = create_train_state(
+            model, optax.sgd(0.1), model.batch_template(2), seed=0
+        )
+        out = model.apply(
+            {"params": state.params, "batch_stats": state.batch_stats},
+            jnp.zeros((2, 32, 32, 3)), train=False,
+        )
+        assert out.shape == (2, 10)
+        assert state.batch_stats  # BatchNorm state exists
+        # log-probabilities: each row sums to ~1 in prob space
+        assert np.allclose(np.exp(np.asarray(out)).sum(-1), 1.0, atol=1e-4)
+
+    def test_resnet50_param_count(self):
+        """ResNet-50/ImageNet has the canonical ~25.5M params."""
+        from pytorch_distributed_template_tpu.models.base import param_count
+
+        model = MODELS.get("ResNet50")(num_classes=1000)
+        state = create_train_state(
+            model, optax.sgd(0.1), model.batch_template(1), seed=0
+        )
+        n = param_count(state.params)
+        assert 25.0e6 < n < 26.0e6, n
+
+    def test_bfloat16_compute_fp32_params(self):
+        model = MODELS.get("ResNet18")(
+            num_classes=10, cifar_stem=True, bfloat16=True
+        )
+        state = create_train_state(
+            model, optax.sgd(0.1), model.batch_template(2), seed=0
+        )
+        leaves = jax.tree_util.tree_leaves(state.params)
+        assert all(l.dtype == jnp.float32 for l in leaves)
+        out = model.apply(
+            {"params": state.params, "batch_stats": state.batch_stats},
+            jnp.zeros((2, 32, 32, 3)), train=False,
+        )
+        assert out.dtype == jnp.float32  # head upcasts
+
+    def test_trains_and_updates_batch_stats(self):
+        mesh = build_mesh({"data": -1})
+        model = MODELS.get("ResNet18")(num_classes=10, cifar_stem=True)
+        tx = optax.sgd(0.1, momentum=0.9)
+        state = create_train_state(model, tx, model.batch_template(1), seed=0)
+        state = jax.device_put(state, apply_rules(state, mesh, []))
+        step = jax.jit(
+            make_train_step(model, tx, LOSSES.get("nll_loss"),
+                            [METRICS.get("accuracy")]),
+            donate_argnums=0,
+        )
+        rng = np.random.default_rng(0)
+        bs = batch_sharding(mesh)
+        stats_before = jax.tree_util.tree_leaves(state.batch_stats)[0].copy()
+        losses = []
+        for i in range(8):
+            batch = {
+                k: jax.device_put(v, bs)
+                for k, v in _image_batch(rng, 32, (32, 32, 3), 10).items()
+            }
+            state, m = step(state, batch)
+            losses.append(float(m["loss_sum"]) / float(m["count"]))
+        stats_after = jax.tree_util.tree_leaves(state.batch_stats)[0]
+        assert not np.allclose(stats_before, stats_after)
+        assert int(state.step) == 8
+        assert all(np.isfinite(l) for l in losses)
