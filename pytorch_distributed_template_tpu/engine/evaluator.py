@@ -14,11 +14,31 @@ import jax.numpy as jnp
 
 from ..config.registry import LOADERS, LOSSES, METRICS, MODELS
 from ..data.loader import prefetch_to_device
+from ..models.base import inject_mesh
 from ..parallel import batch_sharding, dist, mesh_from_config
 from ..parallel.sharding import apply_rules
 from .optim import build_optimizer
 from .state import create_train_state
 from .steps import finalize_metrics, make_eval_step
+
+
+def _build_test_loader(config):
+    """Resolve the eval loader like the reference does: an explicit
+    ``test_loader`` block wins; otherwise reuse the experiment's loader
+    config with ``training=False`` (reference test.py:43-52 rebuilds the
+    training config's loader in eval mode), preferring ``valid_loader``."""
+    if config.get("test_loader", None):
+        return config.init_obj("test_loader", LOADERS)
+    for block in ("valid_loader", "train_loader"):
+        spec = config.get(block, None)
+        if spec:
+            args = dict(spec.get("args", {}))
+            args["training"] = False
+            args.setdefault("shuffle", False)
+            return LOADERS.get(spec["type"])(**args)
+    raise KeyError(
+        "config defines none of test_loader/valid_loader/train_loader"
+    )
 
 
 def evaluate(config, mesh=None) -> dict:
@@ -29,8 +49,9 @@ def evaluate(config, mesh=None) -> dict:
     model = config.init_obj("arch", MODELS)
     criterion = LOSSES.get(config["loss"])
     metric_fns = [METRICS.get(m) for m in config["metrics"]]
-    test_loader = config.init_obj("test_loader", LOADERS)
+    test_loader = _build_test_loader(config)
     mesh = mesh if mesh is not None else mesh_from_config(config)
+    model = inject_mesh(model, mesh)
 
     dk = config.get("data_keys", {}) or {}
     input_key = dk.get("input", "image")

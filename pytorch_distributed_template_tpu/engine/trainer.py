@@ -32,7 +32,7 @@ import numpy as np
 from ..checkpoint import CheckpointManager
 from ..config.registry import LOSSES, METRICS
 from ..data.loader import prefetch_to_device
-from ..models.base import describe
+from ..models.base import describe, inject_mesh
 from ..observability import MetricTracker, TensorboardWriter
 from ..parallel import batch_sharding, dist, mesh_from_config
 from ..parallel.sharding import apply_rules
@@ -170,10 +170,7 @@ class Trainer(BaseTrainer):
                  mesh=None, seed: int = 0):
         super().__init__(config)
         self.mesh = mesh if mesh is not None else mesh_from_config(config)
-        # Mesh-aware models (e.g. ring attention over the seq axis) declare a
-        # ``mesh`` field; inject the trainer's mesh when unset.
-        if getattr(model, "mesh", "absent") is None and hasattr(model, "clone"):
-            model = model.clone(mesh=self.mesh)
+        model = inject_mesh(model, self.mesh)
         self.model = model
         self.criterion = criterion
         self.metric_ftns = list(metric_ftns)

@@ -2,3 +2,4 @@ from .base import describe, param_count
 from .lenet import LeNet
 from .resnet import ResNet, ResNet18, ResNet34, ResNet50, ResNet101, ResNet152
 from .transformer import TransformerLM, gpt2, tiny_lm
+from .vit import ViT, vit

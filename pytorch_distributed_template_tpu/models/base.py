@@ -17,6 +17,15 @@ def param_count(params) -> int:
     )
 
 
+def inject_mesh(model, mesh):
+    """Give mesh-aware models (declared ``mesh: Optional[Any] = None`` field,
+    e.g. ring attention over the ``seq`` axis) the runtime mesh when unset.
+    No-op for models without a mesh field."""
+    if getattr(model, "mesh", "absent") is None and hasattr(model, "clone"):
+        return model.clone(mesh=mesh)
+    return model
+
+
 def describe(model, params) -> str:
     """Model summary string; reference ``BaseModel.__str__``
     (base/base_model.py:21-25)."""

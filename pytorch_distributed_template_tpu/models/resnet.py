@@ -125,14 +125,13 @@ class ResNet(nn.Module):
         return jnp.zeros((batch_size, *self.input_shape), jnp.float32)
 
 
-def _register(name, stage_sizes, block_cls, **defaults):
+def _register(name, stage_sizes, block_cls):
     @MODELS.register(name)
-    def factory(num_classes: int = defaults.pop("num_classes", 1000),
-                cifar_stem: bool = defaults.get("cifar_stem", False),
+    def factory(num_classes: int = 1000,
+                cifar_stem: bool = False,
                 bfloat16: bool = False,
                 input_shape=None,
-                _stage_sizes=stage_sizes, _block=block_cls,
-                _defaults=dict(defaults)):
+                _stage_sizes=stage_sizes, _block=block_cls):
         shape = tuple(input_shape) if input_shape else (
             (32, 32, 3) if cifar_stem else (224, 224, 3)
         )

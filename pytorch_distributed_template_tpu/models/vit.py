@@ -1,0 +1,175 @@
+"""Vision Transformer (ViT) family, TPU-native.
+
+The reference has no transformer (model zoo = one CNN,
+/root/reference/model/model.py:6-22; SURVEY.md §2.3), but the BASELINE.json
+config ladder requires ViT-B/16 bf16 as the MXU-saturation rung between
+ResNet-50 and GPT-2. TPU-first design choices:
+
+- patch embedding as a strided conv -> one big [B, N, D] batch of tokens:
+  all FLOPs land in large batched matmuls on the MXU;
+- pre-LN encoder blocks sharing the attention op family in ``ops.attention``
+  (XLA fused softmax attention by default; ``attn_impl='flash'`` routes to
+  the Pallas kernel);
+- bf16 compute / fp32 params, fp32 LayerNorm accumulation — same policy as
+  ``TransformerLM``;
+- megatron-style TP partition rules over the ``tensor`` mesh axis (column-
+  parallel QKV/up, row-parallel out/down) so ViT scales the same way the
+  LM does;
+- ``remat=True`` wraps each encoder block in ``jax.checkpoint`` to trade
+  FLOPs for HBM on long token sequences (384px+ inputs).
+"""
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..config.registry import MODELS
+from ..ops.attention import multihead_attention
+
+
+def _init(stddev=0.02):
+    return nn.initializers.normal(stddev=stddev)
+
+
+class EncoderBlock(nn.Module):
+    d_model: int
+    n_head: int
+    d_ff: int
+    dropout: float
+    dtype: Any
+    attn_impl: str = "xla"
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        b, n, _ = x.shape
+        head_dim = self.d_model // self.n_head
+
+        h = nn.LayerNorm(dtype=jnp.float32, name="ln_1")(x)
+        qkv = nn.Dense(3 * self.d_model, dtype=self.dtype,
+                       kernel_init=_init(), name="qkv")(h)
+        qkv = qkv.reshape(b, n, 3, self.n_head, head_dim)
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        if self.attn_impl == "flash":
+            from ..ops.flash import flash_attention
+            ctx = flash_attention(q, k, v, causal=False)
+        else:
+            ctx = multihead_attention(q, k, v, causal=False)
+        ctx = ctx.reshape(b, n, self.d_model)
+        ctx = nn.Dense(self.d_model, dtype=self.dtype, kernel_init=_init(),
+                       name="out")(ctx)
+        x = x + nn.Dropout(self.dropout, deterministic=not train)(ctx)
+
+        h = nn.LayerNorm(dtype=jnp.float32, name="ln_2")(x)
+        y = nn.Dense(self.d_ff, dtype=self.dtype, kernel_init=_init(),
+                     name="up")(h)
+        y = nn.gelu(y)
+        y = nn.Dense(self.d_model, dtype=self.dtype, kernel_init=_init(),
+                     name="down")(y)
+        return x + nn.Dropout(self.dropout, deterministic=not train)(y)
+
+
+class ViT(nn.Module):
+    """ViT classifier: patchify -> encoder stack -> cls-token head."""
+    num_classes: int = 1000
+    image_size: int = 224
+    channels: int = 3
+    patch_size: int = 16
+    n_layer: int = 12
+    n_head: int = 12
+    d_model: int = 768
+    d_ff: int = 0                   # 0 -> 4*d_model
+    dropout: float = 0.0
+    dtype: Any = jnp.float32
+    attn_impl: str = "xla"
+    remat: bool = False
+    pool: str = "cls"               # 'cls' | 'mean'
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        d_ff = self.d_ff or 4 * self.d_model
+        b = x.shape[0]
+        x = nn.Conv(
+            self.d_model, (self.patch_size, self.patch_size),
+            strides=(self.patch_size, self.patch_size), padding="VALID",
+            dtype=self.dtype, kernel_init=_init(), name="patch_embed",
+        )(x.astype(self.dtype))
+        x = x.reshape(b, -1, self.d_model)      # [B, N, D]
+        n = x.shape[1]
+
+        if self.pool == "cls":
+            cls = self.param("cls", nn.initializers.zeros,
+                             (1, 1, self.d_model), jnp.float32)
+            x = jnp.concatenate(
+                [jnp.broadcast_to(cls.astype(self.dtype), (b, 1, self.d_model)),
+                 x], axis=1)
+            n += 1
+        pos = self.param("pos_embed", _init(0.02), (1, n, self.d_model),
+                         jnp.float32)
+        x = x + pos.astype(self.dtype)
+        x = nn.Dropout(self.dropout, deterministic=not train)(x)
+
+        block_cls = EncoderBlock
+        if self.remat:
+            block_cls = nn.remat(
+                EncoderBlock, static_argnums=(2,),
+                policy=jax.checkpoint_policies.nothing_saveable,
+            )
+        for i in range(self.n_layer):
+            x = block_cls(
+                self.d_model, self.n_head, d_ff, self.dropout, self.dtype,
+                self.attn_impl, name=f"h_{i}",
+            )(x, train)
+        x = nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x)
+        x = x[:, 0] if self.pool == "cls" else x.mean(axis=1)
+        logits = nn.Dense(self.num_classes, dtype=jnp.float32,
+                          kernel_init=nn.initializers.zeros, name="head")(x)
+        return nn.log_softmax(logits)
+
+    def batch_template(self, batch_size: int = 1):
+        return jnp.zeros(
+            (batch_size, self.image_size, self.image_size, self.channels),
+            jnp.float32,
+        )
+
+    def partition_rules(self):
+        """TP rules over the ``tensor`` axis (same scheme as TransformerLM;
+        pruned to no-ops on meshes without that axis)."""
+        return [
+            (r"qkv/kernel", P(None, "tensor")),
+            (r"qkv/bias", P("tensor")),
+            (r"out/kernel", P("tensor", None)),
+            (r"up/kernel", P(None, "tensor")),
+            (r"up/bias", P("tensor")),
+            (r"down/kernel", P("tensor", None)),
+            (r"patch_embed/kernel", P(None, None, None, "tensor")),
+            (r"patch_embed/bias", P("tensor")),
+            (r"pos_embed|cls|head", P()),
+        ]
+
+
+_VIT_SIZES = {
+    "vit-ti": dict(n_layer=12, n_head=3, d_model=192),
+    "vit-s": dict(n_layer=12, n_head=6, d_model=384),
+    "vit-b": dict(n_layer=12, n_head=12, d_model=768),
+    "vit-l": dict(n_layer=24, n_head=16, d_model=1024),
+    "vit-h": dict(n_layer=32, n_head=16, d_model=1280),
+}
+
+
+@MODELS.register("ViT")
+def vit(size: str = "vit-b", num_classes: int = 1000, image_size: int = 224,
+        channels: int = 3, patch_size: int = 16, dropout: float = 0.0,
+        bfloat16: bool = False, attn_impl: str = "xla", remat: bool = False,
+        pool: str = "cls", **overrides):
+    cfg = dict(_VIT_SIZES[size])
+    cfg.update(overrides)
+    return ViT(
+        num_classes=num_classes, image_size=image_size, channels=channels,
+        patch_size=patch_size, dropout=dropout,
+        dtype=jnp.bfloat16 if bfloat16 else jnp.float32,
+        attn_impl=attn_impl, remat=remat, pool=pool, **cfg,
+    )

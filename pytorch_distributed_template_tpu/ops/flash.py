@@ -19,6 +19,7 @@ Accumulation is float32 throughout regardless of input dtype.
 from __future__ import annotations
 
 import functools
+import math
 
 import jax
 import jax.numpy as jnp
@@ -31,7 +32,7 @@ DEFAULT_BLOCK_K = 128
 
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale: float,
-                causal: bool, block_k: int):
+                causal: bool, block_k: int, t_valid: int):
     # q_ref: [1, BQ, D]; k_ref/v_ref: [1, T, D]; o_ref: [1, BQ, D];
     # lse_ref: [1, BQ]
     qi = pl.program_id(1)
@@ -61,11 +62,14 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale: float,
             q, k_blk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )                                              # [BQ, BK]
-        if causal:
+        if causal or t_valid < t_kv:
             k_pos = j * block_k + lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1
             )
-            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+            if causal:
+                s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+            if t_valid < t_kv:  # keys past t_valid are padding
+                s = jnp.where(k_pos < t_valid, s, NEG_INF)
         m_new = jnp.maximum(m, jnp.max(s, axis=1))
         p = jnp.exp(s - m_new[:, None])
         corr = jnp.exp(m - m_new)
@@ -83,20 +87,17 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale: float,
 
 
 def _flash_fwd_3d(q, k, v, *, causal: bool, block_q: int, block_k: int,
-                  interpret: bool):
-    """q,k,v: [BH, T, D] -> (out [BH, T, D], lse [BH, T])."""
+                  t_valid: int, interpret: bool):
+    """q,k,v: [BH, T, D] (T block-padded) -> (out, lse [BH, T])."""
     bh, t, d = q.shape
     scale = d ** -0.5
     block_q = min(block_q, t)
     block_k = min(block_k, t)
-    if t % block_q or t % block_k:
-        raise ValueError(
-            f"sequence length {t} must be divisible by block sizes "
-            f"({block_q}, {block_k}); pad the sequence"
-        )
+    assert t % block_q == 0 and t % block_k == 0, (t, block_q, block_k)
     grid = (bh, t // block_q)
     kernel = functools.partial(
-        _fwd_kernel, scale=scale, causal=causal, block_k=block_k
+        _fwd_kernel, scale=scale, causal=causal, block_k=block_k,
+        t_valid=t_valid,
     )
     out, lse = pl.pallas_call(
         kernel,
@@ -119,7 +120,7 @@ def _flash_fwd_3d(q, k, v, *, causal: bool, block_q: int, block_k: int,
     return out, lse
 
 
-def _bwd_3d(causal, block_k, residuals, g):
+def _bwd_3d(causal, block_k, t_valid, residuals, g):
     """Blockwise flash backward over KV blocks (plain JAX, O(T*BK) memory)."""
     q, k, v, out, lse = residuals
     bh, t, d = q.shape
@@ -138,9 +139,11 @@ def _bwd_3d(causal, block_k, residuals, g):
         k_blk = sl(k).astype(jnp.float32)             # [BH, BK, D]
         v_blk = sl(v).astype(jnp.float32)
         s = jnp.einsum("bqd,bkd->bqk", qf, k_blk) * scale
+        k_pos = j * block_k + jnp.arange(block_k)
         if causal:
-            k_pos = j * block_k + jnp.arange(block_k)
             s = jnp.where((q_pos[:, None] >= k_pos[None, :])[None], s, NEG_INF)
+        if t_valid < t:
+            s = jnp.where((k_pos < t_valid)[None, None], s, NEG_INF)
         p = jnp.exp(s - lse[..., None])               # [BH, T, BK]
         dv = jnp.einsum("bqk,bqd->bkd", p, g)
         dp = jnp.einsum("bqd,bkd->bqk", g, v_blk)
@@ -161,22 +164,24 @@ def _bwd_3d(causal, block_k, residuals, g):
     return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash_3d(q, k, v, causal, block_q, block_k, interpret):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash_3d(q, k, v, causal, block_q, block_k, t_valid, interpret):
     out, _ = _flash_fwd_3d(q, k, v, causal=causal, block_q=block_q,
-                           block_k=block_k, interpret=interpret)
+                           block_k=block_k, t_valid=t_valid,
+                           interpret=interpret)
     return out
 
 
-def _flash_3d_fwd(q, k, v, causal, block_q, block_k, interpret):
+def _flash_3d_fwd(q, k, v, causal, block_q, block_k, t_valid, interpret):
     out, lse = _flash_fwd_3d(q, k, v, causal=causal, block_q=block_q,
-                             block_k=block_k, interpret=interpret)
+                             block_k=block_k, t_valid=t_valid,
+                             interpret=interpret)
     return out, (q, k, v, out, lse)
 
 
-def _flash_3d_bwd(causal, block_q, block_k, interpret, residuals, g):
+def _flash_3d_bwd(causal, block_q, block_k, t_valid, interpret, residuals, g):
     del block_q, interpret
-    return _bwd_3d(causal, block_k, residuals, g)
+    return _bwd_3d(causal, block_k, t_valid, residuals, g)
 
 
 _flash_3d.defvjp(_flash_3d_fwd, _flash_3d_bwd)
@@ -196,13 +201,24 @@ def flash_attention(q, k, v, causal: bool = True,
     """Fused attention. q,k,v: [B, T, H, D] -> [B, T, H, D].
 
     ``interpret=None`` auto-selects: compiled on TPU, interpreter elsewhere
-    (CPU tests). Sequence length must divide by the block sizes (clamped to
-    T for short sequences).
+    (CPU tests). Any sequence length works: lengths that don't divide the
+    block sizes are zero-padded to the next block multiple and the padded
+    keys are masked out inside the kernel (padded query rows are sliced off,
+    and ``jnp.pad``'s VJP zeroes their gradients).
     """
     if interpret is None:
         interpret = not _on_tpu()
     b, t, h, d = q.shape
+    bq, bk = min(block_q, t), min(block_k, t)
+    t_pad = t
+    if t % bq or t % bk:
+        lcm = block_q * block_k // math.gcd(block_q, block_k)
+        t_pad = -(-t // lcm) * lcm
     fold = lambda x: jnp.moveaxis(x, 2, 1).reshape(b * h, t, d)
-    out = _flash_3d(fold(q), fold(k), fold(v), causal, block_q, block_k,
-                    interpret)
+    q, k, v = fold(q), fold(k), fold(v)
+    if t_pad != t:
+        pad = ((0, 0), (0, t_pad - t), (0, 0))
+        q, k, v = jnp.pad(q, pad), jnp.pad(k, pad), jnp.pad(v, pad)
+    out = _flash_3d(q, k, v, causal, block_q, block_k, t, interpret)
+    out = out[:, :t]
     return jnp.moveaxis(out.reshape(b, h, t, d), 1, 2)

@@ -186,6 +186,54 @@ class TestFlashAttention:
             np.testing.assert_allclose(np.asarray(b), np.asarray(a),
                                        atol=1e-4, rtol=1e-4)
 
+    @pytest.mark.parametrize("causal", [True, False])
+    @pytest.mark.parametrize("t", [197, 60, 33])
+    def test_non_divisible_seq_len_padded(self, causal, t):
+        """Lengths not divisible by the blocks (ViT's 196+1 cls token) are
+        padded internally and masked — values AND grads must match XLA."""
+        from pytorch_distributed_template_tpu.ops.flash import flash_attention
+
+        q, k, v = _qkv(jax.random.key(6), b=1, t=t, h=2, d=16)
+        ref = multihead_attention(q, k, v, causal=causal)
+        out = flash_attention(q, k, v, causal=causal, block_q=32, block_k=32)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=1e-4, rtol=1e-4)
+
+        def loss_ref(q, k, v):
+            return jnp.sum(multihead_attention(q, k, v, causal=causal) ** 2)
+
+        def loss_flash(q, k, v):
+            return jnp.sum(
+                flash_attention(q, k, v, causal=causal, block_q=32,
+                                block_k=32) ** 2
+            )
+
+        g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        g_fl = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(g_ref, g_fl):
+            np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                       atol=1e-4, rtol=1e-4)
+
+    def test_vit_cls_token_flash(self):
+        """ViT-with-cls (odd token count) runs under attn_impl='flash'."""
+        model = MODELS.get("ViT")(
+            size="vit-ti", num_classes=10, image_size=32, patch_size=8,
+            n_layer=1, attn_impl="flash",
+        )
+        ref = MODELS.get("ViT")(
+            size="vit-ti", num_classes=10, image_size=32, patch_size=8,
+            n_layer=1,
+        )
+        s = create_train_state(ref, optax.sgd(0.1), ref.batch_template(2),
+                               seed=7)
+        x = jnp.asarray(
+            np.random.default_rng(1).normal(size=(2, 32, 32, 3)), jnp.float32
+        )
+        out_fl = model.apply({"params": s.params}, x, train=False)
+        out_ref = ref.apply({"params": s.params}, x, train=False)
+        np.testing.assert_allclose(np.asarray(out_fl), np.asarray(out_ref),
+                                   atol=1e-4, rtol=1e-4)
+
     def test_model_attn_impl_flash(self):
         tokens = jnp.asarray(
             np.random.default_rng(0).integers(0, 256, (2, 64)), jnp.int32
