@@ -34,7 +34,7 @@ def _build_test_loader(config):
         if spec:
             args = dict(spec.get("args", {}))
             args["training"] = False
-            args.setdefault("shuffle", False)
+            args["shuffle"] = False
             return LOADERS.get(spec["type"])(**args)
     raise KeyError(
         "config defines none of test_loader/valid_loader/train_loader"

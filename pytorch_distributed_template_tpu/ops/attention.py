@@ -27,6 +27,7 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 from jax import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
@@ -118,9 +119,18 @@ def ring_attention(q, k, v, mesh: Mesh, causal: bool = True,
     if seq_axis not in mesh.axis_names or mesh.shape[seq_axis] == 1:
         return multihead_attention(q, k, v, causal=causal)
     axis_size = mesh.shape[seq_axis]
+    if q.shape[1] % axis_size != 0:
+        # Sequence not evenly shardable (e.g. a probe batch at init time):
+        # the dense path is always correct, just not sequence-parallel.
+        return multihead_attention(q, k, v, causal=causal)
 
     dp = tuple(a for a in data_axes if a in mesh.axis_names)
+    dp_total = int(np.prod([mesh.shape[a] for a in dp])) if dp else 1
+    if dp and q.shape[0] % dp_total != 0:
+        dp = ()  # batch too small to shard (init probes); replicate it
     hp = head_axis if head_axis in mesh.axis_names else None
+    if hp is not None and q.shape[2] % mesh.shape[hp] != 0:
+        hp = None
     spec = P(dp if dp else None, seq_axis, hp, None)
 
     vary_axes = tuple(dp) + (seq_axis,) + ((hp,) if hp else ())
