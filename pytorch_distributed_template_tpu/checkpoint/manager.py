@@ -79,6 +79,33 @@ class CheckpointManager:
     def wait(self) -> None:
         self._ckptr.wait_until_finished()
 
+    def _ckpt_has_ema(self, path) -> bool:
+        """Whether the on-disk checkpoint tree contains ``ema_params``,
+        from orbax item metadata (no array reads).
+
+        Falls back to scanning the checkpoint's ``_METADATA`` sidecar (the
+        on-disk tree structure file) so an orbax API change cannot silently
+        misreport "no EMA" and discard shadow-weight history."""
+        try:
+            meta = self._ckptr.metadata(Path(path))
+            tree = getattr(meta, "item_metadata", None) or meta
+            if hasattr(tree, "tree"):
+                tree = tree.tree
+            return "ema_params" in tree
+        except Exception:
+            pass
+        try:
+            md = Path(path) / "_METADATA"
+            if md.exists():
+                return '"ema_params"' in md.read_text()
+        except Exception:
+            pass
+        logger.warning(
+            "Warning: could not determine whether %s contains ema_params "
+            "(orbax metadata unavailable); assuming it does not.", path,
+        )
+        return False
+
     # -- restore ------------------------------------------------------------
 
     @staticmethod
@@ -131,13 +158,46 @@ class CheckpointManager:
             != current_config.get("optimizer", {}).get("type")
         )
 
-        restored = self._ckptr.restore(resume_path, _saveable(template_state))
+        template = _saveable(template_state)
+        # Reconcile EMA layout from the checkpoint's own metadata (not
+        # exception-driven: a restore failure can have unrelated causes and
+        # must surface as-is).
+        ckpt_has_ema = self._ckpt_has_ema(resume_path)
+        seed_ema = False
+        if "ema_params" in template and not ckpt_has_ema:
+            # Resuming an EMA run from a pre-EMA checkpoint: restore the
+            # base layout, then re-seed the EMA from the restored params.
+            template.pop("ema_params")
+            seed_ema = True
+            logger.warning(
+                "Warning: checkpoint has no ema_params; seeding EMA from "
+                "the restored params."
+            )
+        elif "ema_params" not in template and ckpt_has_ema:
+            # Saved with EMA, this run disabled it: restore into a
+            # throwaway slot, then drop the shadow weights.
+            template["ema_params"] = jax.tree.map(
+                lambda x: x, template["params"]
+            )
+            logger.warning(
+                "Warning: checkpoint contains ema_params but EMA is "
+                "disabled in this run; shadow weights discarded."
+            )
+        restored = self._ckptr.restore(resume_path, template)
+        if seed_ema:
+            restored["ema_params"] = jax.tree.map(
+                lambda x: x.copy(), restored["params"]
+            )
+        if template_state.ema_params is None:
+            restored.pop("ema_params", None)
         state = template_state.replace(
             step=restored["step"],
             params=restored["params"],
             batch_stats=restored["batch_stats"],
             rng=jax.random.wrap_key_data(restored["rng"]),
         )
+        if "ema_params" in restored and template_state.ema_params is not None:
+            state = state.replace(ema_params=restored["ema_params"])
         if opt_changed:
             logger.warning(
                 "Warning: Optimizer type given in config file is different "
@@ -158,12 +218,17 @@ def _saveable(state) -> dict:
     """TrainState -> plain dict (orbax-friendly, stable key layout).
 
     Typed PRNG keys are stored as raw key data (uint32) since orbax
-    serializes plain arrays; ``restore`` wraps them back.
+    serializes plain arrays; ``restore`` wraps them back. ``ema_params`` is
+    included only when EMA is enabled so checkpoints without EMA stay
+    readable by (and from) older layouts.
     """
-    return {
+    out = {
         "step": state.step,
         "params": state.params,
         "batch_stats": state.batch_stats,
         "opt_state": state.opt_state,
         "rng": jax.random.key_data(state.rng),
     }
+    if state.ema_params is not None:
+        out["ema_params"] = state.ema_params
+    return out

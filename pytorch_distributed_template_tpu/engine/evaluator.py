@@ -58,10 +58,13 @@ def evaluate(config, mesh=None) -> dict:
     target_key = dk.get("target", "label")
 
     # Template state for orbax restore: same tree as training saved
-    # (optimizer slots' shapes depend only on optimizer type + param shapes).
+    # (optimizer slots' shapes depend only on optimizer type + param shapes;
+    # ema_params present iff the training config enabled EMA).
     tx, _ = build_optimizer(config, steps_per_epoch=1)
     sample = test_loader.arrays[input_key][:1]
-    state = create_train_state(model, tx, jnp.asarray(sample))
+    ema_decay = float(config["trainer"].get("ema_decay", 0.0))
+    state = create_train_state(model, tx, jnp.asarray(sample),
+                               with_ema=ema_decay > 0)
     rules = getattr(model, "partition_rules", lambda: [])()
     state_sharding = apply_rules(state, mesh, rules)
     state = jax.device_put(state, state_sharding)
@@ -74,8 +77,12 @@ def evaluate(config, mesh=None) -> dict:
     )
 
     eval_step = jax.jit(
-        make_eval_step(model, criterion, metric_fns,
-                       input_key=input_key, target_key=target_key)
+        make_eval_step(
+            model, criterion, metric_fns,
+            input_key=input_key, target_key=target_key,
+            use_ema=ema_decay > 0
+            and bool(config["trainer"].get("eval_with_ema", True)),
+        )
     )
 
     accum = None

@@ -23,14 +23,19 @@ class TrainState:
     batch_stats: Any             # {} for stateless models (e.g. no BatchNorm)
     opt_state: Any
     rng: jax.Array               # base PRNG key; per-step keys fold in `step`
+    ema_params: Any = None       # shadow params when EMA is enabled
 
 
 def create_train_state(model, tx, sample_input, seed: int = 0,
-                       init_train: bool = False) -> TrainState:
+                       init_train: bool = False,
+                       with_ema: bool = False) -> TrainState:
     """Initialize params (and batch_stats if the model has them) + optimizer.
 
     ``sample_input`` is a shape template batch (e.g.
-    ``model.batch_template()``).
+    ``model.batch_template()``). ``with_ema`` seeds an exponential moving
+    average of the params (updated in the train step when the trainer's
+    ``ema_decay`` > 0; the reference has no EMA, SURVEY.md §2.4 — this is a
+    first-class extension).
     """
     root = jax.random.key(seed)
     param_key, dropout_key, state_key = jax.random.split(root, 3)
@@ -48,4 +53,5 @@ def create_train_state(model, tx, sample_input, seed: int = 0,
         batch_stats=batch_stats,
         opt_state=opt_state,
         rng=state_key,
+        ema_params=jax.tree.map(jnp.copy, params) if with_ema else None,
     )

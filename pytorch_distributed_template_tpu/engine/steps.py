@@ -19,6 +19,7 @@ TPU-idiomatic version of the reference's gather-everything-to-rank-0 eval
 """
 from __future__ import annotations
 
+import inspect
 from typing import Callable, Dict, Sequence
 
 import jax
@@ -30,42 +31,142 @@ def _masked_sum(per_example, mask):
     return jnp.sum(per_example * mask)
 
 
+def _accepts_example_mask(model) -> bool:
+    """Whether the model's ``__call__`` takes ``example_mask`` — models with
+    cross-example coupling (MoE capacity routing) need the batch mask inside
+    the forward pass; per-token models are exact from loss masking alone."""
+    try:
+        return "example_mask" in inspect.signature(
+            type(model).__call__
+        ).parameters
+    except (TypeError, ValueError):  # exotic callables
+        return False
+
+
 def make_train_step(model, tx, criterion: Callable,
                     metric_fns: Sequence[Callable] = (),
                     input_key: str = "image", target_key: str = "label",
-                    grad_clip_norm: float = 0.0):
+                    grad_clip_norm: float = 0.0,
+                    grad_accum_steps: int = 1,
+                    ema_decay: float = 0.0):
     """Build ``train_step(state, batch) -> (state, metrics)``.
 
     ``metrics`` holds scalar sums + count; callers divide after accumulating
     across batches (exact masked averages).
-    """
 
-    def loss_and_output(params, batch_stats, batch, dropout_rng):
+    ``grad_accum_steps > 1`` splits the batch into that many microbatches and
+    runs them through a ``lax.scan`` (one compiled body, k iterations),
+    summing *unnormalized* (masked-sum) gradients and dividing once by the
+    global valid count — the same mean-gradient math as the unaccumulated
+    step on the full batch (equal up to float reassociation; dropout draws
+    per-microbatch keys and BatchNorm normalizes per microbatch, so those
+    layers see genuinely different — not wrong — randomness/statistics), at
+    1/k the activation memory. The reference has no accumulation (SURVEY.md
+    §2.4); this is the TPU-idiomatic way to trade HBM for FLOPs alongside
+    remat.
+
+    ``ema_decay > 0`` maintains ``state.ema_params`` (shadow weights) with
+    ``ema = d*ema + (1-d)*params`` after each update.
+    """
+    pass_example_mask = _accepts_example_mask(model)
+
+    def sumloss_and_output(params, batch_stats, batch, dropout_rng):
+        """Masked SUM of per-example losses (normalized by the caller after
+        accumulation, so microbatched grads sum exactly).
+
+        The ``losses`` collection collects auxiliary objectives modules sow
+        (e.g. the MoE load-balancing loss, models/moe.py); they are scalars
+        scaled by the microbatch's valid count so the final
+        divide-by-global-count yields their count-weighted mean.
+        """
         variables = {"params": params}
+        mutable = ["losses"]
         if batch_stats:
             variables["batch_stats"] = batch_stats
-            output, mutated = model.apply(
-                variables, batch[input_key], train=True,
-                mutable=["batch_stats"], rngs={"dropout": dropout_rng},
-            )
-            new_stats = mutated["batch_stats"]
-        else:
-            output = model.apply(
-                variables, batch[input_key], train=True,
-                rngs={"dropout": dropout_rng},
-            )
-            new_stats = batch_stats
+            mutable = ["batch_stats", "losses"]
+        extra = (
+            {"example_mask": batch["mask"]} if pass_example_mask else {}
+        )
+        output, mutated = model.apply(
+            variables, batch[input_key], train=True,
+            mutable=mutable, rngs={"dropout": dropout_rng}, **extra,
+        )
+        new_stats = mutated.get("batch_stats", batch_stats)
         per_ex = criterion(output, batch[target_key])
         mask = batch["mask"].astype(per_ex.dtype)
-        count = jnp.maximum(mask.sum(), 1.0)
-        loss = _masked_sum(per_ex, mask) / count
-        return loss, (output, new_stats, mask, count)
+        loss_sum = _masked_sum(per_ex, mask)
+        aux = jax.tree.leaves(mutated.get("losses", {}))
+        if aux:
+            loss_sum = loss_sum + sum(jnp.sum(a) for a in aux) * mask.sum()
+        return loss_sum, (output, new_stats, mask)
+
+    grad_fn = jax.value_and_grad(sumloss_and_output, has_aux=True)
+
+    def micro_metrics(output, target, mask):
+        out = {}
+        for fn in metric_fns:
+            out[f"{fn.__name__}_sum"] = _masked_sum(fn(output, target), mask)
+        return out
 
     def train_step(state, batch):
         dropout_rng = jax.random.fold_in(state.rng, state.step)
-        (loss, (output, new_stats, mask, count)), grads = jax.value_and_grad(
-            loss_and_output, has_aux=True
-        )(state.params, state.batch_stats, batch, dropout_rng)
+        k = grad_accum_steps
+
+        if k <= 1:
+            (loss_sum, (output, new_stats, mask)), grads = grad_fn(
+                state.params, state.batch_stats, batch, dropout_rng
+            )
+            count = mask.sum()
+            metrics = {"loss_sum": loss_sum, "count": count}
+            metrics.update(micro_metrics(output, batch[target_key], mask))
+        else:
+            # [B, ...] -> [k, B/k, ...]; B is static so this is shape-checked
+            # at trace time.
+            def split(x):
+                b = x.shape[0]
+                if b % k != 0:
+                    raise ValueError(
+                        f"batch size {b} not divisible by "
+                        f"grad_accum_steps {k}"
+                    )
+                return x.reshape((k, b // k) + x.shape[1:])
+
+            micro = jax.tree.map(split, batch)
+
+            def body(carry, mb):
+                stats, gsum, msum = carry
+                rng = jax.random.fold_in(dropout_rng, mb["_idx"])
+                mb = {kk: v for kk, v in mb.items() if kk != "_idx"}
+                (loss_sum, (output, new_stats, mask)), grads = grad_fn(
+                    state.params, stats, mb, rng
+                )
+                m = {"loss_sum": loss_sum, "count": mask.sum()}
+                m.update(micro_metrics(output, mb[target_key], mask))
+                gsum = jax.tree.map(jnp.add, gsum, grads)
+                msum = jax.tree.map(jnp.add, msum, m)
+                return (new_stats, gsum, msum), None
+
+            micro["_idx"] = jnp.arange(k)
+            zeros_g = jax.tree.map(
+                lambda p: jnp.zeros(p.shape, jnp.promote_types(p.dtype,
+                                                               jnp.float32)),
+                state.params,
+            )
+            zeros_m = {"loss_sum": jnp.zeros((), jnp.float32),
+                       "count": jnp.zeros((), jnp.float32)}
+            for fn in metric_fns:
+                zeros_m[f"{fn.__name__}_sum"] = jnp.zeros((), jnp.float32)
+            (new_stats, grads, metrics), _ = jax.lax.scan(
+                body, (state.batch_stats, zeros_g, zeros_m), micro
+            )
+            loss_sum, count = metrics["loss_sum"], metrics["count"]
+
+        # Normalize the summed gradients by the global valid count (matches
+        # grad-of-mean on the full batch exactly).
+        denom = jnp.maximum(count.astype(jnp.float32), 1.0)
+        grads = jax.tree.map(
+            lambda g: (g / denom).astype(g.dtype), grads
+        )
 
         if grad_clip_norm > 0:
             gnorm = optax.global_norm(grads)
@@ -74,17 +175,23 @@ def make_train_step(model, tx, criterion: Callable,
 
         updates, new_opt_state = tx.update(grads, state.opt_state, state.params)
         new_params = optax.apply_updates(state.params, updates)
+        new_ema = state.ema_params
+        if ema_decay > 0 and new_ema is not None:
+            d = jnp.float32(ema_decay)
+            new_ema = jax.tree.map(
+                lambda e, p: (e * d + p.astype(e.dtype) * (1 - d)),
+                new_ema, new_params,
+            )
         new_state = state.replace(
             step=state.step + 1,
             params=new_params,
             batch_stats=new_stats,
             opt_state=new_opt_state,
+            ema_params=new_ema,
         )
-        metrics = {"loss_sum": loss * count, "count": count}
-        for fn in metric_fns:
-            metrics[f"{fn.__name__}_sum"] = _masked_sum(
-                fn(output, batch[target_key]), mask
-            )
+        metrics = dict(metrics)
+        metrics["loss_sum"] = loss_sum
+        metrics["count"] = count
         return new_state, metrics
 
     return train_step
@@ -92,20 +199,33 @@ def make_train_step(model, tx, criterion: Callable,
 
 def make_eval_step(model, criterion: Callable,
                    metric_fns: Sequence[Callable] = (),
-                   input_key: str = "image", target_key: str = "label"):
+                   input_key: str = "image", target_key: str = "label",
+                   use_ema: bool = False):
     """Build ``eval_step(state, batch) -> metrics`` (sufficient statistics).
 
     Equivalent to the reference's no-grad validation forward
     (trainer/trainer.py:94-113) + the rank-0 global metric computation
     (trainer/trainer.py:75-88), but reduced in-graph: no pickle gathers, no
-    full prediction set on one host.
+    full prediction set on one host. ``use_ema`` evaluates the shadow EMA
+    weights instead of the live params.
     """
 
+    pass_example_mask = _accepts_example_mask(model)
+
     def eval_step(state, batch):
-        variables = {"params": state.params}
+        params = (
+            state.ema_params
+            if use_ema and state.ema_params is not None
+            else state.params
+        )
+        variables = {"params": params}
         if state.batch_stats:
             variables["batch_stats"] = state.batch_stats
-        output = model.apply(variables, batch[input_key], train=False)
+        extra = (
+            {"example_mask": batch["mask"]} if pass_example_mask else {}
+        )
+        output = model.apply(variables, batch[input_key], train=False,
+                             **extra)
         per_ex = criterion(output, batch[target_key])
         mask = batch["mask"].astype(per_ex.dtype)
         metrics = {

@@ -34,6 +34,9 @@ from ..config.registry import LOSSES, METRICS
 from ..data.loader import prefetch_to_device
 from ..models.base import describe, inject_mesh
 from ..observability import MetricTracker, TensorboardWriter
+from ..observability.profiler import (
+    ThroughputMeter, TraceCapture, compiled_flops, mfu,
+)
 from ..parallel import batch_sharding, dist, mesh_from_config
 from ..parallel.sharding import apply_rules
 from .optim import build_optimizer
@@ -146,6 +149,9 @@ class BaseTrainer:
                     )
                 break
         self.ckpt_manager.wait()
+        trace = getattr(self, "trace", None)
+        if trace is not None:
+            trace.close()  # flush a still-open profiler window
         return log
 
     def _save_checkpoint(self, epoch: int, save_best: bool = False) -> None:
@@ -194,9 +200,11 @@ class Trainer(BaseTrainer):
         self.tx, self.lr_fn = build_optimizer(config, self.len_epoch)
 
         # --- state init + placement ---------------------------------------
+        ema_decay = float(config["trainer"].get("ema_decay", 0.0))
         sample = train_loader.arrays[self.input_key][:1]
         state = create_train_state(
-            model, self.tx, jnp.asarray(sample), seed=seed
+            model, self.tx, jnp.asarray(sample), seed=seed,
+            with_ema=ema_decay > 0,
         )
         if dist.is_main_process():
             self.logger.info(describe(model, state.params))
@@ -219,10 +227,12 @@ class Trainer(BaseTrainer):
 
         # --- compile the hot loop -----------------------------------------
         grad_clip = config["trainer"].get("grad_clip_norm", 0.0)
+        grad_accum = int(config["trainer"].get("grad_accum_steps", 1))
         train_step = make_train_step(
             model, self.tx, criterion, self.metric_ftns,
             input_key=self.input_key, target_key=self.target_key,
-            grad_clip_norm=grad_clip,
+            grad_clip_norm=grad_clip, grad_accum_steps=grad_accum,
+            ema_decay=ema_decay,
         )
         metric_sharding = jax.sharding.NamedSharding(
             self.mesh, jax.sharding.PartitionSpec()
@@ -236,6 +246,8 @@ class Trainer(BaseTrainer):
         eval_step = make_eval_step(
             model, criterion, self.metric_ftns,
             input_key=self.input_key, target_key=self.target_key,
+            use_ema=ema_decay > 0
+            and bool(config["trainer"].get("eval_with_ema", True)),
         )
         self._eval_step = jax.jit(
             eval_step,
@@ -246,6 +258,20 @@ class Trainer(BaseTrainer):
         self.valid_metrics = MetricTracker(
             "loss", *[m.__name__ for m in self.metric_ftns], writer=self.writer
         )
+
+        # --- profiling (SURVEY.md §5 tracing tier; reference had only the
+        # steps_per_sec scalar) ---------------------------------------------
+        prof_cfg = config["trainer"].get("profiler", {}) or {}
+        self.profile_enabled = bool(prof_cfg.get("enabled", False))
+        self.throughput = ThroughputMeter()
+        self.trace = TraceCapture(
+            config.log_dir,
+            start_step=prof_cfg.get("trace_start_step", 10),
+            num_steps=prof_cfg.get("trace_steps", 0),
+        )
+        self._peak_flops = prof_cfg.get("peak_flops_per_device")
+        self._flops_per_step = None  # measured lazily on the first batch
+        self._flops_measured = False  # latch: the AOT compile runs at most once
 
     def _metric_keys(self):
         return ["loss_sum", "count"] + [
@@ -264,21 +290,48 @@ class Trainer(BaseTrainer):
 
     def _train_epoch(self, epoch: int) -> dict:
         self.train_metrics.reset()
+        self.throughput.reset()  # exclude validation/checkpoint wall time
         accum = None
         prefetched = prefetch_to_device(
             (b for _, b in self._batches(epoch)), self.batch_sharding
         )
         main = dist.is_main_process()
         for batch_idx, batch in enumerate(prefetched):
+            step = (epoch - 1) * self.len_epoch + batch_idx
+            self.trace.before_step(step)
             self.state, m = self._train_step(self.state, batch)
+            self.trace.after_step(step, sync=m)
+            self.throughput.update(self.train_loader.batch_size)
+
+            if (self.profile_enabled and batch_idx == 0
+                    and not self._flops_measured):
+                # One AOT cost analysis of the compiled step (startup only;
+                # batch_idx gate so resumed runs measure too; the latch stays
+                # set even when the backend reports no FLOPs).
+                self._flops_measured = True
+                self._flops_per_step = compiled_flops(
+                    self._train_step, self.state, batch
+                )
+                jax.block_until_ready(m)
+                self.throughput.reset()  # exclude compilation from rates
+
             accum = m if accum is None else jax.tree.map(jnp.add, accum, m)
 
             if main and batch_idx % self.log_step == 0:
-                step = (epoch - 1) * self.len_epoch + batch_idx
                 self.writer.set_step(step)
                 loss_val = float(m["loss_sum"]) / max(float(m["count"]), 1.0)
                 self.train_metrics.update("loss", loss_val)
                 self.writer.add_scalar("lr", float(self.lr_fn(step)))
+                if self.profile_enabled and step > 0:
+                    # float() above synced the device, so rates are honest.
+                    rate = self.throughput.rate()
+                    self.writer.add_scalar(
+                        "examples_per_sec", rate["examples_per_sec"]
+                    )
+                    util = mfu(self._flops_per_step, rate["steps_per_sec"],
+                               peak_per_device=self._peak_flops)
+                    if util is not None:
+                        self.writer.add_scalar("mfu", util)
                 self.logger.debug(
                     "Train Epoch: %d %s Loss: %.6f",
                     epoch, self._progress(batch_idx + 1), loss_val,

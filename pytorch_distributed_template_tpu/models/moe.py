@@ -1,0 +1,215 @@
+"""Mixture-of-Experts transformer blocks with expert parallelism.
+
+The reference has no MoE (its model zoo is one CNN, SURVEY.md §2.3) — this
+is a first-class extension of the transformer family for the framework's
+expert-parallel (``expert`` mesh axis) story.
+
+TPU-native design, following the GShard/Switch einsum formulation (the form
+the XLA SPMD partitioner understands natively):
+
+- routing builds **dispatch/combine one-hot tensors** ``[S, E, C]`` (token,
+  expert, capacity slot) and the whole layer is four einsums — all MXU work,
+  static shapes, no gather/scatter;
+- expert weights are stacked ``[E, d, f]`` and sharded over the ``expert``
+  mesh axis via ``partition_rules``; when tokens (batch-sharded) meet
+  expert-sharded weights, XLA inserts the **all-to-all** pair — the same
+  collective an MPI MoE implementation would hand-write;
+- tokens over capacity are dropped (their combine weight is zero, the
+  residual path carries them), keeping shapes static for XLA;
+- the Switch load-balancing auxiliary loss is emitted through flax's
+  ``losses`` collection (``sow``), picked up by the train step.
+"""
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..config.registry import MODELS
+
+
+def _init(stddev):
+    return nn.initializers.normal(stddev=stddev)
+
+
+class MoeMlp(nn.Module):
+    """Top-k routed expert FFN (drop-in for the dense MlpBlock).
+
+    :param num_experts: E, total experts (shard over ``expert`` mesh axis).
+    :param top_k: experts per token (1 = Switch, 2 = GShard default).
+    :param capacity_factor: per-expert slot headroom; capacity
+        ``C = ceil(top_k * S / E * capacity_factor)``.
+    :param aux_loss_weight: weight of the load-balancing loss sown into the
+        ``losses`` collection.
+    """
+
+    d_model: int
+    d_ff: int
+    num_experts: int
+    top_k: int = 2
+    capacity_factor: float = 1.25
+    aux_loss_weight: float = 0.01
+    dropout: float = 0.0
+    n_layer: int = 1
+    dtype: Any = jnp.float32
+    mesh: Optional[Any] = None
+
+    @nn.compact
+    def __call__(self, x, train: bool, example_mask=None):
+        b, t, d = x.shape
+        s = b * t
+        e = self.num_experts
+        k = min(self.top_k, e)
+        cap = max(int(-(-k * s * self.capacity_factor // e)), 1)
+        cap = min(cap, s)
+        xf = x.reshape(s, d)
+        # Per-token validity from the per-example mask: padded examples must
+        # not claim expert capacity nor move the balance statistics, or
+        # padding would change real tokens' outputs/gradients (the masked-
+        # exactness contract of engine/steps.py). One caveat remains: the
+        # capacity C is a *static* function of the padded token count (XLA
+        # static shapes), so when real tokens are being capacity-dropped the
+        # drop boundary can differ between padded and unpadded batches —
+        # exactness is guaranteed only while no real token is dropped.
+        if example_mask is not None:
+            tok = jnp.broadcast_to(
+                example_mask.astype(jnp.float32)[:, None], (b, t)
+            ).reshape(s)
+        else:
+            tok = jnp.ones((s,), jnp.float32)
+
+        # --- routing (fp32 for a stable softmax) --------------------------
+        logits = nn.Dense(e, dtype=jnp.float32, kernel_init=_init(0.02),
+                          name="router")(xf.astype(jnp.float32))
+        probs = jax.nn.softmax(logits, axis=-1)            # [S, E]
+        gate_vals, gate_idx = jax.lax.top_k(probs, k)       # [S, k]
+        gate_vals = gate_vals / jnp.maximum(
+            gate_vals.sum(-1, keepdims=True), 1e-9
+        )
+        gate_vals = gate_vals * tok[:, None]
+
+        # --- capacity assignment: slot 0 fills first, then slot 1 ---------
+        combine = jnp.zeros((s, e, cap), jnp.float32)
+        fill = jnp.zeros((e,), jnp.int32)
+        for slot in range(k):
+            oh = jax.nn.one_hot(gate_idx[:, slot], e, dtype=jnp.int32)
+            oh = oh * tok[:, None].astype(jnp.int32)  # padding claims no slot
+            pos = jnp.cumsum(oh, axis=0) - 1 + fill[None, :]   # [S, E]
+            keep = (pos < cap) & (oh > 0)
+            combine = combine + (
+                gate_vals[:, slot, None, None]
+                * keep[..., None].astype(jnp.float32)
+                * jax.nn.one_hot(jnp.where(keep, pos, 0), cap,
+                                 dtype=jnp.float32)
+            )
+            fill = fill + jnp.sum(keep, axis=0, dtype=jnp.int32)
+
+        dispatch = (combine > 0).astype(self.dtype)         # [S, E, C]
+
+        # --- load-balancing aux loss (Switch eq. 4): E * sum(me * ce),
+        # statistics over VALID tokens only ---------------------------------
+        if train and self.aux_loss_weight > 0:
+            denom = jnp.maximum(tok.sum(), 1.0)
+            me = (probs * tok[:, None]).sum(axis=0) / denom          # [E]
+            ce = (jax.nn.one_hot(gate_idx[:, 0], e)
+                  * tok[:, None]).sum(axis=0) / denom                # [E]
+            aux = e * jnp.sum(me * ce)
+            self.sow("losses", "moe_aux",
+                     self.aux_loss_weight * aux,
+                     reduce_fn=lambda a, b: a + b,
+                     init_fn=lambda: jnp.zeros((), jnp.float32))
+
+        # --- expert computation: everything is einsum (MXU + all_to_all) --
+        wi = self.param("wi", _init(0.02), (e, d, self.d_ff), jnp.float32)
+        wo = self.param(
+            "wo", _init(0.02 / (2 * self.n_layer) ** 0.5),
+            (e, self.d_ff, d), jnp.float32,
+        )
+        bi = self.param("bi", nn.initializers.zeros, (e, self.d_ff),
+                        jnp.float32)
+        bo = self.param("bo", nn.initializers.zeros, (e, d), jnp.float32)
+
+        expert_in = jnp.einsum("sec,sd->ecd", dispatch,
+                               xf.astype(self.dtype))       # [E, C, d]
+        expert_in = self._constrain(expert_in, P("expert", None, None))
+        h = jnp.einsum("ecd,edf->ecf", expert_in,
+                       wi.astype(self.dtype)) + bi.astype(self.dtype)[:, None]
+        h = nn.gelu(h)
+        out = jnp.einsum("ecf,efd->ecd", h,
+                         wo.astype(self.dtype)) + bo.astype(self.dtype)[:, None]
+        out = self._constrain(out, P("expert", None, None))
+        y = jnp.einsum("sec,ecd->sd", combine.astype(self.dtype), out)
+        y = nn.Dropout(self.dropout, deterministic=not train)(y)
+        return y.reshape(b, t, d)
+
+    def _constrain(self, arr, spec: P):
+        """Pin the expert-stacked intermediate to the ``expert`` axis so the
+        SPMD partitioner chooses the all-to-all dispatch layout (hint only;
+        no-op without a mesh or when the axis doesn't divide)."""
+        mesh = self.mesh
+        if (
+            mesh is None
+            or "expert" not in mesh.axis_names
+            or mesh.shape["expert"] == 1
+            or arr.shape[0] % mesh.shape["expert"] != 0
+        ):
+            return arr
+        return jax.lax.with_sharding_constraint(
+            arr, NamedSharding(mesh, spec)
+        )
+
+    @staticmethod
+    def partition_rules():
+        """Expert-parallel placement: stacked expert weights shard over the
+        ``expert`` axis (composable with TP on the inner dims); the router
+        stays replicated."""
+        return [
+            (r"moe/wi", P("expert", None, "tensor")),
+            (r"moe/wo", P("expert", "tensor", None)),
+            (r"moe/bi", P("expert", "tensor")),
+            (r"moe/bo", P("expert", None)),
+            (r"moe/router/kernel", P()),
+            (r"moe/router/bias", P()),
+        ]
+
+
+@MODELS.register("MoeLM")
+def moe_lm(vocab_size: int = 50257, n_layer: int = 12, n_head: int = 12,
+           d_model: int = 768, max_len: int = 1024, dropout: float = 0.1,
+           num_experts: int = 8, top_k: int = 2, moe_every: int = 2,
+           capacity_factor: float = 1.25, aux_loss_weight: float = 0.01,
+           bfloat16: bool = False, attn_impl: str = "xla",
+           remat: bool = False, mesh=None, **overrides):
+    """Decoder-only LM with MoE FFNs every ``moe_every``-th block
+    (GShard-style interleaving; ``moe_every=1`` = every block)."""
+    from .transformer import TransformerLM
+
+    return TransformerLM(
+        vocab_size=vocab_size, n_layer=n_layer, n_head=n_head,
+        d_model=d_model, max_len=max_len, dropout=dropout,
+        dtype=jnp.bfloat16 if bfloat16 else jnp.float32,
+        attn_impl=attn_impl, remat=remat, mesh=mesh,
+        moe_experts=num_experts, moe_top_k=top_k, moe_every=moe_every,
+        moe_capacity_factor=capacity_factor,
+        moe_aux_loss_weight=aux_loss_weight, **overrides,
+    )
+
+
+@MODELS.register("TinyMoeLM")
+def tiny_moe_lm(vocab_size: int = 256, n_layer: int = 2, n_head: int = 4,
+                d_model: int = 64, max_len: int = 128, dropout: float = 0.0,
+                num_experts: int = 4, top_k: int = 2, moe_every: int = 1,
+                capacity_factor: float = 2.0, aux_loss_weight: float = 0.01,
+                attn_impl: str = "xla", remat: bool = False, mesh=None,
+                bfloat16: bool = False):
+    """Small MoE config for tests and the multi-chip dry run."""
+    return moe_lm(
+        vocab_size=vocab_size, n_layer=n_layer, n_head=n_head,
+        d_model=d_model, max_len=max_len, dropout=dropout,
+        num_experts=num_experts, top_k=top_k, moe_every=moe_every,
+        capacity_factor=capacity_factor, aux_loss_weight=aux_loss_weight,
+        bfloat16=bfloat16, attn_impl=attn_impl, remat=remat, mesh=mesh,
+    )

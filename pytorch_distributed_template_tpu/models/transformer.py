@@ -94,19 +94,30 @@ class Block(nn.Module):
     dtype: Any
     attn_impl: str
     mesh: Optional[Any]
+    moe: Optional[dict] = None      # MoeMlp kwargs; None -> dense MLP
 
     @nn.compact
-    def __call__(self, x, train: bool):
+    def __call__(self, x, train: bool, example_mask=None):
         h = nn.LayerNorm(dtype=jnp.float32, name="ln_1")(x)
         x = x + SelfAttention(
             self.d_model, self.n_head, self.dropout, self.n_layer,
             self.dtype, self.attn_impl, self.mesh, name="attn",
         )(h, train)
         h = nn.LayerNorm(dtype=jnp.float32, name="ln_2")(x)
-        x = x + MlpBlock(
-            self.d_model, self.d_ff, self.dropout, self.n_layer,
-            self.dtype, name="mlp",
-        )(h, train)
+        if self.moe:
+            from .moe import MoeMlp
+
+            x = x + MoeMlp(
+                d_model=self.d_model, d_ff=self.d_ff,
+                dropout=self.dropout, n_layer=self.n_layer,
+                dtype=self.dtype, mesh=self.mesh, name="moe",
+                **self.moe,
+            )(h, train, example_mask)
+        else:
+            x = x + MlpBlock(
+                self.d_model, self.d_ff, self.dropout, self.n_layer,
+                self.dtype, name="mlp",
+            )(h, train)
         return x
 
 
@@ -124,9 +135,27 @@ class TransformerLM(nn.Module):
     mesh: Optional[Any] = None
     remat: bool = False
     tie_embeddings: bool = True
+    # --- MoE (models/moe.py); moe_experts == 0 -> all-dense blocks --------
+    moe_experts: int = 0
+    moe_top_k: int = 2
+    moe_every: int = 2              # MoE FFN in every Nth block (GShard: 2)
+    moe_capacity_factor: float = 1.25
+    moe_aux_loss_weight: float = 0.01
+
+    def _moe_kwargs(self, layer_idx: int) -> Optional[dict]:
+        if self.moe_experts <= 0 or (layer_idx + 1) % self.moe_every != 0:
+            return None
+        return dict(
+            num_experts=self.moe_experts, top_k=self.moe_top_k,
+            capacity_factor=self.moe_capacity_factor,
+            aux_loss_weight=self.moe_aux_loss_weight,
+        )
 
     @nn.compact
-    def __call__(self, tokens, train: bool = False):
+    def __call__(self, tokens, train: bool = False, example_mask=None):
+        """``example_mask`` ([B] bool): marks padded examples so MoE blocks
+        keep them out of expert capacity/balance statistics (dense blocks
+        are per-token and need no mask — the loss masking suffices)."""
         d_ff = self.d_ff or 4 * self.d_model
         b, t = tokens.shape
         embed = nn.Embed(
@@ -151,8 +180,8 @@ class TransformerLM(nn.Module):
             x = block_cls(
                 self.d_model, self.n_head, d_ff, self.dropout,
                 self.n_layer, self.dtype, self.attn_impl, self.mesh,
-                name=f"h_{i}",
-            )(x, train)
+                self._moe_kwargs(i), name=f"h_{i}",
+            )(x, train, example_mask)
         x = nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x)
         if self.tie_embeddings:
             logits = embed.attend(x.astype(self.dtype))
@@ -175,7 +204,7 @@ class TransformerLM(nn.Module):
         embedding shards over vocab. Rules are no-ops on meshes without a
         ``tensor`` axis (sharding.apply_rules prunes absent axes).
         """
-        return [
+        rules = [
             (r"wte/embedding", P("tensor", None)),
             (r"attn/qkv/kernel", P(None, "tensor")),
             (r"attn/qkv/bias", P("tensor")),
@@ -186,6 +215,11 @@ class TransformerLM(nn.Module):
             (r"lm_head/kernel", P(None, "tensor")),
             (r"wpe", P()),
         ]
+        if self.moe_experts > 0:
+            from .moe import MoeMlp
+
+            rules = MoeMlp.partition_rules() + rules
+        return rules
 
 
 _GPT2_SIZES = {

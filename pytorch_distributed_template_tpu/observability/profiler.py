@@ -1,0 +1,170 @@
+"""Profiling: throughput, MFU, and on-demand XLA trace capture.
+
+The reference's only performance instrumentation is a ``steps_per_sec``
+TensorBoard scalar derived from wall-clock deltas between logging calls
+(/root/reference/logger/visualization.py:40-48). This module supplies the
+TPU-native tier promised in SURVEY.md §5 "Tracing / profiling":
+
+- ``ThroughputMeter``: honest steps/sec + examples/sec over timing windows
+  (the reference's number was really *logging-calls*/sec — kept for TB
+  parity in ``TensorboardWriter.set_step``, while this meter feeds the real
+  values).
+- ``compiled_flops``: cost analysis of the *compiled* XLA executable — the
+  exact FLOPs the hardware will run (post-fusion), not an analytic estimate.
+- ``mfu``: model FLOPs utilization against the chip's peak, with a device
+  table for TPU generations (override via config or
+  ``PDT_TPU_PEAK_FLOPS``).
+- ``TraceCapture``: a step-windowed ``jax.profiler`` trace (view in
+  TensorBoard's profile plugin) — start/stop driven by the trainer's step
+  counter so the capture covers steady-state steps, not compilation.
+"""
+from __future__ import annotations
+
+import os
+import time
+from pathlib import Path
+from typing import Optional
+
+import jax
+
+# Peak dense bf16/fp16 FLOPs per *chip*, by device_kind substring (lowercase,
+# first match wins; order matters: "v5 lite" before "v5"). Public numbers
+# from the TPU generation announcements.
+PEAK_FLOPS_TABLE = (
+    ("v6 lite", 918e12),
+    ("v6e", 918e12),
+    ("v5 lite", 197e12),
+    ("v5e", 197e12),
+    ("v5p", 459e12),
+    ("v5", 459e12),
+    ("v4 lite", 137e12),
+    ("v4", 275e12),
+    ("v3", 123e12),
+    ("v2", 45e12),
+)
+
+
+def peak_flops_per_device(device=None) -> Optional[float]:
+    """Peak FLOPs/s for one device, or None when unknown (e.g. CPU)."""
+    env = os.environ.get("PDT_TPU_PEAK_FLOPS")
+    if env:
+        return float(env)
+    if device is None:
+        device = jax.devices()[0]
+    kind = getattr(device, "device_kind", "").lower()
+    for key, val in PEAK_FLOPS_TABLE:
+        if key in kind:
+            return val
+    return None
+
+
+def compiled_flops(jitted_fn, *args, **kwargs) -> Optional[float]:
+    """FLOPs of one invocation, from XLA's cost analysis of the compiled
+    executable (post-fusion). Returns None when the backend doesn't report.
+
+    Note: this runs an AOT lower+compile of ``jitted_fn`` for the given
+    shapes; call it once at startup (compilation is cached per shape on most
+    backends, but do not put this in the hot loop).
+    """
+    try:
+        compiled = jitted_fn.lower(*args, **kwargs).compile()
+        cost = compiled.cost_analysis()
+        if isinstance(cost, (list, tuple)):  # older jax returns [dict]
+            cost = cost[0] if cost else {}
+        flops = cost.get("flops")
+        return float(flops) if flops else None
+    except Exception:
+        return None
+
+
+def mfu(flops_per_step: Optional[float], steps_per_sec: float,
+        peak_per_device: Optional[float] = None) -> Optional[float]:
+    """Model FLOPs utilization in [0, 1]; None when peak/flops unknown.
+
+    ``flops_per_step`` is the *per-device* figure: under SPMD partitioning,
+    ``cost_analysis`` on the compiled executable reports the partitioned
+    per-device module (on one device that equals the whole program), so it
+    is compared against a single device's peak.
+    """
+    if not flops_per_step or not steps_per_sec:
+        return None
+    if peak_per_device is None:
+        peak_per_device = peak_flops_per_device()
+    if peak_per_device is None:
+        return None
+    return (flops_per_step * steps_per_sec) / peak_per_device
+
+
+class ThroughputMeter:
+    """Windowed steps/sec + examples/sec.
+
+    ``update(n_examples)`` once per step; ``rate()`` returns the rates since
+    the last ``rate()``/``reset()`` call and opens a new window. The first
+    window of an epoch includes compilation unless ``reset`` is called after
+    the first step (the trainer does).
+    """
+
+    def __init__(self):
+        self.reset()
+
+    def reset(self) -> None:
+        self._t0 = time.perf_counter()
+        self._steps = 0
+        self._examples = 0
+
+    def update(self, n_examples: int = 0) -> None:
+        self._steps += 1
+        self._examples += int(n_examples)
+
+    def rate(self) -> dict:
+        dt = max(time.perf_counter() - self._t0, 1e-9)
+        out = {
+            "steps_per_sec": self._steps / dt,
+            "examples_per_sec": self._examples / dt,
+        }
+        self.reset()
+        return out
+
+
+class TraceCapture:
+    """Step-windowed ``jax.profiler`` trace into ``<log_dir>/profile``.
+
+    :param log_dir: run log dir; traces land in its ``profile/`` subdir.
+    :param start_step: first step included in the capture (global step).
+    :param num_steps: how many steps to capture.
+
+    Call ``before_step(step)`` / ``after_step(step)`` around each train
+    step; idempotent and a no-op once the window has been captured or when
+    disabled (``num_steps == 0``).
+    """
+
+    def __init__(self, log_dir, start_step: int = 10, num_steps: int = 0):
+        self.dir = str(Path(log_dir) / "profile")
+        self.start_step = int(start_step)
+        self.num_steps = int(num_steps)
+        self._active = False
+        self._done = self.num_steps <= 0
+
+    def before_step(self, step: int) -> None:
+        if not self._done and not self._active and step >= self.start_step:
+            Path(self.dir).mkdir(parents=True, exist_ok=True)
+            jax.profiler.start_trace(self.dir)
+            self._active = True
+            self._until = step + self.num_steps
+
+    def after_step(self, step: int, sync=None) -> None:
+        """``sync``: step outputs to ``block_until_ready`` before stopping —
+        steps are dispatched asynchronously, so without it the trace would
+        close while the captured steps still run on device."""
+        if self._active and step + 1 >= self._until:
+            if sync is not None:
+                jax.block_until_ready(sync)
+            jax.profiler.stop_trace()
+            self._active = False
+            self._done = True
+
+    def close(self) -> None:
+        if self._active:
+            jax.profiler.stop_trace()
+            self._active = False
+            self._done = True

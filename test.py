@@ -6,6 +6,15 @@ is rediscovered next to the checkpoint), evaluates the ``test_loader`` over
 the full mesh, reports loss + metrics over the global dataset.
 """
 import argparse
+import os
+
+if os.environ.get("JAX_PLATFORMS"):
+    # Same platform-override dance as train.py: make an explicit
+    # JAX_PLATFORMS request stick on images whose site hook pre-registers
+    # an accelerator plugin.
+    import jax
+
+    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
 
 from pytorch_distributed_template_tpu.config import ConfigParser
 from pytorch_distributed_template_tpu import data, models  # noqa: F401  (register)

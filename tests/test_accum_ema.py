@@ -1,0 +1,220 @@
+"""Gradient accumulation and EMA (engine/steps.py).
+
+The reference has neither (SURVEY.md §2.4); these are first-class TPU-side
+extensions, so the contract is defined here: accumulated microbatch steps
+must reproduce the full-batch update exactly (sum-gradient/normalize-once
+math), and EMA must track ``d*ema + (1-d)*params``.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from flax import linen as nn
+
+from pytorch_distributed_template_tpu.engine.state import create_train_state
+from pytorch_distributed_template_tpu.engine.steps import (
+    make_eval_step, make_train_step,
+)
+
+
+class TinyMLP(nn.Module):
+    """Deterministic model (no dropout/BN) so accum equivalence is exact."""
+
+    @nn.compact
+    def __call__(self, x, train=False):
+        x = x.reshape((x.shape[0], -1))
+        x = nn.Dense(16)(x)
+        x = nn.relu(x)
+        return nn.log_softmax(nn.Dense(4)(x))
+
+
+class TinyBN(nn.Module):
+    """BatchNorm model: checks batch_stats thread through the scan carry."""
+
+    @nn.compact
+    def __call__(self, x, train=False):
+        x = x.reshape((x.shape[0], -1))
+        x = nn.Dense(8)(x)
+        x = nn.BatchNorm(use_running_average=not train)(x)
+        return nn.log_softmax(nn.Dense(4)(x))
+
+
+def nll(output, target):
+    return -jnp.take_along_axis(output, target[:, None], axis=1)[:, 0]
+
+
+def _batch(rng, n=16):
+    return {
+        "image": rng.normal(size=(n, 6)).astype(np.float32),
+        "label": rng.integers(0, 4, size=n).astype(np.int32),
+        "mask": np.ones(n, bool),
+    }
+
+
+def _state(model, tx, with_ema=False):
+    return create_train_state(
+        model, tx, jnp.zeros((1, 6), jnp.float32), seed=0, with_ema=with_ema
+    )
+
+
+def test_accum_matches_full_batch():
+    model = TinyMLP()
+    tx = optax.sgd(0.1)
+    rng = np.random.default_rng(0)
+    batch = _batch(rng)
+
+    s_full = _state(model, tx)
+    s_acc = _state(model, tx)
+    step_full = jax.jit(make_train_step(model, tx, nll))
+    step_acc = jax.jit(make_train_step(model, tx, nll, grad_accum_steps=4))
+
+    for _ in range(3):
+        s_full, m_full = step_full(s_full, batch)
+        s_acc, m_acc = step_acc(s_acc, batch)
+
+    for a, b in zip(jax.tree.leaves(s_full.params),
+                    jax.tree.leaves(s_acc.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(float(m_full["loss_sum"]),
+                               float(m_acc["loss_sum"]), rtol=1e-5)
+    assert float(m_acc["count"]) == 16.0
+
+
+def test_accum_masked_padding_exact():
+    """Wraparound-padded rows (mask=False) must not affect the update."""
+    model = TinyMLP()
+    tx = optax.sgd(0.1)
+    rng = np.random.default_rng(1)
+    real = _batch(rng, n=12)
+
+    # pad 12 real rows to 16 with masked junk
+    padded = {
+        "image": np.concatenate(
+            [real["image"], rng.normal(size=(4, 6)).astype(np.float32)]),
+        "label": np.concatenate(
+            [real["label"], rng.integers(0, 4, size=4).astype(np.int32)]),
+        "mask": np.concatenate([np.ones(12, bool), np.zeros(4, bool)]),
+    }
+
+    s_ref = _state(model, tx)
+    s_pad = _state(model, tx)
+    step_ref = jax.jit(make_train_step(model, tx, nll))
+    step_pad = jax.jit(make_train_step(model, tx, nll, grad_accum_steps=2))
+
+    s_ref, _ = step_ref(s_ref, real)
+    s_pad, m = step_pad(s_pad, padded)
+    assert float(m["count"]) == 12.0
+    for a, b in zip(jax.tree.leaves(s_ref.params),
+                    jax.tree.leaves(s_pad.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_accum_indivisible_batch_raises():
+    model = TinyMLP()
+    tx = optax.sgd(0.1)
+    s = _state(model, tx)
+    step = make_train_step(model, tx, nll, grad_accum_steps=3)
+    with pytest.raises(ValueError, match="not divisible"):
+        jax.jit(step)(s, _batch(np.random.default_rng(2), n=16))
+
+
+def test_accum_with_batch_stats():
+    """BN stats update per microbatch and the step still trains."""
+    model = TinyBN()
+    tx = optax.sgd(0.05)
+    s = _state(model, tx)
+    step = jax.jit(make_train_step(model, tx, nll, grad_accum_steps=2))
+    batch = _batch(np.random.default_rng(3))
+    s1, m1 = step(s, batch)
+    s2, m2 = step(s1, batch)
+    assert np.isfinite(float(m2["loss_sum"]))
+    # running stats actually moved
+    a = jax.tree.leaves(s.batch_stats)
+    b = jax.tree.leaves(s2.batch_stats)
+    assert any(not np.allclose(np.asarray(x), np.asarray(y))
+               for x, y in zip(a, b))
+
+
+def test_ema_tracks_params():
+    model = TinyMLP()
+    tx = optax.sgd(0.1)
+    d = 0.9
+    s = _state(model, tx, with_ema=True)
+    p0 = jax.tree.map(np.asarray, s.ema_params)
+    step = jax.jit(make_train_step(model, tx, nll, ema_decay=d))
+    batch = _batch(np.random.default_rng(4))
+    s1, _ = step(s, batch)
+
+    # manual shadow update: d*ema0 + (1-d)*params1
+    expect = jax.tree.map(
+        lambda e, p: e * d + np.asarray(p) * (1 - d), p0, s1.params
+    )
+    for a, b in zip(jax.tree.leaves(expect), jax.tree.leaves(s1.ema_params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_ema_checkpoint_roundtrip_both_directions(tmp_path):
+    """EMA<->non-EMA layout mismatches restore gracefully (found driving
+    test.py on an EMA checkpoint: the eval template lacked ema_params)."""
+    from pytorch_distributed_template_tpu.checkpoint import CheckpointManager
+
+    model = TinyMLP()
+    tx = optax.sgd(0.1)
+    batch = _batch(np.random.default_rng(6))
+
+    # save WITH ema
+    s = _state(model, tx, with_ema=True)
+    s, _ = jax.jit(make_train_step(model, tx, nll, ema_decay=0.5))(s, batch)
+    mgr = CheckpointManager(tmp_path)
+    mgr.save(epoch=1, state=s, arch="TinyMLP", config={}, monitor_best=0.0)
+    mgr.wait()
+
+    # restore into an EMA template: shadow weights come back
+    t_ema = _state(model, tx, with_ema=True)
+    r, _, _ = mgr.restore(tmp_path / "checkpoint-epoch1", t_ema, {}, "TinyMLP")
+    for a, b in zip(jax.tree.leaves(s.ema_params),
+                    jax.tree.leaves(r.ema_params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    # restore into a non-EMA template: shadow weights dropped, params intact
+    t_plain = _state(model, tx, with_ema=False)
+    r2, _, _ = mgr.restore(tmp_path / "checkpoint-epoch1", t_plain, {},
+                           "TinyMLP")
+    assert r2.ema_params is None
+    for a, b in zip(jax.tree.leaves(s.params), jax.tree.leaves(r2.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    # save WITHOUT ema, restore into an EMA template: ema seeded from params
+    mgr.save(epoch=2, state=r2, arch="TinyMLP", config={}, monitor_best=0.0)
+    mgr.wait()
+    r3, _, _ = mgr.restore(tmp_path / "checkpoint-epoch2", t_ema, {},
+                           "TinyMLP")
+    for a, b in zip(jax.tree.leaves(r3.params), jax.tree.leaves(r3.ema_params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_eval_uses_ema_when_asked():
+    model = TinyMLP()
+    tx = optax.sgd(0.5)
+    s = _state(model, tx, with_ema=True)
+    step = jax.jit(make_train_step(model, tx, nll, ema_decay=0.99))
+    batch = _batch(np.random.default_rng(5))
+    for _ in range(5):
+        s, _ = step(s, batch)
+
+    ev_live = jax.jit(make_eval_step(model, nll))
+    ev_ema = jax.jit(make_eval_step(model, nll, use_ema=True))
+    m_live = ev_live(s, batch)
+    m_ema = ev_ema(s, batch)
+    # after 5 fast SGD steps the live and shadow weights must differ
+    assert abs(float(m_live["loss_sum"]) - float(m_ema["loss_sum"])) > 1e-6
+
+    # ema eval == eval of a state whose params are the shadow weights
+    s_sub = s.replace(params=s.ema_params)
+    m_sub = ev_live(s_sub, batch)
+    np.testing.assert_allclose(float(m_ema["loss_sum"]),
+                               float(m_sub["loss_sum"]), rtol=1e-6)

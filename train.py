@@ -13,6 +13,16 @@ Differences, by design:
 """
 import argparse
 import collections
+import os
+
+if os.environ.get("JAX_PLATFORMS"):
+    # Honor an explicit platform request (e.g. JAX_PLATFORMS=cpu with
+    # --xla_force_host_platform_device_count for a virtual debug mesh) even
+    # on images whose site hook registers an accelerator plugin at startup —
+    # there the env var alone does not stick, the config must be set too.
+    import jax
+
+    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
 
 from pytorch_distributed_template_tpu.config import (
     ConfigParser, LOADERS, LOSSES, METRICS, MODELS,
