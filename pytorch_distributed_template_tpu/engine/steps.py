@@ -189,9 +189,6 @@ def make_train_step(model, tx, criterion: Callable,
             opt_state=new_opt_state,
             ema_params=new_ema,
         )
-        metrics = dict(metrics)
-        metrics["loss_sum"] = loss_sum
-        metrics["count"] = count
         return new_state, metrics
 
     return train_step

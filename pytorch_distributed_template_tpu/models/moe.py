@@ -86,9 +86,13 @@ class MoeMlp(nn.Module):
                           name="router")(xf.astype(jnp.float32))
         probs = jax.nn.softmax(logits, axis=-1)            # [S, E]
         gate_vals, gate_idx = jax.lax.top_k(probs, k)       # [S, k]
-        gate_vals = gate_vals / jnp.maximum(
-            gate_vals.sum(-1, keepdims=True), 1e-9
-        )
+        if k > 1:
+            # GShard: renormalize the k selected gates.
+            gate_vals = gate_vals / jnp.maximum(
+                gate_vals.sum(-1, keepdims=True), 1e-9
+            )
+        # else Switch: the RAW top-1 probability is the gate — renormalizing
+        # would pin it to 1.0 and cut the router off from the task gradient.
         gate_vals = gate_vals * tok[:, None]
 
         # --- capacity assignment: slot 0 fills first, then slot 1 ---------

@@ -1,0 +1,197 @@
+"""Pipeline-parallel causal LM (trunk streamed through ``pipe`` stages).
+
+The reference is DP-only; this model family carries the framework's
+pipeline-parallelism story (parallel/pipeline.py). Structure:
+
+- embedding + final norm + LM head live OUTSIDE the pipeline (they are
+  cheap and stage-asymmetric);
+- the trunk's ``n_layer`` homogeneous blocks are declared as **stacked
+  parameter tensors** (leading dim = layer) so they can be regrouped into
+  ``[n_stages, layers_per_stage, ...]`` and fed to ``pipeline_apply`` —
+  each pipe-stage device holds only its stage's slice (P('pipe', ...));
+- the batch is split into M microbatches that stream through the GPipe
+  schedule; combine ``pipe`` with ``data`` mesh axes for DP x PP.
+
+The block math matches models/transformer.py's ``Block`` (pre-LN, causal
+MHA, GeLU MLP) but is written as pure functions over raw tensors because
+the pipeline needs the per-layer weights as stacked arrays, not module
+instances. Dropout is intentionally unsupported in the pipelined trunk
+(keep ``dropout=0``): per-(stage, tick) RNG plumbing is provided by
+``pipeline_apply`` but the parity-tested path is deterministic.
+"""
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..config.registry import MODELS
+from ..ops.attention import multihead_attention
+
+
+def _init(stddev):
+    return nn.initializers.normal(stddev=stddev)
+
+
+def _layer_norm(x, g, b, eps=1e-5):
+    xf = x.astype(jnp.float32)
+    mu = xf.mean(-1, keepdims=True)
+    var = ((xf - mu) ** 2).mean(-1, keepdims=True)
+    return ((xf - mu) / jnp.sqrt(var + eps) * g + b).astype(x.dtype)
+
+
+def _block_apply(p, x, n_head):
+    """One pre-LN transformer block from a dict of raw tensors."""
+    b, t, d = x.shape
+    h = _layer_norm(x, p["ln1_g"], p["ln1_b"])
+    qkv = h @ p["qkv_k"].astype(h.dtype) + p["qkv_b"].astype(h.dtype)
+    qkv = qkv.reshape(b, t, 3, n_head, d // n_head)
+    ctx = multihead_attention(
+        qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2], causal=True
+    ).reshape(b, t, d)
+    x = x + ctx @ p["out_k"].astype(x.dtype) + p["out_b"].astype(x.dtype)
+    h = _layer_norm(x, p["ln2_g"], p["ln2_b"])
+    y = nn.gelu(h @ p["up_k"].astype(h.dtype) + p["up_b"].astype(h.dtype))
+    x = x + y @ p["down_k"].astype(x.dtype) + p["down_b"].astype(x.dtype)
+    return x
+
+
+class PipelinedLM(nn.Module):
+    """Decoder-only LM with a pipeline-parallel trunk.
+
+    :param n_stages: pipeline stages; ``n_layer % n_stages == 0``.
+    :param n_microbatches: GPipe microbatches; batch must divide evenly.
+    """
+
+    vocab_size: int = 50257
+    n_layer: int = 12
+    n_head: int = 12
+    d_model: int = 768
+    d_ff: int = 0                    # 0 -> 4*d_model
+    max_len: int = 1024
+    n_stages: int = 2
+    n_microbatches: int = 4
+    dtype: Any = jnp.float32
+    mesh: Optional[Any] = None
+
+    def _stacked(self, name, init_std, shape):
+        return self.param(name, _init(init_std), (self.n_layer,) + shape,
+                          jnp.float32)
+
+    @nn.compact
+    def __call__(self, tokens, train: bool = False):
+        if self.n_layer % self.n_stages:
+            raise ValueError(
+                f"n_layer {self.n_layer} not divisible by n_stages "
+                f"{self.n_stages}"
+            )
+        d, f = self.d_model, self.d_ff or 4 * self.d_model
+        L, S = self.n_layer, self.n_stages
+        b, t = tokens.shape
+
+        wte = self.param("wte", _init(0.02), (self.vocab_size, d),
+                         jnp.float32)
+        wpe = self.param("wpe", _init(0.01), (self.max_len, d), jnp.float32)
+        x = (wte[tokens] + wpe[None, :t]).astype(self.dtype)
+
+        ones = nn.initializers.ones
+        zeros = nn.initializers.zeros
+        blocks = {
+            "ln1_g": self.param("ln1_g", ones, (L, d), jnp.float32),
+            "ln1_b": self.param("ln1_b", zeros, (L, d), jnp.float32),
+            "qkv_k": self._stacked("qkv_k", 0.02, (d, 3 * d)),
+            "qkv_b": self.param("qkv_b", zeros, (L, 3 * d), jnp.float32),
+            "out_k": self._stacked("out_k", 0.02 / (2 * L) ** 0.5, (d, d)),
+            "out_b": self.param("out_b", zeros, (L, d), jnp.float32),
+            "ln2_g": self.param("ln2_g", ones, (L, d), jnp.float32),
+            "ln2_b": self.param("ln2_b", zeros, (L, d), jnp.float32),
+            "up_k": self._stacked("up_k", 0.02, (d, f)),
+            "up_b": self.param("up_b", zeros, (L, f), jnp.float32),
+            "down_k": self._stacked("down_k", 0.02 / (2 * L) ** 0.5, (f, d)),
+            "down_b": self.param("down_b", zeros, (L, d), jnp.float32),
+        }
+        # [L, ...] -> [S, L/S, ...]: stage s holds layers [s*L/S, (s+1)*L/S)
+        staged = jax.tree.map(
+            lambda a: a.reshape((S, L // S) + a.shape[1:]), blocks
+        )
+
+        n_head = self.n_head
+
+        def stage_fn(p_stage, mb, _rng):
+            # apply this stage's L/S consecutive layers
+            def layer(x, p_layer):
+                return _block_apply(p_layer, x, n_head), None
+
+            out, _ = jax.lax.scan(layer, mb, p_stage)
+            return out
+
+        m = min(self.n_microbatches, b)
+        if b % m:
+            raise ValueError(
+                f"batch {b} not divisible by n_microbatches {m}"
+            )
+        micro = x.reshape((m, b // m, t, d))
+
+        if self.mesh is not None and "pipe" in self.mesh.axis_names:
+            from ..parallel.pipeline import pipeline_apply
+
+            y = pipeline_apply(stage_fn, staged, micro, self.mesh)
+        else:
+            # no mesh: sequential trunk (same math, no pipelining)
+            def run_one(mb):
+                def st(x, p_stage):
+                    return stage_fn(p_stage, x, None), None
+
+                out, _ = jax.lax.scan(st, mb, staged)
+                return out
+
+            y = jax.vmap(run_one)(micro)
+
+        x = y.reshape(b, t, d)
+        ln_g = self.param("lnf_g", ones, (d,), jnp.float32)
+        ln_b = self.param("lnf_b", zeros, (d,), jnp.float32)
+        x = _layer_norm(x, ln_g, ln_b)
+        logits = x.astype(self.dtype) @ wte.T.astype(self.dtype)
+        return logits.astype(jnp.float32)
+
+    def batch_template(self, batch_size: int = 1):
+        return jnp.zeros((batch_size, min(self.max_len, 16)), jnp.int32)
+
+    def partition_rules(self):
+        """Stacked trunk tensors shard their layer dim over ``pipe`` (the
+        [L] -> [S, L/S] regroup is a contiguous local reshape on each
+        stage); embeddings/head replicate (sharded variants are the
+        TP rules' job in the dense family)."""
+        return [
+            (r"(ln1|ln2|qkv|out|up|down)_[kgb]", P("pipe")),
+            (r"wte|wpe|lnf_[gb]", P()),
+        ]
+
+
+@MODELS.register("PipelinedLM")
+def pipelined_lm(vocab_size: int = 50257, n_layer: int = 12,
+                 n_head: int = 12, d_model: int = 768, max_len: int = 1024,
+                 n_stages: int = 2, n_microbatches: int = 4,
+                 bfloat16: bool = False, mesh=None, **overrides):
+    return PipelinedLM(
+        vocab_size=vocab_size, n_layer=n_layer, n_head=n_head,
+        d_model=d_model, max_len=max_len, n_stages=n_stages,
+        n_microbatches=n_microbatches,
+        dtype=jnp.bfloat16 if bfloat16 else jnp.float32, mesh=mesh,
+        **overrides,
+    )
+
+
+@MODELS.register("TinyPipeLM")
+def tiny_pipe_lm(vocab_size: int = 256, n_layer: int = 4, n_head: int = 4,
+                 d_model: int = 64, max_len: int = 128, n_stages: int = 2,
+                 n_microbatches: int = 4, bfloat16: bool = False, mesh=None):
+    """Small pipelined config for tests and the multi-chip dry run."""
+    return pipelined_lm(
+        vocab_size=vocab_size, n_layer=n_layer, n_head=n_head,
+        d_model=d_model, max_len=max_len, n_stages=n_stages,
+        n_microbatches=n_microbatches, bfloat16=bfloat16, mesh=mesh,
+    )

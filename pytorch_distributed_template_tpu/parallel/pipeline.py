@@ -1,0 +1,139 @@
+"""Pipeline parallelism: GPipe-style microbatch pipelining over ``pipe``.
+
+The reference is DP-only (SURVEY.md §2.3); pipeline parallelism is part of
+this framework's first-class parallelism inventory. TPU-native formulation
+(the pattern used by large JAX trainers on TPU pods):
+
+- the model's repeated trunk is expressed as **stacked stage parameters**
+  (leading dim = number of stages) sharded over the ``pipe`` mesh axis —
+  each device physically holds only its stage's weights;
+- ``shard_map`` runs one program per stage; microbatches stream through a
+  ``lax.scan`` of ``M + S - 1`` ticks where activations hop stage→stage+1
+  via ``lax.ppermute`` each tick (the classic GPipe schedule: fill, steady
+  state, drain — bubble fraction (S-1)/(M+S-1));
+- the ppermute rides ICI and XLA's latency-hiding scheduler overlaps it
+  with the next tick's compute;
+- gradients flow through the whole schedule by plain ``jax.grad`` — the
+  transposed program pipelines in reverse automatically.
+
+``pipeline_apply`` is the reusable op; models opt in by stacking their
+trunk (e.g. ``nn.scan`` over homogeneous blocks) and calling it.
+"""
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def pipeline_apply(stage_fn: Callable, stage_params, microbatches,
+                   mesh: Mesh, axis_name: str = "pipe",
+                   rng: Optional[jax.Array] = None):
+    """Run ``microbatches`` through ``S`` pipeline stages.
+
+    :param stage_fn: ``(params_one_stage, x, rng_or_None) -> y`` applying ONE
+        stage to ONE microbatch; ``y`` must have ``x``'s shape/dtype (a
+        homogeneous trunk — embeddings/heads live outside the pipeline).
+    :param stage_params: pytree whose leaves have leading dim ``S`` (the
+        stacked per-stage weights), sharded ``P('pipe', ...)``.
+    :param microbatches: ``[M, mb, ...]`` array of M microbatches.
+    :param rng: optional base PRNG key; each (stage, tick) folds in its own
+        subkey so dropout differs per stage and microbatch.
+    :returns: ``[M, mb, ...]`` outputs, replicated over ``axis_name``.
+    """
+    if axis_name not in mesh.axis_names or mesh.shape[axis_name] == 1:
+        # No pipe axis: run stages sequentially (scan over the stage dim).
+        n_stages = jax.tree.leaves(stage_params)[0].shape[0]
+
+        def body(x, args):
+            p, s_idx = args
+            r = _stage_rng(rng, s_idx, jnp.int32(0))
+            return stage_fn(p, x, r), None
+
+        def run_one(mb):
+            out, _ = lax.scan(
+                body, mb, (stage_params, jnp.arange(n_stages))
+            )
+            return out
+
+        return jax.vmap(run_one)(microbatches)
+
+    S = mesh.shape[axis_name]
+    has_rng = rng is not None
+    rng_in = rng if has_rng else jax.random.key(0)
+
+    def per_stage(params, x_all, rngs):
+        s = lax.axis_index(axis_name)
+        # shard_map hands this stage its own params slice with a leading
+        # stage dim of 1; drop it.
+        p_local = jax.tree.map(lambda a: jnp.squeeze(a, 0), params)
+        m = x_all.shape[0]
+        perm = [(i, (i + 1) % S) for i in range(S)]
+
+        def tick(carry, t):
+            recv, outs = carry
+            # stage 0 ingests microbatch t (clipped; garbage ticks beyond M
+            # never reach the output window), others take the handoff
+            x_in = jnp.where(
+                s == 0, lax.dynamic_index_in_dim(x_all, jnp.clip(t, 0, m - 1),
+                                                 keepdims=False),
+                recv,
+            )
+            r = _stage_rng(rngs, s, t) if has_rng else None
+            y = stage_fn(p_local, x_in, r)
+            # collect the finished microbatch on the LAST stage: at tick t
+            # it completes microbatch t - (S - 1)
+            mb_idx = t - (S - 1)
+            valid = (s == S - 1) & (mb_idx >= 0)
+            idx = jnp.clip(mb_idx, 0, m - 1)
+            cur = lax.dynamic_index_in_dim(outs, idx, keepdims=False)
+            outs = lax.dynamic_update_index_in_dim(
+                outs, jnp.where(valid, y, cur), idx, 0
+            )
+            recv_new = lax.ppermute(y, axis_name, perm)
+            return (recv_new, outs), None
+
+        recv0 = jnp.zeros_like(x_all[0])
+        outs0 = jnp.zeros_like(x_all)
+        (_, outs), _ = lax.scan(
+            tick, (recv0, outs0), jnp.arange(m + S - 1)
+        )
+        # only the last stage holds real outputs; replicate via psum
+        outs = jnp.where(s == S - 1, outs, jnp.zeros_like(outs))
+        return lax.psum(outs, axis_name)
+
+    # Shard the per-microbatch batch dim over the data-like axes so DP
+    # replicas each pipeline only their own slice (replicating it would make
+    # every data group redo the full global trunk). Falls back to
+    # replication when the microbatch size doesn't divide.
+    import numpy as np
+
+    from .sharding import DATA_AXES
+
+    dp = tuple(
+        a for a in DATA_AXES
+        if a in mesh.axis_names and mesh.shape[a] > 1
+    )
+    dp_total = int(np.prod([mesh.shape[a] for a in dp])) if dp else 1
+    mb_spec = (
+        P(None, dp) if dp and microbatches.shape[1] % dp_total == 0 else P()
+    )
+    in_specs = (
+        jax.tree.map(lambda _: P(axis_name), stage_params),
+        mb_spec,        # replicated over pipe, sharded over data axes
+        P(),
+    )
+    return shard_map(
+        per_stage, mesh=mesh, in_specs=in_specs, out_specs=mb_spec,
+        check_vma=False,
+    )(stage_params, microbatches, rng_in)
+
+
+def _stage_rng(rng, stage_idx, t):
+    if rng is None:
+        return None
+    return jax.random.fold_in(jax.random.fold_in(rng, stage_idx), t)

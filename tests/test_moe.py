@@ -162,6 +162,21 @@ def test_expert_parallel_step_matches_single_device():
                                    rtol=2e-3, atol=1e-5)
 
 
+def test_switch_top1_router_gets_task_gradient():
+    """top_k=1 gates must be the RAW top-1 probability (Switch), not a
+    renormalized 1.0 — else the router is invisible to the task loss."""
+    layer = MoeMlp(d_model=8, d_ff=16, num_experts=4, top_k=1,
+                   capacity_factor=4.0, aux_loss_weight=0.0)
+    x = jax.random.normal(jax.random.key(0), (2, 8, 8))
+    variables = layer.init(jax.random.key(1), x, False)
+
+    def loss(params):
+        return jnp.sum(layer.apply({"params": params}, x, False) ** 2)
+
+    g = jax.grad(loss)(variables["params"])
+    assert float(jnp.abs(g["router"]["kernel"]).max()) > 1e-6
+
+
 def test_moe_masked_padding_exact():
     """Padded examples must not perturb the update: padding claims no
     expert capacity and is excluded from the aux-loss statistics.
