@@ -19,11 +19,14 @@ array-backed datasets in-tree this loader is already IO-free after startup.
 from __future__ import annotations
 
 import collections
+import queue
+import threading
 from typing import Iterable, Iterator, Optional
 
 import jax
 import numpy as np
 
+from . import native
 from .sampler import ShardedSampler, epoch_permutation
 
 
@@ -90,7 +93,11 @@ class ArrayDataLoader:
                 batch_mask = np.concatenate(
                     [batch_mask, np.zeros(pad, dtype=bool)]
                 )
-            batch = {k: v[batch_idx] for k, v in self.arrays.items()}
+            # native multithreaded gather (data/native, the torch-C++-
+            # dataloader equivalent); falls back to numpy per array
+            batch = {
+                k: native.gather(v, batch_idx) for k, v in self.arrays.items()
+            }
             batch["mask"] = batch_mask
             yield batch
 
@@ -99,6 +106,56 @@ class ArrayDataLoader:
         if self.drop_last:
             return idx_len // self.batch_size
         return -(-idx_len // self.batch_size)
+
+
+def host_prefetch(iterable: Iterable, depth: int = 2) -> Iterator:
+    """Assemble batches on a background thread (bounded queue).
+
+    The role of the reference's DataLoader worker processes
+    (base_data_loader.py:19 ``num_workers``): host-side batch gathering
+    overlaps device compute instead of serializing with it. One thread is
+    enough here because gathering is itself multithreaded (data/native).
+    Worker exceptions re-raise at the consuming site.
+    """
+    q: "queue.Queue" = queue.Queue(maxsize=max(depth, 1))
+    done = object()
+    stop = threading.Event()
+
+    def worker():
+        try:
+            for item in iterable:
+                while not stop.is_set():
+                    try:
+                        q.put(item, timeout=0.1)
+                        break
+                    except queue.Full:
+                        pass
+                if stop.is_set():
+                    return
+            q.put(done)
+        except BaseException as e:  # propagate into the consumer
+            if not stop.is_set():
+                q.put(e)
+
+    t = threading.Thread(target=worker, daemon=True)
+    t.start()
+    try:
+        while True:
+            item = q.get()
+            if item is done:
+                break
+            if isinstance(item, BaseException):
+                raise item
+            yield item
+    finally:
+        # abandoned early (consumer raised / generator closed): unblock the
+        # worker so buffered batches don't stay pinned for the process life
+        stop.set()
+        while True:
+            try:
+                q.get_nowait()
+            except queue.Empty:
+                break
 
 
 def prefetch_to_device(iterator: Iterable[dict], sharding,

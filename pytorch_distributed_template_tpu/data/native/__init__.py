@@ -1,0 +1,102 @@
+"""ctypes binding for the native batch assembler (batcher.cpp).
+
+Compiles the C++ on first use with the system g++ (cached in
+``<repo>/.build/``), loads it via ctypes, and exposes ``gather``: a
+multithreaded row-gather used by ``ArrayDataLoader`` as a drop-in fast path
+for numpy fancy indexing. Degrades gracefully: any failure (no compiler,
+unusual platform, non-contiguous arrays) falls back to numpy — mirroring
+the reference's ability to run with ``num_workers: 0``
+(/root/reference/config/debug.json).
+"""
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import threading
+from pathlib import Path
+from typing import Optional
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+_SRC = Path(__file__).parent / "batcher.cpp"
+_BUILD_DIR = Path(__file__).resolve().parents[3] / ".build"
+_LIB_PATH = _BUILD_DIR / "libbatcher.so"
+
+_lock = threading.Lock()
+_lib = None
+_tried = False
+_threads = min(8, os.cpu_count() or 1)
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    """Compile (if stale) and load the shared library; None on failure."""
+    global _lib, _tried
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        try:
+            if (not _LIB_PATH.exists()
+                    or _LIB_PATH.stat().st_mtime < _SRC.stat().st_mtime):
+                _BUILD_DIR.mkdir(parents=True, exist_ok=True)
+                # per-process tmp: concurrent builders must not interleave
+                # writes into one file (os.replace keeps the install atomic)
+                tmp = _LIB_PATH.with_suffix(f".so.tmp{os.getpid()}")
+                subprocess.run(
+                    ["g++", "-O3", "-shared", "-fPIC", "-pthread",
+                     str(_SRC), "-o", str(tmp)],
+                    check=True, capture_output=True, timeout=120,
+                )
+                os.replace(tmp, _LIB_PATH)
+            lib = ctypes.CDLL(str(_LIB_PATH))
+            lib.gather_rows.argtypes = [
+                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
+                ctypes.c_int64, ctypes.c_void_p, ctypes.c_int32,
+            ]
+            lib.gather_rows.restype = None
+            _lib = lib
+        except Exception as e:  # no g++, sandboxed exec, etc.
+            logger.info("native batcher unavailable (%s); using numpy", e)
+            _lib = None
+        return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def gather(src: np.ndarray, idx: np.ndarray) -> np.ndarray:
+    """``src[idx]`` through the native multithreaded gather.
+
+    Falls back to numpy when the library is unavailable or the array
+    layout doesn't qualify (non-contiguous rows).
+    """
+    lib = _load()
+    idx = np.asarray(idx)
+    if (lib is None or not src.flags.c_contiguous or src.ndim < 1
+            or src.itemsize == 0 or src.dtype.hasobject
+            or idx.ndim != 1 or len(idx) == 0
+            or idx.dtype.kind not in "iu"):
+        # numpy handles every non-fast-path case: object arrays (memcpy of
+        # PyObject* would corrupt refcounts), boolean masks and float
+        # indices (an int64 cast would silently select the WRONG rows)
+        return src[idx]
+    idx64 = np.ascontiguousarray(idx, dtype=np.int64)
+    if int(idx64.min()) < 0:
+        idx64 = idx64.copy()
+        idx64[idx64 < 0] += len(src)  # numpy negative-index semantics
+    if int(idx64.min()) < 0 or int(idx64.max()) >= len(src):
+        raise IndexError("gather index out of range")
+    out = np.empty((len(idx64),) + src.shape[1:], dtype=src.dtype)
+    row_bytes = int(np.prod(src.shape[1:], dtype=np.int64)) * src.itemsize
+    if row_bytes == 0:
+        return src[idx]
+    lib.gather_rows(
+        src.ctypes.data, idx64.ctypes.data, len(idx64), row_bytes,
+        out.ctypes.data, _threads,
+    )
+    return out

@@ -31,7 +31,7 @@ import numpy as np
 
 from ..checkpoint import CheckpointManager
 from ..config.registry import LOSSES, METRICS
-from ..data.loader import prefetch_to_device
+from ..data.loader import host_prefetch, prefetch_to_device
 from ..models.base import describe, inject_mesh
 from ..observability import MetricTracker, TensorboardWriter
 from ..observability.profiler import (
@@ -292,9 +292,11 @@ class Trainer(BaseTrainer):
         self.train_metrics.reset()
         self.throughput.reset()  # exclude validation/checkpoint wall time
         accum = None
-        prefetched = prefetch_to_device(
-            (b for _, b in self._batches(epoch)), self.batch_sharding
-        )
+        batches = (b for _, b in self._batches(epoch))
+        depth = int(self.config["trainer"].get("host_prefetch", 2))
+        if depth > 0:
+            batches = host_prefetch(batches, depth)
+        prefetched = prefetch_to_device(batches, self.batch_sharding)
         main = dist.is_main_process()
         for batch_idx, batch in enumerate(prefetched):
             step = (epoch - 1) * self.len_epoch + batch_idx

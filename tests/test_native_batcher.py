@@ -1,0 +1,137 @@
+"""Native C++ batch assembler (data/native) + threaded host prefetch.
+
+The native gather must be bit-identical to numpy fancy indexing across
+dtypes/shapes, bound-checked, and the loader must produce the same batches
+with or without it. ``host_prefetch`` must preserve order and propagate
+worker exceptions.
+"""
+import numpy as np
+import pytest
+
+from pytorch_distributed_template_tpu.data import native
+from pytorch_distributed_template_tpu.data.loader import (
+    ArrayDataLoader, host_prefetch,
+)
+
+
+def test_native_lib_compiles_and_loads():
+    # the image bakes g++ in; if this fails the fallback still works but we
+    # want to KNOW the native path is exercised in CI
+    assert native.available()
+
+
+@pytest.mark.parametrize("dtype", [np.float32, np.uint8, np.int64])
+@pytest.mark.parametrize("shape", [(100,), (64, 28, 28, 3), (50, 7)])
+def test_gather_matches_numpy(dtype, shape):
+    rng = np.random.default_rng(0)
+    src = (rng.normal(size=shape) * 100).astype(dtype)
+    idx = rng.integers(0, shape[0], size=37)
+    np.testing.assert_array_equal(native.gather(src, idx), src[idx])
+
+
+def test_gather_large_multithreaded_path():
+    rng = np.random.default_rng(1)
+    src = rng.normal(size=(512, 3200)).astype(np.float32)  # >1MiB total
+    idx = rng.integers(0, 512, size=256)
+    np.testing.assert_array_equal(native.gather(src, idx), src[idx])
+
+
+def test_gather_bounds_checked():
+    src = np.zeros((10, 4), np.float32)
+    with pytest.raises(IndexError):
+        native.gather(src, np.array([0, 10]))
+    with pytest.raises(IndexError):
+        native.gather(src, np.array([-11]))
+
+
+def test_gather_negative_indices_like_numpy():
+    src = np.arange(40, dtype=np.float32).reshape(10, 4)
+    idx = np.array([-1, 0, -10, 5])
+    np.testing.assert_array_equal(native.gather(src, idx), src[idx])
+
+
+def test_gather_non_contiguous_falls_back():
+    src = np.asfortranarray(np.arange(40, dtype=np.float32).reshape(10, 4))
+    idx = np.array([3, 1, 2])
+    np.testing.assert_array_equal(native.gather(src, idx), src[idx])
+
+
+def test_gather_object_dtype_falls_back():
+    # memcpy of PyObject* would corrupt refcounts; must use numpy
+    src = np.array([["a"], ["bb"], ["ccc"]], dtype=object)
+    idx = np.array([2, 0, 2])
+    out = native.gather(src, idx)
+    np.testing.assert_array_equal(out, src[idx])
+    assert out[0, 0] is src[2, 0]
+
+
+def test_gather_float_index_raises_like_numpy():
+    src = np.zeros((10, 4), np.float32)
+    with pytest.raises(IndexError):
+        native.gather(src, np.array([1.7, 2.3]))
+    # boolean masks also go through numpy semantics
+    mask = np.zeros(10, dtype=bool)
+    mask[[1, 4]] = True
+    np.testing.assert_array_equal(native.gather(src, mask), src[mask])
+
+
+def test_loader_batches_identical_with_native():
+    rng = np.random.default_rng(2)
+    arrays = {
+        "image": rng.normal(size=(100, 8, 8, 1)).astype(np.float32),
+        "label": rng.integers(0, 10, 100).astype(np.int32),
+    }
+    loader = ArrayDataLoader(arrays, batch_size=32, shuffle=True, seed=3)
+    loader.set_epoch(1)
+    batches = list(loader)
+    # reference: plain numpy gather over the same epoch permutation
+    from pytorch_distributed_template_tpu.data.sampler import (
+        epoch_permutation,
+    )
+
+    idx = epoch_permutation(3, 1, 100)
+    np.testing.assert_array_equal(batches[0]["image"],
+                                  arrays["image"][idx[:32]])
+    assert sum(int(b["mask"].sum()) for b in batches) == 100
+
+
+def test_host_prefetch_order_and_exhaustion():
+    out = list(host_prefetch(iter(range(20)), depth=3))
+    assert out == list(range(20))
+
+
+def test_host_prefetch_propagates_exceptions():
+    def gen():
+        yield 1
+        raise RuntimeError("loader blew up")
+
+    it = host_prefetch(gen(), depth=2)
+    assert next(it) == 1
+    with pytest.raises(RuntimeError, match="blew up"):
+        list(it)
+
+
+def test_host_prefetch_early_close_unblocks_worker():
+    import threading
+    import time
+
+    started = threading.Event()
+    produced = []
+
+    def gen():
+        for i in range(1000):
+            started.set()
+            produced.append(i)
+            yield i
+
+    it = host_prefetch(gen(), depth=1)
+    assert next(it) == 0
+    started.wait(5)
+    it.close()  # consumer abandons mid-stream
+    # worker must notice the stop flag and exit rather than blocking in
+    # q.put() forever; give it a moment then confirm production halted
+    time.sleep(0.5)
+    n = len(produced)
+    time.sleep(0.3)
+    assert len(produced) == n
+    assert n < 1000
