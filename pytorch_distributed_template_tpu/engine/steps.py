@@ -48,7 +48,8 @@ def make_train_step(model, tx, criterion: Callable,
                     input_key: str = "image", target_key: str = "label",
                     grad_clip_norm: float = 0.0,
                     grad_accum_steps: int = 1,
-                    ema_decay: float = 0.0):
+                    ema_decay: float = 0.0,
+                    skip_nonfinite: bool = False):
     """Build ``train_step(state, batch) -> (state, metrics)``.
 
     ``metrics`` holds scalar sums + count; callers divide after accumulating
@@ -67,6 +68,15 @@ def make_train_step(model, tx, criterion: Callable,
 
     ``ema_decay > 0`` maintains ``state.ema_params`` (shadow weights) with
     ``ema = d*ema + (1-d)*params`` after each update.
+
+    ``skip_nonfinite`` guards the update in-graph: when any gradient leaf
+    (or the loss) is non-finite the whole update is suppressed via
+    ``jnp.where`` — params/opt_state/EMA keep their old values and
+    ``skipped_sum`` counts the event — instead of poisoning the weights.
+    A branchless select keeps the step a single static XLA program (no
+    host round-trip, unlike torch-style ``if not torch.isfinite(loss)``
+    Python checks). The step counter still advances so dropout keys and
+    schedules stay aligned with wall progress.
     """
     pass_example_mask = _accepts_example_mask(model)
 
@@ -173,8 +183,35 @@ def make_train_step(model, tx, criterion: Callable,
             scale = jnp.minimum(1.0, grad_clip_norm / (gnorm + 1e-6))
             grads = jax.tree.map(lambda g: g * scale, grads)
 
+        ok = jnp.array(True)
+        if skip_nonfinite:
+            ok = jnp.isfinite(loss_sum)
+            for g in jax.tree.leaves(grads):
+                ok = ok & jnp.all(jnp.isfinite(g))
+            # zero the grads on a bad step so the (discarded) optimizer
+            # update below is NaN-free even under jax_debug_nans
+            grads = jax.tree.map(
+                lambda g: jnp.where(ok, g, jnp.zeros_like(g)), grads
+            )
+
         updates, new_opt_state = tx.update(grads, state.opt_state, state.params)
         new_params = optax.apply_updates(state.params, updates)
+        if skip_nonfinite:
+            # branchless select: a suppressed step leaves params/opt_state/
+            # batch_stats bit-identical (no host round-trip, stays one XLA
+            # program), and its contaminated sufficient statistics are
+            # zeroed so epoch aggregates exclude the bad batch entirely
+            sel = lambda n, o: jnp.where(ok, n, o)  # noqa: E731
+            new_params = jax.tree.map(sel, new_params, state.params)
+            new_opt_state = jax.tree.map(sel, new_opt_state, state.opt_state)
+            new_stats = jax.tree.map(sel, new_stats, state.batch_stats)
+            metrics = {
+                kk: jnp.where(ok, v, jnp.zeros_like(v))
+                for kk, v in metrics.items()
+            }
+            metrics["skipped_sum"] = (
+                (1.0 - ok.astype(jnp.float32)) * jnp.maximum(count, 1.0)
+            )
         new_ema = state.ema_params
         if ema_decay > 0 and new_ema is not None:
             d = jnp.float32(ema_decay)
@@ -182,6 +219,11 @@ def make_train_step(model, tx, criterion: Callable,
                 lambda e, p: (e * d + p.astype(e.dtype) * (1 - d)),
                 new_ema, new_params,
             )
+            if skip_nonfinite:
+                new_ema = jax.tree.map(
+                    lambda n, o: jnp.where(ok, n, o),
+                    new_ema, state.ema_params,
+                )
         new_state = state.replace(
             step=state.step + 1,
             params=new_params,

@@ -39,6 +39,8 @@ from ..observability.profiler import (
 )
 from ..parallel import batch_sharding, dist, mesh_from_config
 from ..parallel.sharding import apply_rules
+from ..utils import preemption
+from ..utils.debug import configure_debug
 from .optim import build_optimizer
 from .state import create_train_state
 from .steps import finalize_metrics, make_eval_step, make_train_step
@@ -100,6 +102,7 @@ class BaseTrainer:
         gated on the main process. Early stop therefore needs no cross-host
         consensus exchange.
         """
+        preemption.install()
         not_improved_count = 0
         log: dict = {}
         for epoch in range(self.start_epoch, self.epochs + 1):
@@ -138,6 +141,18 @@ class BaseTrainer:
                 else:
                     not_improved_count += 1
 
+            if preemption.sync_requested():
+                # any host got SIGTERM: checkpoint NOW (regardless of
+                # save_period) and stop everywhere together — resume loses
+                # at most the in-flight epoch (utils/preemption.py)
+                if dist.is_main_process():
+                    self.logger.warning(
+                        "Preemption signal received; saving checkpoint at "
+                        "epoch %d and stopping.", epoch,
+                    )
+                self._save_checkpoint(epoch, save_best=best)
+                break
+
             if epoch % self.save_period == 0:
                 self._save_checkpoint(epoch, save_best=best)
 
@@ -175,6 +190,7 @@ class Trainer(BaseTrainer):
                  train_loader, valid_loader=None, len_epoch: Optional[int] = None,
                  mesh=None, seed: int = 0):
         super().__init__(config)
+        configure_debug(config["trainer"].get("debug"))
         self.mesh = mesh if mesh is not None else mesh_from_config(config)
         model = inject_mesh(model, self.mesh)
         self.model = model
@@ -228,20 +244,26 @@ class Trainer(BaseTrainer):
         # --- compile the hot loop -----------------------------------------
         grad_clip = config["trainer"].get("grad_clip_norm", 0.0)
         grad_accum = int(config["trainer"].get("grad_accum_steps", 1))
+        self.skip_nonfinite = bool(
+            config["trainer"].get("skip_nonfinite", False)
+        )
         train_step = make_train_step(
             model, self.tx, criterion, self.metric_ftns,
             input_key=self.input_key, target_key=self.target_key,
             grad_clip_norm=grad_clip, grad_accum_steps=grad_accum,
-            ema_decay=ema_decay,
+            ema_decay=ema_decay, skip_nonfinite=self.skip_nonfinite,
         )
         metric_sharding = jax.sharding.NamedSharding(
             self.mesh, jax.sharding.PartitionSpec()
+        )
+        train_keys = self._metric_keys() + (
+            ["skipped_sum"] if self.skip_nonfinite else []
         )
         self._train_step = jax.jit(
             train_step,
             donate_argnums=0,
             out_shardings=(self.state_sharding,
-                           {k: metric_sharding for k in self._metric_keys()}),
+                           {k: metric_sharding for k in train_keys}),
         )
         eval_step = make_eval_step(
             model, criterion, self.metric_ftns,

@@ -1,0 +1,42 @@
+"""Debug modes: NaN checking and interpreted (jit-less) execution.
+
+SURVEY.md §5 "race detection / sanitizers": the reference has no sanitizer
+tooling at all; on TPU, collective-order safety already comes free from
+XLA's compiled SPMD, so the useful debug switches are numeric and
+structural:
+
+- ``nan_check``: ``jax.config jax_debug_nans`` — every jitted computation
+  re-runs eagerly when a NaN appears and raises at the exact primitive
+  that produced it (the analogue of ``torch.autograd.set_detect_anomaly``).
+- ``disable_jit``: op-by-op interpretation, so Python debuggers (pdb,
+  print) see intermediate values — the analogue of the reference's
+  commented-out pdb breakpoints in its hot path
+  (/root/reference/trainer/trainer.py:52-54).
+
+Both are process-global, trade large slowdowns for observability, and are
+meant for the debug-config tier (configs/mnist_debug.json), never
+production runs.
+"""
+from __future__ import annotations
+
+import logging
+
+import jax
+
+logger = logging.getLogger(__name__)
+
+
+def configure_debug(debug_cfg: dict | None) -> None:
+    """Apply the ``trainer.debug`` config block (no-op when absent/empty).
+
+    Schema: ``{"nan_check": bool, "disable_jit": bool}``.
+    """
+    if not debug_cfg:
+        return
+    if debug_cfg.get("nan_check"):
+        jax.config.update("jax_debug_nans", True)
+        logger.warning("debug: jax_debug_nans enabled (slow; re-runs jitted "
+                       "computations eagerly on NaN)")
+    if debug_cfg.get("disable_jit"):
+        jax.config.update("jax_disable_jit", True)
+        logger.warning("debug: jit disabled (op-by-op interpretation)")

@@ -1,0 +1,70 @@
+"""Preemption-aware graceful shutdown.
+
+SURVEY.md §5 "failure detection / elastic recovery": the reference's only
+fault tolerance is crash -> relaunch -> resume-from-checkpoint
+(/root/reference/base/base_trainer.py:134-163); a SIGTERM mid-epoch loses
+all progress since the last ``save_period`` checkpoint. TPU VMs receive a
+termination notice (SIGTERM) before maintenance/preemption events, so the
+trainer can convert that notice into an immediate checkpoint + clean exit,
+making resume lose at most the in-flight epoch.
+
+Design: a signal handler flips a process-local flag (async-signal-safe: no
+I/O, no locks in the handler). The trainer polls the flag at epoch
+boundaries through :func:`sync_requested`, which reaches *consensus across
+hosts* — any host signalled => every host checkpoints and stops together,
+the same any-rank-triggers-all shape as the reference's early-stop
+consensus (base_trainer.py:101-107) — because a one-host exit would hang
+the others' next collective.
+"""
+from __future__ import annotations
+
+import logging
+import signal
+import threading
+from typing import Iterable
+
+from ..parallel import dist
+
+logger = logging.getLogger(__name__)
+
+_flag = threading.Event()
+_installed = False
+
+
+def _handler(signum, frame):  # noqa: ARG001 (signal signature)
+    _flag.set()
+
+
+def install(signals: Iterable[int] = (signal.SIGTERM,)) -> None:
+    """Install the preemption handler (main thread only; idempotent)."""
+    global _installed
+    if _installed:
+        return
+    try:
+        for s in signals:
+            signal.signal(s, _handler)
+        _installed = True
+    except ValueError:  # not the main thread (e.g. tests run in a worker)
+        logger.info("preemption handler not installed (non-main thread)")
+
+
+def requested() -> bool:
+    """This process's local flag (no cross-host exchange)."""
+    return _flag.is_set()
+
+
+def sync_requested() -> bool:
+    """Cross-host consensus: True iff ANY host saw a preemption signal.
+
+    Single-host this is just the local flag; multi-host it is one small
+    host-collective (``all_gather_object`` over DCN), called only at epoch
+    edges so its cost is irrelevant.
+    """
+    if dist.process_count() == 1:
+        return _flag.is_set()
+    return any(dist.all_gather_object(_flag.is_set()))
+
+
+def reset() -> None:
+    """Clear the flag (tests)."""
+    _flag.clear()

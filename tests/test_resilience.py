@@ -1,0 +1,187 @@
+"""Resilience/debug tier: non-finite guard, preemption, debug modes.
+
+SURVEY.md §5 rows "race detection / sanitizers" and "failure detection":
+the reference has neither; these are the TPU-native additions.
+"""
+import json
+import os
+import signal
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from flax import linen as nn
+
+from pytorch_distributed_template_tpu.engine.state import create_train_state
+from pytorch_distributed_template_tpu.engine.steps import make_train_step
+from pytorch_distributed_template_tpu.utils import preemption
+from pytorch_distributed_template_tpu.utils.debug import configure_debug
+
+from test_e2e_mnist import build_trainer, make_config
+
+
+class _Tiny(nn.Module):
+    @nn.compact
+    def __call__(self, x, train=False):
+        return nn.Dense(4)(x)
+
+
+class _TinyBN(nn.Module):
+    @nn.compact
+    def __call__(self, x, train=False):
+        x = nn.BatchNorm(use_running_average=not train)(x)
+        return nn.Dense(4)(x)
+
+
+def _sq_err(output, target):
+    return jnp.sum((output - target[:, None].astype(output.dtype)) ** 2,
+                   axis=-1)
+
+
+def _make(skip_nonfinite, ema_decay=0.0, model=None):
+    model = model if model is not None else _Tiny()
+    tx = optax.sgd(0.05)
+    sample = jnp.ones((1, 3), jnp.float32)
+    state = create_train_state(model, tx, sample, seed=0,
+                               with_ema=ema_decay > 0)
+    step = jax.jit(make_train_step(
+        model, tx, _sq_err, skip_nonfinite=skip_nonfinite,
+        ema_decay=ema_decay,
+    ))
+    return state, step
+
+
+def _batch(poison=False):
+    x = np.ones((8, 3), np.float32)
+    if poison:
+        x[3, 1] = np.inf
+    return {
+        "image": jnp.asarray(x),
+        "label": jnp.zeros((8,), jnp.int32),
+        "mask": jnp.ones((8,), bool),
+    }
+
+
+def test_skip_nonfinite_suppresses_bad_update():
+    state, step = _make(skip_nonfinite=True)
+    before = jax.tree.map(np.asarray, state.params)
+
+    state, m = step(state, _batch(poison=True))
+    assert float(m["skipped_sum"]) == 8.0
+    # contaminated statistics are zeroed out of the epoch aggregates
+    assert float(m["count"]) == 0.0
+    assert float(m["loss_sum"]) == 0.0
+    for a, b in zip(jax.tree.leaves(before),
+                    jax.tree.leaves(state.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert int(state.step) == 1  # counter still advances
+
+    state, m = step(state, _batch(poison=False))
+    assert float(m["skipped_sum"]) == 0.0
+    assert float(m["count"]) == 8.0
+    assert np.isfinite(float(m["loss_sum"]))
+    changed = any(
+        not np.array_equal(np.asarray(a), np.asarray(b))
+        for a, b in zip(jax.tree.leaves(before),
+                        jax.tree.leaves(state.params))
+    )
+    assert changed
+
+
+def test_skip_nonfinite_guards_batch_stats():
+    """BatchNorm running statistics must not absorb the poisoned forward
+    pass — they feed every later eval and checkpoint."""
+    state, step = _make(skip_nonfinite=True, model=_TinyBN())
+    stats_before = jax.tree.map(np.asarray, state.batch_stats)
+    state, _ = step(state, _batch(poison=True))
+    for a, b in zip(jax.tree.leaves(stats_before),
+                    jax.tree.leaves(state.batch_stats)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert all(
+        np.isfinite(np.asarray(s)).all()
+        for s in jax.tree.leaves(state.batch_stats)
+    )
+    # clean step does update the running stats
+    state, _ = step(state, _batch(poison=False))
+    changed = any(
+        not np.array_equal(np.asarray(a), np.asarray(b))
+        for a, b in zip(jax.tree.leaves(stats_before),
+                        jax.tree.leaves(state.batch_stats))
+    )
+    assert changed
+
+
+def test_skip_nonfinite_guards_ema_and_opt_state():
+    state, step = _make(skip_nonfinite=True, ema_decay=0.9)
+    ema_before = jax.tree.map(np.asarray, state.ema_params)
+    opt_before = jax.tree.map(
+        np.asarray, jax.tree.leaves(state.opt_state)
+    )
+    state, _ = step(state, _batch(poison=True))
+    for a, b in zip(jax.tree.leaves(ema_before),
+                    jax.tree.leaves(state.ema_params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    for a, b in zip(opt_before, jax.tree.leaves(state.opt_state)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_without_guard_nan_poisons_params():
+    state, step = _make(skip_nonfinite=False)
+    state, m = step(state, _batch(poison=True))
+    assert "skipped_sum" not in m
+    leaves = [np.asarray(p) for p in jax.tree.leaves(state.params)]
+    assert any(not np.isfinite(p).all() for p in leaves)
+
+
+def test_sigterm_sets_flag_and_consensus():
+    preemption.reset()
+    preemption.install()
+    assert not preemption.requested()
+    assert not preemption.sync_requested()
+    os.kill(os.getpid(), signal.SIGTERM)
+    assert preemption.requested()
+    assert preemption.sync_requested()  # single-host consensus == local
+    preemption.reset()
+
+
+def test_preemption_checkpoints_and_stops(tmp_path):
+    """Flag set during epoch 1 -> checkpoint saved even outside save_period,
+    loop exits after that epoch."""
+    config = make_config(
+        tmp_path, run_id="preempt",
+        **{"trainer;epochs": 3, "trainer;save_period": 5},
+    )
+    t = build_trainer(config)
+    preemption.reset()
+    preemption._flag.set()
+    try:
+        log = t.train()
+    finally:
+        preemption.reset()
+    assert log["epoch"] == 1
+    assert (config.save_dir / "checkpoint-epoch1").is_dir()
+    assert not (config.save_dir / "checkpoint-epoch2").exists()
+    # the forced save is resumable
+    meta = json.loads(
+        (config.save_dir / "checkpoint-epoch1.meta.json").read_text()
+    )
+    assert meta["epoch"] == 1
+
+
+def test_configure_debug_flags():
+    try:
+        configure_debug({"nan_check": True, "disable_jit": True})
+        assert jax.config.jax_debug_nans
+        assert jax.config.jax_disable_jit
+    finally:
+        jax.config.update("jax_debug_nans", False)
+        jax.config.update("jax_disable_jit", False)
+
+
+def test_configure_debug_noop():
+    configure_debug(None)
+    configure_debug({})
+    assert not jax.config.jax_debug_nans
+    assert not jax.config.jax_disable_jit
