@@ -281,14 +281,25 @@ def make_eval_step(model, criterion: Callable,
 
 
 def finalize_metrics(sums: Dict[str, float]) -> Dict[str, float]:
-    """Convert accumulated sufficient statistics to averages."""
-    count = float(sums.get("count", 1.0)) or 1.0
+    """Convert accumulated sufficient statistics to averages.
+
+    ``count == 0`` (every batch skipped by the non-finite guard) yields
+    NaN averages, not 0.0 — a 0.0 loss would be recorded as an unbeatable
+    false best by a ``min``-mode monitor. ``skipped_sum`` is a raw example
+    count, not an average (its examples are excluded from ``count``).
+    """
+    raw_count = float(sums.get("count", 1.0))
+    count = raw_count or 1.0
     out = {}
     for k, v in sums.items():
         if k == "count":
             continue
-        if k.endswith("_sum"):
-            out[k[: -len("_sum")]] = float(v) / count
+        if k == "skipped_sum":
+            out["skipped"] = float(v)
+        elif k.endswith("_sum"):
+            out[k[: -len("_sum")]] = (
+                float(v) / count if raw_count > 0 else float("nan")
+            )
         else:
             out[k] = float(v)
     return out

@@ -320,6 +320,17 @@ class Trainer(BaseTrainer):
             batches = host_prefetch(batches, depth)
         prefetched = prefetch_to_device(batches, self.batch_sharding)
         main = dist.is_main_process()
+        # Mid-epoch preemption polling: the SIGTERM notice window (~30s on
+        # cloud TPUs) is far shorter than an ImageNet epoch, so waiting for
+        # the epoch edge would forfeit the save. Single-host polls the free
+        # local flag every batch; multi-host polls the consensus collective
+        # every preempt_check_steps batches so every host breaks at the
+        # SAME batch (a lone early exit would hang peers' collectives).
+        check_every = max(
+            int(self.config["trainer"].get("preempt_check_steps", 100)), 1
+        )
+        single_host = dist.process_count() == 1
+        preempted = False  # consensus result: identical on every host
         for batch_idx, batch in enumerate(prefetched):
             step = (epoch - 1) * self.len_epoch + batch_idx
             self.trace.before_step(step)
@@ -362,12 +373,24 @@ class Trainer(BaseTrainer):
                 )
                 self._log_input_images(batch)
 
+            if ((single_host or (batch_idx + 1) % check_every == 0)
+                    and preemption.sync_requested()):
+                preempted = True
+                if main:
+                    self.logger.warning(
+                        "Preemption signal: breaking epoch %d at batch %d "
+                        "(partial epoch will be checkpointed).",
+                        epoch, batch_idx + 1,
+                    )
+                break
+
         log = (
             finalize_metrics(jax.tree.map(float, accum)) if accum else {}
         )
         # Keep the tracker's smoothed loss for TB parity, but report the
-        # exact global epoch averages.
-        if self.do_validation:
+        # exact global epoch averages. A preempted epoch skips validation —
+        # the SIGTERM notice window is for checkpointing, not eval.
+        if self.do_validation and not preempted:
             val_log = self._valid_epoch(epoch)
             log.update(**{f"val_{k}": v for k, v in val_log.items()})
         return log

@@ -8,31 +8,34 @@ termination notice (SIGTERM) before maintenance/preemption events, so the
 trainer can convert that notice into an immediate checkpoint + clean exit,
 making resume lose at most the in-flight epoch.
 
-Design: a signal handler flips a process-local flag (async-signal-safe: no
-I/O, no locks in the handler). The trainer polls the flag at epoch
-boundaries through :func:`sync_requested`, which reaches *consensus across
-hosts* — any host signalled => every host checkpoints and stops together,
-the same any-rank-triggers-all shape as the reference's early-stop
-consensus (base_trainer.py:101-107) — because a one-host exit would hang
-the others' next collective.
+Design: a signal handler flips a process-local plain bool (async-signal-
+safe: a module-global store, no locks/IO — ``threading.Event.set`` would
+take a non-reentrant lock and can deadlock under a re-sent SIGTERM). The
+trainer polls the local flag cheaply every batch and reaches *consensus
+across hosts* every ``preempt_check_steps`` batches and at epoch edges
+through :func:`sync_requested` — any host signalled => every host
+checkpoints and stops together at the same step, the same
+any-rank-triggers-all shape as the reference's early-stop consensus
+(base_trainer.py:101-107) — because a one-host mid-epoch exit would hang
+the other hosts' next collective.
 """
 from __future__ import annotations
 
 import logging
 import signal
-import threading
 from typing import Iterable
 
 from ..parallel import dist
 
 logger = logging.getLogger(__name__)
 
-_flag = threading.Event()
+_flag = False
 _installed = False
 
 
 def _handler(signum, frame):  # noqa: ARG001 (signal signature)
-    _flag.set()
+    global _flag
+    _flag = True
 
 
 def install(signals: Iterable[int] = (signal.SIGTERM,)) -> None:
@@ -49,22 +52,31 @@ def install(signals: Iterable[int] = (signal.SIGTERM,)) -> None:
 
 
 def requested() -> bool:
-    """This process's local flag (no cross-host exchange)."""
-    return _flag.is_set()
+    """This process's local flag (no cross-host exchange; free to poll)."""
+    return _flag
 
 
 def sync_requested() -> bool:
     """Cross-host consensus: True iff ANY host saw a preemption signal.
 
     Single-host this is just the local flag; multi-host it is one small
-    host-collective (``all_gather_object`` over DCN), called only at epoch
-    edges so its cost is irrelevant.
+    host-collective (``all_gather_object`` over DCN). Callers MUST invoke
+    it at the same point on every host (epoch edge, or every
+    ``preempt_check_steps`` batches) — that alignment is what makes the
+    mid-epoch stop collective-safe.
     """
     if dist.process_count() == 1:
-        return _flag.is_set()
-    return any(dist.all_gather_object(_flag.is_set()))
+        return _flag
+    return any(dist.all_gather_object(_flag))
+
+
+def set_local() -> None:
+    """Set the flag as if a signal had arrived (tests)."""
+    global _flag
+    _flag = True
 
 
 def reset() -> None:
     """Clear the flag (tests)."""
-    _flag.clear()
+    global _flag
+    _flag = False

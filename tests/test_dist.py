@@ -1,0 +1,40 @@
+"""The dist seam's single-host graceful degradation (SURVEY.md §4d).
+
+The reference's de-facto test strategy is that every dist helper works
+without a launcher (/root/reference/utils/dist.py:8-14,18-21,25-28,43-44);
+our analogues must degrade the same way so the whole stack runs (and is
+testable) in one process.
+"""
+from pytorch_distributed_template_tpu.parallel import dist
+
+
+def test_introspection_single_host():
+    assert dist.process_index() == 0
+    assert dist.process_count() == 1
+    assert dist.is_main_process()
+    assert dist.global_device_count() >= dist.local_device_count() >= 1
+
+
+def test_synchronize_noop():
+    dist.synchronize("test-edge")  # must not hang or require peers
+
+
+def test_all_gather_object_degrades():
+    obj = {"count": 3, "name": "rank0", "arr": [1, 2]}
+    out = dist.all_gather_object(obj)
+    assert out == [obj]
+    assert out[0] is obj  # no pickle round-trip needed single-host
+
+
+def test_broadcast_object_degrades():
+    obj = ("payload", 42)
+    assert dist.broadcast_object(obj) is obj
+
+
+def test_initialize_noop_single_host(monkeypatch):
+    # no coordinator env vars set -> must not attempt a rendezvous
+    for var in ("JAX_COORDINATOR_ADDRESS", "COORDINATOR_ADDRESS",
+                "NUM_PROCESSES", "TPU_WORKER_HOSTNAMES"):
+        monkeypatch.delenv(var, raising=False)
+    dist.initialize()  # would raise/hang if it tried to rendezvous
+    assert dist.process_count() == 1

@@ -155,12 +155,15 @@ def test_preemption_checkpoints_and_stops(tmp_path):
     )
     t = build_trainer(config)
     preemption.reset()
-    preemption._flag.set()
+    preemption.set_local()
     try:
         log = t.train()
     finally:
         preemption.reset()
     assert log["epoch"] == 1
+    # mid-epoch polling: single-host checks every batch, so the epoch was
+    # cut at its first batch and validation was skipped entirely
+    assert "val_loss" not in log
     assert (config.save_dir / "checkpoint-epoch1").is_dir()
     assert not (config.save_dir / "checkpoint-epoch2").exists()
     # the forced save is resumable
@@ -168,6 +171,20 @@ def test_preemption_checkpoints_and_stops(tmp_path):
         (config.save_dir / "checkpoint-epoch1.meta.json").read_text()
     )
     assert meta["epoch"] == 1
+
+
+def test_finalize_metrics_zero_count_is_nan_not_false_best():
+    from pytorch_distributed_template_tpu.engine.steps import (
+        finalize_metrics,
+    )
+
+    out = finalize_metrics(
+        {"loss_sum": 0.0, "count": 0.0, "skipped_sum": 16.0}
+    )
+    assert np.isnan(out["loss"])  # NOT 0.0 (unbeatable min-monitor best)
+    assert out["skipped"] == 16.0  # raw example count, not a ratio
+    # a 'min loss' monitor must treat NaN as not-improved
+    assert not (out["loss"] <= 2.0)
 
 
 def test_configure_debug_flags():

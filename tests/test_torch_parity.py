@@ -1,0 +1,147 @@
+"""Numerical parity against the reference's stack (SURVEY.md §4).
+
+The reference trains ``MnistModel`` with NLL loss + SGD on torch
+(/root/reference/model/model.py, model/loss.py, train.py:42). Here the same
+weights are loaded into both our flax LeNet and a torch replica of the
+reference model, then both are trained for several SGD steps on identical
+batches: per-step losses, gradients (step 1), and final accuracies must
+agree to float tolerance. This pins down layout translation (NHWC vs NCHW,
+flatten order), loss definition, and optimizer math in one test.
+
+Dropout is inactive (both frameworks' RNGs differ by construction); the
+parity target is the deterministic compute graph.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+import torch
+import torch.nn.functional as F
+from torch import nn
+
+from pytorch_distributed_template_tpu.config.registry import (
+    LOSSES, METRICS, MODELS,
+)
+import pytorch_distributed_template_tpu.engine  # noqa: F401  (register losses)
+import pytorch_distributed_template_tpu.models  # noqa: F401
+
+LR = 0.05
+STEPS = 5
+BATCH = 32
+
+
+class TorchLeNet(nn.Module):
+    """The reference MnistModel's architecture (model/model.py:6-22),
+    restated in torch for the oracle side."""
+
+    def __init__(self, num_classes=10):
+        super().__init__()
+        self.conv1 = nn.Conv2d(1, 10, kernel_size=5)
+        self.conv2 = nn.Conv2d(10, 20, kernel_size=5)
+        self.fc1 = nn.Linear(320, 50)
+        self.fc2 = nn.Linear(50, num_classes)
+
+    def forward(self, x):
+        x = F.relu(F.max_pool2d(self.conv1(x), 2))
+        x = F.relu(F.max_pool2d(self.conv2(x), 2))
+        x = x.flatten(1)
+        x = F.relu(self.fc1(x))
+        return F.log_softmax(self.fc2(x), dim=1)
+
+
+def _copy_params_to_torch(params, tmodel):
+    """flax NHWC params -> torch NCHW; flatten order reconciled for fc1."""
+    p = jax.tree.map(np.asarray, params)
+    with torch.no_grad():
+        # conv kernels: [H, W, Cin, Cout] -> [Cout, Cin, H, W]
+        tmodel.conv1.weight.copy_(
+            torch.from_numpy(p["Conv_0"]["kernel"].transpose(3, 2, 0, 1)))
+        tmodel.conv1.bias.copy_(torch.from_numpy(p["Conv_0"]["bias"]))
+        tmodel.conv2.weight.copy_(
+            torch.from_numpy(p["Conv_1"]["kernel"].transpose(3, 2, 0, 1)))
+        tmodel.conv2.bias.copy_(torch.from_numpy(p["Conv_1"]["bias"]))
+        # fc1: flax flattens (H, W, C), torch flattens (C, H, W)
+        k = p["Dense_0"]["kernel"].reshape(4, 4, 20, 50)
+        k = k.transpose(2, 0, 1, 3).reshape(320, 50)
+        tmodel.fc1.weight.copy_(torch.from_numpy(k.T))
+        tmodel.fc1.bias.copy_(torch.from_numpy(p["Dense_0"]["bias"]))
+        tmodel.fc2.weight.copy_(torch.from_numpy(p["Dense_1"]["kernel"].T))
+        tmodel.fc2.bias.copy_(torch.from_numpy(p["Dense_1"]["bias"]))
+
+
+@pytest.fixture(scope="module")
+def batches():
+    rng = np.random.default_rng(7)
+    xs = rng.normal(size=(STEPS, BATCH, 28, 28, 1)).astype(np.float32)
+    ys = rng.integers(0, 10, size=(STEPS, BATCH)).astype(np.int64)
+    return xs, ys
+
+
+def _train_jax(xs, ys):
+    model = MODELS.get("LeNet")(num_classes=10)
+    params = model.init(jax.random.PRNGKey(0), model.batch_template(1))[
+        "params"
+    ]
+    criterion = LOSSES.get("nll_loss")
+    tx = optax.sgd(LR)
+    opt_state = tx.init(params)
+
+    def loss_fn(params, x, y):
+        out = model.apply({"params": params}, x, train=False)
+        return jnp.mean(criterion(out, y)), out
+
+    grad_fn = jax.jit(jax.value_and_grad(loss_fn, has_aux=True))
+    losses, accs, first_grads = [], [], None
+    for i in range(STEPS):
+        (loss, out), grads = grad_fn(
+            params, jnp.asarray(xs[i]), jnp.asarray(ys[i])
+        )
+        if first_grads is None:
+            first_grads = jax.tree.map(np.asarray, grads)
+        losses.append(float(loss))
+        accs.append(float(jnp.mean(
+            METRICS.get("accuracy")(out, jnp.asarray(ys[i]))
+        )))
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+    return params, losses, accs, first_grads
+
+
+def _train_torch(params, xs, ys):
+    tmodel = TorchLeNet().eval()  # eval: dropout off, like train=False
+    _copy_params_to_torch(params, tmodel)
+    opt = torch.optim.SGD(tmodel.parameters(), lr=LR)
+    losses, accs, first_grad = [], [], None
+    for i in range(STEPS):
+        x = torch.from_numpy(xs[i].transpose(0, 3, 1, 2))  # NHWC -> NCHW
+        y = torch.from_numpy(ys[i])
+        opt.zero_grad()
+        out = tmodel(x)
+        loss = F.nll_loss(out, y)
+        loss.backward()
+        if first_grad is None:
+            first_grad = tmodel.conv1.weight.grad.detach().numpy().copy()
+        losses.append(float(loss))
+        accs.append(float((out.argmax(1) == y).float().mean()))
+        opt.step()
+    return tmodel, losses, accs, first_grad
+
+
+def test_loss_trajectory_matches_reference_stack(batches):
+    xs, ys = batches
+    model = MODELS.get("LeNet")(num_classes=10)
+    init_params = model.init(
+        jax.random.PRNGKey(0), model.batch_template(1)
+    )["params"]
+
+    _, jax_losses, jax_accs, jax_grads = _train_jax(xs, ys)
+    _, t_losses, t_accs, t_grad = _train_torch(init_params, xs, ys)
+
+    np.testing.assert_allclose(jax_losses, t_losses, rtol=2e-4, atol=2e-5)
+    np.testing.assert_allclose(jax_accs, t_accs, atol=1e-6)
+    # gradient parity at step 1 (conv1 kernel, layout-transposed)
+    g = jax_grads["Conv_0"]["kernel"].transpose(3, 2, 0, 1)
+    np.testing.assert_allclose(g, t_grad, rtol=1e-3, atol=1e-5)
+    # the two trajectories moved together, not just started together
+    assert jax_losses[0] != jax_losses[-1]
