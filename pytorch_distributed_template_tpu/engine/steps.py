@@ -49,7 +49,8 @@ def make_train_step(model, tx, criterion: Callable,
                     grad_clip_norm: float = 0.0,
                     grad_accum_steps: int = 1,
                     ema_decay: float = 0.0,
-                    skip_nonfinite: bool = False):
+                    skip_nonfinite: bool = False,
+                    augment=None):
     """Build ``train_step(state, batch) -> (state, metrics)``.
 
     ``metrics`` holds scalar sums + count; callers divide after accumulating
@@ -77,6 +78,9 @@ def make_train_step(model, tx, criterion: Callable,
     host round-trip, unlike torch-style ``if not torch.isfinite(loss)``
     Python checks). The step counter still advances so dropout keys and
     schedules stay aligned with wall progress.
+
+    ``augment`` (ops/augment.build_augment) is applied to the input batch
+    in-graph before the forward pass, keyed per step — train-time only.
     """
     pass_example_mask = _accepts_example_mask(model)
 
@@ -120,6 +124,12 @@ def make_train_step(model, tx, criterion: Callable,
 
     def train_step(state, batch):
         dropout_rng = jax.random.fold_in(state.rng, state.step)
+        if augment is not None:
+            # 7919 is outside the 0..k-1 microbatch fold-in range
+            batch = dict(batch)
+            batch[input_key] = augment(
+                jax.random.fold_in(dropout_rng, 7919), batch[input_key]
+            )
         k = grad_accum_steps
 
         if k <= 1:

@@ -34,6 +34,7 @@ from ..config.registry import LOSSES, METRICS
 from ..data.loader import host_prefetch, prefetch_to_device
 from ..models.base import describe, inject_mesh
 from ..observability import MetricTracker, TensorboardWriter
+from ..ops.augment import build_augment
 from ..observability.profiler import (
     ThroughputMeter, TraceCapture, compiled_flops, mfu,
 )
@@ -252,6 +253,7 @@ class Trainer(BaseTrainer):
             input_key=self.input_key, target_key=self.target_key,
             grad_clip_norm=grad_clip, grad_accum_steps=grad_accum,
             ema_decay=ema_decay, skip_nonfinite=self.skip_nonfinite,
+            augment=build_augment(config["trainer"].get("augment")),
         )
         metric_sharding = jax.sharding.NamedSharding(
             self.mesh, jax.sharding.PartitionSpec()

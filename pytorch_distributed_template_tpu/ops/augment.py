@@ -1,0 +1,101 @@
+"""On-device data augmentation, fused into the jitted train step.
+
+The reference's only input transform is a host-side Normalize
+(/root/reference/data_loader/data_loaders.py:13-16); anything heavier
+(random crop/flip for CIFAR/ImageNet) would run in torch's CPU worker pool.
+TPU-natively the augmentations run *in-graph* on the accelerator: they are
+a handful of elementwise/gather ops XLA fuses into the step, keyed by the
+step's PRNG — so they cost ~nothing, stay reproducible (pure function of
+the seed), and need no host worker pool at all.
+
+All functions take ``[B, H, W, C]`` batches and a key; each example draws
+its own randomness. Static shapes throughout (pad + dynamic_slice via
+gather indices), so one compiled program serves every step.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def random_flip(key: jax.Array, x: jax.Array) -> jax.Array:
+    """Horizontal flip, per-example coin toss."""
+    flip = jax.random.bernoulli(key, 0.5, (x.shape[0],))
+    return jnp.where(flip[:, None, None, None], x[:, :, ::-1, :], x)
+
+
+def random_crop(key: jax.Array, x: jax.Array, padding: int) -> jax.Array:
+    """Pad-and-crop (the standard CIFAR augmentation), per-example offsets.
+
+    Pads spatially by ``padding`` (reflect) then takes a random HxW window
+    per example. Implemented with per-example gather indices instead of
+    ``dynamic_slice`` so the whole batch is one vectorized op.
+    """
+    b, h, w, c = x.shape
+    xp = jnp.pad(
+        x, ((0, 0), (padding, padding), (padding, padding), (0, 0)),
+        mode="reflect",
+    )
+    ky, kx = jax.random.split(key)
+    oy = jax.random.randint(ky, (b,), 0, 2 * padding + 1)
+    ox = jax.random.randint(kx, (b,), 0, 2 * padding + 1)
+    rows = oy[:, None] + jnp.arange(h)[None, :]          # [B, H]
+    cols = ox[:, None] + jnp.arange(w)[None, :]          # [B, W]
+    batch_idx = jnp.arange(b)[:, None, None]
+    return xp[batch_idx, rows[:, :, None], cols[:, None, :], :]
+
+
+def random_cutout(key: jax.Array, x: jax.Array, size: int) -> jax.Array:
+    """Zero one random ``size x size`` square per example (DeVries &
+    Taylor 2017) — a cheap regularizer that is pure elementwise masking on
+    TPU. The window is placed fully inside the image (corner-sampled), so
+    exactly ``min(size, H) x min(size, W)`` pixels are zeroed."""
+    b, h, w, _ = x.shape
+    ky, kx = jax.random.split(key)
+    oy = jax.random.randint(ky, (b,), 0, max(h - size + 1, 1))
+    ox = jax.random.randint(kx, (b,), 0, max(w - size + 1, 1))
+    ys = jnp.arange(h)[None, :, None]
+    xs = jnp.arange(w)[None, None, :]
+    oy = oy[:, None, None]
+    ox = ox[:, None, None]
+    mask = (ys >= oy) & (ys < oy + size) & (xs >= ox) & (xs < ox + size)
+    return jnp.where(mask[..., None], 0.0, x).astype(x.dtype)
+
+
+def build_augment(cfg: dict | None):
+    """Compose the configured augmentations into one ``(key, x) -> x`` fn.
+
+    Config schema (the ``trainer.augment`` block):
+    ``{"flip": true, "crop_padding": 4, "cutout": 8}`` — all optional;
+    returns None when nothing is enabled so callers can skip the key
+    split entirely.
+    """
+    if not cfg:
+        return None
+    unknown = set(cfg) - {"flip", "crop_padding", "cutout"}
+    if unknown:
+        # fail loudly like the rest of the config system (a misspelled key
+        # silently disabling augmentation would only show up as accuracy)
+        raise ValueError(
+            f"unknown trainer.augment keys {sorted(unknown)}; "
+            "valid: flip, crop_padding, cutout"
+        )
+    steps = []
+    if cfg.get("flip"):
+        steps.append(random_flip)
+    pad = int(cfg.get("crop_padding", 0))
+    if pad > 0:
+        steps.append(lambda k, x: random_crop(k, x, pad))
+    cut = int(cfg.get("cutout", 0))
+    if cut > 0:
+        steps.append(lambda k, x: random_cutout(k, x, cut))
+    if not steps:
+        return None
+
+    def apply(key, x):
+        for i, fn in enumerate(steps):
+            key_i = jax.random.fold_in(key, i)
+            x = fn(key_i, x)
+        return x
+
+    return apply

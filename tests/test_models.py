@@ -72,6 +72,45 @@ class TestResNet:
         )
         assert out.dtype == jnp.float32  # head upcasts
 
+    def test_space_to_depth_stem(self):
+        """The MLPerf s2d stem variant: same output shape, correct 2x2
+        channel packing, and a 4x4x12xF init conv kernel."""
+        model = MODELS.get("ResNet50")(num_classes=10, space_to_depth=True,
+                                       input_shape=(64, 64, 3))
+        state = create_train_state(
+            model, optax.sgd(0.1), model.batch_template(2), seed=0
+        )
+        assert state.params["conv_init"]["kernel"].shape == (4, 4, 12, 64)
+        out = model.apply(
+            {"params": state.params, "batch_stats": state.batch_stats},
+            jnp.zeros((2, 64, 64, 3)), train=False,
+        )
+        assert out.shape == (2, 10)
+        # packing correctness of the reshape: the [0,0] corner of every
+        # 2x2 tile must land in the first C channels
+        x = np.zeros((1, 64, 64, 3), np.float32)
+        x[:, ::2, ::2, :] = 1.0
+        b, h, w, c = x.shape
+        packed = x.reshape(b, h // 2, 2, w // 2, 2, c)
+        packed = packed.transpose(0, 1, 3, 2, 4, 5).reshape(
+            b, h // 2, w // 2, 4 * c
+        )
+        # channel block 0 (the [0,0] corner of each tile) carries the 1s
+        assert packed[..., :3].min() == 1.0
+        assert packed[..., 3:].max() == 0.0
+
+    def test_space_to_depth_guards(self):
+        import pytest
+
+        with pytest.raises(ValueError, match="incompatible with cifar"):
+            MODELS.get("ResNet18")(cifar_stem=True, space_to_depth=True)
+        model = MODELS.get("ResNet50")(space_to_depth=True,
+                                       input_shape=(65, 65, 3))
+        with pytest.raises(ValueError, match="even spatial dims"):
+            create_train_state(
+                model, optax.sgd(0.1), model.batch_template(1), seed=0
+            )
+
     def test_trains_and_updates_batch_stats(self):
         mesh = build_mesh({"data": -1})
         model = MODELS.get("ResNet18")(num_classes=10, cifar_stem=True)
