@@ -1,0 +1,81 @@
+"""Worker script for the two-process multi-host test (test_multihost.py).
+
+Runs as one of N coordinated JAX processes on localhost — the same
+``jax.distributed.initialize`` rendezvous path a real TPU pod uses over
+DCN, just with CPU devices. Exercises the full parallel/dist.py surface:
+rendezvous, host-object collectives, cross-process device reduction over a
+global mesh, and the epoch-edge barrier.
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+import jax.numpy as jnp
+from jax.experimental import multihost_utils
+from jax.sharding import NamedSharding, PartitionSpec
+
+from pytorch_distributed_template_tpu.data.sampler import ShardedSampler
+from pytorch_distributed_template_tpu.parallel import dist
+from pytorch_distributed_template_tpu.parallel.mesh import build_mesh
+
+
+def main():
+    dist.initialize()  # env-driven rendezvous (COORDINATOR_ADDRESS etc.)
+    rank = dist.process_index()
+    nprocs = dist.process_count()
+    assert nprocs == int(os.environ["NUM_PROCESSES"]), nprocs
+    assert not dist.is_main_process() or rank == 0
+
+    # host-object all-gather (the reference's pickle all_gather analogue)
+    gathered = dist.all_gather_object({"rank": rank, "payload": "x" * (rank + 1)})
+    assert [g["rank"] for g in gathered] == list(range(nprocs)), gathered
+    assert [len(g["payload"]) for g in gathered] == list(range(1, nprocs + 1))
+
+    # rank-0 broadcast (non-root passes a non-picklable sentinel safely)
+    msg = dist.broadcast_object(
+        {"best": 0.125, "epoch": 3} if rank == 0 else None
+    )
+    assert msg == {"best": 0.125, "epoch": 3}, msg
+
+    # device-collective over the GLOBAL mesh: each host contributes its
+    # local shard; the jitted sum crosses processes (psum over DCN here,
+    # ICI on a pod).
+    mesh = build_mesh({"data": -1}, jax.devices())
+    assert mesh.size == jax.device_count()
+    local = np.full((jax.local_device_count(),), float(rank + 1), np.float32)
+    global_arr = multihost_utils.host_local_array_to_global_array(
+        local, mesh, PartitionSpec("data")
+    )
+    total = jax.jit(
+        jnp.sum,
+        out_shardings=NamedSharding(mesh, PartitionSpec()),
+    )(global_arr)
+    expect = sum(
+        (r + 1) * jax.local_device_count() for r in range(nprocs)
+    )
+    assert float(total) == float(expect), (float(total), expect)
+
+    # per-host data sharding: shards must be disjoint and cover the set
+    # (the reference's DistributedSampler semantics,
+    # data_loader/data_loaders.py:23-26)
+    sampler = ShardedSampler(num_samples=10, num_shards=nprocs,
+                             shard_index=rank, shuffle=True, seed=5)
+    sampler.set_epoch(1)
+    mine = list(sampler)
+    all_shards = dist.all_gather_object(mine)
+    flat = [i for shard in all_shards for i in shard]
+    assert set(flat) == set(range(10)), sorted(flat)
+    assert len(set(mine)) == len(mine)
+
+    dist.synchronize("test-end")
+    print(f"MULTIHOST_OK rank={rank}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

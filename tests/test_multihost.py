@@ -1,0 +1,72 @@
+"""Two-process multi-host integration test (SURVEY.md §5 "distributed
+communication backend", §7 stage 4).
+
+Spawns two coordinated JAX processes on localhost — the exact
+``jax.distributed.initialize`` rendezvous + gRPC host-collective path a
+TPU pod uses over DCN, with CPU devices standing in for chips. This is
+the closest a single machine gets to proving the multi-host contract:
+rendezvous, host-object all-gather/broadcast, a cross-process device
+reduction over the global mesh, and the barrier (multihost_worker.py).
+"""
+import os
+import socket
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+WORKER = Path(__file__).parent / "multihost_worker.py"
+REPO = Path(__file__).parent.parent
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        return s.getsockname()[1]
+
+
+def test_two_process_rendezvous_and_collectives():
+    port = _free_port()
+    procs = []
+    for rank in range(2):
+        env = dict(os.environ)
+        # preserve inherited flags (conftest.py does the same), but replace
+        # any existing device-count with the per-worker 4
+        inherited = " ".join(
+            f for f in os.environ.get("XLA_FLAGS", "").split()
+            if "xla_force_host_platform_device_count" not in f
+        )
+        env.update({
+            "JAX_PLATFORMS": "cpu",
+            "XLA_FLAGS": (
+                inherited + " --xla_force_host_platform_device_count=4"
+            ).strip(),
+            "COORDINATOR_ADDRESS": f"localhost:{port}",
+            "NUM_PROCESSES": "2",
+            "PROCESS_ID": str(rank),
+        })
+        env.pop("JAX_COORDINATOR_ADDRESS", None)
+        procs.append(subprocess.Popen(
+            [sys.executable, str(WORKER)],
+            env=env, cwd=REPO,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        ))
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=210)
+            outs.append(out)
+    except subprocess.TimeoutExpired:
+        partial = []
+        for p in procs:
+            p.kill()
+            out, _ = p.communicate()  # reap; collect hang diagnostics
+            partial.append(out or "")
+        pytest.fail(
+            "multi-host workers hung (rendezvous or collective).\n"
+            + "\n---\n".join(o[-2000:] for o in partial)
+        )
+    for rank, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"rank {rank} failed:\n{out[-3000:]}"
+        assert f"MULTIHOST_OK rank={rank}" in out, out[-3000:]
