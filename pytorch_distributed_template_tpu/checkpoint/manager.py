@@ -38,6 +38,9 @@ class CheckpointManager:
     def __init__(self, checkpoint_dir):
         self.checkpoint_dir = Path(checkpoint_dir)
         self._ckptr = ocp.StandardCheckpointer()
+        # save paths whose async write may still be in flight; cleared by
+        # wait(). Lets prune() skip the blocking wait in steady state.
+        self._inflight: set = set()
 
     # -- save ---------------------------------------------------------------
 
@@ -58,6 +61,7 @@ class CheckpointManager:
             "config": config,
         }
         self._ckptr.save(path, _saveable(state), force=True)
+        self._inflight.add(path)
         if dist.is_main_process():
             (self.checkpoint_dir / f"checkpoint-epoch{epoch}.meta.json").write_text(
                 json.dumps(meta, indent=2)
@@ -67,6 +71,7 @@ class CheckpointManager:
             # Wait for the epoch save to snapshot before re-saving the same
             # arrays to model_best.
             self._ckptr.wait_until_finished()
+            self._inflight.clear()
             best = self.checkpoint_dir / "model_best"
             self._ckptr.save(best, _saveable(state), force=True)
             if dist.is_main_process():
@@ -78,6 +83,49 @@ class CheckpointManager:
 
     def wait(self) -> None:
         self._ckptr.wait_until_finished()
+        self._inflight.clear()
+
+    def prune(self, keep_last: int) -> None:
+        """Delete all but the newest ``keep_last`` periodic checkpoints.
+
+        ``model_best`` is never pruned. The reference keeps every
+        ``save_period`` checkpoint forever (base_trainer.py:109-132); this
+        is the opt-in retention extension (``trainer.keep_last``). Host 0
+        only. Blocks on in-flight async saves ONLY when a deletion
+        candidate could still be mid-write (never in steady state — the
+        newest saves are never candidates), preserving the async-save hot
+        path.
+        """
+        if keep_last <= 0 or not dist.is_main_process():
+            return
+        epochs = []
+        for p in self.checkpoint_dir.glob("checkpoint-epoch*"):
+            m = re.match(r"checkpoint-epoch(\d+)$", p.name)
+            if m and p.is_dir():
+                epochs.append((int(m.group(1)), p))
+        epochs.sort()
+        if len(epochs) <= keep_last:
+            return
+        to_delete = [path for _, path in epochs[:-keep_last]]
+        if any(path in self._inflight for path in to_delete):
+            self.wait()
+        import shutil
+
+        for path in to_delete:
+            shutil.rmtree(path, ignore_errors=True)
+            if path.exists():
+                # deletion failed (e.g. EBUSY on a network FS): keep the
+                # sidecar so the surviving checkpoint stays resumable with
+                # its compat metadata
+                logger.warning(
+                    "Warning: could not prune checkpoint %s; keeping its "
+                    "metadata sidecar.", path,
+                )
+                continue
+            meta = path.parent / f"{path.name}.meta.json"
+            if meta.exists():
+                meta.unlink()
+            logger.info("Pruned old checkpoint: %s", path)
 
     def _ckpt_has_ema(self, path) -> bool:
         """Whether the on-disk checkpoint tree contains ``ema_params``,

@@ -427,6 +427,9 @@ class Trainer(BaseTrainer):
             ),
             save_best=save_best,
         )
+        keep = int(self.config["trainer"].get("keep_last", 0))
+        if keep > 0:
+            self.ckpt_manager.prune(keep)
 
     # -- misc ---------------------------------------------------------------
 

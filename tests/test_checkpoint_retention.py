@@ -1,0 +1,38 @@
+"""Checkpoint retention (``trainer.keep_last``) — opt-in extension over the
+reference's keep-everything policy (base_trainer.py:109-132)."""
+import json
+
+from test_e2e_mnist import build_trainer, make_config
+
+
+def test_keep_last_prunes_old_checkpoints(tmp_path):
+    config = make_config(
+        tmp_path, run_id="keep",
+        **{"trainer;epochs": 4, "trainer;save_period": 1,
+           "trainer;keep_last": 2},
+    )
+    t = build_trainer(config)
+    t.train()
+    d = config.save_dir
+    kept = sorted(p.name for p in d.glob("checkpoint-epoch*") if p.is_dir())
+    assert kept == ["checkpoint-epoch3", "checkpoint-epoch4"], kept
+    # sidecars pruned with their checkpoints
+    metas = sorted(p.name for p in d.glob("checkpoint-epoch*.meta.json"))
+    assert metas == ["checkpoint-epoch3.meta.json",
+                     "checkpoint-epoch4.meta.json"], metas
+    # model_best never pruned, and still resumable
+    assert (d / "model_best").is_dir()
+    meta = json.loads((d / "checkpoint-epoch4.meta.json").read_text())
+    assert meta["epoch"] == 4
+
+
+def test_default_keeps_everything(tmp_path):
+    config = make_config(
+        tmp_path, run_id="all",
+        **{"trainer;epochs": 3, "trainer;save_period": 1},
+    )
+    t = build_trainer(config)
+    t.train()
+    d = config.save_dir
+    kept = sorted(p.name for p in d.glob("checkpoint-epoch*") if p.is_dir())
+    assert kept == [f"checkpoint-epoch{i}" for i in (1, 2, 3)], kept
