@@ -23,6 +23,7 @@ from __future__ import annotations
 
 import logging
 from pathlib import Path
+from typing import Optional
 
 import numpy as np
 
@@ -251,6 +252,44 @@ def _try_load_cifar10(data_dir: Path, training: bool):
     std = np.array([0.2470, 0.2435, 0.2616], np.float32)
     x = (x.astype(np.float32) / 255.0 - mean) / std
     return {"image": x, "label": np.concatenate(ys)}
+
+
+@LOADERS.register("NpyDataLoader")
+def npy_loader(data_dir: str = "data/", batch_size: int = 128,
+               shuffle: bool = True, num_workers: int = 0,
+               training: bool = True, files: Optional[dict] = None,
+               mmap: bool = True, seed: int = 0):
+    """Generic real-data loader over ``.npy`` arrays (the escape hatch for
+    any dataset: preprocess once into aligned arrays, train from disk).
+
+    :param files: mapping of batch key -> filename relative to ``data_dir``;
+        ``{split}`` in a filename expands to ``train``/``val``. Default:
+        ``{"image": "{split}_images.npy", "label": "{split}_labels.npy"}``.
+    :param mmap: memory-map the arrays (``np.load mmap_mode='r'``) so
+        datasets larger than host RAM stream pages on demand; the native
+        row-gather (data/native) copies straight out of the mapped pages.
+
+    All arrays must share their leading (sample) dimension. Labels are cast
+    to int32; floating images are used as stored (preprocess/normalize at
+    conversion time).
+    """
+    del num_workers
+    split = "train" if training else "val"
+    files = files or {"image": "{split}_images.npy",
+                      "label": "{split}_labels.npy"}
+    arrays = {}
+    for key, fname in files.items():
+        path = Path(data_dir) / fname.format(split=split)
+        if not path.exists():
+            raise FileNotFoundError(
+                f"NpyDataLoader: {path} not found (key '{key}')"
+            )
+        arr = np.load(path, mmap_mode="r" if mmap else None)
+        if key == "label":
+            arr = np.asarray(arr, dtype=np.int32)  # small; materialize
+        arrays[key] = arr
+    # mismatched sample counts raise in ArrayDataLoader.__init__
+    return _make_image_loader(arrays, batch_size, shuffle, seed=seed)
 
 
 @LOADERS.register("SyntheticImageNetLoader")

@@ -175,3 +175,42 @@ def test_epoch_permutations_are_independent():
     # A shifted-stream bug makes permutations nearly rank-correlated.
     corr = np.corrcoef(np.argsort(p0), np.argsort(p1))[0, 1]
     assert abs(corr) < 0.2
+
+
+def test_npy_loader_mmap(tmp_path):
+    """NpyDataLoader: mmap'd real-data arrays through the native gather."""
+    import numpy as np
+    from pytorch_distributed_template_tpu.config.registry import LOADERS
+
+    rng = np.random.default_rng(0)
+    imgs = rng.normal(size=(50, 8, 8, 3)).astype(np.float32)
+    labels = rng.integers(0, 10, 50).astype(np.int64)
+    np.save(tmp_path / "train_images.npy", imgs)
+    np.save(tmp_path / "train_labels.npy", labels)
+
+    loader = LOADERS.get("NpyDataLoader")(
+        data_dir=str(tmp_path), batch_size=16, shuffle=True, training=True,
+        seed=3,
+    )
+    loader.set_epoch(1)
+    batches = list(loader)
+    assert sum(int(b["mask"].sum()) for b in batches) == 50
+    assert batches[0]["label"].dtype == np.int32
+    # rows must be exact copies of the source rows
+    got = np.concatenate([b["image"][b["mask"]] for b in batches])
+    assert sorted(map(tuple, got.reshape(50, -1)[:, :2].tolist())) == sorted(
+        map(tuple, imgs.reshape(50, -1)[:, :2].tolist())
+    )
+
+
+def test_npy_loader_errors(tmp_path):
+    import numpy as np
+    import pytest
+    from pytorch_distributed_template_tpu.config.registry import LOADERS
+
+    with pytest.raises(FileNotFoundError, match="train_images.npy"):
+        LOADERS.get("NpyDataLoader")(data_dir=str(tmp_path))
+    np.save(tmp_path / "train_images.npy", np.zeros((4, 2, 2, 1)))
+    np.save(tmp_path / "train_labels.npy", np.zeros(5))
+    with pytest.raises(ValueError, match="share the leading dim"):
+        LOADERS.get("NpyDataLoader")(data_dir=str(tmp_path))
