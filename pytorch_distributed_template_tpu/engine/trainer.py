@@ -42,6 +42,7 @@ from ..parallel import batch_sharding, dist, mesh_from_config
 from ..parallel.sharding import apply_rules
 from ..utils import preemption
 from ..utils.debug import configure_debug
+from ..utils.watchdog import StepWatchdog
 from .optim import build_optimizer
 from .state import create_train_state
 from .steps import finalize_metrics, make_eval_step, make_train_step
@@ -106,68 +107,77 @@ class BaseTrainer:
         preemption.install()
         not_improved_count = 0
         log: dict = {}
-        for epoch in range(self.start_epoch, self.epochs + 1):
-            result = self._train_epoch(epoch)
+        try:
+            for epoch in range(self.start_epoch, self.epochs + 1):
+                result = self._train_epoch(epoch)
 
-            log = {"epoch": epoch}
-            log.update(result)
-            if dist.is_main_process():
-                for key, value in log.items():
-                    self.logger.info("    %-15s: %s", str(key), value)
+                log = {"epoch": epoch}
+                log.update(result)
+                if dist.is_main_process():
+                    for key, value in log.items():
+                        self.logger.info("    %-15s: %s", str(key), value)
 
-            best = False
-            if self.mnt_mode != "off":
-                try:
-                    improved = (
-                        self.mnt_mode == "min"
-                        and log[self.mnt_metric] <= self.mnt_best
-                    ) or (
-                        self.mnt_mode == "max"
-                        and log[self.mnt_metric] >= self.mnt_best
-                    )
-                except KeyError:
+                best = False
+                if self.mnt_mode != "off":
+                    try:
+                        improved = (
+                            self.mnt_mode == "min"
+                            and log[self.mnt_metric] <= self.mnt_best
+                        ) or (
+                            self.mnt_mode == "max"
+                            and log[self.mnt_metric] >= self.mnt_best
+                        )
+                    except KeyError:
+                        if dist.is_main_process():
+                            self.logger.warning(
+                                "Warning: Metric '%s' is not found. Model "
+                                "performance monitoring is disabled.",
+                                self.mnt_metric,
+                            )
+                        self.mnt_mode = "off"
+                        improved = False
+
+                    if improved:
+                        self.mnt_best = log[self.mnt_metric]
+                        not_improved_count = 0
+                        best = True
+                    else:
+                        not_improved_count += 1
+
+                if preemption.sync_requested():
+                    # any host got SIGTERM: checkpoint NOW (regardless of
+                    # save_period) and stop everywhere together — resume
+                    # loses at most the in-flight epoch (utils/preemption.py)
                     if dist.is_main_process():
                         self.logger.warning(
-                            "Warning: Metric '%s' is not found. Model "
-                            "performance monitoring is disabled.",
-                            self.mnt_metric,
+                            "Preemption signal received; saving checkpoint "
+                            "at epoch %d and stopping.", epoch,
                         )
-                    self.mnt_mode = "off"
-                    improved = False
+                    self._save_checkpoint(epoch, save_best=best)
+                    break
 
-                if improved:
-                    self.mnt_best = log[self.mnt_metric]
-                    not_improved_count = 0
-                    best = True
-                else:
-                    not_improved_count += 1
+                if epoch % self.save_period == 0:
+                    self._save_checkpoint(epoch, save_best=best)
 
-            if preemption.sync_requested():
-                # any host got SIGTERM: checkpoint NOW (regardless of
-                # save_period) and stop everywhere together — resume loses
-                # at most the in-flight epoch (utils/preemption.py)
-                if dist.is_main_process():
-                    self.logger.warning(
-                        "Preemption signal received; saving checkpoint at "
-                        "epoch %d and stopping.", epoch,
-                    )
-                self._save_checkpoint(epoch, save_best=best)
-                break
-
-            if epoch % self.save_period == 0:
-                self._save_checkpoint(epoch, save_best=best)
-
-            if self.mnt_mode != "off" and not_improved_count > self.early_stop:
-                if dist.is_main_process():
-                    self.logger.info(
-                        "Validation performance didn't improve for %s epochs. "
-                        "Training stops.", self.early_stop,
-                    )
-                break
-        self.ckpt_manager.wait()
-        trace = getattr(self, "trace", None)
-        if trace is not None:
-            trace.close()  # flush a still-open profiler window
+                if (self.mnt_mode != "off"
+                        and not_improved_count > self.early_stop):
+                    if dist.is_main_process():
+                        self.logger.info(
+                            "Validation performance didn't improve for %s "
+                            "epochs. Training stops.", self.early_stop,
+                        )
+                    break
+        finally:
+            # stop the watchdog FIRST: no steps run past this point, and
+            # the async checkpoint flush below can legitimately take
+            # longer than the stall threshold
+            watchdog = getattr(self, "watchdog", None)
+            if watchdog is not None:
+                watchdog.stop()
+            self.ckpt_manager.wait()
+            trace = getattr(self, "trace", None)
+            if trace is not None:
+                trace.close()  # flush a still-open profiler window
         return log
 
     def _save_checkpoint(self, epoch: int, save_best: bool = False) -> None:
@@ -297,6 +307,11 @@ class Trainer(BaseTrainer):
         self._flops_per_step = None  # measured lazily on the first batch
         self._flops_measured = False  # latch: the AOT compile runs at most once
 
+        # hung-step detection (utils/watchdog.py); 0 disables
+        self.watchdog = StepWatchdog(
+            timeout_s=float(config["trainer"].get("watchdog_secs", 0))
+        )
+
     def _metric_keys(self):
         return ["loss_sum", "count"] + [
             f"{m.__name__}_sum" for m in self.metric_ftns
@@ -333,11 +348,15 @@ class Trainer(BaseTrainer):
         )
         single_host = dist.process_count() == 1
         preempted = False  # consensus result: identical on every host
+        # idempotent; trainer.watchdog_secs must exceed the first-step
+        # compile time or epoch 1 will false-alarm
+        self.watchdog.start()
         for batch_idx, batch in enumerate(prefetched):
             step = (epoch - 1) * self.len_epoch + batch_idx
             self.trace.before_step(step)
             self.state, m = self._train_step(self.state, batch)
             self.trace.after_step(step, sync=m)
+            self.watchdog.beat()
             self.throughput.update(self.train_loader.batch_size)
 
             if (self.profile_enabled and batch_idx == 0
@@ -407,6 +426,7 @@ class Trainer(BaseTrainer):
         for batch in prefetch_to_device(self.valid_loader, self.batch_sharding):
             m = self._eval_step(self.state, batch)
             accum = m if accum is None else jax.tree.map(jnp.add, accum, m)
+            self.watchdog.beat()
         result = finalize_metrics(jax.tree.map(float, accum)) if accum else {}
         if dist.is_main_process():
             self.writer.set_step(epoch * self.len_epoch, mode="valid")

@@ -1,0 +1,92 @@
+"""Hung-step detection (SURVEY.md §5 "failure detection").
+
+The reference has no liveness tooling: a hung rank deadlocks everyone in
+``dist.barrier`` forever (/root/reference/utils/dist.py:15) with zero
+diagnostics. On TPU the equivalent stall is a wedged device/collective —
+the host blocks inside a transfer or ``block_until_ready`` with no Python
+traceback ever surfacing.
+
+``StepWatchdog`` is a monitor thread fed a heartbeat from the training
+loop. When no step completes within ``timeout_s`` it logs an error and
+dumps ALL thread stacks (``faulthandler``) to stderr — so a wedged run
+leaves a post-mortem trail showing exactly which call never returned —
+and keeps repeating while the stall lasts. Detection only, by design:
+killing or restarting is the orchestrator's job (crash -> relaunch ->
+resume is the recovery contract, SURVEY.md §5).
+"""
+from __future__ import annotations
+
+import faulthandler
+import logging
+import sys
+import threading
+import time
+
+logger = logging.getLogger(__name__)
+
+
+class StepWatchdog:
+    """Monitor thread that alarms when ``beat()`` stops arriving.
+
+    :param timeout_s: stall threshold; <= 0 disables entirely (no thread).
+    :param dump_stacks: also ``faulthandler.dump_traceback`` on alarm.
+
+    Usage::
+
+        wd = StepWatchdog(timeout_s=300); wd.start()
+        for batch in loader:
+            ...
+            wd.beat()
+        wd.stop()
+    """
+
+    def __init__(self, timeout_s: float, dump_stacks: bool = True):
+        self.timeout_s = float(timeout_s)
+        self.dump_stacks = dump_stacks
+        self._last = time.monotonic()
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self.alarms = 0  # number of stall alarms fired (observable in tests)
+
+    def start(self) -> None:
+        if self.timeout_s <= 0 or self._thread is not None:
+            return
+        self._last = time.monotonic()
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._run, name="step-watchdog", daemon=True
+        )
+        self._thread.start()
+
+    def beat(self) -> None:
+        self._last = time.monotonic()
+
+    def stop(self) -> None:
+        if self._thread is None:
+            return
+        self._stop.set()
+        self._thread.join(timeout=5)
+        self._thread = None
+
+    # -- internals ----------------------------------------------------------
+
+    def _run(self) -> None:
+        # poll at a fraction of the timeout so alarms fire promptly without
+        # busy-waiting
+        poll = max(self.timeout_s / 4.0, 0.05)
+        while not self._stop.wait(poll):
+            stalled = time.monotonic() - self._last
+            if stalled >= self.timeout_s:
+                self.alarms += 1
+                logger.error(
+                    "Watchdog: no training step completed in %.0fs "
+                    "(threshold %.0fs) — device/collective likely hung. "
+                    "Dumping thread stacks to stderr.",
+                    stalled, self.timeout_s,
+                )
+                if self.dump_stacks:
+                    try:
+                        faulthandler.dump_traceback(file=sys.stderr)
+                    except Exception:  # stderr closed in exotic harnesses
+                        pass
+                self._last = time.monotonic()  # re-arm, repeat while stalled

@@ -1,0 +1,54 @@
+"""Hung-step watchdog (utils/watchdog.py; SURVEY.md §5 failure detection)."""
+import logging
+import time
+
+from pytorch_distributed_template_tpu.utils.watchdog import StepWatchdog
+
+from test_e2e_mnist import build_trainer, make_config
+
+
+def test_alarm_fires_on_stall(caplog):
+    wd = StepWatchdog(timeout_s=0.2, dump_stacks=False)
+    wd.start()
+    try:
+        with caplog.at_level(logging.ERROR):
+            time.sleep(0.7)  # no beats -> stall
+    finally:
+        wd.stop()
+    assert wd.alarms >= 1
+    assert any("no training step completed" in r.message
+               for r in caplog.records)
+
+
+def test_no_alarm_while_beating():
+    # wide margin (2.0s threshold vs 0.1s beats) so CI scheduler pauses
+    # cannot flake this
+    wd = StepWatchdog(timeout_s=2.0, dump_stacks=False)
+    wd.start()
+    try:
+        for _ in range(6):
+            time.sleep(0.1)
+            wd.beat()
+    finally:
+        wd.stop()
+    assert wd.alarms == 0
+
+
+def test_disabled_spawns_no_thread():
+    wd = StepWatchdog(timeout_s=0)
+    wd.start()
+    assert wd._thread is None
+    wd.stop()  # no-op
+
+
+def test_trainer_integration(tmp_path):
+    """watchdog_secs plumbs through; a healthy run fires no alarms and the
+    monitor thread is stopped at exit."""
+    config = make_config(
+        tmp_path, run_id="wd",
+        **{"trainer;epochs": 1, "trainer;watchdog_secs": 120},
+    )
+    t = build_trainer(config)
+    t.train()
+    assert t.watchdog.alarms == 0
+    assert t.watchdog._thread is None  # stopped
