@@ -12,11 +12,12 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from ..config.registry import LOADERS, LOSSES, METRICS, MODELS
+from ..config.registry import LOADERS, METRICS, MODELS
 from ..data.loader import prefetch_to_device
 from ..models.base import inject_mesh
 from ..parallel import batch_sharding, dist, mesh_from_config
 from ..parallel.sharding import apply_rules
+from .losses import resolve_loss
 from .optim import build_optimizer
 from .state import create_train_state
 from .steps import finalize_metrics, make_eval_step
@@ -47,7 +48,7 @@ def evaluate(config, mesh=None) -> dict:
     assert config.resume is not None, "evaluation requires a checkpoint (-r)"
 
     model = config.init_obj("arch", MODELS)
-    criterion = LOSSES.get(config["loss"])
+    criterion = resolve_loss(config["loss"])
     metric_fns = [METRICS.get(m) for m in config["metrics"]]
     test_loader = _build_test_loader(config)
     mesh = mesh if mesh is not None else mesh_from_config(config)

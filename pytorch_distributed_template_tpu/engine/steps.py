@@ -50,7 +50,8 @@ def make_train_step(model, tx, criterion: Callable,
                     grad_accum_steps: int = 1,
                     ema_decay: float = 0.0,
                     skip_nonfinite: bool = False,
-                    augment=None):
+                    augment=None,
+                    mixup_alpha: float = 0.0):
     """Build ``train_step(state, batch) -> (state, metrics)``.
 
     ``metrics`` holds scalar sums + count; callers divide after accumulating
@@ -81,6 +82,14 @@ def make_train_step(model, tx, criterion: Callable,
 
     ``augment`` (ops/augment.build_augment) is applied to the input batch
     in-graph before the forward pass, keyed per step — train-time only.
+
+    ``mixup_alpha > 0`` enables mixup (Zhang et al. 2018) in-graph: one
+    Beta(alpha, alpha) draw per step mixes the batch with a random
+    permutation of itself, and the loss becomes the matching convex
+    combination ``lam * L(out, y) + (1-lam) * L(out, y_perm)``. Metrics
+    are still computed against the original labels. Composes with
+    ``augment`` (mixup runs after) and grad accumulation (the mixed
+    targets ride the batch pytree through the microbatch split).
     """
     pass_example_mask = _accepts_example_mask(model)
 
@@ -107,6 +116,12 @@ def make_train_step(model, tx, criterion: Callable,
         )
         new_stats = mutated.get("batch_stats", batch_stats)
         per_ex = criterion(output, batch[target_key])
+        if mixup_alpha > 0:
+            lam = batch["_mix_lam"].astype(per_ex.dtype)
+            per_ex = (
+                lam * per_ex
+                + (1.0 - lam) * criterion(output, batch["_mix_target"])
+            )
         mask = batch["mask"].astype(per_ex.dtype)
         loss_sum = _masked_sum(per_ex, mask)
         aux = jax.tree.leaves(mutated.get("losses", {}))
@@ -125,11 +140,33 @@ def make_train_step(model, tx, criterion: Callable,
     def train_step(state, batch):
         dropout_rng = jax.random.fold_in(state.rng, state.step)
         if augment is not None:
-            # 7919 is outside the 0..k-1 microbatch fold-in range
+            # 7919/7920 are outside the 0..k-1 microbatch fold-in range
             batch = dict(batch)
             batch[input_key] = augment(
                 jax.random.fold_in(dropout_rng, 7919), batch[input_key]
             )
+        if mixup_alpha > 0:
+            mk = jax.random.fold_in(dropout_rng, 7920)
+            lam = jax.random.beta(mk, mixup_alpha, mixup_alpha)
+            x = batch[input_key]
+            # partner = batch rolled by a random shift: pairs examples
+            # uniformly across steps like a permutation, but on a
+            # data-sharded batch it compiles to a cheap cyclic shard
+            # exchange instead of the full cross-device gather a random
+            # x[perm] would cost every step
+            shift = jax.random.randint(
+                jax.random.fold_in(mk, 1), (), 1, x.shape[0]
+            )
+            batch = dict(batch)
+            batch["_mix_target"] = jnp.roll(  # before x overwrite
+                batch[target_key], shift, axis=0
+            )
+            batch[input_key] = (
+                lam.astype(x.dtype) * x
+                + (1.0 - lam).astype(x.dtype) * jnp.roll(x, shift, axis=0)
+            )
+            # broadcast to [B] so the grad-accum microbatch split applies
+            batch["_mix_lam"] = jnp.full((x.shape[0],), lam, jnp.float32)
         k = grad_accum_steps
 
         if k <= 1:

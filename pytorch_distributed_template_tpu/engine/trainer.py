@@ -30,7 +30,6 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..checkpoint import CheckpointManager
-from ..config.registry import LOSSES, METRICS
 from ..data.loader import host_prefetch, prefetch_to_device
 from ..models.base import describe, inject_mesh
 from ..observability import MetricTracker, TensorboardWriter
@@ -264,6 +263,7 @@ class Trainer(BaseTrainer):
             grad_clip_norm=grad_clip, grad_accum_steps=grad_accum,
             ema_decay=ema_decay, skip_nonfinite=self.skip_nonfinite,
             augment=build_augment(config["trainer"].get("augment")),
+            mixup_alpha=float(config["trainer"].get("mixup_alpha", 0.0)),
         )
         metric_sharding = jax.sharding.NamedSharding(
             self.mesh, jax.sharding.PartitionSpec()

@@ -117,3 +117,68 @@ def test_train_step_applies_augment():
     _, m_aug = auged(s1, batch)
     # same params, same batch: augmentation must change the computed loss
     assert float(m_plain["loss_sum"]) != float(m_aug["loss_sum"])
+
+
+def test_mixup_changes_loss_and_preserves_metrics_labels():
+    import jax
+
+    class Probe(nn.Module):
+        @nn.compact
+        def __call__(self, x, train=False):
+            return nn.Dense(8)(x.reshape(x.shape[0], -1))
+
+    model = Probe()
+    tx = optax.sgd(0.01)
+    sample = jnp.zeros((1, 4, 4, 1), jnp.float32)
+
+    def crit(out, tgt):
+        return optax.softmax_cross_entropy_with_integer_labels(out, tgt)
+
+    def acc(out, tgt):
+        return (out.argmax(-1) == tgt).astype(jnp.float32)
+    acc.__name__ = "accuracy"
+
+    batch = {
+        "image": _imgs(16, 4, 4, 1),
+        "label": jnp.asarray(np.arange(16) % 8, jnp.int32),
+        "mask": jnp.ones((16,), bool),
+    }
+    plain = jax.jit(make_train_step(model, tx, crit, [acc]),
+                    donate_argnums=0)
+    mixed = jax.jit(make_train_step(model, tx, crit, [acc],
+                                    mixup_alpha=0.4), donate_argnums=0)
+    s0 = create_train_state(model, tx, sample, seed=0)
+    s1 = create_train_state(model, tx, sample, seed=0)
+    _, m0 = plain(s0, dict(batch))
+    _, m1 = mixed(s1, dict(batch))
+    assert float(m0["loss_sum"]) != float(m1["loss_sum"])
+    assert np.isfinite(float(m1["loss_sum"]))
+    assert float(m1["count"]) == 16.0
+
+
+def test_mixup_composes_with_grad_accum():
+    import jax
+
+    class Probe(nn.Module):
+        @nn.compact
+        def __call__(self, x, train=False):
+            return nn.Dense(8)(x.reshape(x.shape[0], -1))
+
+    model = Probe()
+    tx = optax.sgd(0.01)
+    sample = jnp.zeros((1, 4, 4, 1), jnp.float32)
+
+    def crit(out, tgt):
+        return optax.softmax_cross_entropy_with_integer_labels(out, tgt)
+
+    batch = {
+        "image": _imgs(16, 4, 4, 1),
+        "label": jnp.asarray(np.arange(16) % 8, jnp.int32),
+        "mask": jnp.ones((16,), bool),
+    }
+    step = jax.jit(make_train_step(model, tx, crit, mixup_alpha=0.4,
+                                   grad_accum_steps=4), donate_argnums=0)
+    s = create_train_state(model, tx, sample, seed=0)
+    s, m = step(s, batch)
+    assert np.isfinite(float(m["loss_sum"]))
+    assert float(m["count"]) == 16.0

@@ -202,3 +202,37 @@ def test_configure_debug_noop():
     configure_debug({})
     assert not jax.config.jax_debug_nans
     assert not jax.config.jax_disable_jit
+
+
+def test_resolve_loss_name_and_factory():
+    from pytorch_distributed_template_tpu.engine.losses import (
+        resolve_loss, smooth_cross_entropy,
+    )
+
+    plain = resolve_loss("cross_entropy")
+    smooth = resolve_loss(
+        {"type": "smooth_cross_entropy", "args": {"smoothing": 0.2}}
+    )
+    logits = jnp.asarray([[4.0, 0.0, 0.0], [0.0, 4.0, 0.0]])
+    y = jnp.asarray([0, 1])
+    l_plain = np.asarray(plain(logits, y))
+    l_smooth = np.asarray(smooth(logits, y))
+    assert l_smooth.shape == l_plain.shape == (2,)
+    # smoothing strictly increases the loss on confident-correct logits
+    assert (l_smooth > l_plain).all()
+    # smoothing=0 factory matches plain CE exactly
+    s0 = smooth_cross_entropy(0.0)
+    np.testing.assert_allclose(np.asarray(s0(logits, y)), l_plain,
+                               rtol=1e-5, atol=1e-6)
+    import pytest
+    with pytest.raises(ValueError, match="smoothing"):
+        smooth_cross_entropy(1.5)
+
+
+def test_resolve_loss_form_mismatch_errors():
+    from pytorch_distributed_template_tpu.engine.losses import resolve_loss
+
+    with pytest.raises(ValueError, match="dict form"):
+        resolve_loss("smooth_cross_entropy")
+    with pytest.raises(ValueError, match="string form"):
+        resolve_loss({"type": "cross_entropy", "args": {}})

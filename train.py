@@ -25,10 +25,11 @@ if os.environ.get("JAX_PLATFORMS"):
     jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
 
 from pytorch_distributed_template_tpu.config import (
-    ConfigParser, LOADERS, LOSSES, METRICS, MODELS,
+    ConfigParser, LOADERS, METRICS, MODELS,
 )
 from pytorch_distributed_template_tpu import data, models  # noqa: F401  (register)
 from pytorch_distributed_template_tpu.engine import Trainer
+from pytorch_distributed_template_tpu.engine.losses import resolve_loss
 from pytorch_distributed_template_tpu.parallel import dist, mesh_from_config
 
 
@@ -46,7 +47,7 @@ def main(args, config):
         )
 
     model = config.init_obj("arch", MODELS)
-    criterion = LOSSES.get(config["loss"])
+    criterion = resolve_loss(config["loss"])
     metric_fns = [METRICS.get(m) for m in config["metrics"]]
 
     train_loader = config.init_obj("train_loader", LOADERS)
