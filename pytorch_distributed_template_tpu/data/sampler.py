@@ -64,7 +64,10 @@ class ShardedSampler:
             idx = np.arange(self.num_samples)
         pad = self.total_size - self.num_samples
         if pad:
-            idx = np.concatenate([idx, idx[:pad]])  # duplicate-padding
+            # duplicate-padding, cycling when pad > n (e.g. 1 sample over
+            # 3 shards needs the sample repeated twice) — same wraparound
+            # as DistributedSampler's repeated-indices padding
+            idx = np.resize(idx, self.total_size)
         return idx
 
     def indices(self) -> np.ndarray:

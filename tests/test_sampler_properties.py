@@ -1,0 +1,70 @@
+"""Property-based tests for the sharded sampler (hypothesis).
+
+The sampler replicates DistributedSampler semantics (SURVEY.md §7 hard-part
+(c)); these properties must hold for EVERY (n, shards, epoch, seed), not
+just the hand-picked cases in test_data.py:
+
+1. union of all shards == duplicate-padded multiset covering every sample;
+2. all shards are the same length (static shapes for jit);
+3. real (non-padding) positions cover each sample exactly once;
+4. the same (seed, epoch) is reproducible, different epochs reshuffle.
+"""
+from hypothesis import given, settings, strategies as st
+
+from pytorch_distributed_template_tpu.data.sampler import ShardedSampler
+
+
+@st.composite
+def _shard_setups(draw):
+    n = draw(st.integers(min_value=1, max_value=257))
+    shards = draw(st.integers(min_value=1, max_value=9))
+    epoch = draw(st.integers(min_value=0, max_value=4))
+    seed = draw(st.integers(min_value=0, max_value=3))
+    shuffle = draw(st.booleans())
+    return n, shards, epoch, seed, shuffle
+
+
+@settings(max_examples=120, deadline=None)
+@given(_shard_setups())
+def test_shards_cover_and_balance(setup):
+    n, shards, epoch, seed, shuffle = setup
+    samplers = [
+        ShardedSampler(n, shards, i, shuffle=shuffle, seed=seed)
+        for i in range(shards)
+    ]
+    for s in samplers:
+        s.set_epoch(epoch)
+    all_idx = [list(s) for s in samplers]
+
+    # (2) equal static lengths
+    lens = {len(ix) for ix in all_idx}
+    assert lens == {samplers[0].shard_size}
+    total = -(-n // shards) * shards
+    assert samplers[0].shard_size * shards == total
+
+    # (1) union covers every sample; only padding duplicates beyond one
+    flat = [i for ix in all_idx for i in ix]
+    assert set(flat) == set(range(n))
+    assert len(flat) == total
+
+    # (3) masked (real) positions cover each sample exactly once
+    real = []
+    for s, ix in zip(samplers, all_idx):
+        mask = s.pad_mask()
+        assert len(mask) == len(ix)
+        real.extend(i for i, keep in zip(ix, mask) if keep)
+    assert sorted(real) == list(range(n))
+
+
+@settings(max_examples=60, deadline=None)
+@given(_shard_setups())
+def test_determinism_and_epoch_reshuffle(setup):
+    n, shards, epoch, seed, shuffle = setup
+    a = ShardedSampler(n, shards, 0, shuffle=shuffle, seed=seed)
+    b = ShardedSampler(n, shards, 0, shuffle=shuffle, seed=seed)
+    a.set_epoch(epoch)
+    b.set_epoch(epoch)
+    assert list(a) == list(b)  # (4) reproducible
+    if shuffle and n > 16:
+        b.set_epoch(epoch + 1)
+        assert list(a) != list(b)  # reshuffles across epochs
