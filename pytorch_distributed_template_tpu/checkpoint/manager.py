@@ -156,6 +156,23 @@ class CheckpointManager:
 
     # -- restore ------------------------------------------------------------
 
+    def _disk_subtree_template(self, path, key: str):
+        """Zeros pytree matching the checkpoint's own structure for ``key``
+        (from orbax metadata, no array reads) — used to restore subtrees
+        the caller will discard (e.g. opt_state of a changed optimizer).
+
+        Unwraps the same orbax API shape variants as ``_ckpt_has_ema``."""
+        import jax.numpy as jnp
+
+        md = self._ckptr.metadata(Path(path))
+        tree = getattr(md, "item_metadata", None) or md
+        if hasattr(tree, "tree"):
+            tree = tree.tree
+        return jax.tree.map(
+            lambda m: jnp.zeros(tuple(m.shape), m.dtype),
+            tree[key], is_leaf=lambda x: hasattr(x, "shape"),
+        )
+
     @staticmethod
     def load_meta(resume_path) -> Optional[dict]:
         resume_path = Path(resume_path)
@@ -207,6 +224,15 @@ class CheckpointManager:
         )
 
         template = _saveable(template_state)
+        if opt_changed:
+            # a different optimizer type means a different opt_state tree
+            # structure — restoring into the new template would fail in
+            # orbax before the policy below could drop it. Restore the
+            # on-disk opt_state into a throwaway placeholder built from
+            # the checkpoint's own metadata instead (discarded below).
+            template["opt_state"] = self._disk_subtree_template(
+                resume_path, "opt_state"
+            )
         # Reconcile EMA layout from the checkpoint's own metadata (not
         # exception-driven: a restore failure can have unrelated causes and
         # must surface as-is).

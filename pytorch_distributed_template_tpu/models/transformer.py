@@ -62,14 +62,17 @@ class SelfAttention(nn.Module):
     mesh: Optional[Any] = None      # required for 'ring'
 
     @nn.compact
-    def __call__(self, x, train: bool):
+    def __call__(self, x, train: bool, decode: bool = False,
+                 decode_index=None):
         b, t, _ = x.shape
         head_dim = self.d_model // self.n_head
         qkv = nn.Dense(3 * self.d_model, dtype=self.dtype,
                        kernel_init=_dense_init(0.02), name="qkv")(x)
         qkv = qkv.reshape(b, t, 3, self.n_head, head_dim)
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
-        if self.attn_impl == "ring":
+        if decode:
+            ctx = self._cached_attention(q, k, v, decode_index)
+        elif self.attn_impl == "ring":
             if self.mesh is None:
                 raise ValueError("attn_impl='ring' requires a mesh")
             ctx = ring_attention(q, k, v, self.mesh, causal=True)
@@ -84,6 +87,45 @@ class SelfAttention(nn.Module):
                        name="out")(ctx)
         return nn.Dropout(self.dropout, deterministic=not train)(out)
 
+    def _cached_attention(self, q, k, v, cur):
+        """Incremental attention against a KV cache (flax decode pattern).
+
+        ``cur`` is the write position — the model-level ``pos_index``
+        counter, threaded down so there is exactly ONE position counter
+        (engine/generate.py drives it). Cache tensors are created on the
+        FIRST decode-mode call with that call's sequence length as the
+        decode budget; later calls insert ``t`` new K/V rows at ``cur``
+        and attend causally over the filled prefix — supporting both
+        multi-token prefill and single-token steps. The attention math is
+        the shared ``ops.attention.multihead_attention`` with a visibility
+        mask.
+        """
+        b, t, h, d = q.shape
+        is_init = self.has_variable("cache", "cached_key")
+        cached_k = self.variable("cache", "cached_key", jnp.zeros,
+                                 k.shape, k.dtype)
+        cached_v = self.variable("cache", "cached_value", jnp.zeros,
+                                 v.shape, v.dtype)
+        if not is_init:
+            # shape-setting pass: allocate the cache, no attention needed
+            return jnp.zeros((b, t, h, d), q.dtype)
+        max_len = cached_k.value.shape[1]
+        if t > max_len:
+            raise ValueError(f"decode input {t} exceeds cache {max_len}")
+        k_all = jax.lax.dynamic_update_slice(
+            cached_k.value, k.astype(cached_k.value.dtype), (0, cur, 0, 0)
+        )
+        v_all = jax.lax.dynamic_update_slice(
+            cached_v.value, v.astype(cached_v.value.dtype), (0, cur, 0, 0)
+        )
+        cached_k.value = k_all
+        cached_v.value = v_all
+        q_pos = cur + jnp.arange(t)                       # [t]
+        visible = jnp.arange(max_len)[None, :] <= q_pos[:, None]  # [t, L]
+        return multihead_attention(
+            q, k_all, v_all, causal=False, mask=visible[None, None]
+        )
+
 
 class Block(nn.Module):
     d_model: int
@@ -97,12 +139,13 @@ class Block(nn.Module):
     moe: Optional[dict] = None      # MoeMlp kwargs; None -> dense MLP
 
     @nn.compact
-    def __call__(self, x, train: bool, example_mask=None):
+    def __call__(self, x, train: bool, example_mask=None,
+                 decode: bool = False, decode_index=None):
         h = nn.LayerNorm(dtype=jnp.float32, name="ln_1")(x)
         x = x + SelfAttention(
             self.d_model, self.n_head, self.dropout, self.n_layer,
             self.dtype, self.attn_impl, self.mesh, name="attn",
-        )(h, train)
+        )(h, train, decode, decode_index)
         h = nn.LayerNorm(dtype=jnp.float32, name="ln_2")(x)
         if self.moe:
             from .moe import MoeMlp
@@ -152,10 +195,16 @@ class TransformerLM(nn.Module):
         )
 
     @nn.compact
-    def __call__(self, tokens, train: bool = False, example_mask=None):
+    def __call__(self, tokens, train: bool = False, example_mask=None,
+                 decode: bool = False):
         """``example_mask`` ([B] bool): marks padded examples so MoE blocks
         keep them out of expert capacity/balance statistics (dense blocks
-        are per-token and need no mask — the loss masking suffices)."""
+        are per-token and need no mask — the loss masking suffices).
+
+        ``decode=True`` runs incremental KV-cached inference: the first
+        decode call (over ``[B, total_len]`` zeros, mutable=["cache"])
+        allocates the caches, later calls consume new tokens at the cached
+        position (engine/generate.py drives this)."""
         d_ff = self.d_ff or 4 * self.d_model
         b, t = tokens.shape
         embed = nn.Embed(
@@ -167,13 +216,30 @@ class TransformerLM(nn.Module):
             "wpe", _dense_init(0.01), (self.max_len, self.d_model),
             jnp.float32,
         )
-        x = embed(tokens) + pos_embed[None, :t].astype(self.dtype)
+        start = None
+        if decode:
+            # the ONE position counter for the whole decode state; each
+            # attention layer receives it as its cache write index
+            is_init = self.has_variable("cache", "pos_index")
+            pos_index = self.variable(
+                "cache", "pos_index", lambda: jnp.zeros((), jnp.int32)
+            )
+            start = pos_index.value if is_init else jnp.zeros((), jnp.int32)
+            pos = jax.lax.dynamic_slice_in_dim(pos_embed, start, t, axis=0)
+            if is_init:
+                pos_index.value = start + t
+        else:
+            pos = pos_embed[:t]
+        x = embed(tokens) + pos[None].astype(self.dtype)
         x = nn.Dropout(self.dropout, deterministic=not train)(x)
 
         block_cls = Block
         if self.remat:
+            # static_argnums count `self` as 0: train=2 and decode=4 are
+            # Python bools and must stay static; example_mask (3) is a
+            # traced [B] array and must NOT be listed
             block_cls = nn.remat(
-                Block, static_argnums=(2,),
+                Block, static_argnums=(2, 4),
                 policy=jax.checkpoint_policies.nothing_saveable,
             )
         for i in range(self.n_layer):
@@ -181,7 +247,7 @@ class TransformerLM(nn.Module):
                 self.d_model, self.n_head, d_ff, self.dropout,
                 self.n_layer, self.dtype, self.attn_impl, self.mesh,
                 self._moe_kwargs(i), name=f"h_{i}",
-            )(x, train, example_mask)
+            )(x, train, example_mask, decode, start)
         x = nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x)
         if self.tie_embeddings:
             logits = embed.attend(x.astype(self.dtype))

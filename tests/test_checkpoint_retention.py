@@ -36,3 +36,36 @@ def test_default_keeps_everything(tmp_path):
     d = config.save_dir
     kept = sorted(p.name for p in d.glob("checkpoint-epoch*") if p.is_dir())
     assert kept == [f"checkpoint-epoch{i}" for i in (1, 2, 3)], kept
+
+
+def test_resume_with_changed_optimizer_type(tmp_path):
+    """Reference policy (base_trainer.py:156-161): optimizer type changed
+    -> warn, drop optimizer state, still restore params/epoch. Must not
+    crash on the structural mismatch between opt_state trees."""
+    import jax
+    import numpy as np
+
+    c1 = make_config(tmp_path, run_id="opt1", **{"trainer;epochs": 1})
+    t1 = build_trainer(c1)
+    t1.train()
+    ckpt = c1.save_dir / "checkpoint-epoch1"
+
+    c2 = make_config(
+        tmp_path, run_id="opt2", resume=ckpt,
+        **{"trainer;epochs": 2,
+           "optimizer;type": "SGD",
+           "optimizer;args": {"lr": 0.01, "momentum": 0.9}},
+    )
+    t2 = build_trainer(c2)
+    assert t2.start_epoch == 2
+    # params actually came from the checkpoint...
+    for a, b in zip(jax.tree.leaves(t1.state.params),
+                    jax.tree.leaves(t2.state.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # ...but the opt_state is the FRESH SGD tree, not Adam's (different
+    # structure: Adam carries two moment trees, SGD+momentum one trace)
+    s1 = jax.tree.structure(t1.state.opt_state)
+    s2 = jax.tree.structure(t2.state.opt_state)
+    assert s1 != s2
+    # and training continues with the fresh SGD state
+    t2.train()
