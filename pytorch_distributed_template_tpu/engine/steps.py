@@ -51,7 +51,8 @@ def make_train_step(model, tx, criterion: Callable,
                     ema_decay: float = 0.0,
                     skip_nonfinite: bool = False,
                     augment=None,
-                    mixup_alpha: float = 0.0):
+                    mixup_alpha: float = 0.0,
+                    log_grad_norm: bool = False):
     """Build ``train_step(state, batch) -> (state, metrics)``.
 
     ``metrics`` holds scalar sums + count; callers divide after accumulating
@@ -225,8 +226,14 @@ def make_train_step(model, tx, criterion: Callable,
             lambda g: (g / denom).astype(g.dtype), grads
         )
 
-        if grad_clip_norm > 0:
+        if log_grad_norm or grad_clip_norm > 0:
+            # pre-clip global norm of the mean gradient
             gnorm = optax.global_norm(grads)
+        if log_grad_norm:
+            # count-weighted so finalize_metrics' divide-by-count yields
+            # the epoch's mean per-step grad norm
+            metrics["grad_norm_sum"] = gnorm * jnp.maximum(count, 1.0)
+        if grad_clip_norm > 0:
             scale = jnp.minimum(1.0, grad_clip_norm / (gnorm + 1e-6))
             grads = jax.tree.map(lambda g: g * scale, grads)
 

@@ -257,6 +257,9 @@ class Trainer(BaseTrainer):
         self.skip_nonfinite = bool(
             config["trainer"].get("skip_nonfinite", False)
         )
+        self.log_grad_norm = bool(
+            config["trainer"].get("log_grad_norm", False)
+        )
         train_step = make_train_step(
             model, self.tx, criterion, self.metric_ftns,
             input_key=self.input_key, target_key=self.target_key,
@@ -264,13 +267,14 @@ class Trainer(BaseTrainer):
             ema_decay=ema_decay, skip_nonfinite=self.skip_nonfinite,
             augment=build_augment(config["trainer"].get("augment")),
             mixup_alpha=float(config["trainer"].get("mixup_alpha", 0.0)),
+            log_grad_norm=self.log_grad_norm,
         )
         metric_sharding = jax.sharding.NamedSharding(
             self.mesh, jax.sharding.PartitionSpec()
         )
         train_keys = self._metric_keys() + (
             ["skipped_sum"] if self.skip_nonfinite else []
-        )
+        ) + (["grad_norm_sum"] if self.log_grad_norm else [])
         self._train_step = jax.jit(
             train_step,
             donate_argnums=0,

@@ -218,3 +218,17 @@ def test_eval_uses_ema_when_asked():
     m_sub = ev_live(s_sub, batch)
     np.testing.assert_allclose(float(m_ema["loss_sum"]),
                                float(m_sub["loss_sum"]), rtol=1e-6)
+
+
+def test_log_grad_norm_metric(tmp_path):
+    """trainer.log_grad_norm surfaces an epoch-mean grad_norm metric."""
+    from test_e2e_mnist import build_trainer, make_config
+
+    config = make_config(
+        tmp_path, run_id="gn",
+        **{"trainer;epochs": 1, "trainer;log_grad_norm": True},
+    )
+    t = build_trainer(config)
+    log = t.train()
+    assert "grad_norm" in log
+    assert np.isfinite(log["grad_norm"]) and log["grad_norm"] > 0
