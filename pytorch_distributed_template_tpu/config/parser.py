@@ -78,12 +78,30 @@ class ConfigParser:
         """Build from argparse. Returns ``(parsed_args, config_parser)``.
 
         Mirrors /root/reference/parse_config.py:49-77 including the resume
-        config rediscovery and fine-tune overlay.
+        config rediscovery and fine-tune overlay. ``--auto-resume`` (when
+        the entry point defines it) locates the experiment's newest
+        checkpoint and resumes it — the relaunch half of the
+        crash/preempt -> relaunch -> resume recovery contract
+        (SURVEY.md §5 failure detection); a fresh run starts when no
+        checkpoint exists yet.
         """
         for opt in options:
             args.add_argument(*opt.flags, default=None, type=opt.type)
         if not isinstance(args, tuple):
             args = args.parse_args()
+
+        if (getattr(args, "auto_resume", False) and args.resume is None
+                and args.config is not None):
+            scan_cfg = read_json(Path(args.config))
+            if getattr(args, "save_dir", None) is not None:
+                # honor -s here too, else the scan looks in the wrong tree
+                scan_cfg["trainer"]["save_dir"] = args.save_dir
+            found = find_latest_checkpoint(scan_cfg)
+            if found is not None:
+                args.resume = str(found)
+                logging.getLogger(__name__).warning(
+                    "--auto-resume: resuming from %s", found
+                )
 
         if args.resume is not None:
             resume = Path(args.resume)
@@ -169,6 +187,34 @@ class ConfigParser:
     @property
     def run_id(self) -> str:
         return self._run_id
+
+
+def find_latest_checkpoint(config: dict):
+    """Newest ``checkpoint-epochN`` across the experiment's train runs.
+
+    Scans ``<save_dir>/<name>/train/<run_id>/`` and picks the most
+    recently written checkpoint (directory mtime; epoch breaks ties).
+    Recency comes from mtime, NOT the run-id name — MMDD_HHMMSS ids carry
+    no year, so lexicographic order lies across a New Year boundary.
+    Returns None when the experiment has never checkpointed.
+    """
+    import re
+
+    base = (
+        Path(config["trainer"]["save_dir"]) / config["name"] / "train"
+    )
+    candidates = []
+    if base.is_dir():
+        for run in base.iterdir():
+            for ck in run.glob("checkpoint-epoch*"):
+                m = re.match(r"checkpoint-epoch(\d+)$", ck.name)
+                if m and ck.is_dir():
+                    candidates.append(
+                        (ck.stat().st_mtime, int(m.group(1)), ck)
+                    )
+    if not candidates:
+        return None
+    return max(candidates)[2]
 
 
 def _resume_config_path(resume: Path) -> Path:

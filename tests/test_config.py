@@ -193,3 +193,32 @@ def test_get_logger_verbosity(tmp_path):
     assert lg.level == logging.INFO
     with pytest.raises(AssertionError):
         parser.get_logger("x", verbosity=9)
+
+
+def test_find_latest_checkpoint(tmp_path):
+    from pytorch_distributed_template_tpu.config.parser import (
+        find_latest_checkpoint,
+    )
+
+    import os
+
+    cfg = {"name": "Exp", "trainer": {"save_dir": str(tmp_path)}}
+    assert find_latest_checkpoint(cfg) is None  # nothing yet
+
+    base = tmp_path / "Exp" / "train"
+    # "1231_*" run created FIRST (older), "0101_*" run created after — the
+    # year-boundary case where lexicographic run ids lie about recency
+    for i, (run, epochs) in enumerate(
+        (("1231_090000", (1, 2)), ("0101_080000", (1,)))
+    ):
+        for e in epochs:
+            d = base / run / f"checkpoint-epoch{e}"
+            d.mkdir(parents=True)
+            os.utime(d, (1000 + i * 100 + e, 1000 + i * 100 + e))
+    # decoys that must not match
+    (base / "0101_080000" / "checkpoint-epoch2.meta.json").write_text("{}")
+    (base / "0101_080000" / "model_best").mkdir()
+
+    found = find_latest_checkpoint(cfg)
+    # mtime recency wins, not the (year-less) run-id string order
+    assert found == base / "0101_080000" / "checkpoint-epoch1"

@@ -78,6 +78,9 @@ if __name__ == "__main__":
                         help="dir of save path")
     parser.add_argument("--no-validate", action="store_true",
                         help="skip validation during training")
+    parser.add_argument("--auto-resume", action="store_true",
+                        help="resume from the experiment's newest checkpoint "
+                             "if one exists (relaunch-after-preemption)")
     parser.add_argument("--seed", type=int, default=None, help="Random seed.")
     parser.add_argument("--deterministic", action="store_true",
                         help="accepted for parity; TPU/XLA runs are "
