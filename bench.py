@@ -12,13 +12,40 @@ throughput on the same host.
 """
 from __future__ import annotations
 
+import faulthandler
 import json
+import os
+import sys
+import threading
 import time
 
 import numpy as np
 
 WARMUP = 5
 STEPS = 20
+# Diagnostic watchdog: a wedged device/tunnel would otherwise hang this
+# process silently. A THREAD (not signal.alarm: SIGALRM handlers can't run
+# while the main thread is stuck inside a blocking C call — exactly the
+# wedge case) dumps all stacks to stderr (stdout keeps the one-JSON-line
+# contract) and hard-exits non-zero so the driver sees a failure with a
+# cause instead of a timeout with nothing. Deliberately standalone from
+# utils/watchdog.StepWatchdog: the bench guard must arm before, and
+# survive, a package/jax import that itself hangs on the wedged device.
+WATCHDOG_SECS = 1200
+_done = threading.Event()
+
+
+def _start_watchdog():
+    def run():
+        if not _done.wait(WATCHDOG_SECS):
+            print("bench watchdog: no completion after "
+                  f"{WATCHDOG_SECS}s — device/tunnel likely hung",
+                  file=sys.stderr)
+            faulthandler.dump_traceback(file=sys.stderr)
+            sys.stderr.flush()
+            os._exit(2)
+
+    threading.Thread(target=run, daemon=True).start()
 
 
 def bench_tpu_native(batch: int) -> float:
@@ -138,6 +165,7 @@ def bench_reference_torch(batch: int = 16, steps: int = 3) -> float:
 
 
 def main():
+    _start_watchdog()
     ours = None
     for batch in (128, 64, 32):
         try:
@@ -158,6 +186,7 @@ def main():
         "unit": "images/sec",
         "vs_baseline": round(vs, 3),
     }))
+    _done.set()
 
 
 if __name__ == "__main__":
