@@ -1,0 +1,96 @@
+"""Import HuggingFace GPT-2 weights into the in-tree TransformerLM.
+
+Interop with the torch ecosystem the reference lives in: a user can take
+any HF ``GPT2LMHeadModel`` checkpoint (torch, CPU — never in the compute
+path) and obtain a params pytree for :class:`..models.transformer.TransformerLM`,
+then train/generate TPU-natively. Verified by logit-parity tests against
+``transformers`` (tests/test_hf_import.py).
+
+Layout mapping (HF ``Conv1D`` stores ``[in, out]`` — the same orientation
+as a flax ``Dense`` kernel, so no transposes are needed anywhere):
+
+==========================  =================================
+HF GPT-2                    TransformerLM params
+==========================  =================================
+``wte.weight [V, D]``       ``wte/embedding``
+``wpe.weight [P, D]``       ``wpe``
+``h.{i}.ln_1.{w,b}``        ``h_{i}/ln_1/{scale,bias}``
+``h.{i}.attn.c_attn``       ``h_{i}/attn/qkv``    (q|k|v blocks)
+``h.{i}.attn.c_proj``       ``h_{i}/attn/out``
+``h.{i}.ln_2.{w,b}``        ``h_{i}/ln_2/{scale,bias}``
+``h.{i}.mlp.c_fc``          ``h_{i}/mlp/up``
+``h.{i}.mlp.c_proj``        ``h_{i}/mlp/down``
+``ln_f.{w,b}``              ``ln_f/{scale,bias}``
+(tied lm_head)              (tie_embeddings=True)
+==========================  =================================
+
+The in-tree QKV reshape ``[.., 3D] -> [.., 3, H, hd]`` orders features as
+three D-wide blocks, exactly HF's ``c_attn`` concatenation, so the fused
+kernel copies over verbatim.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+
+def _to_np(t) -> np.ndarray:
+    if hasattr(t, "detach"):
+        return t.detach().cpu().numpy()
+    return np.asarray(t)
+
+
+def import_hf_gpt2(hf_state_dict, n_layer: int) -> dict:
+    """Convert an HF GPT2LMHeadModel ``state_dict()`` to a params pytree.
+
+    :param hf_state_dict: mapping of HF parameter names to tensors (torch
+        tensors or arrays); both ``transformer.``-prefixed
+        (GPT2LMHeadModel) and bare (GPT2Model) names are accepted.
+    :param n_layer: number of transformer blocks to convert.
+    :returns: params dict for ``TransformerLM`` with matching dims and
+        ``tie_embeddings=True``.
+    """
+    sd = {}
+    for k, v in hf_state_dict.items():
+        sd[k[len("transformer."):] if k.startswith("transformer.") else k] = v
+
+    def g(name):
+        if name not in sd:
+            raise KeyError(
+                f"HF state dict is missing '{name}' — not a GPT-2 "
+                "checkpoint, or n_layer too large"
+            )
+        return _to_np(sd[name]).astype(np.float32)
+
+    if f"h.{n_layer}.ln_1.weight" in sd:
+        raise ValueError(
+            f"HF checkpoint has more than n_layer={n_layer} blocks "
+            f"(found 'h.{n_layer}.'); converting a truncated model would "
+            "silently produce wrong logits"
+        )
+
+    params = {
+        "wte": {"embedding": g("wte.weight")},
+        "wpe": g("wpe.weight"),
+        "ln_f": {"scale": g("ln_f.weight"), "bias": g("ln_f.bias")},
+    }
+    for i in range(n_layer):
+        p = f"h.{i}."
+        params[f"h_{i}"] = {
+            "ln_1": {"scale": g(p + "ln_1.weight"),
+                     "bias": g(p + "ln_1.bias")},
+            "ln_2": {"scale": g(p + "ln_2.weight"),
+                     "bias": g(p + "ln_2.bias")},
+            "attn": {
+                "qkv": {"kernel": g(p + "attn.c_attn.weight"),
+                        "bias": g(p + "attn.c_attn.bias")},
+                "out": {"kernel": g(p + "attn.c_proj.weight"),
+                        "bias": g(p + "attn.c_proj.bias")},
+            },
+            "mlp": {
+                "up": {"kernel": g(p + "mlp.c_fc.weight"),
+                       "bias": g(p + "mlp.c_fc.bias")},
+                "down": {"kernel": g(p + "mlp.c_proj.weight"),
+                         "bias": g(p + "mlp.c_proj.bias")},
+            },
+        }
+    return params

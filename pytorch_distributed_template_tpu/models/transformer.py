@@ -137,16 +137,19 @@ class Block(nn.Module):
     attn_impl: str
     mesh: Optional[Any]
     moe: Optional[dict] = None      # MoeMlp kwargs; None -> dense MLP
+    ln_eps: float = 1e-5
 
     @nn.compact
     def __call__(self, x, train: bool, example_mask=None,
                  decode: bool = False, decode_index=None):
-        h = nn.LayerNorm(dtype=jnp.float32, name="ln_1")(x)
+        h = nn.LayerNorm(epsilon=self.ln_eps, dtype=jnp.float32,
+                         name="ln_1")(x)
         x = x + SelfAttention(
             self.d_model, self.n_head, self.dropout, self.n_layer,
             self.dtype, self.attn_impl, self.mesh, name="attn",
         )(h, train, decode, decode_index)
-        h = nn.LayerNorm(dtype=jnp.float32, name="ln_2")(x)
+        h = nn.LayerNorm(epsilon=self.ln_eps, dtype=jnp.float32,
+                         name="ln_2")(x)
         if self.moe:
             from .moe import MoeMlp
 
@@ -178,6 +181,7 @@ class TransformerLM(nn.Module):
     mesh: Optional[Any] = None
     remat: bool = False
     tie_embeddings: bool = True
+    ln_eps: float = 1e-5            # GPT-2's layer_norm_epsilon
     # --- MoE (models/moe.py); moe_experts == 0 -> all-dense blocks --------
     moe_experts: int = 0
     moe_top_k: int = 2
@@ -244,11 +248,14 @@ class TransformerLM(nn.Module):
             )
         for i in range(self.n_layer):
             x = block_cls(
-                self.d_model, self.n_head, d_ff, self.dropout,
-                self.n_layer, self.dtype, self.attn_impl, self.mesh,
-                self._moe_kwargs(i), name=f"h_{i}",
+                d_model=self.d_model, n_head=self.n_head, d_ff=d_ff,
+                dropout=self.dropout, n_layer=self.n_layer,
+                dtype=self.dtype, attn_impl=self.attn_impl, mesh=self.mesh,
+                moe=self._moe_kwargs(i), ln_eps=self.ln_eps,
+                name=f"h_{i}",
             )(x, train, example_mask, decode, start)
-        x = nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x)
+        x = nn.LayerNorm(epsilon=self.ln_eps, dtype=jnp.float32,
+                         name="ln_f")(x)
         if self.tie_embeddings:
             logits = embed.attend(x.astype(self.dtype))
         else:
