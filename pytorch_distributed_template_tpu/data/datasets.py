@@ -296,10 +296,12 @@ def npy_loader(data_dir: str = "data/", batch_size: int = 128,
 def imagenet_loader(data_dir: str = "data/", batch_size: int = 128,
                     shuffle: bool = True, num_workers: int = 0,
                     training: bool = True, n: int = 1024,
-                    image_size: int = 224, seed: int = 0):
+                    image_size: int = 224, num_classes: int = 1000,
+                    seed: int = 0):
     del num_workers
     data = synthetic_imagenet(
-        n=n, image_size=image_size, seed=seed, training=training
+        n=n, image_size=image_size, seed=seed, training=training,
+        num_classes=num_classes,
     )
     return _make_image_loader(data, batch_size, shuffle, seed=seed)
 
