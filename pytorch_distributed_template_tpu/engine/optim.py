@@ -78,6 +78,39 @@ def adagrad(lr=1e-2, eps=1e-10, learning_rate=None):
     return optax.adagrad(_lr(lr, learning_rate), eps=eps)
 
 
+# --- large-batch optimizers (beyond the reference: the TPU data-parallel
+# scaling path runs at batch sizes where plain SGD/Adam degrade; LARS/LAMB
+# are the standard trust-ratio fixes, Lion the memory-lean alternative) ----
+
+@OPTIMIZERS.register("LARS")
+def lars(lr=1.0, momentum=0.9, weight_decay=0.0,
+         trust_coefficient=0.001, learning_rate=None):
+    """Layer-wise adaptive rate scaling (You et al. 2017) — large-batch
+    ResNet/ImageNet (the MLPerf recipe)."""
+    return optax.lars(
+        _lr(lr, learning_rate), weight_decay=weight_decay,
+        momentum=momentum, trust_coefficient=trust_coefficient,
+    )
+
+
+@OPTIMIZERS.register("LAMB")
+def lamb(lr=1e-3, betas=(0.9, 0.999), eps=1e-6, weight_decay=0.0,
+         learning_rate=None):
+    """Layer-wise Adam (You et al. 2020) — large-batch transformers."""
+    b1, b2 = betas
+    return optax.lamb(_lr(lr, learning_rate), b1=b1, b2=b2, eps=eps,
+                      weight_decay=weight_decay)
+
+
+@OPTIMIZERS.register("Lion")
+def lion(lr=1e-4, betas=(0.9, 0.99), weight_decay=0.0, learning_rate=None):
+    """Sign-momentum optimizer (Chen et al. 2023): one momentum slot —
+    half Adam's optimizer HBM, a real win at TPU memory limits."""
+    b1, b2 = betas
+    return optax.lion(_lr(lr, learning_rate), b1=b1, b2=b2,
+                      weight_decay=weight_decay)
+
+
 # ---------------------------------------------------------------------------
 # epoch-indexed LR scale schedules (reference lr_scheduler parity)
 # ---------------------------------------------------------------------------

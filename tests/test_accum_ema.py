@@ -232,3 +232,23 @@ def test_log_grad_norm_metric(tmp_path):
     log = t.train()
     assert "grad_norm" in log
     assert np.isfinite(log["grad_norm"]) and log["grad_norm"] > 0
+
+
+@pytest.mark.parametrize("opt_type,args", [
+    ("LARS", {"lr": 0.5, "momentum": 0.9, "weight_decay": 1e-4}),
+    ("LAMB", {"lr": 1e-3, "weight_decay": 0.01}),
+    ("Lion", {"lr": 1e-4, "weight_decay": 0.01}),
+])
+def test_large_batch_optimizers_train(tmp_path, opt_type, args):
+    """LARS/LAMB/Lion resolve from config and complete a training epoch."""
+    from test_e2e_mnist import build_trainer, make_config
+
+    config = make_config(
+        tmp_path, run_id=f"opt_{opt_type}",
+        **{"trainer;epochs": 1,
+           "optimizer;type": opt_type,
+           "optimizer;args": args},
+    )
+    t = build_trainer(config)
+    log = t.train()
+    assert np.isfinite(log["loss"])
