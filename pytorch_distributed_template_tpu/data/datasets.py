@@ -306,6 +306,49 @@ def imagenet_loader(data_dir: str = "data/", batch_size: int = 128,
     return _make_image_loader(data, batch_size, shuffle, seed=seed)
 
 
+@LOADERS.register("ByteLMLoader")
+def byte_lm_loader(data_dir: str = "data/", batch_size: int = 8,
+                   shuffle: bool = True, num_workers: int = 0,
+                   training: bool = True, file: str = "input.txt",
+                   seq_len: int = 256, val_fraction: float = 0.1,
+                   seed: int = 0):
+    """Byte-level LM over any local text/binary file (vocab = 256).
+
+    The tokenizer-free path to real-text training for the GPT-2 family:
+    no vocab files, no network, UTF-8 agnostic. The file is split into
+    train/val by ``val_fraction`` (tail split, so val is held-out text),
+    then chunked into non-overlapping ``seq_len`` windows. Falls back to
+    the synthetic bigram stream when the file is absent (same degradation
+    contract as the image loaders).
+    """
+    del num_workers
+    path = Path(data_dir) / file
+    if not path.exists():
+        logger.warning(
+            "ByteLMLoader: %s not found; using synthetic byte-LM data.",
+            path,
+        )
+        data = synthetic_lm(n=2048, seq_len=seq_len, vocab_size=256,
+                            seed=seed, training=training)
+        return _make_image_loader(data, batch_size, shuffle, seed=seed)
+    # memory-map and keep uint8: a multi-GB corpus stays on disk (pages
+    # stream on demand through the native gather) instead of 4x-expanding
+    # into resident int32 — same beyond-RAM contract as NpyDataLoader.
+    # uint8 tokens flow through embed/CE unchanged (integer ops cast).
+    raw = np.memmap(path, dtype=np.uint8, mode="r")
+    split = int(len(raw) * (1.0 - val_fraction))
+    part = raw[:split] if training else raw[split:]
+    n_chunks = len(part) // seq_len
+    if n_chunks == 0:
+        raise ValueError(
+            f"ByteLMLoader: {path} too small for one {seq_len}-byte "
+            f"{'train' if training else 'val'} sequence"
+        )
+    tokens = part[: n_chunks * seq_len].reshape(n_chunks, seq_len)
+    return _make_image_loader({"tokens": tokens}, batch_size, shuffle,
+                              seed=seed)
+
+
 @LOADERS.register("SyntheticLMLoader")
 def lm_loader(data_dir: str = "data/", batch_size: int = 8,
               shuffle: bool = True, num_workers: int = 0,
