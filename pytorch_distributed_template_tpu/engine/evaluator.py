@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ..config.registry import LOADERS, METRICS, MODELS
 from ..data.loader import prefetch_to_device
@@ -19,7 +20,7 @@ from ..parallel import batch_sharding, dist, mesh_from_config
 from ..parallel.sharding import apply_rules
 from .losses import resolve_loss
 from .optim import build_optimizer
-from .state import create_train_state
+from .state import create_sharded_train_state
 from .steps import finalize_metrics, make_eval_step
 
 
@@ -62,13 +63,11 @@ def evaluate(config, mesh=None) -> dict:
     # (optimizer slots' shapes depend only on optimizer type + param shapes;
     # ema_params present iff the training config enabled EMA).
     tx, _ = build_optimizer(config, steps_per_epoch=1)
-    sample = test_loader.arrays[input_key][:1]
     ema_decay = float(config["trainer"].get("ema_decay", 0.0))
-    state = create_train_state(model, tx, jnp.asarray(sample),
-                               with_ema=ema_decay > 0)
-    rules = getattr(model, "partition_rules", lambda: [])()
-    state_sharding = apply_rules(state, mesh, rules)
-    state = jax.device_put(state, state_sharding)
+    state, _ = create_sharded_train_state(
+        model, tx, test_loader.arrays[input_key][:1], mesh,
+        with_ema=ema_decay > 0,
+    )
 
     from ..checkpoint import CheckpointManager
 

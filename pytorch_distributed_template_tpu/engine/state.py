@@ -55,3 +55,30 @@ def create_train_state(model, tx, sample_input, seed: int = 0,
         rng=state_key,
         ema_params=jax.tree.map(jnp.copy, params) if with_ema else None,
     )
+
+
+def create_sharded_train_state(model, tx, sample_input, mesh, seed: int = 0,
+                               with_ema: bool = False):
+    """Mesh-placed TrainState: create INSIDE jit with out_shardings.
+
+    The multi-host-legal placement path shared by the trainer and the
+    evaluator: a host-locally built state cannot be ``device_put`` to a
+    sharding spanning non-addressable devices, but jit outputs are born
+    global; single-host the two are equivalent. ``sample_input`` should be
+    numpy so it embeds as a literal rather than a host-local array
+    operand. Returns ``(state, state_sharding)``.
+    """
+    import numpy as np
+
+    from ..parallel.sharding import apply_rules
+
+    sample = np.asarray(sample_input)
+
+    def init_fn():
+        return create_train_state(model, tx, sample, seed=seed,
+                                  with_ema=with_ema)
+
+    rules = getattr(model, "partition_rules", lambda: [])()
+    sharding = apply_rules(jax.eval_shape(init_fn), mesh, rules)
+    state = jax.jit(init_fn, out_shardings=sharding)()
+    return state, sharding

@@ -43,7 +43,7 @@ from ..utils import preemption
 from ..utils.debug import configure_debug
 from ..utils.watchdog import StepWatchdog
 from .optim import build_optimizer
-from .state import create_train_state
+from .state import create_sharded_train_state
 from .steps import finalize_metrics, make_eval_step, make_train_step
 
 
@@ -225,20 +225,16 @@ class Trainer(BaseTrainer):
         # --- optimizer + schedule (per-step, epoch-indexed; optim.py) ------
         self.tx, self.lr_fn = build_optimizer(config, self.len_epoch)
 
-        # --- state init + placement ---------------------------------------
+        # --- state init + placement (multi-host-legal jit creation; see
+        # engine/state.create_sharded_train_state) --------------------------
         ema_decay = float(config["trainer"].get("ema_decay", 0.0))
-        sample = train_loader.arrays[self.input_key][:1]
-        state = create_train_state(
-            model, self.tx, jnp.asarray(sample), seed=seed,
-            with_ema=ema_decay > 0,
+        self.state, self.state_sharding = create_sharded_train_state(
+            model, self.tx, train_loader.arrays[self.input_key][:1],
+            self.mesh, seed=seed, with_ema=ema_decay > 0,
         )
-        if dist.is_main_process():
-            self.logger.info(describe(model, state.params))
-
-        rules = getattr(model, "partition_rules", lambda: [])()
-        self.state_sharding = apply_rules(state, self.mesh, rules)
         self.batch_sharding = batch_sharding(self.mesh)
-        self.state = jax.device_put(state, self.state_sharding)
+        if dist.is_main_process():
+            self.logger.info(describe(model, self.state.params))
 
         # --- resume (reference base_trainer.py:48-49,134-163) -------------
         if config.resume is not None:

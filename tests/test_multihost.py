@@ -17,6 +17,7 @@ from pathlib import Path
 import pytest
 
 WORKER = Path(__file__).parent / "multihost_worker.py"
+TRAIN_WORKER = Path(__file__).parent / "multihost_train_worker.py"
 REPO = Path(__file__).parent.parent
 
 
@@ -26,13 +27,11 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def test_two_process_rendezvous_and_collectives():
+def _spawn_pair(script, extra_args=(), timeout=210):
     port = _free_port()
     procs = []
     for rank in range(2):
         env = dict(os.environ)
-        # preserve inherited flags (conftest.py does the same), but replace
-        # any existing device-count with the per-worker 4
         inherited = " ".join(
             f for f in os.environ.get("XLA_FLAGS", "").split()
             if "xla_force_host_platform_device_count" not in f
@@ -48,25 +47,54 @@ def test_two_process_rendezvous_and_collectives():
         })
         env.pop("JAX_COORDINATOR_ADDRESS", None)
         procs.append(subprocess.Popen(
-            [sys.executable, str(WORKER)],
+            [sys.executable, str(script), *extra_args],
             env=env, cwd=REPO,
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
         ))
     outs = []
     try:
         for p in procs:
-            out, _ = p.communicate(timeout=210)
+            out, _ = p.communicate(timeout=timeout)
             outs.append(out)
     except subprocess.TimeoutExpired:
         partial = []
         for p in procs:
             p.kill()
-            out, _ = p.communicate()  # reap; collect hang diagnostics
+            out, _ = p.communicate()
             partial.append(out or "")
         pytest.fail(
-            "multi-host workers hung (rendezvous or collective).\n"
+            f"multi-host workers did not finish within {timeout}s "
+            "(hung, or the machine is too slow for the budget).\n"
             + "\n---\n".join(o[-2000:] for o in partial)
         )
+    return procs, outs
+
+
+def test_two_process_rendezvous_and_collectives():
+    procs, outs = _spawn_pair(WORKER)
     for rank, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"rank {rank} failed:\n{out[-3000:]}"
         assert f"MULTIHOST_OK rank={rank}" in out, out[-3000:]
+
+
+def test_two_process_full_training(tmp_path):
+    """REAL Trainer, two hosts: sharded data, global-batch assembly,
+    cross-host grad psum, identical global metrics on every host, and a
+    multi-host orbax checkpoint (multihost_train_worker.py)."""
+    # generous budget: two epochs of CPU jit compiles + orbax saves
+    procs, outs = _spawn_pair(TRAIN_WORKER, extra_args=(str(tmp_path),),
+                              timeout=480)
+    lines = []
+    for rank, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"rank {rank} failed:\n{out[-3000:]}"
+        assert f"MULTIHOST_TRAIN_OK rank={rank}" in out, out[-3000:]
+        lines.append(
+            next(ln for ln in out.splitlines() if ln.startswith("MHTRAIN"))
+        )
+    # both hosts computed bit-identical global metrics (drop the rank field)
+    assert lines[0].split(" ", 2)[2] == lines[1].split(" ", 2)[2], lines
+    # one run dir, config snapshot from rank 0 only, checkpoint complete
+    run = tmp_path / "Mnist_LeNet_Debug" / "train" / "mh"
+    assert (run / "config.json").exists()
+    assert (run / "checkpoint-epoch2").is_dir()
+    assert (run / "model_best").is_dir()
