@@ -17,7 +17,6 @@ from ..config.registry import LOADERS, METRICS, MODELS
 from ..data.loader import prefetch_to_device
 from ..models.base import inject_mesh
 from ..parallel import batch_sharding, dist, mesh_from_config
-from ..parallel.sharding import apply_rules
 from .losses import resolve_loss
 from .optim import build_optimizer
 from .state import create_sharded_train_state

@@ -20,7 +20,6 @@ Key structural translation (SURVEY.md §3.1 hot loop -> jit):
 """
 from __future__ import annotations
 
-import logging
 import math
 from abc import abstractmethod
 from typing import Optional
@@ -38,7 +37,6 @@ from ..observability.profiler import (
     ThroughputMeter, TraceCapture, compiled_flops, mfu,
 )
 from ..parallel import batch_sharding, dist, mesh_from_config
-from ..parallel.sharding import apply_rules
 from ..utils import preemption
 from ..utils.debug import configure_debug
 from ..utils.watchdog import StepWatchdog

@@ -20,7 +20,7 @@ ResNet-50 and GPT-2. TPU-first design choices:
 """
 from __future__ import annotations
 
-from typing import Any, Optional
+from typing import Any
 
 import flax.linen as nn
 import jax
