@@ -127,30 +127,30 @@ class CheckpointManager:
                 meta.unlink()
             logger.info("Pruned old checkpoint: %s", path)
 
-    def _ckpt_has_ema(self, path) -> bool:
-        """Whether the on-disk checkpoint tree contains ``ema_params``,
+    def _ckpt_has_key(self, path, key: str) -> bool:
+        """Whether the on-disk checkpoint tree contains top-level ``key``,
         from orbax item metadata (no array reads).
 
         Falls back to scanning the checkpoint's ``_METADATA`` sidecar (the
         on-disk tree structure file) so an orbax API change cannot silently
-        misreport "no EMA" and discard shadow-weight history."""
+        misreport absence and discard history (e.g. EMA shadow weights)."""
         try:
             meta = self._ckptr.metadata(Path(path))
             tree = getattr(meta, "item_metadata", None) or meta
             if hasattr(tree, "tree"):
                 tree = tree.tree
-            return "ema_params" in tree
+            return key in tree
         except Exception:
             pass
         try:
             md = Path(path) / "_METADATA"
             if md.exists():
-                return '"ema_params"' in md.read_text()
+                return f'"{key}"' in md.read_text()
         except Exception:
             pass
         logger.warning(
-            "Warning: could not determine whether %s contains ema_params "
-            "(orbax metadata unavailable); assuming it does not.", path,
+            "Warning: could not determine whether %s contains %s "
+            "(orbax metadata unavailable); assuming it does not.", path, key,
         )
         return False
 
@@ -236,7 +236,7 @@ class CheckpointManager:
         # Reconcile EMA layout from the checkpoint's own metadata (not
         # exception-driven: a restore failure can have unrelated causes and
         # must surface as-is).
-        ckpt_has_ema = self._ckpt_has_ema(resume_path)
+        ckpt_has_ema = self._ckpt_has_key(resume_path, "ema_params")
         seed_ema = False
         if "ema_params" in template and not ckpt_has_ema:
             # Resuming an EMA run from a pre-EMA checkpoint: restore the
@@ -257,6 +257,16 @@ class CheckpointManager:
                 "Warning: checkpoint contains ema_params but EMA is "
                 "disabled in this run; shadow weights discarded."
             )
+        # lr_scale joined the layout after the first release: drop it from
+        # the template when resuming an older checkpoint (the fresh 1.0
+        # stands in; the plateau controller re-derives from there).
+        if ("lr_scale" in template
+                and not self._ckpt_has_key(resume_path, "lr_scale")):
+            template.pop("lr_scale")
+            logger.warning(
+                "Warning: checkpoint has no lr_scale; starting from 1.0 "
+                "(any prior ReduceLROnPlateau reduction is not resumed)."
+            )
         restored = self._ckptr.restore(resume_path, template)
         if seed_ema:
             restored["ema_params"] = jax.tree.map(
@@ -272,6 +282,8 @@ class CheckpointManager:
         )
         if "ema_params" in restored and template_state.ema_params is not None:
             state = state.replace(ema_params=restored["ema_params"])
+        if "lr_scale" in restored and template_state.lr_scale is not None:
+            state = state.replace(lr_scale=restored["lr_scale"])
         if opt_changed:
             logger.warning(
                 "Warning: Optimizer type given in config file is different "
@@ -305,4 +317,6 @@ def _saveable(state) -> dict:
     }
     if state.ema_params is not None:
         out["ema_params"] = state.ema_params
+    if state.lr_scale is not None:
+        out["lr_scale"] = state.lr_scale
     return out

@@ -61,7 +61,7 @@ def evaluate(config, mesh=None) -> dict:
     # Template state for orbax restore: same tree as training saved
     # (optimizer slots' shapes depend only on optimizer type + param shapes;
     # ema_params present iff the training config enabled EMA).
-    tx, _ = build_optimizer(config, steps_per_epoch=1)
+    tx, _, _ = build_optimizer(config, steps_per_epoch=1)
     ema_decay = float(config["trainer"].get("ema_decay", 0.0))
     state, _ = create_sharded_train_state(
         model, tx, test_loader.arrays[input_key][:1], mesh,

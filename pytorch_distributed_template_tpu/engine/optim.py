@@ -78,6 +78,53 @@ def adagrad(lr=1e-2, eps=1e-10, learning_rate=None):
     return optax.adagrad(_lr(lr, learning_rate), eps=eps)
 
 
+@OPTIMIZERS.register("Adadelta")
+def adadelta(lr=1.0, rho=0.9, eps=1e-6, weight_decay=0.0,
+             learning_rate=None):
+    return optax.adadelta(_lr(lr, learning_rate), rho=rho, eps=eps,
+                          weight_decay=weight_decay)
+
+
+@OPTIMIZERS.register("Adamax")
+def adamax(lr=2e-3, betas=(0.9, 0.999), eps=1e-8, weight_decay=0.0,
+           learning_rate=None):
+    b1, b2 = betas
+    base = optax.adamax(_lr(lr, learning_rate), b1=b1, b2=b2, eps=eps)
+    if weight_decay:
+        return optax.chain(optax.add_decayed_weights(weight_decay), base)
+    return base
+
+
+@OPTIMIZERS.register("NAdam")
+def nadam(lr=2e-3, betas=(0.9, 0.999), eps=1e-8, weight_decay=0.0,
+          learning_rate=None):
+    b1, b2 = betas
+    base = optax.nadam(_lr(lr, learning_rate), b1=b1, b2=b2, eps=eps)
+    if weight_decay:
+        return optax.chain(optax.add_decayed_weights(weight_decay), base)
+    return base
+
+
+@OPTIMIZERS.register("RAdam")
+def radam(lr=1e-3, betas=(0.9, 0.999), eps=1e-8, weight_decay=0.0,
+          learning_rate=None):
+    b1, b2 = betas
+    base = optax.radam(_lr(lr, learning_rate), b1=b1, b2=b2, eps=eps)
+    if weight_decay:
+        return optax.chain(optax.add_decayed_weights(weight_decay), base)
+    return base
+
+
+@OPTIMIZERS.register("Adafactor")
+def adafactor(lr=None, weight_decay=0.0, learning_rate=None):
+    """Factored second-moment Adam (Shazeer & Stern 2018) — the T5/TPU
+    recipe: O(n+m) optimizer memory per [n, m] matrix instead of Adam's
+    O(n*m). Not in torch.optim; first-class here because optimizer HBM is
+    a real TPU ceiling at LM scale."""
+    return optax.adafactor(_lr(lr, learning_rate),
+                           weight_decay_rate=weight_decay or None)
+
+
 # --- large-batch optimizers (beyond the reference: the TPU data-parallel
 # scaling path runs at batch sizes where plain SGD/Adam degrade; LARS/LAMB
 # are the standard trust-ratio fixes, Lion the memory-lean alternative) ----
@@ -143,6 +190,64 @@ def cosine_annealing_lr(T_max: int, eta_min_ratio: float = 0.0):
     return f
 
 
+@SCHEDULERS.register("LinearLR")
+def linear_lr(start_factor: float = 1.0 / 3, end_factor: float = 1.0,
+              total_iters: int = 5):
+    """torch LinearLR: ramp start_factor -> end_factor over total_iters
+    epochs, then hold."""
+
+    def f(epoch):
+        frac = jnp.minimum(epoch, total_iters) / max(total_iters, 1)
+        return start_factor + (end_factor - start_factor) * frac
+
+    return f
+
+
+@SCHEDULERS.register("ConstantLR")
+def constant_lr(factor: float = 1.0 / 3, total_iters: int = 5):
+    """torch ConstantLR: scale by ``factor`` until total_iters, then 1."""
+    return lambda epoch: jnp.where(epoch < total_iters, factor, 1.0)
+
+
+@SCHEDULERS.register("PolynomialLR")
+def polynomial_lr(total_iters: int = 5, power: float = 1.0):
+    def f(epoch):
+        frac = 1.0 - jnp.minimum(epoch, total_iters) / max(total_iters, 1)
+        return frac ** power
+
+    return f
+
+
+@SCHEDULERS.register("CosineAnnealingWarmRestarts")
+def cosine_annealing_warm_restarts(T_0: int, T_mult: int = 1):
+    """torch semantics: cosine cycles of length T_0, T_0*T_mult, ... The
+    cycle index is closed-form so the schedule stays a pure function of the
+    epoch (jit/resume safe)."""
+    if T_mult < 1:
+        raise ValueError("T_mult must be >= 1")
+
+    def f(epoch):
+        e = jnp.asarray(epoch, jnp.float32)
+        if T_mult == 1:
+            t_cur, t_i = e % T_0, float(T_0)
+        else:
+            # cycle index; the +1e-4 absorbs float32 log rounding at restart
+            # boundaries (where the ratio is exactly integral but the
+            # computed value can land a few ulps below — flooring that would
+            # place the restart epoch at the END of the previous cycle and
+            # emit scale 0 instead of the intended 1)
+            n = jnp.floor(
+                jnp.log(e / T_0 * (T_mult - 1) + 1) / math.log(T_mult)
+                + 1e-4
+            )
+            geom = (T_mult ** n - 1) / (T_mult - 1)   # epochs before cycle n
+            t_cur = e - T_0 * geom
+            t_i = T_0 * T_mult ** n
+        return (1 + jnp.cos(math.pi * t_cur / t_i)) / 2
+
+    return f
+
+
 @SCHEDULERS.register("WarmupCosine")
 def warmup_cosine(warmup_epochs: int, total_epochs: int,
                   min_ratio: float = 0.0):
@@ -158,22 +263,124 @@ def warmup_cosine(warmup_epochs: int, total_epochs: int,
     return f
 
 
+class PlateauController:
+    """Host-side ReduceLROnPlateau (torch.optim.lr_scheduler semantics).
+
+    The reference's lr_scheduler slot resolves any torch scheduler by name
+    (/root/reference/train.py:43); plateau scheduling is the one family that
+    cannot be a pure function of the step counter — it reacts to a monitored
+    metric. Here it drives ``TrainState.lr_scale`` (a replicated scalar the
+    jitted step multiplies into the optimizer update), so the compiled step
+    never retraces when the LR drops. Epoch metrics are identical on every
+    host (in-graph global reductions), so each host's controller makes the
+    same decision with no extra collective.
+
+    ``step(value) -> scale`` is called once per epoch with the monitored
+    metric; ``monitor`` names the epoch-log key (e.g. ``val_loss``). The
+    scale survives checkpoints via TrainState; the counters reset on resume
+    (the reference checkpoints no scheduler state either,
+    base_trainer.py:109-132).
+    """
+
+    def __init__(self, mode: str = "min", factor: float = 0.1,
+                 patience: int = 10, threshold: float = 1e-4,
+                 threshold_mode: str = "rel", cooldown: int = 0,
+                 min_scale: float = 0.0, eps_scale: float = 1e-8,
+                 monitor: str = "val_loss"):
+        if mode not in ("min", "max"):
+            raise ValueError(f"mode must be min|max, got {mode!r}")
+        if threshold_mode not in ("rel", "abs"):
+            raise ValueError(
+                f"threshold_mode must be rel|abs, got {threshold_mode!r}"
+            )
+        if not 0.0 < factor < 1.0:
+            raise ValueError("factor must be in (0, 1)")
+        self.mode = mode
+        self.factor = factor
+        self.patience = patience
+        self.threshold = threshold
+        self.threshold_mode = threshold_mode
+        self.cooldown = cooldown
+        self.min_scale = min_scale
+        self.eps_scale = eps_scale  # torch's eps, in scale (lr/base_lr) units
+        self.monitor = monitor
+        self.best = math.inf if mode == "min" else -math.inf
+        self.num_bad_epochs = 0
+        self.cooldown_counter = 0
+        self.scale = 1.0
+
+    def _improved(self, value: float) -> bool:
+        if self.mode == "min":
+            bar = (
+                self.best * (1 - self.threshold)
+                if self.threshold_mode == "rel" else self.best - self.threshold
+            )
+            return value < bar
+        bar = (
+            self.best * (1 + self.threshold)
+            if self.threshold_mode == "rel" else self.best + self.threshold
+        )
+        return value > bar
+
+    def step(self, value: float) -> float:
+        # mirrors torch's sequencing exactly: cooldown ticks down on every
+        # epoch (improved or not) and zeroes the bad-epoch count afterwards
+        if self._improved(value):
+            self.best = value
+            self.num_bad_epochs = 0
+        else:
+            self.num_bad_epochs += 1
+        if self.cooldown_counter > 0:
+            self.cooldown_counter -= 1
+            self.num_bad_epochs = 0
+        if self.num_bad_epochs > self.patience:
+            new_scale = max(self.scale * self.factor, self.min_scale)
+            if self.scale - new_scale > self.eps_scale:  # torch's eps gate
+                self.scale = new_scale
+            self.cooldown_counter = self.cooldown
+            self.num_bad_epochs = 0
+        return self.scale
+
+
 def build_optimizer(config, steps_per_epoch: int):
     """Compose optimizer + epoch-scale scheduler into one optax transform.
 
-    Returns ``(tx, lr_fn)`` where ``lr_fn(step) -> lr`` is for logging. The
-    epoch used is ``step // steps_per_epoch`` with the reference's
-    convention: the scheduler has been stepped ``epoch`` times after epoch
-    ``epoch`` completes, i.e. during epoch e (1-based) the scale is
-    f(e - 1).
+    Returns ``(tx, lr_fn, plateau)`` where ``lr_fn(step) -> lr`` is for
+    logging and ``plateau`` is a PlateauController when the config's
+    lr_scheduler is ``ReduceLROnPlateau`` (else None). The epoch used is
+    ``step // steps_per_epoch`` with the reference's convention: the
+    scheduler has been stepped ``epoch`` times after epoch ``epoch``
+    completes, i.e. during epoch e (1-based) the scale is f(e - 1).
     """
     opt_cfg = config["optimizer"]
     opt_args = dict(opt_cfg.get("args", {}))
-    base_lr = opt_args.get("learning_rate", opt_args.get("lr", 1e-3))
+    # Adafactor's native default is lr=None (relative-step mode); every
+    # other registered optimizer defaults like torch (a numeric lr).
+    default_lr = None if opt_cfg["type"] == "Adafactor" else 1e-3
+    base_lr = opt_args.get("learning_rate", opt_args.get("lr", default_lr))
 
     scale_fn: Optional[Callable] = None
+    plateau: Optional[PlateauController] = None
     sched_cfg = config["lr_scheduler"] if "lr_scheduler" in config else None
-    if sched_cfg:
+    if sched_cfg and base_lr is None:
+        raise ValueError(
+            "lr_scheduler requires an explicit numeric optimizer lr "
+            f"(got lr=None for {opt_cfg['type']}, which means "
+            "optimizer-internal relative stepping)"
+        )
+    if sched_cfg and sched_cfg["type"] == "ReduceLROnPlateau":
+        args = dict(sched_cfg.get("args", {}))
+        # torch spells min_lr/eps in lr units (min_lr possibly as a
+        # per-param-group list — we have one group); scale is relative
+        if "min_lr" in args:
+            min_lr = args.pop("min_lr")
+            if isinstance(min_lr, (list, tuple)):
+                min_lr = min_lr[0]
+            args["min_scale"] = min_lr / base_lr
+        if "eps" in args:
+            args["eps_scale"] = args.pop("eps") / base_lr
+        plateau = PlateauController(**args)
+    elif sched_cfg:
         factory = SCHEDULERS.get(sched_cfg["type"])
         scale_fn = factory(**sched_cfg.get("args", {}))
 
@@ -181,6 +388,10 @@ def build_optimizer(config, steps_per_epoch: int):
         def schedule(step):
             epoch0 = step // max(steps_per_epoch, 1)  # 0-based completed epochs
             return base_lr * scale_fn(epoch0)
+    elif base_lr is None:
+        # relative-step mode: the optimizer derives its own magnitude; the
+        # logging lr_fn reports NaN (there is no single lr to report)
+        schedule = None
     else:
         def schedule(step):
             return base_lr
@@ -188,4 +399,7 @@ def build_optimizer(config, steps_per_epoch: int):
     opt_args.pop("lr", None)
     opt_args["learning_rate"] = schedule
     tx = OPTIMIZERS.get(opt_cfg["type"])(**opt_args)
-    return tx, schedule
+    lr_fn = schedule if schedule is not None else (
+        lambda step: float("nan")
+    )
+    return tx, lr_fn, plateau

@@ -24,6 +24,9 @@ class TrainState:
     opt_state: Any
     rng: jax.Array               # base PRNG key; per-step keys fold in `step`
     ema_params: Any = None       # shadow params when EMA is enabled
+    lr_scale: Any = None         # scalar multiplier on optimizer updates;
+                                 # host-driven (ReduceLROnPlateau) — lives in
+                                 # state so it checkpoints and replicates
 
 
 def create_train_state(model, tx, sample_input, seed: int = 0,
@@ -54,6 +57,7 @@ def create_train_state(model, tx, sample_input, seed: int = 0,
         opt_state=opt_state,
         rng=state_key,
         ema_params=jax.tree.map(jnp.copy, params) if with_ema else None,
+        lr_scale=jnp.ones((), jnp.float32),
     )
 
 

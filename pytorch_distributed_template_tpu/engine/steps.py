@@ -249,6 +249,12 @@ def make_train_step(model, tx, criterion: Callable,
             )
 
         updates, new_opt_state = tx.update(grads, state.opt_state, state.params)
+        if state.lr_scale is not None:
+            # host-driven LR multiplier (ReduceLROnPlateau): every registered
+            # optimizer ends in scale_by_learning_rate, so scaling the final
+            # update equals scaling the learning rate
+            s = state.lr_scale.astype(jnp.float32)
+            updates = jax.tree.map(lambda u: (u * s).astype(u.dtype), updates)
         new_params = optax.apply_updates(state.params, updates)
         if skip_nonfinite:
             # branchless select: a suppressed step leaves params/opt_state/

@@ -221,7 +221,9 @@ class Trainer(BaseTrainer):
         self.target_key = dk.get("target", "label")
 
         # --- optimizer + schedule (per-step, epoch-indexed; optim.py) ------
-        self.tx, self.lr_fn = build_optimizer(config, self.len_epoch)
+        self.tx, self.lr_fn, self.plateau = build_optimizer(
+            config, self.len_epoch
+        )
 
         # --- state init + placement (multi-host-legal jit creation; see
         # engine/state.create_sharded_train_state) --------------------------
@@ -244,6 +246,20 @@ class Trainer(BaseTrainer):
             )
             if restored_best is not None:
                 self.mnt_best = restored_best
+
+        # host-side mirror of state.lr_scale (plateau LR control; survives
+        # resume via the checkpointed state)
+        replicated = jax.sharding.NamedSharding(
+            self.mesh, jax.sharding.PartitionSpec()
+        )
+        self._replicate = jax.jit(lambda x: x, out_shardings=replicated)
+        self._lr_scale_host = (
+            float(jax.device_get(self.state.lr_scale))
+            if self.state.lr_scale is not None else 1.0
+        )
+        if self.plateau is not None:
+            self.plateau.scale = self._lr_scale_host
+        self._plateau_warned = False
 
         # --- compile the hot loop -----------------------------------------
         grad_clip = config["trainer"].get("grad_clip_norm", 0.0)
@@ -375,7 +391,9 @@ class Trainer(BaseTrainer):
                 self.writer.set_step(step)
                 loss_val = float(m["loss_sum"]) / max(float(m["count"]), 1.0)
                 self.train_metrics.update("loss", loss_val)
-                self.writer.add_scalar("lr", float(self.lr_fn(step)))
+                self.writer.add_scalar(
+                    "lr", float(self.lr_fn(step)) * self._lr_scale_host
+                )
                 if self.profile_enabled and step > 0:
                     # float() above synced the device, so rates are honest.
                     rate = self.throughput.rate()
@@ -412,7 +430,48 @@ class Trainer(BaseTrainer):
         if self.do_validation and not preempted:
             val_log = self._valid_epoch(epoch)
             log.update(**{f"val_{k}": v for k, v in val_log.items()})
+        # a preempted epoch skipped validation, so the monitored key is
+        # legitimately absent — not a plateau decision and not a misconfig
+        if self.plateau is not None and not preempted:
+            self._plateau_step(log)
         return log
+
+    def _plateau_step(self, log: dict) -> None:
+        """Per-epoch ReduceLROnPlateau update of ``state.lr_scale``.
+
+        Runs identically on every host (epoch metrics are global
+        reductions), so the replicated scalar stays consistent without a
+        collective. The jit identity makes the new value a born-global
+        array (legal multi-host, like create_sharded_train_state).
+        """
+        value = log.get(self.plateau.monitor)
+        if value is None:
+            # typo'd monitor key or validation disabled: say so once instead
+            # of silently training at full LR forever (mirrors the trainer's
+            # monitor-metric-not-found warning)
+            if not self._plateau_warned and dist.is_main_process():
+                self.logger.warning(
+                    "Warning: ReduceLROnPlateau monitor '%s' not found in "
+                    "epoch metrics %s; plateau LR scheduling is inactive.",
+                    self.plateau.monitor, sorted(log),
+                )
+            self._plateau_warned = True
+            return
+        if not math.isfinite(value):
+            return
+        new_scale = self.plateau.step(float(value))
+        if new_scale != self._lr_scale_host:
+            if dist.is_main_process():
+                self.logger.info(
+                    "ReduceLROnPlateau: %s did not improve for %d epochs; "
+                    "lr scale %.3g -> %.3g",
+                    self.plateau.monitor, self.plateau.patience + 1,
+                    self._lr_scale_host, new_scale,
+                )
+            self._lr_scale_host = new_scale
+            self.state = self.state.replace(
+                lr_scale=self._replicate(np.float32(new_scale))
+            )
 
     def _valid_epoch(self, epoch: int) -> dict:
         """Validation with in-graph global reduction (vs reference's pickle
