@@ -41,6 +41,9 @@ class CheckpointManager:
         # save paths whose async write may still be in flight; cleared by
         # wait(). Lets prune() skip the blocking wait in steady state.
         self._inflight: set = set()
+        # per-path cache of the on-disk tree metadata (restore probes it for
+        # several optional keys; on remote storage each fetch is a roundtrip)
+        self._tree_cache: dict = {}
 
     # -- save ---------------------------------------------------------------
 
@@ -127,21 +130,31 @@ class CheckpointManager:
                 meta.unlink()
             logger.info("Pruned old checkpoint: %s", path)
 
+    def _ckpt_tree(self, path):
+        """The on-disk checkpoint's tree metadata (no array reads), fetched
+        once per path and cached; None when the orbax API call fails."""
+        cache_key = str(path)
+        if cache_key not in self._tree_cache:
+            tree = None
+            try:
+                meta = self._ckptr.metadata(Path(path))
+                tree = getattr(meta, "item_metadata", None) or meta
+                if hasattr(tree, "tree"):
+                    tree = tree.tree
+            except Exception:
+                tree = None
+            self._tree_cache[cache_key] = tree
+        return self._tree_cache[cache_key]
+
     def _ckpt_has_key(self, path, key: str) -> bool:
-        """Whether the on-disk checkpoint tree contains top-level ``key``,
-        from orbax item metadata (no array reads).
+        """Whether the on-disk checkpoint tree contains top-level ``key``.
 
         Falls back to scanning the checkpoint's ``_METADATA`` sidecar (the
         on-disk tree structure file) so an orbax API change cannot silently
         misreport absence and discard history (e.g. EMA shadow weights)."""
-        try:
-            meta = self._ckptr.metadata(Path(path))
-            tree = getattr(meta, "item_metadata", None) or meta
-            if hasattr(tree, "tree"):
-                tree = tree.tree
+        tree = self._ckpt_tree(path)
+        if tree is not None:
             return key in tree
-        except Exception:
-            pass
         try:
             md = Path(path) / "_METADATA"
             if md.exists():
@@ -161,13 +174,14 @@ class CheckpointManager:
         (from orbax metadata, no array reads) — used to restore subtrees
         the caller will discard (e.g. opt_state of a changed optimizer).
 
-        Unwraps the same orbax API shape variants as ``_ckpt_has_ema``."""
+        Shares ``_ckpt_tree``'s cached metadata fetch."""
         import jax.numpy as jnp
 
-        md = self._ckptr.metadata(Path(path))
-        tree = getattr(md, "item_metadata", None) or md
-        if hasattr(tree, "tree"):
-            tree = tree.tree
+        tree = self._ckpt_tree(path)
+        if tree is None:
+            raise RuntimeError(
+                f"cannot read checkpoint tree metadata for {path}"
+            )
         return jax.tree.map(
             lambda m: jnp.zeros(tuple(m.shape), m.dtype),
             tree[key], is_leaf=lambda x: hasattr(x, "shape"),

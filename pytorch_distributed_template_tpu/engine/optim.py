@@ -358,6 +358,13 @@ def build_optimizer(config, steps_per_epoch: int):
     # other registered optimizer defaults like torch (a numeric lr).
     default_lr = None if opt_cfg["type"] == "Adafactor" else 1e-3
     base_lr = opt_args.get("learning_rate", opt_args.get("lr", default_lr))
+    if base_lr is None and opt_cfg["type"] != "Adafactor":
+        # only Adafactor can derive its own magnitude; anything else would
+        # silently fall through to the registry builder's default lr
+        raise ValueError(
+            f"optimizer {opt_cfg['type']!r} requires a numeric lr "
+            "(lr=None is Adafactor's relative-step mode only)"
+        )
 
     scale_fn: Optional[Callable] = None
     plateau: Optional[PlateauController] = None

@@ -457,8 +457,9 @@ class Trainer(BaseTrainer):
                 )
             self._plateau_warned = True
             return
-        if not math.isfinite(value):
-            return
+        # NaN/inf flows into the controller: comparisons with NaN are False,
+        # so it counts as a bad epoch — exactly torch's behavior (and the
+        # LR drop it triggers is often what rescues a diverging run)
         new_scale = self.plateau.step(float(value))
         if new_scale != self._lr_scale_host:
             if dist.is_main_process():

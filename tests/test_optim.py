@@ -141,6 +141,27 @@ def test_plateau_max_mode(kwargs):
         assert ctrl.step(v) == pytest.approx(opt.param_groups[0]["lr"])
 
 
+def test_plateau_nan_counts_as_bad_epoch():
+    """NaN metrics must count as bad epochs (torch behavior) — the LR drop
+    is often what rescues a diverging run."""
+    kwargs = {"mode": "min", "factor": 0.5, "patience": 1}
+    seq = [1.0, float("nan"), float("nan"), float("nan"), 0.9]
+    p = torch.nn.Parameter(torch.zeros(1))
+    opt = torch.optim.SGD([p], lr=1.0)
+    sched = torch.optim.lr_scheduler.ReduceLROnPlateau(opt, **kwargs)
+    ctrl = PlateauController(**kwargs)
+    for v in seq:
+        sched.step(v)
+        assert ctrl.step(v) == pytest.approx(opt.param_groups[0]["lr"])
+    assert ctrl.scale < 1.0
+
+
+def test_null_lr_rejected_for_non_adafactor():
+    cfg = {"optimizer": {"type": "SGD", "args": {"lr": None}}}
+    with pytest.raises(ValueError, match="numeric lr"):
+        build_optimizer(cfg, steps_per_epoch=10)
+
+
 def test_plateau_min_scale_floor():
     ctrl = PlateauController(mode="min", factor=0.1, patience=0,
                              min_scale=0.01)
