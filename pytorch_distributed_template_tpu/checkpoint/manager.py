@@ -64,6 +64,7 @@ class CheckpointManager:
             "config": config,
         }
         self._ckptr.save(path, _saveable(state), force=True)
+        self._tree_cache.pop(str(path), None)  # overwrite invalidates metadata
         self._inflight.add(path)
         if dist.is_main_process():
             (self.checkpoint_dir / f"checkpoint-epoch{epoch}.meta.json").write_text(
@@ -77,6 +78,7 @@ class CheckpointManager:
             self._inflight.clear()
             best = self.checkpoint_dir / "model_best"
             self._ckptr.save(best, _saveable(state), force=True)
+            self._tree_cache.pop(str(best), None)
             if dist.is_main_process():
                 (self.checkpoint_dir / "model_best.meta.json").write_text(
                     json.dumps(meta, indent=2)
@@ -132,19 +134,22 @@ class CheckpointManager:
 
     def _ckpt_tree(self, path):
         """The on-disk checkpoint's tree metadata (no array reads), fetched
-        once per path and cached; None when the orbax API call fails."""
+        once per path and cached; None when the orbax API call fails.
+        Failures are NOT cached — a transient storage error on the first
+        probe must not permanently disable metadata for the path."""
         cache_key = str(path)
-        if cache_key not in self._tree_cache:
-            tree = None
-            try:
-                meta = self._ckptr.metadata(Path(path))
-                tree = getattr(meta, "item_metadata", None) or meta
-                if hasattr(tree, "tree"):
-                    tree = tree.tree
-            except Exception:
-                tree = None
-            self._tree_cache[cache_key] = tree
-        return self._tree_cache[cache_key]
+        if cache_key in self._tree_cache:
+            return self._tree_cache[cache_key]
+        tree = None
+        try:
+            meta = self._ckptr.metadata(Path(path))
+            tree = getattr(meta, "item_metadata", None) or meta
+            if hasattr(tree, "tree"):
+                tree = tree.tree
+        except Exception:
+            return None
+        self._tree_cache[cache_key] = tree
+        return tree
 
     def _ckpt_has_key(self, path, key: str) -> bool:
         """Whether the on-disk checkpoint tree contains top-level ``key``.
@@ -154,7 +159,10 @@ class CheckpointManager:
         misreport absence and discard history (e.g. EMA shadow weights)."""
         tree = self._ckpt_tree(path)
         if tree is not None:
-            return key in tree
+            try:
+                return key in tree
+            except Exception:
+                pass  # non-container metadata object: sidecar fallback below
         try:
             md = Path(path) / "_METADATA"
             if md.exists():

@@ -24,10 +24,13 @@ from typing import Any, Optional
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.sharding import PartitionSpec as P
 
 from ..config.registry import MODELS
-from ..ops.attention import multihead_attention, ring_attention
+from ..ops.attention import (
+    multihead_attention, ring_attention, zigzag_perm,
+)
 
 
 def _dense_init(stddev):
@@ -60,6 +63,7 @@ class SelfAttention(nn.Module):
     dtype: Any
     attn_impl: str = "xla"          # 'xla' | 'ring' | 'flash'
     mesh: Optional[Any] = None      # required for 'ring'
+    seq_layout: str = "natural"     # 'zigzag' -> inputs are zigzag-permuted
 
     @nn.compact
     def __call__(self, x, train: bool, decode: bool = False,
@@ -75,7 +79,12 @@ class SelfAttention(nn.Module):
         elif self.attn_impl == "ring":
             if self.mesh is None:
                 raise ValueError("attn_impl='ring' requires a mesh")
-            ctx = ring_attention(q, k, v, self.mesh, causal=True)
+            ctx = ring_attention(
+                q, k, v, self.mesh, causal=True,
+                layout=(
+                    "zigzag" if self.seq_layout == "zigzag" else "contig"
+                ),
+            )
         elif self.attn_impl == "flash":
             from ..ops.flash import flash_attention
             ctx = flash_attention(q, k, v, causal=True)
@@ -138,6 +147,7 @@ class Block(nn.Module):
     mesh: Optional[Any]
     moe: Optional[dict] = None      # MoeMlp kwargs; None -> dense MLP
     ln_eps: float = 1e-5
+    seq_layout: str = "natural"
 
     @nn.compact
     def __call__(self, x, train: bool, example_mask=None,
@@ -146,7 +156,8 @@ class Block(nn.Module):
                          name="ln_1")(x)
         x = x + SelfAttention(
             self.d_model, self.n_head, self.dropout, self.n_layer,
-            self.dtype, self.attn_impl, self.mesh, name="attn",
+            self.dtype, self.attn_impl, self.mesh,
+            seq_layout=self.seq_layout, name="attn",
         )(h, train, decode, decode_index)
         h = nn.LayerNorm(epsilon=self.ln_eps, dtype=jnp.float32,
                          name="ln_2")(x)
@@ -180,6 +191,7 @@ class TransformerLM(nn.Module):
     attn_impl: str = "xla"
     mesh: Optional[Any] = None
     remat: bool = False
+    seq_layout: str = "natural"     # 'zigzag': balanced causal ring (ops/attention.py)
     tie_embeddings: bool = True
     ln_eps: float = 1e-5            # GPT-2's layer_norm_epsilon
     # --- MoE (models/moe.py); moe_experts == 0 -> all-dense blocks --------
@@ -211,6 +223,27 @@ class TransformerLM(nn.Module):
         position (engine/generate.py drives this)."""
         d_ff = self.d_ff or 4 * self.d_model
         b, t = tokens.shape
+        # Zigzag sequence layout for balanced causal ring attention: permute
+        # the tokens ONCE here (one resharding collective under a seq-sharded
+        # mesh), run every block in zigzag order — positions ride along via
+        # the permuted position embedding, and LayerNorm/dense-MLP are
+        # per-token so only attention notices — and invert ONCE before the
+        # LM head. The logits are therefore in natural order: loss/metrics/
+        # generation are untouched. Amortized over all n_layer attention
+        # calls. MoE models are excluded: capacity-based token dropping in
+        # MoeMlp is flatten-order-sensitive, so a permuted layout would drop
+        # different tokens than the natural one.
+        zperm = None
+        if (
+            self.seq_layout == "zigzag" and not decode
+            and self.moe_experts <= 0
+            and self.attn_impl == "ring" and self.mesh is not None
+            and "seq" in self.mesh.axis_names
+            and self.mesh.shape["seq"] > 1
+            and t % (2 * self.mesh.shape["seq"]) == 0
+        ):
+            zperm = zigzag_perm(t, self.mesh.shape["seq"])
+            tokens = tokens[:, zperm]
         embed = nn.Embed(
             self.vocab_size, self.d_model,
             embedding_init=_dense_init(0.02), name="wte",
@@ -234,6 +267,8 @@ class TransformerLM(nn.Module):
                 pos_index.value = start + t
         else:
             pos = pos_embed[:t]
+            if zperm is not None:
+                pos = pos[zperm]
         x = embed(tokens) + pos[None].astype(self.dtype)
         x = nn.Dropout(self.dropout, deterministic=not train)(x)
 
@@ -252,10 +287,13 @@ class TransformerLM(nn.Module):
                 dropout=self.dropout, n_layer=self.n_layer,
                 dtype=self.dtype, attn_impl=self.attn_impl, mesh=self.mesh,
                 moe=self._moe_kwargs(i), ln_eps=self.ln_eps,
+                seq_layout="zigzag" if zperm is not None else "natural",
                 name=f"h_{i}",
             )(x, train, example_mask, decode, start)
         x = nn.LayerNorm(epsilon=self.ln_eps, dtype=jnp.float32,
                          name="ln_f")(x)
+        if zperm is not None:
+            x = x[:, np.argsort(zperm)]  # back to natural order pre-head
         if self.tie_embeddings:
             logits = embed.attend(x.astype(self.dtype))
         else:
@@ -307,13 +345,14 @@ _GPT2_SIZES = {
 def gpt2(size: str = "gpt2-small", vocab_size: int = 50257,
          max_len: int = 1024, dropout: float = 0.1, bfloat16: bool = False,
          attn_impl: str = "xla", remat: bool = False, mesh=None,
-         **overrides):
+         seq_layout: str = "natural", **overrides):
     cfg = dict(_GPT2_SIZES[size])
     cfg.update(overrides)
     return TransformerLM(
         vocab_size=vocab_size, max_len=max_len, dropout=dropout,
         dtype=jnp.bfloat16 if bfloat16 else jnp.float32,
-        attn_impl=attn_impl, remat=remat, mesh=mesh, **cfg,
+        attn_impl=attn_impl, remat=remat, mesh=mesh,
+        seq_layout=seq_layout, **cfg,
     )
 
 
@@ -321,11 +360,12 @@ def gpt2(size: str = "gpt2-small", vocab_size: int = 50257,
 def tiny_lm(vocab_size: int = 256, n_layer: int = 2, n_head: int = 4,
             d_model: int = 64, max_len: int = 128, dropout: float = 0.0,
             attn_impl: str = "xla", remat: bool = False, mesh=None,
-            bfloat16: bool = False):
+            bfloat16: bool = False, seq_layout: str = "natural"):
     """Small config for tests and the multi-chip dry run."""
     return TransformerLM(
         vocab_size=vocab_size, n_layer=n_layer, n_head=n_head,
         d_model=d_model, max_len=max_len, dropout=dropout,
         dtype=jnp.bfloat16 if bfloat16 else jnp.float32,
         attn_impl=attn_impl, remat=remat, mesh=mesh,
+        seq_layout=seq_layout,
     )

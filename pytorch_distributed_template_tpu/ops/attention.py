@@ -14,6 +14,17 @@ here with three interchangeable implementations:
   O(T/s · d) instead of O(T · d) and the T×T score matrix never
   materializes globally. KV transfers ride ICI concurrently with the local
   block's compute (XLA's latency-hiding scheduler overlaps the ppermute).
+- ``ring_attention(..., layout="zigzag")``: causal load-balanced variant.
+  With the contiguous layout, causal masking makes ring shard i skip every
+  K/V block originating from shard j > i — half the ring steps are fully
+  masked yet still paid for (utilization (s+1)/2s). In the zigzag layout
+  each device holds sequence chunks ``(i, 2s-1-i)`` of 2s chunks, so every
+  device sees the same visible-key count and each post-local ring step
+  needs only two quarter-block matmuls, all fully visible (no masks at
+  all): half the attention FLOPs and no stragglers. Callers permute the
+  sequence with ``zigzag_perm`` once at the input and invert once at the
+  output (models/transformer.py does this around the whole block stack —
+  two cheap all-to-alls per step, amortized over all layers).
 - ``flash_attention`` (ops/flash.py): fused Pallas TPU kernel for the
   single-device block-streaming case.
 
@@ -53,6 +64,134 @@ def multihead_attention(q, k, v, causal: bool = True,
     return out.astype(dtype)
 
 
+def _online_update(m, l, o, scores, vb):
+    """Flash-style online-softmax accumulator update for one key block.
+
+    m/l/o: running max [B,H,Tq], normalizer [B,H,Tq], output [B,H,Tq,D];
+    scores: [B,H,Tq,Tk] for the new block; vb: [B,Tk,H,D] values.
+    Shared by both ring bodies so numerics changes stay in one place.
+    """
+    blk_max = jnp.max(scores, axis=-1)
+    m_new = jnp.maximum(m, blk_max)
+    p = jnp.exp(scores - m_new[..., None])
+    scale = jnp.exp(m - m_new)
+    l_new = l * scale + jnp.sum(p, axis=-1)
+    o_new = o * scale[..., None] + jnp.einsum(
+        "bhqk,bkhd->bhqd", p, vb.astype(jnp.float32)
+    )
+    return m_new, l_new, o_new
+
+
+def zigzag_perm(t: int, s: int) -> np.ndarray:
+    """Natural→zigzag sequence permutation for ``s`` ring shards.
+
+    The sequence splits into ``2s`` chunks of ``t // (2s)``; ring shard i
+    holds chunks ``(i, 2s-1-i)`` concatenated. Returns ``perm`` such that
+    ``x[:, perm]`` is the zigzag layout; invert with ``np.argsort(perm)``.
+    """
+    if t % (2 * s) != 0:
+        raise ValueError(f"t={t} not divisible by 2*s={2 * s}")
+    c = t // (2 * s)
+    parts = []
+    for i in range(s):
+        parts.append(np.arange(i * c, (i + 1) * c))
+        j = 2 * s - 1 - i
+        parts.append(np.arange(j * c, (j + 1) * c))
+    return np.concatenate(parts)
+
+
+def _ring_attention_zigzag_local(q, k, v, *, axis_name: str, axis_size: int):
+    """Causal zigzag ring attention body (runs inside shard_map).
+
+    Local ``[B, Tl, H, D]`` slices are in zigzag layout: the first half is
+    global chunk ``my`` ("lo"), the second half chunk ``2s-1-my`` ("hi"),
+    of 2s chunks of ``c = Tl/2`` tokens. Key property (for ring step
+    t >= 1, K/V arriving from shard ``src = (my - t) % s != my``):
+
+    - ``q_hi × k_lo`` is ALWAYS fully visible (chunk 2s-1-my >= s > src);
+    - exactly one of ``q_lo × k_lo`` (iff src < my) or ``q_hi × k_hi``
+      (iff src > my) is fully visible; the other three pairings are fully
+      masked.
+
+    So every device does two fully-visible quarter-block matmuls per step —
+    balanced, maskless — instead of one full (often fully-masked) block.
+    Step 0 (the local block, the only one with intra-chunk diagonals) runs
+    once with an explicit position mask before the scan.
+    """
+    dtype = q.dtype
+    b, tl, h, d = q.shape
+    c = tl // 2
+    s = axis_size
+    my = lax.axis_index(axis_name)
+    qf = q.astype(jnp.float32) * (d ** -0.5)
+
+    lo_pos = my * c + jnp.arange(c)                # chunk my
+    hi_pos = (2 * s - 1 - my) * c + jnp.arange(c)  # chunk 2s-1-my
+    q_pos = jnp.concatenate([lo_pos, hi_pos])
+
+    # ---- step 0: local block, position-masked (the only diagonals) ------
+    scores0 = jnp.einsum("bqhd,bkhd->bhqk", qf, k.astype(jnp.float32))
+    visible0 = q_pos[:, None] >= q_pos[None, :]
+    scores0 = jnp.where(visible0[None, None], scores0, NEG_INF)
+    m0 = jnp.max(scores0, axis=-1)                 # [B, H, Tl]
+    p0 = jnp.exp(scores0 - m0[..., None])
+    l0 = jnp.sum(p0, axis=-1)
+    o0 = jnp.einsum("bhqk,bkhd->bhqd", p0, v.astype(jnp.float32))
+
+    q_lo, q_hi = qf[:, :c], qf[:, c:]
+    # Unlike the contiguous body, every carry derives from device-varying
+    # data (scores from q/k, positions from axis_index), so no pcast is
+    # needed to stabilize the scan carry type.
+    carry0 = (k, v, m0, l0, o0)
+
+    perm = [(i, (i + 1) % s) for i in range(s)]
+
+    def step(carry, t):
+        kb, vb, m, l, o = carry
+        # rotate FIRST: at scan iteration t (1-based below) the local block
+        # holds K/V originating from shard (my - t) % s
+        kb = lax.ppermute(kb, axis_name, perm)
+        vb = lax.ppermute(vb, axis_name, perm)
+        src = (my - t) % s
+        pred = src < my
+        k_lo, k_hi = kb[:, :c], kb[:, c:]
+        v_lo, v_hi = vb[:, :c], vb[:, c:]
+        # E2: the step's second visible quarter — lo×lo below the ring
+        # diagonal, hi×hi above it. Selects are on inputs (cheap); both
+        # cases are FULLY visible so no mask is ever applied.
+        sel_q = jnp.where(pred, q_lo, q_hi)
+        sel_k = jnp.where(pred, k_lo, k_hi)
+        sel_v = jnp.where(pred, v_lo, v_hi)
+        e1 = jnp.einsum("bqhd,bkhd->bhqk", q_hi, k_lo.astype(jnp.float32))
+        e2 = jnp.einsum("bqhd,bkhd->bhqk", sel_q, sel_k.astype(jnp.float32))
+        m_lo, l_lo, o_lo = m[..., :c], l[..., :c], o[..., :c, :]
+        m_hi, l_hi, o_hi = m[..., c:], l[..., c:], o[..., c:, :]
+        # update 1: hi rows absorb e1 (always visible)
+        m_hi, l_hi, o_hi = _online_update(m_hi, l_hi, o_hi, e1, v_lo)
+        # update 2: e2 belongs to the lo rows when pred, else to the
+        # (post-e1) hi rows — select the accumulator halves in, update,
+        # and scatter back. Two quarter-block updates per step, nothing
+        # inert: exactly half the contiguous body's per-step FLOPs.
+        m_b = jnp.where(pred, m_lo, m_hi)
+        l_b = jnp.where(pred, l_lo, l_hi)
+        o_b = jnp.where(pred, o_lo, o_hi)
+        m_b, l_b, o_b = _online_update(m_b, l_b, o_b, e2, sel_v)
+        m_lo = jnp.where(pred, m_b, m_lo)
+        l_lo = jnp.where(pred, l_b, l_lo)
+        o_lo = jnp.where(pred, o_b, o_lo)
+        m_hi = jnp.where(pred, m_hi, m_b)
+        l_hi = jnp.where(pred, l_hi, l_b)
+        o_hi = jnp.where(pred, o_hi, o_b)
+        m = jnp.concatenate([m_lo, m_hi], axis=-1)
+        l = jnp.concatenate([l_lo, l_hi], axis=-1)
+        o = jnp.concatenate([o_lo, o_hi], axis=-2)
+        return (kb, vb, m, l, o), None
+
+    (kb, vb, m, l, o), _ = lax.scan(step, carry0, jnp.arange(1, s))
+    out = o / jnp.maximum(l, 1e-30)[..., None]     # [B, H, Tl, D]
+    return jnp.transpose(out, (0, 2, 1, 3)).astype(dtype)
+
+
 def _ring_attention_local(q, k, v, *, axis_name: str, axis_size: int,
                           causal: bool, vary_axes: tuple = ()):
     """Per-shard ring attention body (runs inside shard_map).
@@ -78,14 +217,7 @@ def _ring_attention_local(q, k, v, *, axis_name: str, axis_size: int,
         if causal:
             visible = q_pos[:, None] >= k_pos[None, :]  # [Tl_q, Tl_k]
             scores = jnp.where(visible[None, None], scores, NEG_INF)
-        blk_max = jnp.max(scores, axis=-1)            # [B, H, Tq]
-        m_new = jnp.maximum(m, blk_max)
-        p = jnp.exp(scores - m_new[..., None])        # [B, H, Tq, Tk]
-        scale = jnp.exp(m - m_new)                    # [B, H, Tq]
-        l_new = l * scale + jnp.sum(p, axis=-1)
-        o_new = o * scale[..., None] + jnp.einsum(
-            "bhqk,bkhd->bhqd", p, vb.astype(jnp.float32)
-        )
+        m_new, l_new, o_new = _online_update(m, l, o, scores, vb)
         kb = lax.ppermute(kb, axis_name, perm)
         vb = lax.ppermute(vb, axis_name, perm)
         return (kb, vb, m_new, l_new, o_new), None
@@ -108,17 +240,27 @@ def _ring_attention_local(q, k, v, *, axis_name: str, axis_size: int,
 
 def ring_attention(q, k, v, mesh: Mesh, causal: bool = True,
                    seq_axis: str = "seq", data_axes=("data", "fsdp"),
-                   head_axis: str = "tensor"):
+                   head_axis: str = "tensor", layout: str = "contig"):
     """Sequence-parallel attention over the mesh's ``seq`` axis.
 
     q,k,v are global ``[B, T, H, D]`` arrays (T sharded over ``seq``); the
     TxT score matrix never exists — only [Tl x Tl] blocks per device per
     ring step. Composes with DP (batch over data axes) and TP (heads over
     ``tensor``) in one shard_map.
+
+    ``layout="zigzag"`` (causal only, T divisible by 2s): inputs must be in
+    ``zigzag_perm(T, s)`` order; the balanced maskless body cuts attention
+    FLOPs 2× (module docstring). Output stays in zigzag order.
     """
     if seq_axis not in mesh.axis_names or mesh.shape[seq_axis] == 1:
         return multihead_attention(q, k, v, causal=causal)
     axis_size = mesh.shape[seq_axis]
+    zigzag = layout == "zigzag"
+    if zigzag and (not causal or q.shape[1] % (2 * axis_size) != 0):
+        raise ValueError(
+            "layout='zigzag' needs causal=True and T divisible by "
+            f"2*seq ({2 * axis_size}); got causal={causal}, T={q.shape[1]}"
+        )
     if q.shape[1] % axis_size != 0:
         # Sequence not evenly shardable (e.g. a probe batch at init time):
         # the dense path is always correct, just not sequence-parallel.
@@ -133,11 +275,17 @@ def ring_attention(q, k, v, mesh: Mesh, causal: bool = True,
         hp = None
     spec = P(dp if dp else None, seq_axis, hp, None)
 
-    vary_axes = tuple(dp) + (seq_axis,) + ((hp,) if hp else ())
-    fn = functools.partial(
-        _ring_attention_local, axis_name=seq_axis, axis_size=axis_size,
-        causal=causal, vary_axes=vary_axes,
-    )
+    if zigzag:
+        fn = functools.partial(
+            _ring_attention_zigzag_local, axis_name=seq_axis,
+            axis_size=axis_size,
+        )
+    else:
+        vary_axes = tuple(dp) + (seq_axis,) + ((hp,) if hp else ())
+        fn = functools.partial(
+            _ring_attention_local, axis_name=seq_axis, axis_size=axis_size,
+            causal=causal, vary_axes=vary_axes,
+        )
     return shard_map(
         fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
     )(q, k, v)
