@@ -87,6 +87,20 @@ class ConfigParser:
         """
         for opt in options:
             args.add_argument(*opt.flags, default=None, type=opt.type)
+        if hasattr(args, "add_argument"):
+            # Generic keychain override: repeatable, value parsed as JSON
+            # when possible (numbers, bools, dicts) else kept as a string.
+            # Superset of the reference's declared CustomArgs
+            # (parse_config.py:133-156 + train.py:94-98): any nested key is
+            # addressable without pre-declaring a flag, e.g.
+            #   --set "arch;args;seq_layout" zigzag
+            #   --set "mesh;axes" '{"data": 2, "seq": 4}'
+            args.add_argument(
+                "--set", action="append", nargs=2, default=None,
+                metavar=("KEYCHAIN", "VALUE"),
+                help="Override a ;-separated config keychain "
+                     "(repeatable; VALUE parsed as JSON when possible).",
+            )
         if not isinstance(args, tuple):
             args = args.parse_args()
 
@@ -125,6 +139,8 @@ class ConfigParser:
         modification = {
             opt.target: getattr(args, _get_opt_name(opt.flags)) for opt in options
         }
+        for chain, raw in (getattr(args, "set", None) or ()):
+            modification[chain] = _parse_cli_value(raw)
         return args, cls(config, resume, modification, training=training)
 
     def init_obj(self, name, namespace, *args, **kwargs):
@@ -248,9 +264,33 @@ def _get_opt_name(flags):
     return flags[0].lstrip("-").replace("-", "_")
 
 
+def _parse_cli_value(raw: str):
+    """JSON-decode a ``--set`` value when possible, else keep the string.
+
+    ``0.002`` -> float, ``true`` -> bool, ``{"data": 2}`` -> dict,
+    ``zigzag`` -> str (not valid JSON, stays literal).
+    """
+    import json
+
+    try:
+        return json.loads(raw)
+    except (ValueError, TypeError):
+        return raw
+
+
 def _set_by_path(tree, keys, value):
+    """Set a ``;``-keychain, creating missing intermediate dicts (so
+    ``--set`` can introduce keys a config omits, e.g. a model option that
+    has a default)."""
     keys = keys.split(";")
-    _get_by_path(tree, keys[:-1])[keys[-1]] = value
+    node = tree
+    for k in keys[:-1]:
+        node = node.setdefault(k, {})
+        if not isinstance(node, dict):
+            raise TypeError(
+                f"keychain {';'.join(keys)} crosses non-dict value at {k!r}"
+            )
+    node[keys[-1]] = value
 
 
 def _get_by_path(tree, keys):

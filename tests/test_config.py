@@ -14,6 +14,7 @@ import pytest
 from pytorch_distributed_template_tpu.config import ConfigParser, Registry
 from pytorch_distributed_template_tpu.config.parser import (
     _get_opt_name,
+    _parse_cli_value,
     _set_by_path,
     _update_config,
 )
@@ -49,6 +50,53 @@ def test_set_by_path_nested():
 def test_get_opt_name():
     assert _get_opt_name(["--lr", "--learning_rate"]) == "lr"
     assert _get_opt_name(["-x"]) == "x"
+
+
+def test_set_by_path_creates_missing_intermediates():
+    tree = {"arch": {"args": {}}}
+    _set_by_path(tree, "arch;args;seq_layout", "zigzag")
+    assert tree["arch"]["args"]["seq_layout"] == "zigzag"
+    _set_by_path(tree, "mesh;axes", {"data": 2})
+    assert tree["mesh"]["axes"] == {"data": 2}
+    with pytest.raises(TypeError):
+        _set_by_path({"a": 3}, "a;b", 1)  # crosses a non-dict leaf
+
+
+def test_parse_cli_value():
+    assert _parse_cli_value("0.002") == 0.002
+    assert _parse_cli_value("true") is True
+    assert _parse_cli_value('{"data": 2, "seq": 4}') == {"data": 2, "seq": 4}
+    assert _parse_cli_value("zigzag") == "zigzag"  # not JSON -> literal str
+
+
+def test_from_args_generic_set(tmp_path):
+    """--set overrides any keychain without a pre-declared flag and creates
+    keys the config omits; values are JSON-decoded when possible."""
+    cfg_file = tmp_path / "c.json"
+    cfg_file.write_text(json.dumps(minimal_config(tmp_path)))
+    ap = argparse.ArgumentParser()
+    ap.add_argument("-c", "--config", default=None)
+    ap.add_argument("-r", "--resume", default=None)
+    ap.add_argument("-s", "--save_dir", default=None)
+    import sys
+
+    argv = sys.argv
+    sys.argv = [
+        "prog", "-c", str(cfg_file),
+        "--set", "arch;args;width", "64",
+        "--set", "arch;args;seq_layout", "zigzag",
+        "--set", "mesh;axes", '{"data": 2, "seq": 4}',
+    ]
+    try:
+        args, parser = ConfigParser.from_args(ap, ())
+    finally:
+        sys.argv = argv
+    assert parser["arch"]["args"]["width"] == 64
+    assert parser["arch"]["args"]["seq_layout"] == "zigzag"
+    assert parser["mesh"]["axes"] == {"data": 2, "seq": 4}
+    # the run-dir snapshot records the overridden config
+    snap = json.loads((parser.save_dir / "config.json").read_text())
+    assert snap["arch"]["args"]["width"] == 64
 
 
 def test_run_dir_layout_and_snapshot(tmp_path):
