@@ -61,8 +61,8 @@ class SelfAttention(nn.Module):
     dropout: float
     n_layer: int
     dtype: Any
-    attn_impl: str = "xla"          # 'xla' | 'ring' | 'flash'
-    mesh: Optional[Any] = None      # required for 'ring'
+    attn_impl: str = "xla"          # 'xla' | 'ring' | 'ring_flash' | 'flash'
+    mesh: Optional[Any] = None      # required for 'ring*'
     seq_layout: str = "natural"     # 'zigzag' -> inputs are zigzag-permuted
 
     @nn.compact
@@ -76,13 +76,16 @@ class SelfAttention(nn.Module):
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
         if decode:
             ctx = self._cached_attention(q, k, v, decode_index)
-        elif self.attn_impl == "ring":
+        elif self.attn_impl in ("ring", "ring_flash"):
             if self.mesh is None:
-                raise ValueError("attn_impl='ring' requires a mesh")
+                raise ValueError(f"attn_impl={self.attn_impl!r} requires a mesh")
             ctx = ring_attention(
                 q, k, v, self.mesh, causal=True,
                 layout=(
                     "zigzag" if self.seq_layout == "zigzag" else "contig"
+                ),
+                block_impl=(
+                    "flash" if self.attn_impl == "ring_flash" else "einsum"
                 ),
             )
         elif self.attn_impl == "flash":
@@ -237,7 +240,8 @@ class TransformerLM(nn.Module):
         if (
             self.seq_layout == "zigzag" and not decode
             and self.moe_experts <= 0
-            and self.attn_impl == "ring" and self.mesh is not None
+            and self.attn_impl in ("ring", "ring_flash")
+            and self.mesh is not None
             and "seq" in self.mesh.axis_names
             and self.mesh.shape["seq"] > 1
             and t % (2 * self.mesh.shape["seq"]) == 0

@@ -100,6 +100,112 @@ def zigzag_perm(t: int, s: int) -> np.ndarray:
     return np.concatenate(parts)
 
 
+def _merge_blocks(o, lse, o_b, lse_b):
+    """Merge two attention partials over disjoint key blocks.
+
+    o/o_b: [B, T, H, D] (o in float32); lse/lse_b: [B, H, T]. Exact:
+    softmax over the union of key sets = lse-weighted combination of the
+    per-block softmaxes. A fully-masked partial (lse_b == NEG_INF) merges
+    as a no-op (weight exp(NEG_INF - lse) == 0).
+    """
+    lse_new = jnp.logaddexp(lse, lse_b)
+    w = jnp.moveaxis(jnp.exp(lse - lse_new), 1, 2)[..., None]
+    w_b = jnp.moveaxis(jnp.exp(lse_b - lse_new), 1, 2)[..., None]
+    return o * w + o_b.astype(jnp.float32) * w_b, lse_new
+
+
+def _ring_attention_local_flash(q, k, v, *, axis_name: str, axis_size: int,
+                                causal: bool):
+    """Contiguous-layout ring body with the Pallas flash kernel per block.
+
+    Same ring schedule as ``_ring_attention_local``, but each [Tl x Tl]
+    block runs through ``flash_attention_lse`` (scores stream through VMEM
+    — nothing Tl x Tl ever materializes in HBM, so per-device sequence
+    slices can be long) and partials chain via ``_merge_blocks``. Step 0 is
+    the local (diagonal) block — the only one needing causal masking;
+    every later block is fully visible or fully masked (gated by
+    lse = NEG_INF, which also zeroes its gradient).
+    """
+    from .flash import flash_attention_lse
+
+    dtype = q.dtype
+    s = axis_size
+    my = lax.axis_index(axis_name)
+    out0, lse0 = flash_attention_lse(q, k, v, causal=causal)
+    carry0 = (k, v, out0.astype(jnp.float32), lse0)
+    perm = [(i, (i + 1) % s) for i in range(s)]
+
+    def step(carry, t):
+        kb, vb, o, lse = carry
+        kb = lax.ppermute(kb, axis_name, perm)
+        vb = lax.ppermute(vb, axis_name, perm)
+        out_b, lse_b = flash_attention_lse(q, kb, vb, causal=False)
+        if causal:
+            src = (my - t) % s
+            lse_b = jnp.where(src < my, lse_b, NEG_INF)
+        o, lse = _merge_blocks(o, lse, out_b, lse_b)
+        return (kb, vb, o, lse), None
+
+    (_, _, o, _), _ = lax.scan(step, carry0, jnp.arange(1, s))
+    return o.astype(dtype)
+
+
+def _ring_attention_zigzag_local_flash(q, k, v, *, axis_name: str,
+                                       axis_size: int):
+    """Zigzag ring body with the Pallas flash kernel per quarter block.
+
+    The balanced schedule of ``_ring_attention_zigzag_local`` (same chunk
+    visibility proof), with each quarter block as one flash call and
+    lse-merges instead of the inline online-softmax accumulator. Step 0 is
+    three quarter blocks (the two intra-chunk diagonals + the always-
+    visible hi×lo); later steps are exactly two maskless quarter calls.
+    """
+    from .flash import flash_attention_lse
+
+    dtype = q.dtype
+    b, tl, h, d = q.shape
+    c = tl // 2
+    s = axis_size
+    my = lax.axis_index(axis_name)
+    q_lo, q_hi = q[:, :c], q[:, c:]
+
+    o_ll, l_ll = flash_attention_lse(q_lo, k[:, :c], v[:, :c], causal=True)
+    o_hl, l_hl = flash_attention_lse(q_hi, k[:, :c], v[:, :c], causal=False)
+    o_hh, l_hh = flash_attention_lse(q_hi, k[:, c:], v[:, c:], causal=True)
+    o_lo, l_lo = o_ll.astype(jnp.float32), l_ll
+    o_hi, l_hi = _merge_blocks(o_hl.astype(jnp.float32), l_hl, o_hh, l_hh)
+
+    perm = [(i, (i + 1) % s) for i in range(s)]
+
+    def step(carry, t):
+        kb, vb, o_lo, l_lo, o_hi, l_hi = carry
+        kb = lax.ppermute(kb, axis_name, perm)
+        vb = lax.ppermute(vb, axis_name, perm)
+        src = (my - t) % s
+        pred = src < my
+        k_lo, k_hi = kb[:, :c], kb[:, c:]
+        v_lo, v_hi = vb[:, :c], vb[:, c:]
+        sel_q = jnp.where(pred, q_lo, q_hi)
+        sel_k = jnp.where(pred, k_lo, k_hi)
+        sel_v = jnp.where(pred, v_lo, v_hi)
+        e1_o, e1_l = flash_attention_lse(q_hi, k_lo, v_lo, causal=False)
+        e2_o, e2_l = flash_attention_lse(sel_q, sel_k, sel_v, causal=False)
+        o_hi, l_hi = _merge_blocks(o_hi, l_hi, e1_o, e1_l)
+        # e2 routes to the lo rows when pred, else to the (post-e1) hi rows
+        o_b = jnp.where(pred, o_lo, o_hi)
+        l_b = jnp.where(pred, l_lo, l_hi)
+        o_b, l_b = _merge_blocks(o_b, l_b, e2_o, e2_l)
+        o_lo = jnp.where(pred, o_b, o_lo)
+        l_lo = jnp.where(pred, l_b, l_lo)
+        o_hi = jnp.where(pred, o_hi, o_b)
+        l_hi = jnp.where(pred, l_hi, l_b)
+        return (kb, vb, o_lo, l_lo, o_hi, l_hi), None
+
+    carry0 = (k, v, o_lo, l_lo, o_hi, l_hi)
+    (_, _, o_lo, _, o_hi, _), _ = lax.scan(step, carry0, jnp.arange(1, s))
+    return jnp.concatenate([o_lo, o_hi], axis=1).astype(dtype)
+
+
 def _ring_attention_zigzag_local(q, k, v, *, axis_name: str, axis_size: int):
     """Causal zigzag ring attention body (runs inside shard_map).
 
@@ -240,7 +346,8 @@ def _ring_attention_local(q, k, v, *, axis_name: str, axis_size: int,
 
 def ring_attention(q, k, v, mesh: Mesh, causal: bool = True,
                    seq_axis: str = "seq", data_axes=("data", "fsdp"),
-                   head_axis: str = "tensor", layout: str = "contig"):
+                   head_axis: str = "tensor", layout: str = "contig",
+                   block_impl: str = "einsum"):
     """Sequence-parallel attention over the mesh's ``seq`` axis.
 
     q,k,v are global ``[B, T, H, D]`` arrays (T sharded over ``seq``); the
@@ -251,6 +358,12 @@ def ring_attention(q, k, v, mesh: Mesh, causal: bool = True,
     ``layout="zigzag"`` (causal only, T divisible by 2s): inputs must be in
     ``zigzag_perm(T, s)`` order; the balanced maskless body cuts attention
     FLOPs 2× (module docstring). Output stays in zigzag order.
+
+    ``block_impl="flash"`` runs each ring block through the Pallas flash
+    kernel (``ops/flash.flash_attention_lse``) and merges partials by
+    logsumexp — per-device score tiles stream through VMEM instead of
+    materializing [Tl x Tl], so long per-device slices stay HBM-light.
+    ``"einsum"`` (default) is the plain-XLA body, best for short slices.
     """
     if seq_axis not in mesh.axis_names or mesh.shape[seq_axis] == 1:
         return multihead_attention(q, k, v, causal=causal)
@@ -275,10 +388,21 @@ def ring_attention(q, k, v, mesh: Mesh, causal: bool = True,
         hp = None
     spec = P(dp if dp else None, seq_axis, hp, None)
 
+    if block_impl not in ("einsum", "flash"):
+        raise ValueError(
+            f"block_impl={block_impl!r}; expected 'einsum' or 'flash'"
+        )
+    flash_blocks = block_impl == "flash"
     if zigzag:
         fn = functools.partial(
-            _ring_attention_zigzag_local, axis_name=seq_axis,
-            axis_size=axis_size,
+            _ring_attention_zigzag_local_flash if flash_blocks
+            else _ring_attention_zigzag_local,
+            axis_name=seq_axis, axis_size=axis_size,
+        )
+    elif flash_blocks:
+        fn = functools.partial(
+            _ring_attention_local_flash, axis_name=seq_axis,
+            axis_size=axis_size, causal=causal,
         )
     else:
         vary_axes = tuple(dp) + (seq_axis,) + ((hp,) if hp else ())
@@ -286,6 +410,10 @@ def ring_attention(q, k, v, mesh: Mesh, causal: bool = True,
             _ring_attention_local, axis_name=seq_axis, axis_size=axis_size,
             causal=causal, vary_axes=vary_axes,
         )
+    # Pallas calls don't annotate varying-mesh-axes metadata on their
+    # outputs, so the flash bodies run with the vma check off (the einsum
+    # bodies keep it, with explicit pcasts where carries start replicated).
     return shard_map(
         fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=not flash_blocks,
     )(q, k, v)

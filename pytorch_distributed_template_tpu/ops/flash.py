@@ -316,8 +316,15 @@ def _bwd_dq_kernel(q_ref, g_ref, lse_ref, delta_ref, k_ref, v_ref, dq_ref,
 
 
 def _bwd_pallas_3d(causal, block_q, block_k, t_valid, interpret,
-                   residuals, g):
-    """Pallas two-kernel flash backward. Same signature/result as _bwd_3d."""
+                   residuals, g, g_lse=None):
+    """Pallas two-kernel flash backward. Same signature/result as _bwd_3d.
+
+    ``g_lse`` ([BH, T] or None): cotangent of the logsumexp output when the
+    caller consumed it (flash_attention_lse — e.g. the ring-merge weights).
+    d(lse)/ds is the normalized probability tile p, so its contribution is
+    ``ds += p * g_lse`` — which folds into the existing ``ds = p*(dp-delta)``
+    as ``delta' = delta - g_lse``. The kernels are unchanged.
+    """
     q, k, v, out, lse = residuals
     bh, t, d = q.shape
     scale = d ** -0.5
@@ -331,6 +338,8 @@ def _bwd_pallas_3d(causal, block_q, block_k, t_valid, interpret,
         g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1,
         keepdims=True,
     )
+    if g_lse is not None:
+        delta = delta - g_lse.astype(jnp.float32)[..., None]
     lse = lse.astype(jnp.float32)[..., None]
 
     dk, dv = pl.pallas_call(
@@ -407,6 +416,33 @@ def _flash_3d_bwd(causal, block_q, block_k, t_valid, interpret, residuals, g):
 _flash_3d.defvjp(_flash_3d_fwd, _flash_3d_bwd)
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash_3d_lse(q, k, v, causal, block_q, block_k, t_valid, interpret):
+    """Like ``_flash_3d`` but also returns the logsumexp rows [BH, T] —
+    the composition primitive: softmaxes over disjoint key blocks merge
+    exactly from (out, lse) pairs (ops/attention.py ring 'flash' bodies)."""
+    return _flash_fwd_3d(q, k, v, causal=causal, block_q=block_q,
+                         block_k=block_k, t_valid=t_valid,
+                         interpret=interpret)
+
+
+def _flash_3d_lse_fwd(q, k, v, causal, block_q, block_k, t_valid, interpret):
+    out, lse = _flash_fwd_3d(q, k, v, causal=causal, block_q=block_q,
+                             block_k=block_k, t_valid=t_valid,
+                             interpret=interpret)
+    return (out, lse), (q, k, v, out, lse)
+
+
+def _flash_3d_lse_bwd(causal, block_q, block_k, t_valid, interpret,
+                      residuals, cotangents):
+    g, g_lse = cotangents
+    return _bwd_pallas_3d(causal, block_q, block_k, t_valid, interpret,
+                          residuals, g, g_lse=g_lse)
+
+
+_flash_3d_lse.defvjp(_flash_3d_lse_fwd, _flash_3d_lse_bwd)
+
+
 def _on_tpu() -> bool:
     try:
         return jax.devices()[0].platform == "tpu"
@@ -442,3 +478,45 @@ def flash_attention(q, k, v, causal: bool = True,
     out = _flash_3d(q, k, v, causal, block_q, block_k, t, interpret)
     out = out[:, :t]
     return jnp.moveaxis(out.reshape(b, h, t, d), 1, 2)
+
+
+def flash_attention_lse(q, k, v, causal: bool = False,
+                        block_q: int = DEFAULT_BLOCK_Q,
+                        block_k: int = DEFAULT_BLOCK_K,
+                        interpret: bool | None = None):
+    """Fused attention returning ``(out, lse)``.
+
+    q, k, v: [B, T, H, D]; out: [B, T, H, D]; lse: [B, H, T] float32 —
+    ``logsumexp_k(q·k/sqrt(d))`` per query row. Disjoint-key-block results
+    combine exactly:
+
+        lse = logaddexp(lse_a, lse_b)
+        out = exp(lse_a - lse)·out_a + exp(lse_b - lse)·out_b
+
+    which is how the ring bodies (ops/attention.py) chain this kernel over
+    K/V blocks arriving via ppermute (ring blocks are always square, so
+    Tq == Tk is required). Gradients flow through BOTH outputs (the lse
+    cotangent folds into the backward kernels' delta term).
+    """
+    if interpret is None:
+        interpret = not _on_tpu()
+    b, t, h, d = q.shape
+    if k.shape[1] != t:
+        raise ValueError(f"flash_attention_lse needs Tq == Tk; "
+                         f"{t} vs {k.shape[1]}")
+    bq, bk = min(block_q, t), min(block_k, t)
+    t_pad = t
+    if t % bq or t % bk:
+        lcm = block_q * block_k // math.gcd(block_q, block_k)
+        t_pad = -(-t // lcm) * lcm
+    fold = lambda x: jnp.moveaxis(x, 2, 1).reshape(b * h, t, d)
+    qf, kf, vf = fold(q), fold(k), fold(v)
+    if t_pad != t:
+        pad = ((0, 0), (0, t_pad - t), (0, 0))
+        qf, kf, vf = jnp.pad(qf, pad), jnp.pad(kf, pad), jnp.pad(vf, pad)
+    out, lse = _flash_3d_lse(qf, kf, vf, causal, block_q, block_k,
+                             t, interpret)
+    out = out[:, :t]
+    lse = lse[:, :t]
+    return (jnp.moveaxis(out.reshape(b, h, t, d), 1, 2),
+            lse.reshape(b, h, t))

@@ -33,16 +33,40 @@ def _qkv(key, b=2, t=32, h=4, d=16, dtype=jnp.float32):
 
 
 class TestRingAttention:
+    @pytest.mark.parametrize("block_impl", ["einsum", "flash"])
     @pytest.mark.parametrize("causal", [True, False])
-    def test_matches_xla_attention(self, causal):
+    def test_matches_xla_attention(self, causal, block_impl):
         mesh = build_mesh({"data": 2, "seq": 4})
         q, k, v = _qkv(jax.random.key(0))
         ref = multihead_attention(q, k, v, causal=causal)
         out = jax.jit(
-            lambda q, k, v: ring_attention(q, k, v, mesh, causal=causal)
+            lambda q, k, v: ring_attention(
+                q, k, v, mesh, causal=causal, block_impl=block_impl
+            )
         )(q, k, v)
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                    atol=1e-5, rtol=1e-5)
+
+    def test_flash_blocks_gradients_match(self):
+        """Pallas-per-block ring (contig): grads vs dense — exercises the
+        lse-cotangent path of flash_attention_lse through the merges."""
+        mesh = build_mesh({"data": 2, "seq": 4})
+        q, k, v = _qkv(jax.random.key(3), b=1, t=16, h=2, d=8)
+
+        def loss_ref(q, k, v):
+            return jnp.sum(multihead_attention(q, k, v, causal=True) ** 2)
+
+        def loss_rf(q, k, v):
+            return jnp.sum(
+                ring_attention(q, k, v, mesh, causal=True,
+                               block_impl="flash") ** 2
+            )
+
+        g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        g_rf = jax.jit(jax.grad(loss_rf, argnums=(0, 1, 2)))(q, k, v)
+        for a, b in zip(g_ref, g_rf):
+            np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                       atol=1e-4, rtol=1e-4)
 
     def test_gradients_match(self):
         mesh = build_mesh({"seq": 8})
@@ -67,8 +91,9 @@ class TestRingAttention:
         ref = multihead_attention(q, k, v)
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref))
 
+    @pytest.mark.parametrize("block_impl", ["einsum", "flash"])
     @pytest.mark.parametrize("s,t", [(4, 16), (2, 32), (8, 32)])
-    def test_zigzag_matches_xla_attention(self, s, t):
+    def test_zigzag_matches_xla_attention(self, s, t, block_impl):
         """zigzag-permuted inputs through the balanced body == dense causal
         attention in natural order (fwd), for several ring sizes."""
         mesh = build_mesh({"seq": s} if s == 8 else {"data": 8 // s,
@@ -79,11 +104,34 @@ class TestRingAttention:
         ref = multihead_attention(q, k, v, causal=True)
         out = jax.jit(
             lambda q, k, v: ring_attention(
-                q, k, v, mesh, causal=True, layout="zigzag"
+                q, k, v, mesh, causal=True, layout="zigzag",
+                block_impl=block_impl,
             )
         )(q[:, perm], k[:, perm], v[:, perm])
         np.testing.assert_allclose(np.asarray(out[:, inv]), np.asarray(ref),
                                    atol=1e-5, rtol=1e-5)
+
+    def test_zigzag_flash_gradients_match(self):
+        mesh = build_mesh({"data": 2, "seq": 4})
+        t = 16
+        q, k, v = _qkv(jax.random.key(10), b=1, t=t, h=2, d=8)
+        perm = zigzag_perm(t, 4)
+
+        def loss_ref(q, k, v):
+            return jnp.sum(multihead_attention(q, k, v, causal=True) ** 2)
+
+        def loss_zf(q, k, v):
+            out = ring_attention(
+                q[:, perm], k[:, perm], v[:, perm], mesh,
+                causal=True, layout="zigzag", block_impl="flash",
+            )
+            return jnp.sum(out ** 2)
+
+        g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        g_zf = jax.jit(jax.grad(loss_zf, argnums=(0, 1, 2)))(q, k, v)
+        for a, b in zip(g_ref, g_zf):
+            np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                       atol=1e-4, rtol=1e-4)
 
     def test_zigzag_gradients_match(self):
         mesh = build_mesh({"data": 2, "seq": 4})
@@ -149,7 +197,8 @@ class TestTransformerLM:
         np.testing.assert_allclose(np.asarray(out1), np.asarray(out2),
                                    atol=1e-5)
 
-    def test_zigzag_model_matches_natural(self):
+    @pytest.mark.parametrize("impl", ["ring", "ring_flash"])
+    def test_zigzag_model_matches_natural(self, impl):
         """TinyLM with seq_layout='zigzag' + ring attention produces the
         same natural-order logits as the plain XLA-attention model (the
         in-model permute/invert must be transparent to every consumer)."""
@@ -159,7 +208,7 @@ class TestTransformerLM:
         )
         m_ref = MODELS.get("TinyLM")()
         m_zig = MODELS.get("TinyLM")(
-            attn_impl="ring", mesh=mesh, seq_layout="zigzag"
+            attn_impl=impl, mesh=mesh, seq_layout="zigzag"
         )
         s = create_train_state(m_ref, optax.sgd(0.1), tokens, seed=11)
         out_ref = m_ref.apply({"params": s.params}, tokens, train=False)
