@@ -109,6 +109,45 @@ def test_resume_continues_and_matches(trained, tmp_path):
         )
 
 
+def test_resume_across_mesh_shapes(trained, tmp_path):
+    """A checkpoint saved under one mesh factorization restores into a
+    different one (dp-only -> dp x fsdp): orbax reshards arrays to the new
+    template's shardings, params land actually sharded over ``fsdp``, and
+    continued training matches the straight run. This is the
+    scale-up/scale-down half of the crash->relaunch->resume contract the
+    reference cannot express (its DDP world is layout-free; our arrays
+    carry shardings)."""
+    import jax
+
+    _, _, straight_trainer, _ = trained
+
+    c1 = make_config(tmp_path, run_id="m1", **{"trainer;epochs": 1})
+    t1 = build_trainer(c1)
+    t1.train()
+    ckpt = c1.save_dir / "checkpoint-epoch1"
+
+    c2 = make_config(
+        tmp_path, run_id="m2", resume=ckpt,
+        **{"trainer;epochs": 2, "mesh": {"axes": {"data": 2, "fsdp": 4}}},
+    )
+    t2 = build_trainer(c2)
+    assert t2.start_epoch == 2
+    # the restored params must live on the NEW mesh, sharded over fsdp
+    sharded = [
+        p for p in jax.tree.leaves(t2.state.params)
+        if "fsdp" in jax.tree_util.tree_leaves(tuple(p.sharding.spec))
+    ]
+    assert sharded, "no parameter restored with an fsdp-sharded layout"
+    t2.train()
+
+    p_straight = jax.tree.leaves(straight_trainer.state.params)
+    p_resumed = jax.tree.leaves(t2.state.params)
+    for a, b in zip(p_straight, p_resumed):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-3, atol=2e-4
+        )
+
+
 def test_evaluate_checkpoint(trained):
     _, config, _, log = trained
     ckpt = config.save_dir / "model_best"
