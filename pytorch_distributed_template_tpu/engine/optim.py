@@ -35,36 +35,76 @@ def _lr(lr, learning_rate):
     return lr
 
 
+def _decay_mask(exclude):
+    """Build an optax weight-decay mask from path patterns.
+
+    ``exclude`` is a list of regexes searched against each parameter's
+    ``/``-joined path (e.g. ``h_0/attn/qkv/bias``); matching leaves get NO
+    decay. Returns None (decay everything — torch semantics, the default)
+    when ``exclude`` is falsy, else a callable ``params -> bool pytree``
+    (evaluated at init, so the mask follows whatever tree it is given).
+
+    Not a torch.optim arg: torch decays every parameter, and so do we by
+    default. The standard LM/ViT recipes exempt biases, LayerNorms, and
+    position embeddings — e.g. ``"weight_decay_exclude":
+    ["bias$", "ln_", "wpe"]``.
+    """
+    if not exclude:
+        return None
+    import re
+
+    import jax
+
+    pats = [re.compile(p) for p in exclude]
+
+    def mask(params):
+        def decide(path, _):
+            name = "/".join(str(getattr(k, "key", k)) for k in path)
+            return not any(p.search(name) for p in pats)
+
+        return jax.tree_util.tree_map_with_path(decide, params)
+
+    return mask
+
+
+def _decayed(weight_decay, base, exclude=None):
+    """``add_decayed_weights`` (coupled, torch-style) chained before
+    ``base``, honoring an optional exclusion mask."""
+    if not weight_decay:
+        return base
+    return optax.chain(
+        optax.add_decayed_weights(weight_decay, mask=_decay_mask(exclude)),
+        base,
+    )
+
+
 @OPTIMIZERS.register("Adam")
 def adam(lr=1e-3, betas=(0.9, 0.999), eps=1e-8, weight_decay=0.0,
-         amsgrad=False, learning_rate=None):
+         amsgrad=False, learning_rate=None, weight_decay_exclude=None):
     lr = _lr(lr, learning_rate)
     b1, b2 = betas
     if amsgrad:
         base = optax.amsgrad(lr, b1=b1, b2=b2, eps=eps)
     else:
         base = optax.adam(lr, b1=b1, b2=b2, eps=eps)
-    if weight_decay:
-        return optax.chain(optax.add_decayed_weights(weight_decay), base)
-    return base
+    return _decayed(weight_decay, base, weight_decay_exclude)
 
 
 @OPTIMIZERS.register("AdamW")
 def adamw(lr=1e-3, betas=(0.9, 0.999), eps=1e-8, weight_decay=0.01,
-          learning_rate=None):
+          learning_rate=None, weight_decay_exclude=None):
     b1, b2 = betas
     return optax.adamw(_lr(lr, learning_rate), b1=b1, b2=b2, eps=eps,
-                       weight_decay=weight_decay)
+                       weight_decay=weight_decay,
+                       mask=_decay_mask(weight_decay_exclude))
 
 
 @OPTIMIZERS.register("SGD")
 def sgd(lr=0.1, momentum=0.0, weight_decay=0.0, nesterov=False,
-        learning_rate=None):
+        learning_rate=None, weight_decay_exclude=None):
     base = optax.sgd(_lr(lr, learning_rate), momentum=momentum or None,
                      nesterov=nesterov)
-    if weight_decay:
-        return optax.chain(optax.add_decayed_weights(weight_decay), base)
-    return base
+    return _decayed(weight_decay, base, weight_decay_exclude)
 
 
 @OPTIMIZERS.register("RMSprop")
@@ -87,32 +127,26 @@ def adadelta(lr=1.0, rho=0.9, eps=1e-6, weight_decay=0.0,
 
 @OPTIMIZERS.register("Adamax")
 def adamax(lr=2e-3, betas=(0.9, 0.999), eps=1e-8, weight_decay=0.0,
-           learning_rate=None):
+           learning_rate=None, weight_decay_exclude=None):
     b1, b2 = betas
     base = optax.adamax(_lr(lr, learning_rate), b1=b1, b2=b2, eps=eps)
-    if weight_decay:
-        return optax.chain(optax.add_decayed_weights(weight_decay), base)
-    return base
+    return _decayed(weight_decay, base, weight_decay_exclude)
 
 
 @OPTIMIZERS.register("NAdam")
 def nadam(lr=2e-3, betas=(0.9, 0.999), eps=1e-8, weight_decay=0.0,
-          learning_rate=None):
+          learning_rate=None, weight_decay_exclude=None):
     b1, b2 = betas
     base = optax.nadam(_lr(lr, learning_rate), b1=b1, b2=b2, eps=eps)
-    if weight_decay:
-        return optax.chain(optax.add_decayed_weights(weight_decay), base)
-    return base
+    return _decayed(weight_decay, base, weight_decay_exclude)
 
 
 @OPTIMIZERS.register("RAdam")
 def radam(lr=1e-3, betas=(0.9, 0.999), eps=1e-8, weight_decay=0.0,
-          learning_rate=None):
+          learning_rate=None, weight_decay_exclude=None):
     b1, b2 = betas
     base = optax.radam(_lr(lr, learning_rate), b1=b1, b2=b2, eps=eps)
-    if weight_decay:
-        return optax.chain(optax.add_decayed_weights(weight_decay), base)
-    return base
+    return _decayed(weight_decay, base, weight_decay_exclude)
 
 
 @OPTIMIZERS.register("Adafactor")
@@ -131,31 +165,37 @@ def adafactor(lr=None, weight_decay=0.0, learning_rate=None):
 
 @OPTIMIZERS.register("LARS")
 def lars(lr=1.0, momentum=0.9, weight_decay=0.0,
-         trust_coefficient=0.001, learning_rate=None):
+         trust_coefficient=0.001, learning_rate=None,
+         weight_decay_exclude=None):
     """Layer-wise adaptive rate scaling (You et al. 2017) — large-batch
     ResNet/ImageNet (the MLPerf recipe)."""
+    mask = _decay_mask(weight_decay_exclude)
+    kwargs = {} if mask is None else {"weight_decay_mask": mask}
     return optax.lars(
         _lr(lr, learning_rate), weight_decay=weight_decay,
-        momentum=momentum, trust_coefficient=trust_coefficient,
+        momentum=momentum, trust_coefficient=trust_coefficient, **kwargs,
     )
 
 
 @OPTIMIZERS.register("LAMB")
 def lamb(lr=1e-3, betas=(0.9, 0.999), eps=1e-6, weight_decay=0.0,
-         learning_rate=None):
+         learning_rate=None, weight_decay_exclude=None):
     """Layer-wise Adam (You et al. 2020) — large-batch transformers."""
     b1, b2 = betas
     return optax.lamb(_lr(lr, learning_rate), b1=b1, b2=b2, eps=eps,
-                      weight_decay=weight_decay)
+                      weight_decay=weight_decay,
+                      mask=_decay_mask(weight_decay_exclude))
 
 
 @OPTIMIZERS.register("Lion")
-def lion(lr=1e-4, betas=(0.9, 0.99), weight_decay=0.0, learning_rate=None):
+def lion(lr=1e-4, betas=(0.9, 0.99), weight_decay=0.0, learning_rate=None,
+         weight_decay_exclude=None):
     """Sign-momentum optimizer (Chen et al. 2023): one momentum slot —
     half Adam's optimizer HBM, a real win at TPU memory limits."""
     b1, b2 = betas
     return optax.lion(_lr(lr, learning_rate), b1=b1, b2=b2,
-                      weight_decay=weight_decay)
+                      weight_decay=weight_decay,
+                      mask=_decay_mask(weight_decay_exclude))
 
 
 # ---------------------------------------------------------------------------

@@ -319,3 +319,40 @@ def test_lr_scale_scales_update():
             np.asarray(ph - p0), 0.5 * np.asarray(pf - p0),
             rtol=1e-5, atol=1e-7,
         )
+
+
+@pytest.mark.parametrize("name,kwargs", [
+    ("AdamW", {"lr": 0.1, "weight_decay": 0.5}),
+    ("SGD", {"lr": 0.1, "weight_decay": 0.5}),
+    ("LAMB", {"lr": 0.1, "weight_decay": 0.5}),
+    ("Lion", {"lr": 0.1, "weight_decay": 0.5}),
+])
+def test_weight_decay_exclude(name, kwargs):
+    """weight_decay_exclude exempts matching param paths from decay: with
+    zero gradients, excluded leaves stay bit-identical while decayed ones
+    shrink. Default (no exclude) decays everything — torch semantics."""
+    import optax
+
+    params = {
+        "dense": {"kernel": jnp.ones((3, 3)), "bias": jnp.ones((3,))},
+        "ln_f": {"scale": jnp.ones((3,))},
+    }
+    grads = jax.tree.map(jnp.zeros_like, params)
+
+    tx = OPTIMIZERS.get(name)(**kwargs,
+                              weight_decay_exclude=["bias$", "ln_"])
+    state = tx.init(params)
+    updates, _ = tx.update(grads, state, params)
+    new = optax.apply_updates(params, updates)
+    assert float(jnp.max(jnp.abs(new["dense"]["kernel"] - 1.0))) > 0
+    np.testing.assert_array_equal(np.asarray(new["dense"]["bias"]),
+                                  np.ones(3))
+    np.testing.assert_array_equal(np.asarray(new["ln_f"]["scale"]),
+                                  np.ones(3))
+
+    tx_all = OPTIMIZERS.get(name)(**kwargs)
+    state = tx_all.init(params)
+    updates, _ = tx_all.update(grads, state, params)
+    new = optax.apply_updates(params, updates)
+    for leaf in jax.tree.leaves(new):
+        assert float(jnp.max(jnp.abs(leaf - 1.0))) > 0
