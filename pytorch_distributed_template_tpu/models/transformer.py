@@ -29,7 +29,7 @@ from jax.sharding import PartitionSpec as P
 
 from ..config.registry import MODELS
 from ..ops.attention import (
-    multihead_attention, ring_attention, zigzag_perm,
+    multihead_attention, ring_attention, ulysses_attention, zigzag_perm,
 )
 
 
@@ -61,8 +61,9 @@ class SelfAttention(nn.Module):
     dropout: float
     n_layer: int
     dtype: Any
-    attn_impl: str = "xla"          # 'xla' | 'ring' | 'ring_flash' | 'flash'
-    mesh: Optional[Any] = None      # required for 'ring*'
+    # 'xla' | 'ring' | 'ring_flash' | 'ulysses' | 'ulysses_flash' | 'flash'
+    attn_impl: str = "xla"
+    mesh: Optional[Any] = None      # required for 'ring*' / 'ulysses*'
     seq_layout: str = "natural"     # 'zigzag' -> inputs are zigzag-permuted
 
     @nn.compact
@@ -86,6 +87,15 @@ class SelfAttention(nn.Module):
                 ),
                 block_impl=(
                     "flash" if self.attn_impl == "ring_flash" else "einsum"
+                ),
+            )
+        elif self.attn_impl in ("ulysses", "ulysses_flash"):
+            if self.mesh is None:
+                raise ValueError(f"attn_impl={self.attn_impl!r} requires a mesh")
+            ctx = ulysses_attention(
+                q, k, v, self.mesh, causal=True,
+                inner=(
+                    "flash" if self.attn_impl == "ulysses_flash" else "xla"
                 ),
             )
         elif self.attn_impl == "flash":

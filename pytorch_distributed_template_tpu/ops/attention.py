@@ -298,6 +298,77 @@ def _ring_attention_zigzag_local(q, k, v, *, axis_name: str, axis_size: int):
     return jnp.transpose(out, (0, 2, 1, 3)).astype(dtype)
 
 
+def _ulysses_local(q, k, v, *, axis_name: str, axis_size: int,
+                   causal: bool, inner: str):
+    """Per-shard Ulysses body (runs inside shard_map).
+
+    q,k,v: local [B, T/s, H, D] sequence slices. One tiled all-to-all
+    re-shards each to [B, T, H/s, D] (full sequence, 1/s of the heads),
+    attention runs LOCALLY over the whole sequence — heads are
+    embarrassingly parallel — and a second all-to-all restores the
+    sequence layout. Two collectives total per attention call (vs the
+    ring's s ppermutes), and the local compute is plain full-T attention,
+    so the causal 2x comes from the flash kernel's diagonal predication
+    rather than a schedule. Positions stay natural — no zigzag needed.
+    """
+    a2a = functools.partial(lax.all_to_all, axis_name=axis_name, tiled=True)
+    q = a2a(q, split_axis=2, concat_axis=1)        # [B, T, H/s, D]
+    k = a2a(k, split_axis=2, concat_axis=1)
+    v = a2a(v, split_axis=2, concat_axis=1)
+    if inner == "flash":
+        from .flash import flash_attention
+
+        out = flash_attention(q, k, v, causal=causal)
+    else:
+        out = multihead_attention(q, k, v, causal=causal)
+    return a2a(out, split_axis=1, concat_axis=2)   # [B, T/s, H, D]
+
+
+def ulysses_attention(q, k, v, mesh: Mesh, causal: bool = True,
+                      seq_axis: str = "seq", data_axes=("data", "fsdp"),
+                      head_axis: str = "tensor", inner: str = "xla"):
+    """All-to-all (DeepSpeed-Ulysses-style) sequence parallelism.
+
+    The alternative SP strategy to ``ring_attention``: instead of rotating
+    K/V blocks s times around the ring, ONE all-to-all converts the
+    sequence sharding into a head sharding (heads are independent in
+    attention), full-sequence attention runs locally, and one all-to-all
+    converts back. Cheaper in collective count for moderate T; the ring
+    wins when T is so long that even [B, T, H/s, D] per device is too big.
+    Local head count (after any ``tensor`` sharding) must divide by the
+    seq-axis size; otherwise — and for probe shapes — falls back dense.
+
+    ``inner`` selects the local kernel: "xla" einsum or "flash" (Pallas).
+    """
+    if seq_axis not in mesh.axis_names or mesh.shape[seq_axis] == 1:
+        return multihead_attention(q, k, v, causal=causal)
+    s = mesh.shape[seq_axis]
+    if q.shape[1] % s != 0:
+        return multihead_attention(q, k, v, causal=causal)
+
+    dp = tuple(a for a in data_axes if a in mesh.axis_names)
+    dp_total = int(np.prod([mesh.shape[a] for a in dp])) if dp else 1
+    if dp and q.shape[0] % dp_total != 0:
+        dp = ()
+    hp = head_axis if head_axis in mesh.axis_names else None
+    if hp is not None and q.shape[2] % mesh.shape[hp] != 0:
+        hp = None
+    local_heads = q.shape[2] // (mesh.shape[hp] if hp else 1)
+    if local_heads % s != 0:
+        # not enough heads per device to split across the seq axis
+        return multihead_attention(q, k, v, causal=causal)
+    spec = P(dp if dp else None, seq_axis, hp, None)
+
+    fn = functools.partial(
+        _ulysses_local, axis_name=seq_axis, axis_size=s, causal=causal,
+        inner=inner,
+    )
+    return shard_map(
+        fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=inner != "flash",
+    )(q, k, v)
+
+
 def _ring_attention_local(q, k, v, *, axis_name: str, axis_size: int,
                           causal: bool, vary_axes: tuple = ()):
     """Per-shard ring attention body (runs inside shard_map).
