@@ -27,7 +27,7 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def _spawn_pair(script, extra_args=(), timeout=210):
+def _spawn_pair(script, extra_args=(), timeout=330):
     port = _free_port()
     procs = []
     for rank in range(2):
