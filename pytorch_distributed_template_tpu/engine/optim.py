@@ -391,6 +391,12 @@ def build_optimizer(config, steps_per_epoch: int):
     ``step // steps_per_epoch`` with the reference's convention: the
     scheduler has been stepped ``epoch`` times after epoch ``epoch``
     completes, i.e. during epoch e (1-based) the scale is f(e - 1).
+
+    ``"unit": "step"`` on the lr_scheduler block indexes the schedule by
+    optimizer step instead (its args then denote steps — e.g.
+    ``WarmupCosine(warmup_epochs=2000, total_epochs=100000)`` reads as
+    warmup steps / total steps). Smooth per-step warmup for long-epoch LM
+    runs; the default ("epoch") keeps reference semantics.
     """
     opt_cfg = config["optimizer"]
     opt_args = dict(opt_cfg.get("args", {}))
@@ -415,6 +421,12 @@ def build_optimizer(config, steps_per_epoch: int):
             f"(got lr=None for {opt_cfg['type']}, which means "
             "optimizer-internal relative stepping)"
         )
+    if (sched_cfg and sched_cfg.get("unit") == "step"
+            and sched_cfg["type"] == "ReduceLROnPlateau"):
+        raise ValueError(
+            "ReduceLROnPlateau is metric-driven per epoch; unit='step' "
+            "does not apply"
+        )
     if sched_cfg and sched_cfg["type"] == "ReduceLROnPlateau":
         args = dict(sched_cfg.get("args", {}))
         # torch spells min_lr/eps in lr units (min_lr possibly as a
@@ -431,7 +443,19 @@ def build_optimizer(config, steps_per_epoch: int):
         factory = SCHEDULERS.get(sched_cfg["type"])
         scale_fn = factory(**sched_cfg.get("args", {}))
 
-    if scale_fn is not None:
+    # granularity of the schedule index: "epoch" (reference semantics — the
+    # scheduler steps once per epoch, train.py:43 + trainer.py:90-91) or
+    # "step" (the schedule's args are in optimizer steps — the LM warmup
+    # idiom, where one epoch can be thousands of steps and an epoch-ticked
+    # warmup would jump the LR in cliffs)
+    unit = (sched_cfg or {}).get("unit", "epoch")
+    if unit not in ("epoch", "step"):
+        raise ValueError(f"lr_scheduler unit must be epoch|step, got {unit!r}")
+
+    if scale_fn is not None and unit == "step":
+        def schedule(step):
+            return base_lr * scale_fn(step)
+    elif scale_fn is not None:
         def schedule(step):
             epoch0 = step // max(steps_per_epoch, 1)  # 0-based completed epochs
             return base_lr * scale_fn(epoch0)

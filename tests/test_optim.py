@@ -356,3 +356,37 @@ def test_weight_decay_exclude(name, kwargs):
     new = optax.apply_updates(params, updates)
     for leaf in jax.tree.leaves(new):
         assert float(jnp.max(jnp.abs(leaf - 1.0))) > 0
+
+
+def test_step_unit_schedule():
+    """lr_scheduler "unit": "step" indexes the schedule by optimizer step
+    (smooth per-step warmup) instead of by completed epoch."""
+    cfg = {
+        "optimizer": {"type": "SGD", "args": {"lr": 1.0}},
+        "lr_scheduler": {
+            "type": "WarmupCosine", "unit": "step",
+            "args": {"warmup_epochs": 10, "total_epochs": 100},
+        },
+    }
+    _, lr_fn, _ = build_optimizer(cfg, steps_per_epoch=1000)
+    # per-step ramp: step 4 -> (4+1)/10, unaffected by steps_per_epoch
+    assert abs(float(lr_fn(4)) - 0.5) < 1e-6
+    assert abs(float(lr_fn(9)) - 1.0) < 1e-6
+    # cosine tail reaches ~0 at step 100
+    assert float(lr_fn(100)) < 1e-3
+
+    # same config with the default epoch unit: constant within epoch 0
+    cfg["lr_scheduler"].pop("unit")
+    _, lr_fn_e, _ = build_optimizer(cfg, steps_per_epoch=1000)
+    assert abs(float(lr_fn_e(4)) - 0.1) < 1e-6   # epoch 0 -> (0+1)/10
+    assert abs(float(lr_fn_e(999)) - 0.1) < 1e-6
+
+
+def test_step_unit_rejects_plateau():
+    cfg = {
+        "optimizer": {"type": "SGD", "args": {"lr": 1.0}},
+        "lr_scheduler": {"type": "ReduceLROnPlateau", "unit": "step",
+                         "args": {}},
+    }
+    with pytest.raises(ValueError):
+        build_optimizer(cfg, steps_per_epoch=10)
