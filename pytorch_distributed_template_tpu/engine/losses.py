@@ -71,6 +71,59 @@ def smooth_cross_entropy(smoothing: float = 0.1):
 smooth_cross_entropy._loss_factory = True  # dict-form config required
 
 
+@LOSSES.register("fused_lm_cross_entropy")
+def fused_lm_cross_entropy(chunk: int = 256):
+    """FACTORY loss: next-token CE fused with the LM head, sequence-chunked.
+
+    Pairs with a model built with ``fused_head: true`` (models/transformer
+    TransformerLM): ``output`` is ``(hidden [B,T,D], head_w [D,V])`` and
+    the [B, T, V] logits tensor NEVER materializes — a ``lax.scan`` over
+    ``chunk``-token slices computes each slice's logits, its CE, and (via
+    ``jax.checkpoint`` on the body) recomputes them in backward, so peak
+    HBM holds one [B, chunk, V] slice instead of the full T. At GPT-2
+    vocab (50257) and long T this is the dominant activation saved.
+    Numerically identical to ``lm_cross_entropy`` on the same params
+    (same shift, per-sequence mean) up to float reassociation.
+    """
+    if chunk <= 0:
+        raise ValueError(f"chunk must be positive, got {chunk}")
+
+    def loss(output, target):
+        h, w = output                       # [B, T, D], [D, V]
+        h = h[:, :-1]
+        labels = target[:, 1:]
+        b, tm1, d = h.shape
+        n_chunks = -(-tm1 // chunk)
+        t_pad = n_chunks * chunk
+        if t_pad != tm1:
+            h = jnp.pad(h, ((0, 0), (0, t_pad - tm1), (0, 0)))
+            labels = jnp.pad(labels, ((0, 0), (0, t_pad - tm1)))
+        valid = (jnp.arange(t_pad) < tm1).astype(jnp.float32)
+        # [n_chunks, B, chunk, ...] so scan carries one chunk at a time
+        h_c = jnp.moveaxis(h.reshape(b, n_chunks, chunk, d), 1, 0)
+        l_c = jnp.moveaxis(labels.reshape(b, n_chunks, chunk), 1, 0)
+        v_c = valid.reshape(n_chunks, chunk)
+
+        @jax.checkpoint
+        def body(carry, inp):
+            hc, lc, vc = inp
+            logits = (hc @ w).astype(jnp.float32)       # [B, chunk, V]
+            tok = optax.softmax_cross_entropy_with_integer_labels(
+                logits, lc
+            )
+            return carry + jnp.sum(tok * vc[None, :], axis=-1), None
+
+        total, _ = jax.lax.scan(
+            body, jnp.zeros((b,), jnp.float32), (h_c, l_c, v_c)
+        )
+        return total / tm1
+
+    return loss
+
+
+fused_lm_cross_entropy._loss_factory = True
+
+
 def resolve_loss(loss_cfg):
     """Resolve the config ``loss`` entry to a per-example callable.
 

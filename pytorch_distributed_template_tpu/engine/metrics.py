@@ -30,7 +30,35 @@ def top_k_acc(output, target, k: int = 3):
 
 @METRICS.register("lm_token_accuracy")
 def lm_token_accuracy(output, target):
-    """Next-token accuracy for LM heads: output [B,T,V], target [B,T]."""
+    """Next-token accuracy for LM heads: output [B,T,V], target [B,T].
+
+    Also accepts the ``fused_head`` model's ``(hidden [B,T,D], head_w
+    [D,V])`` tuple, computing argmax per 256-token chunk so the full
+    logits tensor stays unmaterialized here too."""
+    if isinstance(output, tuple):
+        h, w = output
+        h = h[:, :-1]
+        labels = target[:, 1:]
+        b, tm1, d = h.shape
+        chunk = 256
+        n_chunks = -(-tm1 // chunk)
+        t_pad = n_chunks * chunk
+        if t_pad != tm1:
+            h = jnp.pad(h, ((0, 0), (0, t_pad - tm1), (0, 0)))
+            labels = jnp.pad(labels, ((0, 0), (0, t_pad - tm1)),
+                             constant_values=-1)  # never matches argmax
+        h_c = jnp.moveaxis(h.reshape(b, n_chunks, chunk, d), 1, 0)
+        l_c = jnp.moveaxis(labels.reshape(b, n_chunks, chunk), 1, 0)
+
+        def body(carry, inp):
+            hc, lc = inp
+            pred = jnp.argmax((hc @ w).astype(jnp.float32), axis=-1)
+            return carry + jnp.sum((pred == lc).astype(jnp.float32), -1), None
+
+        hits, _ = jax.lax.scan(
+            body, jnp.zeros((b,), jnp.float32), (h_c, l_c)
+        )
+        return hits / tm1
     pred = jnp.argmax(output[:, :-1], axis=-1)
     hit = (pred == target[:, 1:]).astype(jnp.float32)
     return hit.mean(axis=-1)

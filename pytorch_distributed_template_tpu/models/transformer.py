@@ -205,6 +205,7 @@ class TransformerLM(nn.Module):
     mesh: Optional[Any] = None
     remat: bool = False
     seq_layout: str = "natural"     # 'zigzag': balanced causal ring (ops/attention.py)
+    fused_head: bool = False        # return (hidden, head_w) for chunked loss
     tie_embeddings: bool = True
     ln_eps: float = 1e-5            # GPT-2's layer_norm_epsilon
     # --- MoE (models/moe.py); moe_experts == 0 -> all-dense blocks --------
@@ -308,6 +309,16 @@ class TransformerLM(nn.Module):
                          name="ln_f")(x)
         if zperm is not None:
             x = x[:, np.argsort(zperm)]  # back to natural order pre-head
+        if self.fused_head and not decode:
+            # Memory-efficient head: hand (hidden, head weights) to a fused
+            # chunked loss (engine/losses.fused_lm_cross_entropy) so the
+            # full [B, T, V] logits tensor never materializes — at large
+            # vocab it dominates peak HBM. Decode still produces logits
+            # (generation needs them token-by-token, where V is cheap).
+            if not self.tie_embeddings:
+                raise ValueError("fused_head requires tie_embeddings=True")
+            w = embed.embedding.T.astype(self.dtype)     # [D, V]
+            return x.astype(self.dtype), w
         if self.tie_embeddings:
             logits = embed.attend(x.astype(self.dtype))
         else:
@@ -359,14 +370,15 @@ _GPT2_SIZES = {
 def gpt2(size: str = "gpt2-small", vocab_size: int = 50257,
          max_len: int = 1024, dropout: float = 0.1, bfloat16: bool = False,
          attn_impl: str = "xla", remat: bool = False, mesh=None,
-         seq_layout: str = "natural", **overrides):
+         seq_layout: str = "natural", fused_head: bool = False,
+         **overrides):
     cfg = dict(_GPT2_SIZES[size])
     cfg.update(overrides)
     return TransformerLM(
         vocab_size=vocab_size, max_len=max_len, dropout=dropout,
         dtype=jnp.bfloat16 if bfloat16 else jnp.float32,
         attn_impl=attn_impl, remat=remat, mesh=mesh,
-        seq_layout=seq_layout, **cfg,
+        seq_layout=seq_layout, fused_head=fused_head, **cfg,
     )
 
 
@@ -374,12 +386,13 @@ def gpt2(size: str = "gpt2-small", vocab_size: int = 50257,
 def tiny_lm(vocab_size: int = 256, n_layer: int = 2, n_head: int = 4,
             d_model: int = 64, max_len: int = 128, dropout: float = 0.0,
             attn_impl: str = "xla", remat: bool = False, mesh=None,
-            bfloat16: bool = False, seq_layout: str = "natural"):
+            bfloat16: bool = False, seq_layout: str = "natural",
+            fused_head: bool = False):
     """Small config for tests and the multi-chip dry run."""
     return TransformerLM(
         vocab_size=vocab_size, n_layer=n_layer, n_head=n_head,
         d_model=d_model, max_len=max_len, dropout=dropout,
         dtype=jnp.bfloat16 if bfloat16 else jnp.float32,
         attn_impl=attn_impl, remat=remat, mesh=mesh,
-        seq_layout=seq_layout,
+        seq_layout=seq_layout, fused_head=fused_head,
     )

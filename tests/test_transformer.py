@@ -465,3 +465,73 @@ class TestFlashAttention:
         out_fl = m_fl.apply({"params": s.params}, tokens, train=False)
         np.testing.assert_allclose(np.asarray(out_fl), np.asarray(out_ref),
                                    atol=1e-4, rtol=1e-4)
+
+
+class TestFusedHead:
+    """fused_head models return (hidden, head_w); the chunked loss/metric
+    never materialize [B, T, V] — values and grads must match the plain
+    logits path exactly (same shift, same per-sequence mean)."""
+
+    def _pair(self, t=50):
+        tokens = jnp.asarray(
+            np.random.default_rng(4).integers(0, 256, (2, t)), jnp.int32
+        )
+        m_ref = MODELS.get("TinyLM")()
+        m_fused = MODELS.get("TinyLM")(fused_head=True)
+        s = create_train_state(m_ref, optax.sgd(0.1), tokens, seed=0)
+        return tokens, m_ref, m_fused, s
+
+    @pytest.mark.parametrize("chunk", [16, 7, 64])
+    def test_loss_and_grads_match(self, chunk):
+        from pytorch_distributed_template_tpu.engine.losses import (
+            resolve_loss,
+        )
+
+        tokens, m_ref, m_fused, s = self._pair()
+        ce = LOSSES.get("lm_cross_entropy")
+        fce = resolve_loss(
+            {"type": "fused_lm_cross_entropy", "args": {"chunk": chunk}}
+        )
+
+        def loss_ref(p):
+            return ce(
+                m_ref.apply({"params": p}, tokens, train=False), tokens
+            ).mean()
+
+        def loss_fused(p):
+            return fce(
+                m_fused.apply({"params": p}, tokens, train=False), tokens
+            ).mean()
+
+        l1, g1 = jax.value_and_grad(loss_ref)(s.params)
+        l2, g2 = jax.jit(jax.value_and_grad(loss_fused))(s.params)
+        assert abs(float(l1) - float(l2)) < 1e-5
+        for a, b in zip(jax.tree.leaves(g1), jax.tree.leaves(g2)):
+            np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                       atol=1e-5, rtol=1e-4)
+
+    def test_metric_and_generation_match(self):
+        from pytorch_distributed_template_tpu.engine.generate import generate
+
+        tokens, m_ref, m_fused, s = self._pair(t=40)
+        acc = METRICS.get("lm_token_accuracy")
+        a1 = acc(m_ref.apply({"params": s.params}, tokens, train=False),
+                 tokens)
+        a2 = acc(m_fused.apply({"params": s.params}, tokens, train=False),
+                 tokens)
+        np.testing.assert_allclose(np.asarray(a2), np.asarray(a1),
+                                   atol=1e-6)
+        t1 = generate(m_ref, s.params, tokens[:, :8], max_new_tokens=4)
+        t2 = generate(m_fused, s.params, tokens[:, :8], max_new_tokens=4)
+        np.testing.assert_array_equal(np.asarray(t2), np.asarray(t1))
+
+    def test_untied_rejected(self):
+        from pytorch_distributed_template_tpu.models.transformer import (
+            TransformerLM,
+        )
+
+        bad = TransformerLM(vocab_size=64, n_layer=1, n_head=2, d_model=32,
+                            fused_head=True, tie_embeddings=False)
+        tokens = jnp.zeros((1, 8), jnp.int32)
+        with pytest.raises(ValueError):
+            bad.init(jax.random.key(0), tokens)
