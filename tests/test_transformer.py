@@ -525,6 +525,47 @@ class TestFusedHead:
         t2 = generate(m_fused, s.params, tokens[:, :8], max_new_tokens=4)
         np.testing.assert_array_equal(np.asarray(t2), np.asarray(t1))
 
+    def test_trains_under_dp_tp(self):
+        """Fused head with the vocab-sharded (TP) embedding: the chunked
+        loss's V-axis reductions cross the tensor axis via XLA collectives;
+        loss must still decrease."""
+        from pytorch_distributed_template_tpu.data.datasets import (
+            synthetic_lm,
+        )
+        from pytorch_distributed_template_tpu.engine.losses import (
+            resolve_loss,
+        )
+
+        mesh = build_mesh({"data": 2, "tensor": 4})
+        model = MODELS.get("TinyLM")(
+            vocab_size=64, d_model=64, max_len=64, fused_head=True
+        )
+        crit = resolve_loss(
+            {"type": "fused_lm_cross_entropy", "args": {"chunk": 16}}
+        )
+        tx = optax.adam(3e-3)
+        state = create_train_state(model, tx, model.batch_template(1), seed=0)
+        state = jax.device_put(
+            state, apply_rules(state, mesh, model.partition_rules())
+        )
+        step = jax.jit(
+            make_train_step(model, tx, crit,
+                            [METRICS.get("lm_token_accuracy")],
+                            input_key="tokens", target_key="tokens"),
+            donate_argnums=0,
+        )
+        data = synthetic_lm(n=64, seq_len=32, vocab_size=64, seed=0)
+        bs = batch_sharding(mesh)
+        batch = {
+            "tokens": jax.device_put(data["tokens"], bs),
+            "mask": jax.device_put(np.ones(64, bool), bs),
+        }
+        losses = []
+        for _ in range(20):
+            state, m = step(state, batch)
+            losses.append(float(m["loss_sum"]) / float(m["count"]))
+        assert losses[-1] < losses[0] - 0.3, losses[::5]
+
     def test_untied_rejected(self):
         from pytorch_distributed_template_tpu.models.transformer import (
             TransformerLM,
