@@ -28,6 +28,7 @@ Deliberate differences from the reference (documented, not bugs):
 """
 from __future__ import annotations
 
+import json
 import logging
 from datetime import datetime
 from functools import partial, reduce
@@ -270,8 +271,6 @@ def _parse_cli_value(raw: str):
     ``0.002`` -> float, ``true`` -> bool, ``{"data": 2}`` -> dict,
     ``zigzag`` -> str (not valid JSON, stays literal).
     """
-    import json
-
     try:
         return json.loads(raw)
     except (ValueError, TypeError):

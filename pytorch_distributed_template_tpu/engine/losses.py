@@ -71,6 +71,31 @@ def smooth_cross_entropy(smoothing: float = 0.1):
 smooth_cross_entropy._loss_factory = True  # dict-form config required
 
 
+def chunk_shifted_sequence(h, labels, chunk: int, pad_label: int = 0):
+    """Split an already-shifted (hidden, labels) pair into scan-ready
+    chunk-leading arrays for the fused-head consumers (the chunked loss
+    below and engine/metrics.lm_token_accuracy).
+
+    h: [B, T-1, D]; labels: [B, T-1]. Returns ``(h_c [n, B, chunk, D],
+    l_c [n, B, chunk], valid [n, chunk])`` where trailing padding rows are
+    marked invalid and labels padded with ``pad_label``.
+    """
+    b, tm1, d = h.shape
+    n_chunks = -(-tm1 // chunk)
+    t_pad = n_chunks * chunk
+    if t_pad != tm1:
+        h = jnp.pad(h, ((0, 0), (0, t_pad - tm1), (0, 0)))
+        labels = jnp.pad(labels, ((0, 0), (0, t_pad - tm1)),
+                         constant_values=pad_label)
+    h_c = jnp.moveaxis(h.reshape(b, n_chunks, chunk, d), 1, 0)
+    l_c = jnp.moveaxis(labels.reshape(b, n_chunks, chunk), 1, 0)
+    valid = (
+        (jnp.arange(t_pad) < tm1).astype(jnp.float32)
+        .reshape(n_chunks, chunk)
+    )
+    return h_c, l_c, valid
+
+
 @LOSSES.register("fused_lm_cross_entropy")
 def fused_lm_cross_entropy(chunk: int = 256):
     """FACTORY loss: next-token CE fused with the LM head, sequence-chunked.
@@ -90,19 +115,11 @@ def fused_lm_cross_entropy(chunk: int = 256):
 
     def loss(output, target):
         h, w = output                       # [B, T, D], [D, V]
-        h = h[:, :-1]
-        labels = target[:, 1:]
-        b, tm1, d = h.shape
-        n_chunks = -(-tm1 // chunk)
-        t_pad = n_chunks * chunk
-        if t_pad != tm1:
-            h = jnp.pad(h, ((0, 0), (0, t_pad - tm1), (0, 0)))
-            labels = jnp.pad(labels, ((0, 0), (0, t_pad - tm1)))
-        valid = (jnp.arange(t_pad) < tm1).astype(jnp.float32)
-        # [n_chunks, B, chunk, ...] so scan carries one chunk at a time
-        h_c = jnp.moveaxis(h.reshape(b, n_chunks, chunk, d), 1, 0)
-        l_c = jnp.moveaxis(labels.reshape(b, n_chunks, chunk), 1, 0)
-        v_c = valid.reshape(n_chunks, chunk)
+        tm1 = h.shape[1] - 1
+        b = h.shape[0]
+        h_c, l_c, v_c = chunk_shifted_sequence(
+            h[:, :-1], target[:, 1:], chunk
+        )
 
         @jax.checkpoint
         def body(carry, inp):

@@ -36,19 +36,15 @@ def lm_token_accuracy(output, target):
     [D,V])`` tuple, computing argmax per 256-token chunk so the full
     logits tensor stays unmaterialized here too."""
     if isinstance(output, tuple):
+        from .losses import chunk_shifted_sequence
+
         h, w = output
-        h = h[:, :-1]
-        labels = target[:, 1:]
-        b, tm1, d = h.shape
-        chunk = 256
-        n_chunks = -(-tm1 // chunk)
-        t_pad = n_chunks * chunk
-        if t_pad != tm1:
-            h = jnp.pad(h, ((0, 0), (0, t_pad - tm1), (0, 0)))
-            labels = jnp.pad(labels, ((0, 0), (0, t_pad - tm1)),
-                             constant_values=-1)  # never matches argmax
-        h_c = jnp.moveaxis(h.reshape(b, n_chunks, chunk, d), 1, 0)
-        l_c = jnp.moveaxis(labels.reshape(b, n_chunks, chunk), 1, 0)
+        tm1 = h.shape[1] - 1
+        b = h.shape[0]
+        # pad_label=-1 never matches an argmax, so padding rows count 0
+        h_c, l_c, _ = chunk_shifted_sequence(
+            h[:, :-1], target[:, 1:], chunk=256, pad_label=-1
+        )
 
         def body(carry, inp):
             hc, lc = inp

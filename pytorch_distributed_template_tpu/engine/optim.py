@@ -21,8 +21,10 @@ the trainer; ``init_obj('optimizer', OPTIMIZERS)`` also works for direct use.
 from __future__ import annotations
 
 import math
+import re
 from typing import Callable, Optional
 
+import jax
 import jax.numpy as jnp
 import optax
 
@@ -51,10 +53,6 @@ def _decay_mask(exclude):
     """
     if not exclude:
         return None
-    import re
-
-    import jax
-
     pats = [re.compile(p) for p in exclude]
 
     def mask(params):

@@ -298,6 +298,21 @@ def _ring_attention_zigzag_local(q, k, v, *, axis_name: str, axis_size: int):
     return jnp.transpose(out, (0, 2, 1, 3)).astype(dtype)
 
 
+def _sp_partition(mesh: Mesh, q, seq_axis: str, data_axes, head_axis):
+    """Shared sequence-parallel partition plan: which mesh axes shard the
+    batch (dp) and heads (hp) for this array, and the resulting spec.
+    Probe shapes that don't divide an axis simply drop that axis (the
+    caller's shard_map then replicates that dimension)."""
+    dp = tuple(a for a in data_axes if a in mesh.axis_names)
+    dp_total = int(np.prod([mesh.shape[a] for a in dp])) if dp else 1
+    if dp and q.shape[0] % dp_total != 0:
+        dp = ()  # batch too small to shard (init probes); replicate it
+    hp = head_axis if head_axis in mesh.axis_names else None
+    if hp is not None and q.shape[2] % mesh.shape[hp] != 0:
+        hp = None
+    return dp, hp, P(dp if dp else None, seq_axis, hp, None)
+
+
 def _ulysses_local(q, k, v, *, axis_name: str, axis_size: int,
                    causal: bool, inner: str):
     """Per-shard Ulysses body (runs inside shard_map).
@@ -346,18 +361,11 @@ def ulysses_attention(q, k, v, mesh: Mesh, causal: bool = True,
     if q.shape[1] % s != 0:
         return multihead_attention(q, k, v, causal=causal)
 
-    dp = tuple(a for a in data_axes if a in mesh.axis_names)
-    dp_total = int(np.prod([mesh.shape[a] for a in dp])) if dp else 1
-    if dp and q.shape[0] % dp_total != 0:
-        dp = ()
-    hp = head_axis if head_axis in mesh.axis_names else None
-    if hp is not None and q.shape[2] % mesh.shape[hp] != 0:
-        hp = None
+    dp, hp, spec = _sp_partition(mesh, q, seq_axis, data_axes, head_axis)
     local_heads = q.shape[2] // (mesh.shape[hp] if hp else 1)
     if local_heads % s != 0:
         # not enough heads per device to split across the seq axis
         return multihead_attention(q, k, v, causal=causal)
-    spec = P(dp if dp else None, seq_axis, hp, None)
 
     fn = functools.partial(
         _ulysses_local, axis_name=seq_axis, axis_size=s, causal=causal,
@@ -450,14 +458,7 @@ def ring_attention(q, k, v, mesh: Mesh, causal: bool = True,
         # the dense path is always correct, just not sequence-parallel.
         return multihead_attention(q, k, v, causal=causal)
 
-    dp = tuple(a for a in data_axes if a in mesh.axis_names)
-    dp_total = int(np.prod([mesh.shape[a] for a in dp])) if dp else 1
-    if dp and q.shape[0] % dp_total != 0:
-        dp = ()  # batch too small to shard (init probes); replicate it
-    hp = head_axis if head_axis in mesh.axis_names else None
-    if hp is not None and q.shape[2] % mesh.shape[hp] != 0:
-        hp = None
-    spec = P(dp if dp else None, seq_axis, hp, None)
+    dp, hp, spec = _sp_partition(mesh, q, seq_axis, data_axes, head_axis)
 
     if block_impl not in ("einsum", "flash"):
         raise ValueError(
