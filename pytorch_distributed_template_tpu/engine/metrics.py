@@ -13,6 +13,7 @@ import jax
 import jax.numpy as jnp
 
 from ..config.registry import METRICS
+from .losses import chunk_shifted_sequence
 
 
 @METRICS.register("accuracy")
@@ -36,8 +37,6 @@ def lm_token_accuracy(output, target):
     [D,V])`` tuple, computing argmax per 256-token chunk so the full
     logits tensor stays unmaterialized here too."""
     if isinstance(output, tuple):
-        from .losses import chunk_shifted_sequence
-
         h, w = output
         tm1 = h.shape[1] - 1
         b = h.shape[0]

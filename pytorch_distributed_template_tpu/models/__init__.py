@@ -3,5 +3,6 @@ from .lenet import LeNet
 from .resnet import ResNet, ResNet18, ResNet34, ResNet50, ResNet101, ResNet152
 from .moe import MoeMlp, moe_lm, tiny_moe_lm
 from .pipelined import PipelinedLM, pipelined_lm, tiny_pipe_lm
+from .llama import LlamaLM, llama, tiny_llama
 from .transformer import TransformerLM, gpt2, tiny_lm
 from .vit import ViT, vit

@@ -1,4 +1,4 @@
-"""Import HuggingFace GPT-2 weights into the in-tree TransformerLM.
+"""Import HuggingFace GPT-2 / Llama weights into the in-tree LM families.
 
 Interop with the torch ecosystem the reference lives in: a user can take
 any HF ``GPT2LMHeadModel`` checkpoint (torch, CPU — never in the compute
@@ -91,6 +91,73 @@ def import_hf_gpt2(hf_state_dict, n_layer: int) -> dict:
                        "bias": g(p + "mlp.c_fc.bias")},
                 "down": {"kernel": g(p + "mlp.c_proj.weight"),
                          "bias": g(p + "mlp.c_proj.bias")},
+            },
+        }
+    return params
+
+
+def import_hf_llama(hf_state_dict, n_layer: int) -> dict:
+    """Convert an HF ``LlamaForCausalLM`` ``state_dict()`` to a params
+    pytree for :class:`..models.llama.LlamaLM`.
+
+    HF ``nn.Linear`` stores ``[out, in]`` — transposed relative to a flax
+    ``Dense`` kernel — so every projection transposes here (unlike GPT-2's
+    Conv1D). RoPE has no weights; the in-tree rotation matches HF's
+    rotate-half convention, verified by logit-parity tests
+    (tests/test_llama.py::test_hf_llama_import_logit_parity).
+
+    ==================================  ===================================
+    HF LlamaForCausalLM                 LlamaLM params
+    ==================================  ===================================
+    ``model.embed_tokens.weight``       ``embed_tokens/embedding``
+    ``model.layers.{i}.self_attn.*``    ``layers_{i}/self_attn/*`` (T)
+    ``model.layers.{i}.mlp.*``          ``layers_{i}/mlp/*`` (T)
+    ``model.layers.{i}.*_layernorm``    ``layers_{i}/*_layernorm/weight``
+    ``model.norm.weight``               ``norm/weight``
+    ``lm_head.weight``                  ``lm_head/kernel`` (T)
+    ==================================  ===================================
+    """
+    sd = {}
+    for k, v in hf_state_dict.items():
+        sd[k[len("model."):] if k.startswith("model.") else k] = v
+
+    def g(name, transpose=False):
+        if name not in sd:
+            raise KeyError(
+                f"HF state dict is missing '{name}' — not a Llama "
+                "checkpoint, or n_layer too large"
+            )
+        arr = _to_np(sd[name]).astype(np.float32)
+        return arr.T if transpose else arr
+
+    if f"layers.{n_layer}.input_layernorm.weight" in sd:
+        raise ValueError(
+            f"HF checkpoint has more than n_layer={n_layer} blocks "
+            f"(found 'layers.{n_layer}.'); converting a truncated model "
+            "would silently produce wrong logits"
+        )
+
+    params = {
+        "embed_tokens": {"embedding": g("embed_tokens.weight")},
+        "norm": {"weight": g("norm.weight")},
+        "lm_head": {"kernel": g("lm_head.weight", transpose=True)},
+    }
+    for i in range(n_layer):
+        p = f"layers.{i}."
+        params[f"layers_{i}"] = {
+            "input_layernorm": {
+                "weight": g(p + "input_layernorm.weight")},
+            "post_attention_layernorm": {
+                "weight": g(p + "post_attention_layernorm.weight")},
+            "self_attn": {
+                name: {"kernel": g(p + f"self_attn.{name}.weight",
+                                   transpose=True)}
+                for name in ("q_proj", "k_proj", "v_proj", "o_proj")
+            },
+            "mlp": {
+                name: {"kernel": g(p + f"mlp.{name}.weight",
+                                   transpose=True)}
+                for name in ("gate_proj", "up_proj", "down_proj")
             },
         }
     return params

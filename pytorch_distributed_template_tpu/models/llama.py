@@ -1,0 +1,358 @@
+"""Llama-family causal LM: RMSNorm + SwiGLU + RoPE + grouped-query attention.
+
+The reference's model zoo is one MNIST CNN (/root/reference/model/model.py);
+this is the modern-LM counterpart to models/transformer.py's GPT-2 family,
+TPU-native throughout:
+
+- **RMSNorm** in float32 accumulation (no mean subtraction — one fewer HBM
+  pass than LayerNorm);
+- **SwiGLU** MLP (gate/up/down) with column/row-parallel TP rules;
+- **RoPE** (rotary position embedding, HF rotate-half convention so
+  HuggingFace checkpoints import without transposition games) — positions
+  are threaded explicitly, so the zigzag ring layout works: the permuted
+  token order simply carries permuted position ids into the rotation;
+- **GQA** (``n_kv_head < n_head``): K/V are projected and KV-cached at the
+  reduced head count (the decode-cache memory win) and broadcast to the
+  query heads only at attention time;
+- attention dispatches through the same ladder as the GPT-2 family:
+  ``xla`` | ``flash`` | ``ring`` | ``ring_flash`` | ``ulysses`` |
+  ``ulysses_flash`` (ops/attention.py, ops/flash.py).
+"""
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from ..config.registry import MODELS
+from ..ops.attention import (
+    multihead_attention, ring_attention, ulysses_attention, zigzag_perm,
+)
+
+
+def _dense_init(stddev=0.02):
+    return nn.initializers.normal(stddev=stddev)
+
+
+class RMSNorm(nn.Module):
+    eps: float = 1e-6
+
+    @nn.compact
+    def __call__(self, x):
+        dtype = x.dtype
+        xf = x.astype(jnp.float32)
+        scale = self.param("weight", nn.initializers.ones, (x.shape[-1],),
+                           jnp.float32)
+        y = xf * jax.lax.rsqrt(jnp.mean(xf * xf, axis=-1, keepdims=True)
+                               + self.eps)
+        return (y * scale).astype(dtype)
+
+
+def rope_tables(positions, head_dim: int, base: float = 10000.0):
+    """cos/sin tables for HF-convention RoPE.
+
+    positions: int array [T]; returns (cos, sin) each [T, head_dim] with
+    the half-frequencies duplicated (``concat(freqs, freqs)``), matching
+    transformers' LlamaRotaryEmbedding so imported weights reproduce
+    logits exactly.
+    """
+    inv_freq = 1.0 / (
+        base ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim)
+    )
+    freqs = positions.astype(jnp.float32)[:, None] * inv_freq[None, :]
+    emb = jnp.concatenate([freqs, freqs], axis=-1)        # [T, head_dim]
+    return jnp.cos(emb), jnp.sin(emb)
+
+
+def apply_rope(x, cos, sin):
+    """Rotate [B, T, H, D] by per-position tables [T, D] (rotate-half)."""
+    d = x.shape[-1]
+    xf = x.astype(jnp.float32)
+    rot = jnp.concatenate([-xf[..., d // 2:], xf[..., : d // 2]], axis=-1)
+    out = xf * cos[None, :, None, :] + rot * sin[None, :, None, :]
+    return out.astype(x.dtype)
+
+
+class LlamaAttention(nn.Module):
+    d_model: int
+    n_head: int
+    n_kv_head: int
+    dtype: Any
+    attn_impl: str = "xla"
+    mesh: Optional[Any] = None
+    seq_layout: str = "natural"
+    rope_base: float = 10000.0
+
+    @nn.compact
+    def __call__(self, x, positions, train: bool, decode: bool = False,
+                 decode_index=None):
+        b, t, _ = x.shape
+        hd = self.d_model // self.n_head
+        groups = self.n_head // self.n_kv_head
+        dense = lambda feats, name: nn.Dense(
+            feats, use_bias=False, dtype=self.dtype,
+            kernel_init=_dense_init(), name=name,
+        )
+        q = dense(self.n_head * hd, "q_proj")(x).reshape(b, t, self.n_head, hd)
+        k = dense(self.n_kv_head * hd, "k_proj")(x).reshape(
+            b, t, self.n_kv_head, hd)
+        v = dense(self.n_kv_head * hd, "v_proj")(x).reshape(
+            b, t, self.n_kv_head, hd)
+
+        if decode:
+            ctx = self._cached_attention(q, k, v, decode_index, groups)
+        else:
+            cos, sin = rope_tables(positions, hd, self.rope_base)
+            q = apply_rope(q, cos, sin)
+            k = apply_rope(k, cos, sin)
+            # GQA: broadcast K/V to the query heads for the attention math
+            if groups > 1:
+                k = jnp.repeat(k, groups, axis=2)
+                v = jnp.repeat(v, groups, axis=2)
+            if self.attn_impl in ("ring", "ring_flash"):
+                if self.mesh is None:
+                    raise ValueError(
+                        f"attn_impl={self.attn_impl!r} requires a mesh")
+                ctx = ring_attention(
+                    q, k, v, self.mesh, causal=True,
+                    layout=("zigzag" if self.seq_layout == "zigzag"
+                            else "contig"),
+                    block_impl=("flash" if self.attn_impl == "ring_flash"
+                                else "einsum"),
+                )
+            elif self.attn_impl in ("ulysses", "ulysses_flash"):
+                if self.mesh is None:
+                    raise ValueError(
+                        f"attn_impl={self.attn_impl!r} requires a mesh")
+                ctx = ulysses_attention(
+                    q, k, v, self.mesh, causal=True,
+                    inner=("flash" if self.attn_impl == "ulysses_flash"
+                           else "xla"),
+                )
+            elif self.attn_impl == "flash":
+                from ..ops.flash import flash_attention
+
+                ctx = flash_attention(q, k, v, causal=True)
+            else:
+                ctx = multihead_attention(q, k, v, causal=True)
+        ctx = ctx.reshape(b, t, self.n_head * hd)
+        return dense(self.d_model, "o_proj")(ctx)
+
+    def _cached_attention(self, q, k, v, cur, groups: int):
+        """Incremental decode against a K/V cache stored at the KV-head
+        count (GQA memory win; same single-position-counter contract as
+        models/transformer.SelfAttention._cached_attention). RoPE rotates
+        the new rows by their absolute positions before insertion."""
+        b, t, hq, d = q.shape
+        is_init = self.has_variable("cache", "cached_key")
+        cached_k = self.variable("cache", "cached_key", jnp.zeros,
+                                 k.shape, k.dtype)
+        cached_v = self.variable("cache", "cached_value", jnp.zeros,
+                                 v.shape, v.dtype)
+        if not is_init:
+            return jnp.zeros((b, t, hq, d), q.dtype)
+        max_len = cached_k.value.shape[1]
+        if t > max_len:
+            raise ValueError(f"decode input {t} exceeds cache {max_len}")
+        pos = cur + jnp.arange(t)
+        cos, sin = rope_tables(pos, d, self.rope_base)
+        q = apply_rope(q, cos, sin)
+        k = apply_rope(k, cos, sin)
+        k_all = jax.lax.dynamic_update_slice(
+            cached_k.value, k.astype(cached_k.value.dtype), (0, cur, 0, 0)
+        )
+        v_all = jax.lax.dynamic_update_slice(
+            cached_v.value, v.astype(cached_v.value.dtype), (0, cur, 0, 0)
+        )
+        cached_k.value = k_all
+        cached_v.value = v_all
+        if groups > 1:
+            k_all = jnp.repeat(k_all, groups, axis=2)
+            v_all = jnp.repeat(v_all, groups, axis=2)
+        visible = jnp.arange(max_len)[None, :] <= pos[:, None]
+        return multihead_attention(
+            q, k_all, v_all, causal=False, mask=visible[None, None]
+        )
+
+
+class SwiGLU(nn.Module):
+    d_model: int
+    d_ff: int
+    dtype: Any
+
+    @nn.compact
+    def __call__(self, x):
+        dense = lambda feats, name: nn.Dense(
+            feats, use_bias=False, dtype=self.dtype,
+            kernel_init=_dense_init(), name=name,
+        )
+        gate = nn.silu(dense(self.d_ff, "gate_proj")(x))
+        up = dense(self.d_ff, "up_proj")(x)
+        return dense(self.d_model, "down_proj")(gate * up)
+
+
+class LlamaBlock(nn.Module):
+    d_model: int
+    n_head: int
+    n_kv_head: int
+    d_ff: int
+    dtype: Any
+    attn_impl: str
+    mesh: Optional[Any]
+    seq_layout: str
+    rope_base: float
+    rms_eps: float
+
+    @nn.compact
+    def __call__(self, x, positions, train: bool, example_mask=None,
+                 decode: bool = False, decode_index=None):
+        h = RMSNorm(self.rms_eps, name="input_layernorm")(x)
+        x = x + LlamaAttention(
+            self.d_model, self.n_head, self.n_kv_head, self.dtype,
+            self.attn_impl, self.mesh, self.seq_layout, self.rope_base,
+            name="self_attn",
+        )(h, positions, train, decode, decode_index)
+        h = RMSNorm(self.rms_eps, name="post_attention_layernorm")(x)
+        return x + SwiGLU(self.d_model, self.d_ff, self.dtype,
+                          name="mlp")(h)
+
+
+class LlamaLM(nn.Module):
+    """Decoder-only Llama-architecture causal LM."""
+    vocab_size: int = 32000
+    n_layer: int = 12
+    n_head: int = 12
+    n_kv_head: int = 0              # 0 -> n_head (no GQA)
+    d_model: int = 768
+    d_ff: int = 0                   # 0 -> ceil(8/3 * d_model) (Llama ratio)
+    max_len: int = 2048
+    dtype: Any = jnp.float32
+    attn_impl: str = "xla"
+    mesh: Optional[Any] = None
+    remat: bool = False
+    seq_layout: str = "natural"
+    rope_base: float = 10000.0
+    rms_eps: float = 1e-6
+
+    @nn.compact
+    def __call__(self, tokens, train: bool = False, example_mask=None,
+                 decode: bool = False):
+        b, t = tokens.shape
+        n_kv = self.n_kv_head or self.n_head
+        if self.n_head % n_kv != 0:
+            raise ValueError(
+                f"n_head {self.n_head} not divisible by n_kv_head {n_kv}")
+        # Llama's ~8/3 ratio, rounded up to a multiple of 16 so the MLP
+        # kernels tile the MXU and split over typical TP factors (real
+        # checkpoints pass their exact d_ff, e.g. 11008 for 7B)
+        d_ff = self.d_ff or -(-int(self.d_model * 8 / 3) // 16) * 16
+
+        # Zigzag layout (same transparency contract as TransformerLM): RoPE
+        # makes this trivial here — the permuted token order just carries
+        # permuted position ids into the rotation, no table reindex needed.
+        zperm = None
+        if (
+            self.seq_layout == "zigzag" and not decode
+            and self.attn_impl in ("ring", "ring_flash")
+            and self.mesh is not None
+            and "seq" in self.mesh.axis_names
+            and self.mesh.shape["seq"] > 1
+            and t % (2 * self.mesh.shape["seq"]) == 0
+        ):
+            zperm = zigzag_perm(t, self.mesh.shape["seq"])
+            tokens = tokens[:, zperm]
+
+        embed = nn.Embed(self.vocab_size, self.d_model,
+                         embedding_init=_dense_init(), name="embed_tokens",
+                         dtype=self.dtype)
+        x = embed(tokens)
+
+        start = None
+        if decode:
+            is_init = self.has_variable("cache", "pos_index")
+            pos_index = self.variable(
+                "cache", "pos_index", lambda: jnp.zeros((), jnp.int32)
+            )
+            start = pos_index.value if is_init else jnp.zeros((), jnp.int32)
+            if is_init:
+                pos_index.value = start + t
+            positions = None  # per-layer caches rotate by absolute position
+        elif zperm is not None:
+            positions = jnp.asarray(zperm, jnp.int32)
+        else:
+            positions = jnp.arange(t, dtype=jnp.int32)
+
+        block_cls = LlamaBlock
+        if self.remat:
+            # static_argnums count self as 0: train=3 / decode=5 are Python
+            # bools; positions (2) and example_mask (4) are traced
+            block_cls = nn.remat(
+                LlamaBlock, static_argnums=(3, 5),
+                policy=jax.checkpoint_policies.nothing_saveable,
+            )
+        for i in range(self.n_layer):
+            x = block_cls(
+                d_model=self.d_model, n_head=self.n_head, n_kv_head=n_kv,
+                d_ff=d_ff, dtype=self.dtype, attn_impl=self.attn_impl,
+                mesh=self.mesh, seq_layout=(
+                    "zigzag" if zperm is not None else "natural"
+                ),
+                rope_base=self.rope_base, rms_eps=self.rms_eps,
+                name=f"layers_{i}",
+            )(x, positions, train, example_mask, decode, start)
+        x = RMSNorm(self.rms_eps, name="norm")(x)
+        if zperm is not None:
+            x = x[:, np.argsort(zperm)]
+        logits = nn.Dense(self.vocab_size, use_bias=False, dtype=self.dtype,
+                          kernel_init=_dense_init(), name="lm_head")(x)
+        return logits.astype(jnp.float32)
+
+    def batch_template(self, batch_size: int = 1):
+        return jnp.zeros((batch_size, min(self.max_len, 16)), jnp.int32)
+
+    def partition_rules(self):
+        """Megatron TP over ``tensor``: column-parallel q/k/v/gate/up,
+        row-parallel o/down, vocab-sharded embedding + lm_head columns."""
+        return [
+            (r"embed_tokens/embedding", P("tensor", None)),
+            (r"self_attn/(q_proj|k_proj|v_proj)/kernel", P(None, "tensor")),
+            (r"self_attn/o_proj/kernel", P("tensor", None)),
+            (r"mlp/(gate_proj|up_proj)/kernel", P(None, "tensor")),
+            (r"mlp/down_proj/kernel", P("tensor", None)),
+            (r"lm_head/kernel", P(None, "tensor")),
+        ]
+
+
+@MODELS.register("Llama")
+def llama(vocab_size: int = 32000, n_layer: int = 12, n_head: int = 12,
+          n_kv_head: int = 0, d_model: int = 768, d_ff: int = 0,
+          max_len: int = 2048, bfloat16: bool = False,
+          attn_impl: str = "xla", remat: bool = False, mesh=None,
+          seq_layout: str = "natural", rope_base: float = 10000.0,
+          rms_eps: float = 1e-6):
+    return LlamaLM(
+        vocab_size=vocab_size, n_layer=n_layer, n_head=n_head,
+        n_kv_head=n_kv_head, d_model=d_model, d_ff=d_ff, max_len=max_len,
+        dtype=jnp.bfloat16 if bfloat16 else jnp.float32,
+        attn_impl=attn_impl, remat=remat, mesh=mesh, seq_layout=seq_layout,
+        rope_base=rope_base, rms_eps=rms_eps,
+    )
+
+
+@MODELS.register("TinyLlama")
+def tiny_llama(vocab_size: int = 256, n_layer: int = 2, n_head: int = 4,
+               n_kv_head: int = 2, d_model: int = 64, d_ff: int = 0,
+               max_len: int = 128, attn_impl: str = "xla",
+               remat: bool = False, mesh=None, bfloat16: bool = False,
+               seq_layout: str = "natural"):
+    """Small GQA config for tests and dry runs."""
+    return LlamaLM(
+        vocab_size=vocab_size, n_layer=n_layer, n_head=n_head,
+        n_kv_head=n_kv_head, d_model=d_model, d_ff=d_ff, max_len=max_len,
+        dtype=jnp.bfloat16 if bfloat16 else jnp.float32,
+        attn_impl=attn_impl, remat=remat, mesh=mesh, seq_layout=seq_layout,
+    )
