@@ -175,7 +175,36 @@ class BaseTrainer:
             trace = getattr(self, "trace", None)
             if trace is not None:
                 trace.close()  # flush a still-open profiler window
+            self._write_summary(log)
         return log
+
+    def _write_summary(self, log: dict) -> None:
+        """Machine-readable run outcome: ``summary.json`` in the run dir
+        (final epoch's metrics, the monitored best, where it stopped).
+        The reference's outcome lives only in info.log text; tooling around
+        experiments (sweeps, dashboards, the relaunch loop) wants JSON."""
+        if not dist.is_main_process() or not log:
+            return
+        try:
+            import json
+
+            summary = {
+                **{k: (v if isinstance(v, int) else
+                       float(v) if isinstance(v, float) else v)
+                   for k, v in log.items()},
+                "monitor": f"{self.mnt_mode} {self.mnt_metric}"
+                           if self.mnt_mode != "off" else "off",
+                "monitor_best": (
+                    float(self.mnt_best) if self.mnt_mode != "off" else None
+                ),
+                "run_dir": str(self.config.save_dir),
+            }
+            (self.config.save_dir / "summary.json").write_text(
+                json.dumps(summary, indent=2)
+            )
+        except Exception:  # never let bookkeeping kill a finished run
+            self.logger.warning("could not write summary.json",
+                                exc_info=True)
 
     def _save_checkpoint(self, epoch: int, save_best: bool = False) -> None:
         raise NotImplementedError

@@ -68,6 +68,18 @@ def test_training_learns(trained):
     assert log["val_top_k_acc"] >= log["val_accuracy"]
 
 
+def test_summary_json_written(trained):
+    """The run dir gets a machine-readable outcome file with the final
+    epoch's metrics and the monitored best."""
+    _, config, _, log = trained
+    summary = json.loads((config.save_dir / "summary.json").read_text())
+    assert summary["epoch"] == log["epoch"]
+    assert summary["monitor"] == "min val_loss"
+    assert abs(summary["monitor_best"] - summary["val_loss"]) < 1e-6 or \
+        summary["monitor_best"] <= summary["val_loss"]
+    assert summary["run_dir"] == str(config.save_dir)
+
+
 def test_checkpoints_written(trained):
     _, config, _, _ = trained
     d = config.save_dir
