@@ -21,11 +21,15 @@ import jax
 import jax.numpy as jnp
 
 
-def sample_logits(key, logits, temperature: float = 1.0, top_k: int = 0):
+def sample_logits(key, logits, temperature: float = 1.0, top_k: int = 0,
+                  top_p: float = 0.0):
     """Sample token ids from ``[B, V]`` logits (in-graph).
 
     ``temperature <= 0`` means greedy argmax. ``top_k > 0`` restricts
-    sampling to the k highest-probability tokens.
+    sampling to the k highest-probability tokens. ``top_p`` in (0, 1)
+    applies nucleus sampling: the smallest set of tokens whose cumulative
+    probability reaches ``top_p`` (the top token always survives).
+    ``top_k`` and ``top_p`` compose (k-filter first, as in HF).
     """
     if temperature <= 0:
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
@@ -33,11 +37,24 @@ def sample_logits(key, logits, temperature: float = 1.0, top_k: int = 0):
     if top_k > 0:
         kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
         logits = jnp.where(logits < kth, -jnp.inf, logits)
+    if 0.0 < top_p < 1.0:
+        # sort descending; keep tokens while the cumulative probability of
+        # STRICTLY-higher-ranked tokens is < top_p (so the boundary token
+        # that crosses the threshold is kept, like HF's implementation)
+        sorted_logits = jnp.sort(logits, axis=-1)[:, ::-1]
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1) - probs
+        keep = cum < top_p                       # [B, V] in sorted order
+        # threshold logit = smallest kept logit per row
+        thresh = jnp.min(
+            jnp.where(keep, sorted_logits, jnp.inf), axis=-1, keepdims=True
+        )
+        logits = jnp.where(logits < thresh, -jnp.inf, logits)
     return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
 
 
 def generate(model, params, prompt: jnp.ndarray, max_new_tokens: int,
-             temperature: float = 1.0, top_k: int = 0,
+             temperature: float = 1.0, top_k: int = 0, top_p: float = 0.0,
              rng: Optional[jax.Array] = None) -> jnp.ndarray:
     """Generate ``max_new_tokens`` continuations for each prompt row.
 
@@ -76,10 +93,11 @@ def generate(model, params, prompt: jnp.ndarray, max_new_tokens: int,
         lambda s: jnp.zeros(s.shape, s.dtype), shapes[1]["cache"]
     )
 
-    prefill, step = _decode_fns(model, float(temperature), int(top_k))
+    prefill, step = _decode_fns(model, float(temperature), int(top_k),
+                                float(top_p))
     last_logits, cache = prefill(params, cache, prompt)
     keys = jax.random.split(rng, max_new_tokens)
-    token = sample_logits(keys[0], last_logits, temperature, top_k)
+    token = sample_logits(keys[0], last_logits, temperature, top_k, top_p)
     # tokens stay on device through the loop (no per-step host sync);
     # async dispatch pipelines the steps
     out = [prompt, token[:, None]]
@@ -90,7 +108,7 @@ def generate(model, params, prompt: jnp.ndarray, max_new_tokens: int,
 
 
 @functools.lru_cache(maxsize=32)
-def _decode_fns(model, temperature: float, top_k: int):
+def _decode_fns(model, temperature: float, top_k: int, top_p: float = 0.0):
     """Compiled (prefill, step) pair per (model, sampling) combination.
 
     Module-level cache so repeated ``generate()`` calls with the same
@@ -113,7 +131,7 @@ def _decode_fns(model, temperature: float, top_k: int):
             {"params": params, "cache": cache}, token[:, None],
             train=False, decode=True, mutable=["cache"],
         )
-        nxt = sample_logits(key, logits[:, -1], temperature, top_k)
+        nxt = sample_logits(key, logits[:, -1], temperature, top_k, top_p)
         return nxt, vs["cache"]
 
     return prefill, step

@@ -176,3 +176,47 @@ def test_generate_with_tp_sharded_params():
     assert "tensor" in jax.tree_util.tree_leaves(tuple(spec))
     out = generate(model, sharded.params, tokens, max_new_tokens=8)
     np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+
+def test_sample_logits_top_p():
+    """Nucleus filtering: only the smallest prefix of sorted tokens whose
+    cumulative probability reaches p survives; the top token always does."""
+    logits = jnp.log(jnp.asarray([[0.5, 0.3, 0.15, 0.05]]))
+    # p=0.6: token0 (cum-before 0) and token1 (cum-before 0.5) survive;
+    # token2 (cum-before 0.8 >= 0.6) is cut
+    samples = set()
+    for i in range(64):
+        tok = sample_logits(jax.random.key(i), logits, temperature=1.0,
+                            top_p=0.6)
+        samples.add(int(tok[0]))
+    assert samples <= {0, 1}, samples
+    assert 0 in samples
+
+    # p tiny: degenerates to greedy (top token only)
+    for i in range(16):
+        tok = sample_logits(jax.random.key(i), logits, temperature=1.0,
+                            top_p=1e-6)
+        assert int(tok[0]) == 0
+
+    # composes with top_k (k-filter first)
+    for i in range(32):
+        tok = sample_logits(jax.random.key(i), logits, temperature=1.0,
+                            top_k=3, top_p=0.999)
+        assert int(tok[0]) in {0, 1, 2}
+
+
+def test_generate_top_p_runs():
+    tokens = jnp.asarray(
+        np.random.default_rng(0).integers(0, 256, (2, 8)), jnp.int32
+    )
+    m = MODELS.get("TinyLM")()
+    import optax
+
+    from pytorch_distributed_template_tpu.engine.state import (
+        create_train_state,
+    )
+
+    s = create_train_state(m, optax.sgd(0.1), tokens, seed=0)
+    out = generate(m, s.params, tokens, max_new_tokens=4,
+                   temperature=0.8, top_p=0.9, rng=jax.random.key(1))
+    assert out.shape == (2, 12)
