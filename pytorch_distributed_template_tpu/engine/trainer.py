@@ -69,6 +69,15 @@ class BaseTrainer:
         )
         self.epochs = cfg_trainer["epochs"]
         self.save_period = cfg_trainer.get("save_period", 1)
+        # mid-epoch safety net for long epochs (0 = off): every N batches
+        # the CURRENT epoch's periodic checkpoint is overwritten in place,
+        # so a crash loses at most N steps instead of the whole epoch.
+        # Deterministic host-side condition -> every host saves together
+        # (orbax saves are collective). Same partial-epoch resume semantics
+        # as preemption: resume continues at the next epoch.
+        self.save_interval_steps = int(
+            cfg_trainer.get("save_interval_steps", 0)
+        )
         self.monitor = cfg_trainer.get("monitor", "off")
 
         if self.monitor == "off":
@@ -449,6 +458,17 @@ class Trainer(BaseTrainer):
                         epoch, batch_idx + 1,
                     )
                 break
+
+            if (self.save_interval_steps
+                    and (batch_idx + 1) % self.save_interval_steps == 0):
+                # serialize with any in-flight async save of the same path
+                self.ckpt_manager.wait()
+                self._save_checkpoint(epoch, save_best=False)
+                if main:
+                    self.logger.info(
+                        "Interval checkpoint at epoch %d batch %d.",
+                        epoch, batch_idx + 1,
+                    )
 
         log = (
             finalize_metrics(jax.tree.map(float, accum)) if accum else {}

@@ -236,3 +236,41 @@ def test_resolve_loss_form_mismatch_errors():
         resolve_loss("smooth_cross_entropy")
     with pytest.raises(ValueError, match="string form"):
         resolve_loss({"type": "cross_entropy", "args": {}})
+
+
+def test_save_interval_steps(tmp_path):
+    """Mid-epoch interval checkpoints: with save_interval_steps=2 and 8
+    batches/epoch, the epoch's checkpoint exists (and is resumable) even
+    if the run dies before the epoch edge."""
+    import json as _json
+    from pathlib import Path
+
+    from pytorch_distributed_template_tpu.config import (
+        ConfigParser, LOADERS, LOSSES, METRICS, MODELS,
+    )
+    from pytorch_distributed_template_tpu.engine import Trainer
+    from pytorch_distributed_template_tpu.parallel import mesh_from_config
+
+    cfg = _json.loads(
+        (Path(__file__).parent.parent / "configs" / "mnist_debug.json")
+        .read_text()
+    )
+    cfg["trainer"]["save_dir"] = str(tmp_path)
+    cfg["trainer"]["epochs"] = 1
+    cfg["trainer"]["save_period"] = 10**6      # periodic saves off
+    cfg["trainer"]["save_interval_steps"] = 2  # ...but interval saves on
+    config = ConfigParser(cfg, run_id="interval", training=True)
+    model = config.init_obj("arch", MODELS)
+    trainer = Trainer(
+        model, LOSSES.get(config["loss"]),
+        [METRICS.get(m) for m in config["metrics"]], config=config,
+        train_loader=config.init_obj("train_loader", LOADERS),
+        valid_loader=None, mesh=mesh_from_config(config), seed=0,
+    )
+    trainer.train()
+    ck = config.save_dir / "checkpoint-epoch1"
+    assert ck.is_dir()  # written mid-epoch despite save_period never firing
+    meta = _json.loads(
+        (config.save_dir / "checkpoint-epoch1.meta.json").read_text()
+    )
+    assert meta["epoch"] == 1
