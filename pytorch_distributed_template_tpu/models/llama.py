@@ -86,6 +86,7 @@ class LlamaAttention(nn.Module):
     mesh: Optional[Any] = None
     seq_layout: str = "natural"
     rope_base: float = 10000.0
+    window: int = 0                 # sliding-window size; 0 = full causal
 
     @nn.compact
     def __call__(self, x, positions, train: bool, decode: bool = False,
@@ -117,6 +118,13 @@ class LlamaAttention(nn.Module):
                 if self.mesh is None:
                     raise ValueError(
                         f"attn_impl={self.attn_impl!r} requires a mesh")
+                if self.window > 0:
+                    raise ValueError(
+                        "window (sliding-window attention) is not "
+                        "supported with the ring impls; use "
+                        "'ulysses'/'flash'/'xla' (a window bounds memory "
+                        "by itself, so the ring is rarely needed with it)"
+                    )
                 ctx = ring_attention(
                     q, k, v, self.mesh, causal=True,
                     layout=("zigzag" if self.seq_layout == "zigzag"
@@ -132,13 +140,16 @@ class LlamaAttention(nn.Module):
                     q, k, v, self.mesh, causal=True,
                     inner=("flash" if self.attn_impl == "ulysses_flash"
                            else "xla"),
+                    window=self.window,
                 )
             elif self.attn_impl == "flash":
                 from ..ops.flash import flash_attention
 
-                ctx = flash_attention(q, k, v, causal=True)
+                ctx = flash_attention(q, k, v, causal=True,
+                                      window=self.window)
             else:
-                ctx = multihead_attention(q, k, v, causal=True)
+                ctx = multihead_attention(q, k, v, causal=True,
+                                          window=self.window)
         ctx = ctx.reshape(b, t, self.n_head * hd)
         return dense(self.d_model, "o_proj")(ctx)
 
@@ -173,7 +184,10 @@ class LlamaAttention(nn.Module):
         if groups > 1:
             k_all = jnp.repeat(k_all, groups, axis=2)
             v_all = jnp.repeat(v_all, groups, axis=2)
-        visible = jnp.arange(max_len)[None, :] <= pos[:, None]
+        k_pos = jnp.arange(max_len)[None, :]
+        visible = k_pos <= pos[:, None]
+        if self.window > 0:
+            visible = visible & (pos[:, None] - k_pos < self.window)
         return multihead_attention(
             q, k_all, v_all, causal=False, mask=visible[None, None]
         )
@@ -206,6 +220,7 @@ class LlamaBlock(nn.Module):
     seq_layout: str
     rope_base: float
     rms_eps: float
+    window: int = 0
 
     @nn.compact
     def __call__(self, x, positions, train: bool, example_mask=None,
@@ -214,7 +229,7 @@ class LlamaBlock(nn.Module):
         x = x + LlamaAttention(
             self.d_model, self.n_head, self.n_kv_head, self.dtype,
             self.attn_impl, self.mesh, self.seq_layout, self.rope_base,
-            name="self_attn",
+            window=self.window, name="self_attn",
         )(h, positions, train, decode, decode_index)
         h = RMSNorm(self.rms_eps, name="post_attention_layernorm")(x)
         return x + SwiGLU(self.d_model, self.d_ff, self.dtype,
@@ -237,6 +252,7 @@ class LlamaLM(nn.Module):
     seq_layout: str = "natural"
     rope_base: float = 10000.0
     rms_eps: float = 1e-6
+    window: int = 0                 # sliding-window attention; 0 = full
 
     @nn.compact
     def __call__(self, tokens, train: bool = False, example_mask=None,
@@ -302,6 +318,7 @@ class LlamaLM(nn.Module):
                     "zigzag" if zperm is not None else "natural"
                 ),
                 rope_base=self.rope_base, rms_eps=self.rms_eps,
+                window=self.window,
                 name=f"layers_{i}",
             )(x, positions, train, example_mask, decode, start)
         x = RMSNorm(self.rms_eps, name="norm")(x)
@@ -333,13 +350,13 @@ def llama(vocab_size: int = 32000, n_layer: int = 12, n_head: int = 12,
           max_len: int = 2048, bfloat16: bool = False,
           attn_impl: str = "xla", remat: bool = False, mesh=None,
           seq_layout: str = "natural", rope_base: float = 10000.0,
-          rms_eps: float = 1e-6):
+          rms_eps: float = 1e-6, window: int = 0):
     return LlamaLM(
         vocab_size=vocab_size, n_layer=n_layer, n_head=n_head,
         n_kv_head=n_kv_head, d_model=d_model, d_ff=d_ff, max_len=max_len,
         dtype=jnp.bfloat16 if bfloat16 else jnp.float32,
         attn_impl=attn_impl, remat=remat, mesh=mesh, seq_layout=seq_layout,
-        rope_base=rope_base, rms_eps=rms_eps,
+        rope_base=rope_base, rms_eps=rms_eps, window=window,
     )
 
 
@@ -348,11 +365,12 @@ def tiny_llama(vocab_size: int = 256, n_layer: int = 2, n_head: int = 4,
                n_kv_head: int = 2, d_model: int = 64, d_ff: int = 0,
                max_len: int = 128, attn_impl: str = "xla",
                remat: bool = False, mesh=None, bfloat16: bool = False,
-               seq_layout: str = "natural"):
+               seq_layout: str = "natural", window: int = 0):
     """Small GQA config for tests and dry runs."""
     return LlamaLM(
         vocab_size=vocab_size, n_layer=n_layer, n_head=n_head,
         n_kv_head=n_kv_head, d_model=d_model, d_ff=d_ff, max_len=max_len,
         dtype=jnp.bfloat16 if bfloat16 else jnp.float32,
         attn_impl=attn_impl, remat=remat, mesh=mesh, seq_layout=seq_layout,
+        window=window,
     )

@@ -47,16 +47,26 @@ NEG_INF = -1e30
 
 
 def multihead_attention(q, k, v, causal: bool = True,
-                        mask: Optional[jax.Array] = None):
-    """Reference XLA attention. q,k,v: [B, T, H, D] -> [B, T, H, D]."""
+                        mask: Optional[jax.Array] = None,
+                        window: int = 0):
+    """Reference XLA attention. q,k,v: [B, T, H, D] -> [B, T, H, D].
+
+    ``window > 0``: sliding-window (Mistral-style) banding — query t sees
+    keys in ``(t - window, t]`` (combined with ``causal``).
+    """
     dtype = q.dtype
     depth = q.shape[-1]
     q = q.astype(jnp.float32) * (depth ** -0.5)
     scores = jnp.einsum("bqhd,bkhd->bhqk", q, k.astype(jnp.float32))
+    tq, tk = scores.shape[-2], scores.shape[-1]
     if causal:
-        tq, tk = scores.shape[-2], scores.shape[-1]
         cm = jnp.tril(jnp.ones((tq, tk), bool))
         scores = jnp.where(cm[None, None], scores, NEG_INF)
+    if window > 0:
+        q_pos = jnp.arange(tq)[:, None]
+        k_pos = jnp.arange(tk)[None, :]
+        band = q_pos - k_pos < window
+        scores = jnp.where(band[None, None], scores, NEG_INF)
     if mask is not None:
         scores = jnp.where(mask, scores, NEG_INF)
     probs = jax.nn.softmax(scores, axis=-1)
@@ -314,7 +324,7 @@ def _sp_partition(mesh: Mesh, q, seq_axis: str, data_axes, head_axis):
 
 
 def _ulysses_local(q, k, v, *, axis_name: str, axis_size: int,
-                   causal: bool, inner: str):
+                   causal: bool, inner: str, window: int = 0):
     """Per-shard Ulysses body (runs inside shard_map).
 
     q,k,v: local [B, T/s, H, D] sequence slices. One tiled all-to-all
@@ -333,15 +343,16 @@ def _ulysses_local(q, k, v, *, axis_name: str, axis_size: int,
     if inner == "flash":
         from .flash import flash_attention
 
-        out = flash_attention(q, k, v, causal=causal)
+        out = flash_attention(q, k, v, causal=causal, window=window)
     else:
-        out = multihead_attention(q, k, v, causal=causal)
+        out = multihead_attention(q, k, v, causal=causal, window=window)
     return a2a(out, split_axis=1, concat_axis=2)   # [B, T/s, H, D]
 
 
 def ulysses_attention(q, k, v, mesh: Mesh, causal: bool = True,
                       seq_axis: str = "seq", data_axes=("data", "fsdp"),
-                      head_axis: str = "tensor", inner: str = "xla"):
+                      head_axis: str = "tensor", inner: str = "xla",
+                      window: int = 0):
     """All-to-all (DeepSpeed-Ulysses-style) sequence parallelism.
 
     The alternative SP strategy to ``ring_attention``: instead of rotating
@@ -356,20 +367,20 @@ def ulysses_attention(q, k, v, mesh: Mesh, causal: bool = True,
     ``inner`` selects the local kernel: "xla" einsum or "flash" (Pallas).
     """
     if seq_axis not in mesh.axis_names or mesh.shape[seq_axis] == 1:
-        return multihead_attention(q, k, v, causal=causal)
+        return multihead_attention(q, k, v, causal=causal, window=window)
     s = mesh.shape[seq_axis]
     if q.shape[1] % s != 0:
-        return multihead_attention(q, k, v, causal=causal)
+        return multihead_attention(q, k, v, causal=causal, window=window)
 
     dp, hp, spec = _sp_partition(mesh, q, seq_axis, data_axes, head_axis)
     local_heads = q.shape[2] // (mesh.shape[hp] if hp else 1)
     if local_heads % s != 0:
         # not enough heads per device to split across the seq axis
-        return multihead_attention(q, k, v, causal=causal)
+        return multihead_attention(q, k, v, causal=causal, window=window)
 
     fn = functools.partial(
         _ulysses_local, axis_name=seq_axis, axis_size=s, causal=causal,
-        inner=inner,
+        inner=inner, window=window,
     )
     return shard_map(
         fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,

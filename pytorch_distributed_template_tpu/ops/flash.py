@@ -42,9 +42,12 @@ DEFAULT_BLOCK_Q = 512
 DEFAULT_BLOCK_K = 512
 
 
-def _tile_mask(i, j, block_q, block_k, causal, t_valid, t):
-    """NEG_INF mask for score tile (q block i, kv block j); None if no-op."""
-    need = causal or t_valid < t
+def _tile_mask(i, j, block_q, block_k, causal, t_valid, t, window=0):
+    """NEG_INF mask for score tile (q block i, kv block j); None if no-op.
+
+    ``window > 0`` adds the sliding-window band ``q_pos - k_pos < window``
+    (Mistral-style, combined with ``causal``)."""
+    need = causal or t_valid < t or window > 0
     if not need:
         return None
     q_pos = i * block_q + lax.broadcasted_iota(
@@ -56,6 +59,8 @@ def _tile_mask(i, j, block_q, block_k, causal, t_valid, t):
     ok = jnp.full((block_q, block_k), True)
     if causal:
         ok = q_pos >= k_pos
+    if window > 0:
+        ok = ok & (q_pos - k_pos < window)
     if t_valid < t:  # keys past t_valid are padding
         ok = ok & (k_pos < t_valid)
     return ok
@@ -63,7 +68,7 @@ def _tile_mask(i, j, block_q, block_k, causal, t_valid, t):
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
                 *, scale: float, causal: bool, t_valid: int, t: int,
-                num_kv: int):
+                num_kv: int, window: int = 0):
     # grid (BH, num_q, num_kv), kv innermost. q_ref/o_ref: [1, BQ, D];
     # k_ref/v_ref: [1, BK, D] (streamed); lse_ref: [1, BQ, 1] (the trailing
     # unit lane axis keeps the block shape legal under Mosaic's
@@ -88,7 +93,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
             q, k_blk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )                                              # [BQ, BK]
-        ok = _tile_mask(i, j, block_q, block_k, causal, t_valid, t)
+        ok = _tile_mask(i, j, block_q, block_k, causal, t_valid, t,
+                        window)
         if ok is not None:
             s = jnp.where(ok, s, NEG_INF)
         m = m_scr[...]
@@ -116,7 +122,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
 
 
 def _flash_fwd_3d(q, k, v, *, causal: bool, block_q: int, block_k: int,
-                  t_valid: int, interpret: bool):
+                  t_valid: int, interpret: bool, window: int = 0):
     """q,k,v: [BH, T, D] (T block-padded) -> (out, lse [BH, T])."""
     bh, t, d = q.shape
     scale = d ** -0.5
@@ -126,7 +132,7 @@ def _flash_fwd_3d(q, k, v, *, causal: bool, block_q: int, block_k: int,
     num_kv = t // block_k
     kernel = functools.partial(
         _fwd_kernel, scale=scale, causal=causal, t_valid=t_valid, t=t,
-        num_kv=num_kv,
+        num_kv=num_kv, window=window,
     )
     out, lse = pl.pallas_call(
         kernel,
@@ -154,7 +160,7 @@ def _flash_fwd_3d(q, k, v, *, causal: bool, block_q: int, block_k: int,
     return out, lse[..., 0]
 
 
-def _bwd_3d(causal, block_k, t_valid, residuals, g):
+def _bwd_3d(causal, block_k, t_valid, residuals, g, window: int = 0):
     """Blockwise flash backward over KV blocks (plain JAX, O(T*BK) memory)."""
     q, k, v, out, lse = residuals
     bh, t, d = q.shape
@@ -176,6 +182,9 @@ def _bwd_3d(causal, block_k, t_valid, residuals, g):
         k_pos = j * block_k + jnp.arange(block_k)
         if causal:
             s = jnp.where((q_pos[:, None] >= k_pos[None, :])[None], s, NEG_INF)
+        if window > 0:
+            band = q_pos[:, None] - k_pos[None, :] < window
+            s = jnp.where(band[None], s, NEG_INF)
         if t_valid < t:
             s = jnp.where((k_pos < t_valid)[None, None], s, NEG_INF)
         p = jnp.exp(s - lse[..., None])               # [BH, T, BK]
@@ -200,7 +209,8 @@ def _bwd_3d(causal, block_k, t_valid, residuals, g):
 
 def _bwd_dkv_kernel(q_ref, g_ref, lse_ref, delta_ref, k_ref, v_ref,
                     dk_ref, dv_ref, dk_scr, dv_scr, *, scale: float,
-                    causal: bool, t_valid: int, t: int, num_q: int):
+                    causal: bool, t_valid: int, t: int, num_q: int,
+                    window: int = 0):
     # grid (BH, num_kv, num_q), q innermost (streamed). k/v/dk/dv refs:
     # [1, BK, D] (this program's KV block); q_ref/g_ref: [1, BQ, D];
     # lse_ref/delta_ref: [1, BQ, 1]. Scratch dk/dv: [BK, D] f32.
@@ -225,7 +235,8 @@ def _bwd_dkv_kernel(q_ref, g_ref, lse_ref, delta_ref, k_ref, v_ref,
             q_blk, k_blk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         ) * scale                                      # [BQ, BK]
-        ok = _tile_mask(i, j, block_q, block_k, causal, t_valid, t)
+        ok = _tile_mask(i, j, block_q, block_k, causal, t_valid, t,
+                        window)
         if ok is not None:
             s = jnp.where(ok, s, NEG_INF)
         p = jnp.exp(s - lse)                           # [BQ, BK]
@@ -267,7 +278,7 @@ def _bwd_dkv_kernel(q_ref, g_ref, lse_ref, delta_ref, k_ref, v_ref,
 
 def _bwd_dq_kernel(q_ref, g_ref, lse_ref, delta_ref, k_ref, v_ref, dq_ref,
                    dq_scr, *, scale: float, causal: bool, t_valid: int,
-                   t: int, num_kv: int):
+                   t: int, num_kv: int, window: int = 0):
     # grid (BH, num_q, num_kv), kv innermost (streamed). q/g/dq refs:
     # [1, BQ, D]; k_ref/v_ref: [1, BK, D]; lse_ref/delta_ref: [1, BQ, 1].
     # Scratch dq: [BQ, D] f32.
@@ -291,7 +302,8 @@ def _bwd_dq_kernel(q_ref, g_ref, lse_ref, delta_ref, k_ref, v_ref, dq_ref,
             q_blk, k_blk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         ) * scale
-        ok = _tile_mask(i, j, block_q, block_k, causal, t_valid, t)
+        ok = _tile_mask(i, j, block_q, block_k, causal, t_valid, t,
+                        window)
         if ok is not None:
             s = jnp.where(ok, s, NEG_INF)
         p = jnp.exp(s - lse)
@@ -316,7 +328,7 @@ def _bwd_dq_kernel(q_ref, g_ref, lse_ref, delta_ref, k_ref, v_ref, dq_ref,
 
 
 def _bwd_pallas_3d(causal, block_q, block_k, t_valid, interpret,
-                   residuals, g, g_lse=None):
+                   residuals, g, g_lse=None, window: int = 0):
     """Pallas two-kernel flash backward. Same signature/result as _bwd_3d.
 
     ``g_lse`` ([BH, T] or None): cotangent of the logsumexp output when the
@@ -345,7 +357,7 @@ def _bwd_pallas_3d(causal, block_q, block_k, t_valid, interpret,
     dk, dv = pl.pallas_call(
         functools.partial(
             _bwd_dkv_kernel, scale=scale, causal=causal, t_valid=t_valid,
-            t=t, num_q=num_q,
+            t=t, num_q=num_q, window=window,
         ),
         grid=(bh, num_kv, num_q),
         in_specs=[
@@ -374,7 +386,7 @@ def _bwd_pallas_3d(causal, block_q, block_k, t_valid, interpret,
     dq = pl.pallas_call(
         functools.partial(
             _bwd_dq_kernel, scale=scale, causal=causal, t_valid=t_valid,
-            t=t, num_kv=num_kv,
+            t=t, num_kv=num_kv, window=window,
         ),
         grid=(bh, num_q, num_kv),
         in_specs=[
@@ -393,24 +405,27 @@ def _bwd_pallas_3d(causal, block_q, block_k, t_valid, interpret,
     return dq, dk, dv
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash_3d(q, k, v, causal, block_q, block_k, t_valid, interpret):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _flash_3d(q, k, v, causal, block_q, block_k, t_valid, interpret,
+              window=0):
     out, _ = _flash_fwd_3d(q, k, v, causal=causal, block_q=block_q,
                            block_k=block_k, t_valid=t_valid,
-                           interpret=interpret)
+                           interpret=interpret, window=window)
     return out
 
 
-def _flash_3d_fwd(q, k, v, causal, block_q, block_k, t_valid, interpret):
+def _flash_3d_fwd(q, k, v, causal, block_q, block_k, t_valid, interpret,
+                  window=0):
     out, lse = _flash_fwd_3d(q, k, v, causal=causal, block_q=block_q,
                              block_k=block_k, t_valid=t_valid,
-                             interpret=interpret)
+                             interpret=interpret, window=window)
     return out, (q, k, v, out, lse)
 
 
-def _flash_3d_bwd(causal, block_q, block_k, t_valid, interpret, residuals, g):
+def _flash_3d_bwd(causal, block_q, block_k, t_valid, interpret, window,
+                  residuals, g):
     return _bwd_pallas_3d(causal, block_q, block_k, t_valid, interpret,
-                          residuals, g)
+                          residuals, g, window=window)
 
 
 _flash_3d.defvjp(_flash_3d_fwd, _flash_3d_bwd)
@@ -453,7 +468,8 @@ def _on_tpu() -> bool:
 def flash_attention(q, k, v, causal: bool = True,
                     block_q: int = DEFAULT_BLOCK_Q,
                     block_k: int = DEFAULT_BLOCK_K,
-                    interpret: bool | None = None):
+                    interpret: bool | None = None,
+                    window: int = 0):
     """Fused attention. q,k,v: [B, T, H, D] -> [B, T, H, D].
 
     ``interpret=None`` auto-selects: compiled on TPU, interpreter elsewhere
@@ -475,7 +491,8 @@ def flash_attention(q, k, v, causal: bool = True,
     if t_pad != t:
         pad = ((0, 0), (0, t_pad - t), (0, 0))
         q, k, v = jnp.pad(q, pad), jnp.pad(k, pad), jnp.pad(v, pad)
-    out = _flash_3d(q, k, v, causal, block_q, block_k, t, interpret)
+    out = _flash_3d(q, k, v, causal, block_q, block_k, t, interpret,
+                    window)
     out = out[:, :t]
     return jnp.moveaxis(out.reshape(b, h, t, d), 1, 2)
 
