@@ -349,7 +349,8 @@ class Trainer(BaseTrainer):
         # steps_per_sec scalar) ---------------------------------------------
         prof_cfg = config["trainer"].get("profiler", {}) or {}
         self.profile_enabled = bool(prof_cfg.get("enabled", False))
-        self.throughput = ThroughputMeter()
+        self.throughput = ThroughputMeter()          # log_step windows (TB)
+        self.epoch_meter = ThroughputMeter()         # whole-epoch averages
         self.trace = TraceCapture(
             config.log_dir,
             start_step=prof_cfg.get("trace_start_step", 10),
@@ -382,6 +383,8 @@ class Trainer(BaseTrainer):
     def _train_epoch(self, epoch: int) -> dict:
         self.train_metrics.reset()
         self.throughput.reset()  # exclude validation/checkpoint wall time
+        self.epoch_meter.reset()  # (epoch 1 includes compile unless the
+        # profiler's post-compile reset fires; later epochs are clean)
         accum = None
         batches = (b for _, b in self._batches(epoch))
         depth = int(self.config["trainer"].get("host_prefetch", 2))
@@ -410,6 +413,7 @@ class Trainer(BaseTrainer):
             self.trace.after_step(step, sync=m)
             self.watchdog.beat()
             self.throughput.update(self.train_loader.batch_size)
+            self.epoch_meter.update(self.train_loader.batch_size)
 
             if (self.profile_enabled and batch_idx == 0
                     and not self._flops_measured):
@@ -422,6 +426,7 @@ class Trainer(BaseTrainer):
                 )
                 jax.block_until_ready(m)
                 self.throughput.reset()  # exclude compilation from rates
+                self.epoch_meter.reset()
 
             accum = m if accum is None else jax.tree.map(jnp.add, accum, m)
 
@@ -473,6 +478,16 @@ class Trainer(BaseTrainer):
         log = (
             finalize_metrics(jax.tree.map(float, accum)) if accum else {}
         )
+        # whole-epoch throughput (the finalize_metrics float() above synced
+        # the device, so the window is honest); + MFU when the profiler
+        # measured the compiled step's FLOPs
+        if log:
+            rate = self.epoch_meter.rate()
+            log["examples_per_sec"] = round(rate["examples_per_sec"], 1)
+            util = mfu(self._flops_per_step, rate["steps_per_sec"],
+                       peak_per_device=self._peak_flops)
+            if util is not None:
+                log["mfu"] = round(util, 4)
         # Keep the tracker's smoothed loss for TB parity, but report the
         # exact global epoch averages. A preempted epoch skips validation —
         # the SIGTERM notice window is for checkpointing, not eval.
