@@ -66,20 +66,64 @@ def _tile_mask(i, j, block_q, block_k, causal, t_valid, t, window=0):
     return ok
 
 
+def _band_start(i, block_q, block_k, window):
+    """First KV tile that can intersect q block ``i``'s sliding band.
+    Floor division of a possibly-negative numerator rounds toward -inf,
+    which the max-with-0 absorbs."""
+    return jnp.maximum(0, (i * block_q - (window - 1)) // block_k)
+
+
+def _num_band_tiles(span_block, tile_block, window):
+    """Tiles of size ``tile_block`` intersecting a band that spans
+    ``span_block + window - 1`` positions, +1 slack for tile misalignment
+    (static). Used for the KV band per q block (span=block_q,
+    tile=block_k) and, with the roles swapped, the q band per KV block in
+    the dkv backward."""
+    return (span_block + window - 1 + tile_block - 1) // tile_block + 1
+
+
+def _q_band_start(j, block_q, block_k):
+    """First q block whose rows can (causally) see KV tile ``j`` — the
+    diagonal block. Shared by the dkv kernel and its index map so data
+    placement and predication cannot desync."""
+    return (j * block_k) // block_q
+
+
+def _banded_index(start_fn, num_blocks):
+    """Index map for a banded grid axis: block = clip(start(outer) + off).
+    The kernel predicates with the UNclipped index; the clip only keeps
+    the prefetch legal at the edges."""
+
+    def index(b, outer, off):
+        return b, jnp.clip(start_fn(outer) + off, 0, num_blocks - 1), 0
+
+    return index
+
+
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
                 *, scale: float, causal: bool, t_valid: int, t: int,
-                num_kv: int, window: int = 0):
-    # grid (BH, num_q, num_kv), kv innermost. q_ref/o_ref: [1, BQ, D];
+                num_kv: int, window: int = 0, banded: bool = False,
+                nb: int = 0):
+    # grid (BH, num_q, num_kv) — or (BH, num_q, nb) when ``banded`` (causal
+    # sliding window: only the ~window-wide KV tile band per q block is in
+    # the grid at all, so both the compute AND the HBM->VMEM K/V streaming
+    # are O(T * window)). kv innermost. q_ref/o_ref: [1, BQ, D];
     # k_ref/v_ref: [1, BK, D] (streamed); lse_ref: [1, BQ, 1] (the trailing
     # unit lane axis keeps the block shape legal under Mosaic's
     # (8, 128)-or-equal tiling rule). Scratch m/l: [BQ, 1] f32, acc:
     # [BQ, D] f32 — the online-softmax state carried across the kv dim.
     i = pl.program_id(1)
-    j = pl.program_id(2)
+    jb = pl.program_id(2)
     block_q = q_ref.shape[1]
     block_k = k_ref.shape[1]
+    if banded:
+        j = _band_start(i, block_q, block_k, window) + jb
+        last = nb - 1
+    else:
+        j = jb
+        last = num_kv - 1
 
-    @pl.when(j == 0)
+    @pl.when(jb == 0)
     def _init():
         m_scr[...] = jnp.full_like(m_scr, NEG_INF)
         l_scr[...] = jnp.zeros_like(l_scr)
@@ -108,13 +152,22 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
         )
         m_scr[...] = m_new
 
+    pred = None
     if causal:
         # tiles strictly beyond the diagonal are predicated away entirely
-        pl.when(j * block_k < (i + 1) * block_q)(_compute)
+        pred = j * block_k < (i + 1) * block_q
+    if window > 0:
+        # tiles entirely below the band contribute nothing
+        in_band = (j + 1) * block_k > i * block_q - window + 1
+        pred = in_band if pred is None else (pred & in_band)
+    if banded:
+        pred = pred & (j <= num_kv - 1)  # nb overshoot near the edges
+    if pred is not None:
+        pl.when(pred)(_compute)
     else:
         _compute()
 
-    @pl.when(j == num_kv - 1)
+    @pl.when(jb == last)
     def _finalize():
         l_safe = jnp.maximum(l_scr[...], 1e-30)
         o_ref[0] = (acc_scr[...] / l_safe).astype(o_ref.dtype)
@@ -130,17 +183,28 @@ def _flash_fwd_3d(q, k, v, *, causal: bool, block_q: int, block_k: int,
     block_k = min(block_k, t)
     assert t % block_q == 0 and t % block_k == 0, (t, block_q, block_k)
     num_kv = t // block_k
+    banded = causal and 0 < window < t
+    nb = min(_num_band_tiles(block_q, block_k, window), num_kv)
+    if banded and nb >= num_kv:
+        banded = False  # band covers everything: plain grid is simpler
     kernel = functools.partial(
         _fwd_kernel, scale=scale, causal=causal, t_valid=t_valid, t=t,
-        num_kv=num_kv, window=window,
+        num_kv=num_kv, window=window, banded=banded, nb=nb,
     )
+    if banded:
+        kv_grid = nb
+        kv_index = _banded_index(
+            lambda i: _band_start(i, block_q, block_k, window), num_kv
+        )
+    else:
+        kv_grid, kv_index = num_kv, (lambda b, i, j: (b, j, 0))
     out, lse = pl.pallas_call(
         kernel,
-        grid=(bh, t // block_q, num_kv),
+        grid=(bh, t // block_q, kv_grid),
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), kv_index),
+            pl.BlockSpec((1, block_k, d), kv_index),
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
@@ -210,16 +274,24 @@ def _bwd_3d(causal, block_k, t_valid, residuals, g, window: int = 0):
 def _bwd_dkv_kernel(q_ref, g_ref, lse_ref, delta_ref, k_ref, v_ref,
                     dk_ref, dv_ref, dk_scr, dv_scr, *, scale: float,
                     causal: bool, t_valid: int, t: int, num_q: int,
-                    window: int = 0):
-    # grid (BH, num_kv, num_q), q innermost (streamed). k/v/dk/dv refs:
+                    window: int = 0, banded: bool = False, nqb: int = 0):
+    # grid (BH, num_kv, num_q) — or (BH, num_kv, nqb) when ``banded``
+    # (sliding window: only q blocks within ``window`` above this KV block
+    # are visited). q innermost (streamed). k/v/dk/dv refs:
     # [1, BK, D] (this program's KV block); q_ref/g_ref: [1, BQ, D];
     # lse_ref/delta_ref: [1, BQ, 1]. Scratch dk/dv: [BK, D] f32.
     j = pl.program_id(1)
-    i = pl.program_id(2)
+    ib = pl.program_id(2)
     block_q = q_ref.shape[1]
     block_k = k_ref.shape[1]
+    if banded:
+        i = _q_band_start(j, block_q, block_k) + ib
+        last = nqb - 1
+    else:
+        i = ib
+        last = num_q - 1
 
-    @pl.when(i == 0)
+    @pl.when(ib == 0)
     def _init():
         dk_scr[...] = jnp.zeros_like(dk_scr)
         dv_scr[...] = jnp.zeros_like(dv_scr)
@@ -254,13 +326,21 @@ def _bwd_dkv_kernel(q_ref, g_ref, lse_ref, delta_ref, k_ref, v_ref,
             preferred_element_type=jnp.float32,
         )
 
+    pred = None
     if causal:
         # q blocks strictly above this KV block's first row see none of it
-        pl.when((i + 1) * block_q > j * block_k)(_compute)
+        pred = (i + 1) * block_q > j * block_k
+    if window > 0:
+        in_band = (j + 1) * block_k > i * block_q - window + 1
+        pred = in_band if pred is None else (pred & in_band)
+    if banded:
+        pred = pred & (i <= num_q - 1)
+    if pred is not None:
+        pl.when(pred)(_compute)
     else:
         _compute()
 
-    @pl.when(i == num_q - 1)
+    @pl.when(ib == last)
     def _finalize():
         dk = dk_scr[...]
         dv = dv_scr[...]
@@ -278,16 +358,24 @@ def _bwd_dkv_kernel(q_ref, g_ref, lse_ref, delta_ref, k_ref, v_ref,
 
 def _bwd_dq_kernel(q_ref, g_ref, lse_ref, delta_ref, k_ref, v_ref, dq_ref,
                    dq_scr, *, scale: float, causal: bool, t_valid: int,
-                   t: int, num_kv: int, window: int = 0):
-    # grid (BH, num_q, num_kv), kv innermost (streamed). q/g/dq refs:
-    # [1, BQ, D]; k_ref/v_ref: [1, BK, D]; lse_ref/delta_ref: [1, BQ, 1].
-    # Scratch dq: [BQ, D] f32.
+                   t: int, num_kv: int, window: int = 0,
+                   banded: bool = False, nb: int = 0):
+    # grid (BH, num_q, num_kv) — or (BH, num_q, nb) when ``banded``
+    # (sliding window: only the band's KV tiles are visited). kv innermost
+    # (streamed). q/g/dq refs: [1, BQ, D]; k_ref/v_ref: [1, BK, D];
+    # lse_ref/delta_ref: [1, BQ, 1]. Scratch dq: [BQ, D] f32.
     i = pl.program_id(1)
-    j = pl.program_id(2)
+    jb = pl.program_id(2)
     block_q = q_ref.shape[1]
     block_k = k_ref.shape[1]
+    if banded:
+        j = _band_start(i, block_q, block_k, window) + jb
+        last = nb - 1
+    else:
+        j = jb
+        last = num_kv - 1
 
-    @pl.when(j == 0)
+    @pl.when(jb == 0)
     def _init():
         dq_scr[...] = jnp.zeros_like(dq_scr)
 
@@ -317,12 +405,20 @@ def _bwd_dq_kernel(q_ref, g_ref, lse_ref, delta_ref, k_ref, v_ref, dq_ref,
             preferred_element_type=jnp.float32,
         )
 
+    pred = None
     if causal:
-        pl.when(j * block_k < (i + 1) * block_q)(_compute)
+        pred = j * block_k < (i + 1) * block_q
+    if window > 0:
+        in_band = (j + 1) * block_k > i * block_q - window + 1
+        pred = in_band if pred is None else (pred & in_band)
+    if banded:
+        pred = pred & (j <= num_kv - 1)
+    if pred is not None:
+        pl.when(pred)(_compute)
     else:
         _compute()
 
-    @pl.when(j == num_kv - 1)
+    @pl.when(jb == last)
     def _finalize():
         dq_ref[0] = dq_scr[...].astype(dq_ref.dtype)
 
@@ -354,17 +450,31 @@ def _bwd_pallas_3d(causal, block_q, block_k, t_valid, interpret,
         delta = delta - g_lse.astype(jnp.float32)[..., None]
     lse = lse.astype(jnp.float32)[..., None]
 
+    banded = causal and 0 < window < t
+    nqb = min(_num_band_tiles(block_k, block_q, window), num_q)
+    nb = min(_num_band_tiles(block_q, block_k, window), num_kv)
+    if banded and (nqb >= num_q and nb >= num_kv):
+        banded = False
+
+    if banded:
+        q_grid = nqb
+        q_index = _banded_index(
+            lambda j: _q_band_start(j, block_q, block_k), num_q
+        )
+    else:
+        q_grid, q_index = num_q, (lambda b, j, i: (b, i, 0))
+
     dk, dv = pl.pallas_call(
         functools.partial(
             _bwd_dkv_kernel, scale=scale, causal=causal, t_valid=t_valid,
-            t=t, num_q=num_q, window=window,
+            t=t, num_q=num_q, window=window, banded=banded, nqb=nqb,
         ),
-        grid=(bh, num_kv, num_q),
+        grid=(bh, num_kv, q_grid),
         in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),  # q
-            pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),  # g
-            pl.BlockSpec((1, block_q, 1), lambda b, j, i: (b, i, 0)),  # lse
-            pl.BlockSpec((1, block_q, 1), lambda b, j, i: (b, i, 0)),  # delta
+            pl.BlockSpec((1, block_q, d), q_index),                    # q
+            pl.BlockSpec((1, block_q, d), q_index),                    # g
+            pl.BlockSpec((1, block_q, 1), q_index),                    # lse
+            pl.BlockSpec((1, block_q, 1), q_index),                    # delta
             pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),  # k
             pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),  # v
         ],
@@ -383,19 +493,27 @@ def _bwd_pallas_3d(causal, block_q, block_k, t_valid, interpret,
         interpret=interpret,
     )(q, g, lse, delta, k, v)
 
+    if banded:
+        kv_grid = nb
+        kv_index = _banded_index(
+            lambda i: _band_start(i, block_q, block_k, window), num_kv
+        )
+    else:
+        kv_grid, kv_index = num_kv, (lambda b, i, j: (b, j, 0))
+
     dq = pl.pallas_call(
         functools.partial(
             _bwd_dq_kernel, scale=scale, causal=causal, t_valid=t_valid,
-            t=t, num_kv=num_kv, window=window,
+            t=t, num_kv=num_kv, window=window, banded=banded, nb=nb,
         ),
-        grid=(bh, num_q, num_kv),
+        grid=(bh, num_q, kv_grid),
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),  # q
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),  # g
             pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),  # lse
             pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),  # delta
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),  # k
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),  # v
+            pl.BlockSpec((1, block_k, d), kv_index),                   # k
+            pl.BlockSpec((1, block_k, d), kv_index),                   # v
         ],
         out_specs=[pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0))],
         out_shape=[jax.ShapeDtypeStruct((bh, t, d), q.dtype)],

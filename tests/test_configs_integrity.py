@@ -8,11 +8,10 @@ The reference ships two configs and no check that they stay valid
 import json
 from pathlib import Path
 
-import jax.numpy as jnp
 import pytest
 
 from pytorch_distributed_template_tpu.config import (
-    ConfigParser, LOADERS, LOSSES, METRICS, MODELS,
+    ConfigParser, LOADERS, METRICS, MODELS,
 )
 import pytorch_distributed_template_tpu.data  # noqa: F401
 import pytorch_distributed_template_tpu.engine  # noqa: F401

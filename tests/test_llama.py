@@ -217,21 +217,24 @@ class TestSlidingWindow:
         np.testing.assert_allclose(np.asarray(out_fl), np.asarray(ref),
                                    atol=1e-4, rtol=1e-4)
 
-    def test_flash_window_gradients(self):
+    @pytest.mark.parametrize("t,window", [(16, 5), (64, 5), (64, 20)])
+    def test_flash_window_gradients(self, t, window):
+        """t=64 cases activate the BANDED backward grids (band tiles <
+        total tiles); t=16 covers the banding-disabled fallback."""
         from pytorch_distributed_template_tpu.ops.flash import (
             flash_attention,
         )
 
         key = jax.random.key(1)
-        q, k, v = (jax.random.normal(kk, (1, 16, 2, 8), jnp.float32)
+        q, k, v = (jax.random.normal(kk, (1, t, 2, 8), jnp.float32)
                    for kk in jax.random.split(key, 3))
 
         def loss_ref(q, k, v):
-            return jnp.sum(self._band_ref(q, k, v, 5) ** 2)
+            return jnp.sum(self._band_ref(q, k, v, window) ** 2)
 
         def loss_fl(q, k, v):
             return jnp.sum(
-                flash_attention(q, k, v, causal=True, window=5,
+                flash_attention(q, k, v, causal=True, window=window,
                                 block_q=8, block_k=8) ** 2
             )
 
