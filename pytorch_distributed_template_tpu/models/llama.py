@@ -236,6 +236,23 @@ class LlamaBlock(nn.Module):
                           name="mlp")(h)
 
 
+class _HeadKernel(nn.Module):
+    """Param-only holder for the untied LM head weight.
+
+    Exists so ``fused_head`` can hand the raw ``[D, V]`` kernel to the
+    chunked loss (engine/losses.fused_lm_cross_entropy) without computing
+    logits, while keeping the checkpoint/HF-import param path identical to
+    the ``nn.Dense(name="lm_head")`` it replaces (``lm_head/kernel``).
+    """
+    d_model: int
+    vocab_size: int
+
+    @nn.compact
+    def __call__(self):
+        return self.param("kernel", _dense_init(),
+                          (self.d_model, self.vocab_size), jnp.float32)
+
+
 class LlamaLM(nn.Module):
     """Decoder-only Llama-architecture causal LM."""
     vocab_size: int = 32000
@@ -253,6 +270,7 @@ class LlamaLM(nn.Module):
     rope_base: float = 10000.0
     rms_eps: float = 1e-6
     window: int = 0                 # sliding-window attention; 0 = full
+    fused_head: bool = False        # return (hidden, head_w) for chunked loss
 
     @nn.compact
     def __call__(self, tokens, train: bool = False, example_mask=None,
@@ -324,6 +342,13 @@ class LlamaLM(nn.Module):
         x = RMSNorm(self.rms_eps, name="norm")(x)
         if zperm is not None:
             x = x[:, np.argsort(zperm)]
+        if self.fused_head and not decode:
+            # chunked head+loss (engine/losses.fused_lm_cross_entropy):
+            # [B, T, V] logits never materialize. Same param path as the
+            # Dense below, so the two modes share checkpoints/HF imports.
+            w = _HeadKernel(self.d_model, self.vocab_size,
+                            name="lm_head")()
+            return x.astype(self.dtype), w.astype(self.dtype)
         logits = nn.Dense(self.vocab_size, use_bias=False, dtype=self.dtype,
                           kernel_init=_dense_init(), name="lm_head")(x)
         return logits.astype(jnp.float32)
@@ -350,13 +375,14 @@ def llama(vocab_size: int = 32000, n_layer: int = 12, n_head: int = 12,
           max_len: int = 2048, bfloat16: bool = False,
           attn_impl: str = "xla", remat: bool = False, mesh=None,
           seq_layout: str = "natural", rope_base: float = 10000.0,
-          rms_eps: float = 1e-6, window: int = 0):
+          rms_eps: float = 1e-6, window: int = 0, fused_head: bool = False):
     return LlamaLM(
         vocab_size=vocab_size, n_layer=n_layer, n_head=n_head,
         n_kv_head=n_kv_head, d_model=d_model, d_ff=d_ff, max_len=max_len,
         dtype=jnp.bfloat16 if bfloat16 else jnp.float32,
         attn_impl=attn_impl, remat=remat, mesh=mesh, seq_layout=seq_layout,
         rope_base=rope_base, rms_eps=rms_eps, window=window,
+        fused_head=fused_head,
     )
 
 
@@ -365,12 +391,13 @@ def tiny_llama(vocab_size: int = 256, n_layer: int = 2, n_head: int = 4,
                n_kv_head: int = 2, d_model: int = 64, d_ff: int = 0,
                max_len: int = 128, attn_impl: str = "xla",
                remat: bool = False, mesh=None, bfloat16: bool = False,
-               seq_layout: str = "natural", window: int = 0):
+               seq_layout: str = "natural", window: int = 0,
+               fused_head: bool = False):
     """Small GQA config for tests and dry runs."""
     return LlamaLM(
         vocab_size=vocab_size, n_layer=n_layer, n_head=n_head,
         n_kv_head=n_kv_head, d_model=d_model, d_ff=d_ff, max_len=max_len,
         dtype=jnp.bfloat16 if bfloat16 else jnp.float32,
         attn_impl=attn_impl, remat=remat, mesh=mesh, seq_layout=seq_layout,
-        window=window,
+        window=window, fused_head=fused_head,
     )
