@@ -157,37 +157,101 @@ class LlamaAttention(nn.Module):
         """Incremental decode against a K/V cache stored at the KV-head
         count (GQA memory win; same single-position-counter contract as
         models/transformer.SelfAttention._cached_attention). RoPE rotates
-        the new rows by their absolute positions before insertion."""
+        the new rows by their absolute positions before insertion.
+
+        With ``window > 0`` the cache is a ROLLING ring buffer of
+        ``window`` slots (Mistral-style): slot ``p % window`` holds
+        position ``p``, old keys are overwritten as they fall out of the
+        band, and an explicit per-slot position buffer drives the
+        visibility mask — decode memory is O(window), independent of how
+        long generation runs."""
         b, t, hq, d = q.shape
+        # The ALLOCATION call (generate's zeros pass over [B, total]) sizes
+        # the cache: min(window, total) slots when windowed. Later calls
+        # must derive `rolling` from the allocated length — their own t is
+        # the prompt/token length, not the decode budget.
+        alloc_len = (
+            min(self.window, k.shape[1]) if self.window > 0 else k.shape[1]
+        )
         is_init = self.has_variable("cache", "cached_key")
-        cached_k = self.variable("cache", "cached_key", jnp.zeros,
-                                 k.shape, k.dtype)
-        cached_v = self.variable("cache", "cached_value", jnp.zeros,
-                                 v.shape, v.dtype)
+        cached_k = self.variable(
+            "cache", "cached_key", jnp.zeros,
+            (b, alloc_len, k.shape[2], d), k.dtype,
+        )
+        cached_v = self.variable(
+            "cache", "cached_value", jnp.zeros,
+            (b, alloc_len, v.shape[2], d), v.dtype,
+        )
+        cache_len = cached_k.value.shape[1]
+        rolling = self.window > 0 and cache_len == self.window
+        slot_pos = None
+        if self.window > 0:
+            # which absolute position each slot currently holds (-1 = empty)
+            slot_pos = self.variable(
+                "cache", "slot_pos",
+                lambda: jnp.full((cache_len,), -1, jnp.int32),
+            )
         if not is_init:
+            # shape-setting pass: allocate the cache, no attention needed
             return jnp.zeros((b, t, hq, d), q.dtype)
-        max_len = cached_k.value.shape[1]
-        if t > max_len:
-            raise ValueError(f"decode input {t} exceeds cache {max_len}")
+        if not rolling and t > cache_len:
+            raise ValueError(f"decode input {t} exceeds cache {cache_len}")
         pos = cur + jnp.arange(t)
         cos, sin = rope_tables(pos, d, self.rope_base)
         q = apply_rope(q, cos, sin)
         k = apply_rope(k, cos, sin)
-        k_all = jax.lax.dynamic_update_slice(
-            cached_k.value, k.astype(cached_k.value.dtype), (0, cur, 0, 0)
-        )
-        v_all = jax.lax.dynamic_update_slice(
-            cached_v.value, v.astype(cached_v.value.dtype), (0, cur, 0, 0)
-        )
+        if rolling:
+            # Attend over HISTORY (ring buffer) + the call's own tokens —
+            # every query sees its full band even when the call is longer
+            # than the window; eviction applies only to the cache WRITE.
+            hist_pos = slot_pos.value                    # [W], -1 = empty
+            k_all = jnp.concatenate(
+                [cached_k.value, k.astype(cached_k.value.dtype)], axis=1
+            )                                            # [B, W + t, ...]
+            v_all = jnp.concatenate(
+                [cached_v.value, v.astype(cached_v.value.dtype)], axis=1
+            )
+            k_pos = jnp.concatenate([hist_pos, pos])[None, :]  # [1, W + t]
+            visible = (k_pos >= 0) & (k_pos <= pos[:, None]) & (
+                pos[:, None] - k_pos < self.window
+            )
+            # write the trailing <=W new tokens into their ring slots (a
+            # static slice keeps the scatter duplicate-free/deterministic)
+            if t > cache_len:
+                kw, vw, wpos = k[:, -cache_len:], v[:, -cache_len:], \
+                    pos[-cache_len:]
+            else:
+                kw, vw, wpos = k, v, pos
+            slots = wpos % cache_len
+            cached_k.value = cached_k.value.at[:, slots].set(
+                kw.astype(cached_k.value.dtype))
+            cached_v.value = cached_v.value.at[:, slots].set(
+                vw.astype(cached_v.value.dtype))
+            slot_pos.value = hist_pos.at[slots].set(wpos)
+            if groups > 1:
+                k_all = jnp.repeat(k_all, groups, axis=2)
+                v_all = jnp.repeat(v_all, groups, axis=2)
+            return multihead_attention(
+                q, k_all, v_all, causal=False, mask=visible[None, None]
+            )
+        else:
+            k_all = jax.lax.dynamic_update_slice(
+                cached_k.value, k.astype(cached_k.value.dtype),
+                (0, cur, 0, 0)
+            )
+            v_all = jax.lax.dynamic_update_slice(
+                cached_v.value, v.astype(cached_v.value.dtype),
+                (0, cur, 0, 0)
+            )
+            k_pos = jnp.arange(cache_len)[None, :]
+            visible = k_pos <= pos[:, None]
+            if self.window > 0:
+                visible = visible & (pos[:, None] - k_pos < self.window)
         cached_k.value = k_all
         cached_v.value = v_all
         if groups > 1:
             k_all = jnp.repeat(k_all, groups, axis=2)
             v_all = jnp.repeat(v_all, groups, axis=2)
-        k_pos = jnp.arange(max_len)[None, :]
-        visible = k_pos <= pos[:, None]
-        if self.window > 0:
-            visible = visible & (pos[:, None] - k_pos < self.window)
         return multihead_attention(
             q, k_all, v_all, causal=False, mask=visible[None, None]
         )

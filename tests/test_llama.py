@@ -316,3 +316,36 @@ def test_fused_head_matches_plain():
     out = generate(m_fused, s.params, tokens[:, :8], max_new_tokens=4)
     ref = generate(m_ref, s.params, tokens[:, :8], max_new_tokens=4)
     np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+
+def test_rolling_kv_cache_windowed_decode():
+    """window > 0 decode uses a ring-buffer cache of `window` slots (not
+    decode-budget-sized); prefill longer than the window and single-token
+    steps crossing slot reuse all reproduce full-forward logits."""
+    W = 8
+    m = MODELS.get("TinyLlama")(window=W, max_len=128)
+    tokens = _tokens(b=1, t=20)
+    s = _state(m, tokens)
+
+    total = 32
+    _, v = m.apply({"params": s.params}, jnp.zeros((1, total), jnp.int32),
+                   train=False, decode=True, mutable=["cache"])
+    ck = v["cache"]["layers_0"]["self_attn"]["cached_key"]
+    assert ck.shape[1] == W  # O(window) memory, not O(total)
+
+    out, v = m.apply({"params": s.params, **v}, tokens,
+                     train=False, decode=True, mutable=["cache"])
+    full = m.apply({"params": s.params}, tokens, train=False)
+    np.testing.assert_allclose(np.asarray(out[:, -1]),
+                               np.asarray(full[:, -1]),
+                               atol=1e-5, rtol=1e-5)
+    cur = tokens
+    for _ in range(6):  # crosses ring-slot eviction several times
+        nxt = jnp.argmax(out[:, -1], axis=-1)[:, None]
+        out, v = m.apply({"params": s.params, **v}, nxt,
+                         train=False, decode=True, mutable=["cache"])
+        cur = jnp.concatenate([cur, nxt], axis=1)
+    ref = m.apply({"params": s.params}, cur, train=False)
+    np.testing.assert_allclose(np.asarray(out[:, -1]),
+                               np.asarray(ref[:, -1]),
+                               atol=1e-5, rtol=1e-5)
