@@ -450,6 +450,26 @@ def llama(vocab_size: int = 32000, n_layer: int = 12, n_head: int = 12,
     )
 
 
+@MODELS.register("Mistral")
+def mistral(vocab_size: int = 32000, n_layer: int = 32, n_head: int = 32,
+            n_kv_head: int = 8, d_model: int = 4096, d_ff: int = 14336,
+            max_len: int = 32768, window: int = 4096,
+            rope_base: float = 10000.0, rms_eps: float = 1e-5,
+            bfloat16: bool = True, attn_impl: str = "flash",
+            remat: bool = True, mesh=None, fused_head: bool = False):
+    """Mistral-7B-shaped defaults: the Llama architecture with 4:1 GQA and
+    a 4096-token sliding window (banded flash kernels + rolling decode
+    cache). Same param tree as ``Llama``, so ``import_hf_llama`` applies
+    to Mistral HF checkpoints too (they share the state-dict layout)."""
+    return LlamaLM(
+        vocab_size=vocab_size, n_layer=n_layer, n_head=n_head,
+        n_kv_head=n_kv_head, d_model=d_model, d_ff=d_ff, max_len=max_len,
+        dtype=jnp.bfloat16 if bfloat16 else jnp.float32,
+        attn_impl=attn_impl, remat=remat, mesh=mesh, window=window,
+        rope_base=rope_base, rms_eps=rms_eps, fused_head=fused_head,
+    )
+
+
 @MODELS.register("TinyLlama")
 def tiny_llama(vocab_size: int = 256, n_layer: int = 2, n_head: int = 4,
                n_kv_head: int = 2, d_model: int = 64, d_ff: int = 0,
