@@ -42,11 +42,17 @@ class ArrayDataLoader:
     :param drop_last: drop the trailing partial batch. When False the last
         batch is padded by wraparound duplication and ``batch["mask"]`` marks
         real rows — static shapes for XLA, exact metrics for eval.
+    :param normalize: optional ``{"key": "image", "mean": [...],
+        "std": [...]}``. When the named array is uint8 with a trailing
+        channel dim, batches come out float32 ``(x/255 - mean)/std`` via the
+        fused native gather (one pass) — uint8 on-disk datasets are 4x
+        smaller than float32 with no extra host traversals.
     """
 
     def __init__(self, arrays: dict, batch_size: int, shuffle: bool = True,
                  sampler: Optional[ShardedSampler] = None,
-                 drop_last: bool = False, seed: int = 0):
+                 drop_last: bool = False, seed: int = 0,
+                 normalize: Optional[dict] = None):
         if not arrays:
             raise ValueError("arrays must be a non-empty dict")
         lens = {k: len(v) for k, v in arrays.items()}
@@ -62,6 +68,10 @@ class ArrayDataLoader:
         self.drop_last = bool(drop_last)
         self.seed = seed
         self.epoch = 0
+        self.normalize = dict(normalize) if normalize else None
+        if self.normalize and not (
+                "mean" in self.normalize and "std" in self.normalize):
+            raise ValueError("normalize needs 'mean' and 'std'")
 
     def set_epoch(self, epoch: int) -> None:
         self.epoch = epoch
@@ -95,9 +105,18 @@ class ArrayDataLoader:
                 )
             # native multithreaded gather (data/native, the torch-C++-
             # dataloader equivalent); falls back to numpy per array
-            batch = {
-                k: native.gather(v, batch_idx) for k, v in self.arrays.items()
-            }
+            batch = {}
+            for k, v in self.arrays.items():
+                if (self.normalize is not None
+                        and k == self.normalize.get("key", "image")
+                        and v.dtype == np.uint8):
+                    batch[k] = native.gather_normalize_u8(
+                        v, batch_idx,
+                        np.asarray(self.normalize["mean"], np.float32),
+                        np.asarray(self.normalize["std"], np.float32),
+                    )
+                else:
+                    batch[k] = native.gather(v, batch_idx)
             batch["mask"] = batch_mask
             yield batch
 

@@ -58,6 +58,12 @@ def _load() -> Optional[ctypes.CDLL]:
                 ctypes.c_int64, ctypes.c_void_p, ctypes.c_int32,
             ]
             lib.gather_rows.restype = None
+            lib.gather_rows_norm_u8.argtypes = [
+                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
+                ctypes.c_int64, ctypes.c_int64, ctypes.c_void_p,
+                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int32,
+            ]
+            lib.gather_rows_norm_u8.restype = None
             _lib = lib
         except Exception as e:  # no g++, sandboxed exec, etc.
             logger.info("native batcher unavailable (%s); using numpy", e)
@@ -98,5 +104,44 @@ def gather(src: np.ndarray, idx: np.ndarray) -> np.ndarray:
     lib.gather_rows(
         src.ctypes.data, idx64.ctypes.data, len(idx64), row_bytes,
         out.ctypes.data, _threads,
+    )
+    return out
+
+
+def gather_normalize_u8(src: np.ndarray, idx: np.ndarray,
+                        mean: np.ndarray, std: np.ndarray) -> np.ndarray:
+    """Fused ``(src[idx] / 255 - mean) / std`` for uint8 image arrays with
+    a trailing channel dim: one pass over the gathered bytes instead of
+    numpy's gather -> cast -> subtract -> divide (four full-batch
+    traversals). Enables uint8 on-disk datasets (4x smaller than float32).
+    Falls back to the numpy expression when the native path is out.
+    """
+    idx = np.asarray(idx)
+    mean = np.ascontiguousarray(mean, dtype=np.float32).ravel()
+    std = np.ascontiguousarray(std, dtype=np.float32).ravel()
+    n_chan = len(mean)
+    lib = _load()
+
+    def fallback():
+        x = src[idx].astype(np.float32) / 255.0
+        return (x - mean) / std
+
+    if (lib is None or src.dtype != np.uint8 or not src.flags.c_contiguous
+            or src.ndim < 2 or src.shape[-1] != n_chan or len(std) != n_chan
+            or idx.ndim != 1 or len(idx) == 0 or idx.dtype.kind not in "iu"):
+        return fallback()
+    idx64 = np.ascontiguousarray(idx, dtype=np.int64)
+    if int(idx64.min()) < 0:
+        idx64 = idx64.copy()
+        idx64[idx64 < 0] += len(src)
+    if int(idx64.min()) < 0 or int(idx64.max()) >= len(src):
+        raise IndexError("gather index out of range")
+    row_elems = int(np.prod(src.shape[1:], dtype=np.int64))
+    if row_elems == 0 or row_elems % n_chan != 0:
+        return fallback()
+    out = np.empty((len(idx64),) + src.shape[1:], dtype=np.float32)
+    lib.gather_rows_norm_u8(
+        src.ctypes.data, idx64.ctypes.data, len(idx64), row_elems, n_chan,
+        mean.ctypes.data, std.ctypes.data, out.ctypes.data, _threads,
     )
     return out

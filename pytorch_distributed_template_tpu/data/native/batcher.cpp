@@ -46,4 +46,48 @@ void gather_rows(const char* src, const int64_t* idx, int64_t n_idx,
   for (auto& th : ts) th.join();
 }
 
+// Fused gather + uint8->float32 + per-channel normalize:
+//   dst[i, p, c] = (src[idx[i], p, c] / 255 - mean[c]) / std[c]
+// for i in [0, n_idx), p pixels, c in [0, n_chan). One pass over the
+// gathered bytes instead of gather-then-cast-then-normalize (three
+// full-batch traversals in numpy), enabling uint8 on-disk datasets (4x
+// smaller than float32) at full pipeline speed. row_elems counts uint8
+// elements per row; n_chan must divide row_elems (trailing channel dim).
+void gather_rows_norm_u8(const uint8_t* src, const int64_t* idx,
+                         int64_t n_idx, int64_t row_elems, int64_t n_chan,
+                         const float* mean, const float* stddev, float* dst,
+                         int32_t n_threads) {
+  // Precompute per-channel affine: x * a[c] + b[c].
+  std::vector<float> a(n_chan), b(n_chan);
+  for (int64_t c = 0; c < n_chan; ++c) {
+    a[c] = 1.0f / (255.0f * stddev[c]);
+    b[c] = -mean[c] / stddev[c];
+  }
+  auto work = [=, &a, &b](int64_t lo, int64_t hi) {
+    for (int64_t i = lo; i < hi; ++i) {
+      const uint8_t* s = src + idx[i] * row_elems;
+      float* d = dst + i * row_elems;
+      for (int64_t e = 0; e < row_elems; ++e) {
+        const int64_t c = e % n_chan;
+        d[e] = static_cast<float>(s[e]) * a[c] + b[c];
+      }
+    }
+  };
+  if (n_threads < 1) n_threads = 1;
+  if (n_threads == 1 || n_idx * row_elems < (1 << 20)) {
+    work(0, n_idx);
+    return;
+  }
+  std::vector<std::thread> ts;
+  ts.reserve(n_threads);
+  const int64_t chunk = (n_idx + n_threads - 1) / n_threads;
+  for (int32_t t = 0; t < n_threads; ++t) {
+    const int64_t lo = t * chunk;
+    const int64_t hi = std::min(n_idx, lo + chunk);
+    if (lo >= hi) break;
+    ts.emplace_back(work, lo, hi);
+  }
+  for (auto& th : ts) th.join();
+}
+
 }  // extern "C"

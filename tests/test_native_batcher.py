@@ -135,3 +135,49 @@ def test_host_prefetch_early_close_unblocks_worker():
     time.sleep(0.3)
     assert len(produced) == n
     assert n < 1000
+
+
+def test_gather_normalize_u8_matches_numpy():
+    """Fused uint8 gather+normalize == numpy gather->cast->normalize, for
+    both the native path and its fallback."""
+    from pytorch_distributed_template_tpu.data import native
+
+    rng = np.random.default_rng(0)
+    src = rng.integers(0, 256, size=(50, 8, 8, 3)).astype(np.uint8)
+    idx = rng.integers(0, 50, size=17)
+    mean = np.array([0.48, 0.45, 0.40], np.float32)
+    std = np.array([0.22, 0.22, 0.25], np.float32)
+    ref = (src[idx].astype(np.float32) / 255.0 - mean) / std
+    out = native.gather_normalize_u8(src, idx, mean, std)
+    assert out.dtype == np.float32
+    np.testing.assert_allclose(out, ref, atol=1e-6)
+
+    # greyscale (1 channel), non-multiple-of-threads batch
+    src1 = rng.integers(0, 256, size=(30, 5, 5, 1)).astype(np.uint8)
+    idx1 = rng.integers(0, 30, size=7)
+    m1, s1 = np.array([0.13], np.float32), np.array([0.31], np.float32)
+    np.testing.assert_allclose(
+        native.gather_normalize_u8(src1, idx1, m1, s1),
+        (src1[idx1].astype(np.float32) / 255.0 - m1) / s1, atol=1e-6,
+    )
+
+
+def test_loader_normalize_option():
+    """ArrayDataLoader(normalize=...) emits float32 normalized batches from
+    uint8 storage; non-image keys untouched."""
+    from pytorch_distributed_template_tpu.data.loader import ArrayDataLoader
+
+    rng = np.random.default_rng(1)
+    images = rng.integers(0, 256, size=(20, 4, 4, 3)).astype(np.uint8)
+    labels = rng.integers(0, 10, size=20).astype(np.int32)
+    mean, std = [0.5, 0.5, 0.5], [0.25, 0.25, 0.25]
+    loader = ArrayDataLoader(
+        {"image": images, "label": labels}, batch_size=8, shuffle=False,
+        normalize={"mean": mean, "std": std},
+    )
+    batch = next(iter(loader))
+    assert batch["image"].dtype == np.float32
+    ref = (images[:8].astype(np.float32) / 255.0
+           - np.asarray(mean, np.float32)) / np.asarray(std, np.float32)
+    np.testing.assert_allclose(batch["image"], ref, atol=1e-6)
+    assert batch["label"].dtype == np.int32
