@@ -69,9 +69,21 @@ class ArrayDataLoader:
         self.seed = seed
         self.epoch = 0
         self.normalize = dict(normalize) if normalize else None
-        if self.normalize and not (
-                "mean" in self.normalize and "std" in self.normalize):
-            raise ValueError("normalize needs 'mean' and 'std'")
+        if self.normalize:
+            if not ("mean" in self.normalize and "std" in self.normalize):
+                raise ValueError("normalize needs 'mean' and 'std'")
+            nkey = self.normalize.get("key", "image")
+            if nkey not in arrays:
+                raise ValueError(
+                    f"normalize key {nkey!r} not in arrays "
+                    f"{sorted(arrays)}"
+                )
+            if arrays[nkey].dtype != np.uint8:
+                raise ValueError(
+                    f"normalize targets uint8 storage; array {nkey!r} is "
+                    f"{arrays[nkey].dtype} — pre-normalized data should "
+                    "drop the normalize option"
+                )
 
     def set_epoch(self, epoch: int) -> None:
         self.epoch = epoch

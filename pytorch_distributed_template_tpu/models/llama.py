@@ -186,10 +186,14 @@ class LlamaAttention(nn.Module):
         rolling = self.window > 0 and cache_len == self.window
         slot_pos = None
         if self.window > 0:
-            # which absolute position each slot currently holds (-1 = empty)
+            # Which absolute position each slot holds, stored as pos + 1 so
+            # 0 means EMPTY: generate() materializes fresh caches as
+            # all-zeros pytrees from eval_shape (engine/generate.py) — the
+            # init fn below never runs there, so the zero value itself must
+            # encode "empty" or stale slots would masquerade as position 0.
             slot_pos = self.variable(
                 "cache", "slot_pos",
-                lambda: jnp.full((cache_len,), -1, jnp.int32),
+                lambda: jnp.zeros((cache_len,), jnp.int32),
             )
         if not is_init:
             # shape-setting pass: allocate the cache, no attention needed
@@ -204,7 +208,7 @@ class LlamaAttention(nn.Module):
             # Attend over HISTORY (ring buffer) + the call's own tokens —
             # every query sees its full band even when the call is longer
             # than the window; eviction applies only to the cache WRITE.
-            hist_pos = slot_pos.value                    # [W], -1 = empty
+            hist_pos = slot_pos.value - 1                # [W], -1 = empty
             k_all = jnp.concatenate(
                 [cached_k.value, k.astype(cached_k.value.dtype)], axis=1
             )                                            # [B, W + t, ...]
@@ -227,7 +231,7 @@ class LlamaAttention(nn.Module):
                 kw.astype(cached_k.value.dtype))
             cached_v.value = cached_v.value.at[:, slots].set(
                 vw.astype(cached_v.value.dtype))
-            slot_pos.value = hist_pos.at[slots].set(wpos)
+            slot_pos.value = slot_pos.value.at[slots].set(wpos + 1)
             if groups > 1:
                 k_all = jnp.repeat(k_all, groups, axis=2)
                 v_all = jnp.repeat(v_all, groups, axis=2)

@@ -262,3 +262,23 @@ def test_byte_lm_loader_fallback_and_too_small(tmp_path):
             data_dir=str(tmp_path), file="tiny.txt", batch_size=4,
             seq_len=64, training=True,
         )
+
+
+def test_loader_normalize_misconfig_raises():
+    """normalize on a non-uint8 array or a missing key is a config error,
+    not a silent no-op (training on un-normalized data would quietly
+    degrade quality)."""
+    import numpy as np
+    import pytest
+
+    from pytorch_distributed_template_tpu.data.loader import ArrayDataLoader
+
+    imgs_f32 = np.zeros((8, 4, 4, 3), np.float32)
+    with pytest.raises(ValueError, match="uint8"):
+        ArrayDataLoader({"image": imgs_f32}, batch_size=4,
+                        normalize={"mean": [0.5] * 3, "std": [0.2] * 3})
+    imgs_u8 = np.zeros((8, 4, 4, 3), np.uint8)
+    with pytest.raises(ValueError, match="not in arrays"):
+        ArrayDataLoader({"image": imgs_u8}, batch_size=4,
+                        normalize={"key": "images", "mean": [0.5] * 3,
+                                   "std": [0.2] * 3})

@@ -349,3 +349,42 @@ def test_rolling_kv_cache_windowed_decode():
     np.testing.assert_allclose(np.asarray(out[:, -1]),
                                np.asarray(ref[:, -1]),
                                atol=1e-5, rtol=1e-5)
+
+
+def test_rolling_cache_zeros_pytree_short_prompt():
+    """generate() materializes fresh caches as all-ZEROS pytrees (its
+    documented contract), never running variable init fns — the ring
+    buffer's empty-slot encoding must survive that. Short prompt (<
+    window) is the regression case: stale zero slots must not masquerade
+    as position 0. Logit-level comparison (tie-proof)."""
+    W = 8
+    m = MODELS.get("TinyLlama")(window=W, max_len=128)
+    tokens = _tokens(b=1, t=4)  # prompt SHORTER than the window
+    s = _state(m, tokens)
+    total = 12
+    shapes = jax.eval_shape(
+        lambda p: m.apply(
+            {"params": p}, jnp.zeros((1, total), jnp.int32),
+            train=False, decode=True, mutable=["cache"],
+        ),
+        s.params,
+    )
+    cache = jax.tree.map(
+        lambda x: jnp.zeros(x.shape, x.dtype), shapes[1]["cache"]
+    )
+    out, v = m.apply({"params": s.params, "cache": cache}, tokens,
+                     train=False, decode=True, mutable=["cache"])
+    full = m.apply({"params": s.params}, tokens, train=False)
+    np.testing.assert_allclose(np.asarray(out[:, -1]),
+                               np.asarray(full[:, -1]),
+                               atol=1e-5, rtol=1e-5)
+    cur = tokens
+    for _ in range(3):
+        nxt = jnp.argmax(out[:, -1], axis=-1)[:, None]
+        out, v = m.apply({"params": s.params, **v}, nxt,
+                         train=False, decode=True, mutable=["cache"])
+        cur = jnp.concatenate([cur, nxt], axis=1)
+        ref = m.apply({"params": s.params}, cur, train=False)
+        np.testing.assert_allclose(np.asarray(out[:, -1]),
+                                   np.asarray(ref[:, -1]),
+                                   atol=1e-5, rtol=1e-5)
