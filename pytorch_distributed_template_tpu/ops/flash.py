@@ -595,6 +595,11 @@ def flash_attention(q, k, v, causal: bool = True,
     block sizes are zero-padded to the next block multiple and the padded
     keys are masked out inside the kernel (padded query rows are sliced off,
     and ``jnp.pad``'s VJP zeroes their gradients).
+
+    ``window > 0`` (with ``causal``): sliding-window banding. The grid
+    itself is banded — only the ~window-wide KV tile strip per q block is
+    visited in forward and both backward kernels, so compute and K/V
+    streaming are O(T * window).
     """
     if interpret is None:
         interpret = not _on_tpu()
