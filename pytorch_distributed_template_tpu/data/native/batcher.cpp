@@ -63,13 +63,16 @@ void gather_rows_norm_u8(const uint8_t* src, const int64_t* idx,
     a[c] = 1.0f / (255.0f * stddev[c]);
     b[c] = -mean[c] / stddev[c];
   }
+  const int64_t n_pix = row_elems / n_chan;
   auto work = [=, &a, &b](int64_t lo, int64_t hi) {
     for (int64_t i = lo; i < hi; ++i) {
       const uint8_t* s = src + idx[i] * row_elems;
       float* d = dst + i * row_elems;
-      for (int64_t e = 0; e < row_elems; ++e) {
-        const int64_t c = e % n_chan;
-        d[e] = static_cast<float>(s[e]) * a[c] + b[c];
+      for (int64_t p = 0; p < n_pix; ++p) {
+        for (int64_t c = 0; c < n_chan; ++c) {
+          d[p * n_chan + c] =
+              static_cast<float>(s[p * n_chan + c]) * a[c] + b[c];
+        }
       }
     }
   };
