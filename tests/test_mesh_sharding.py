@@ -165,3 +165,34 @@ def test_three_axis_composition_dp_tp_sp():
         s, m = step(s, batch)
         losses.append(float(m["loss_sum"]) / float(m["count"]))
     assert losses[-1] < losses[0] - 0.3, losses[::6]
+
+
+def test_three_axis_composition_dp_tp_ulysses():
+    """Ulysses also composes with TP on one mesh: {data:2, tensor:2,
+    seq:2} — per-device heads after TP (4/2=2) still split over seq."""
+    import optax
+
+    from pytorch_distributed_template_tpu.config.registry import MODELS
+    import pytorch_distributed_template_tpu.models  # noqa: F401
+    from pytorch_distributed_template_tpu.engine.state import (
+        create_train_state,
+    )
+
+    mesh = build_mesh({"data": 2, "tensor": 2, "seq": 2})
+    tokens = jnp.asarray(
+        np.random.default_rng(1).integers(0, 64, (4, 32)), jnp.int32
+    )
+    m_ref = MODELS.get("TinyLM")(vocab_size=64, d_model=64, max_len=64)
+    m_u = MODELS.get("TinyLM")(vocab_size=64, d_model=64, max_len=64,
+                               attn_impl="ulysses", mesh=mesh)
+    state = create_train_state(m_ref, optax.adam(1e-3),
+                               m_ref.batch_template(1), seed=0)
+    ref = m_ref.apply({"params": state.params}, tokens, train=False)
+    sharded = jax.device_put(
+        state, apply_rules(state, mesh, m_u.partition_rules())
+    )
+    out = jax.jit(
+        lambda p, t: m_u.apply({"params": p}, t, train=False)
+    )(sharded.params, tokens)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=1e-4, rtol=1e-4)

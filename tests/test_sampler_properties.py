@@ -68,3 +68,30 @@ def test_determinism_and_epoch_reshuffle(setup):
     if shuffle and n > 16:
         b.set_epoch(epoch + 1)
         assert list(a) != list(b)  # reshuffles across epochs
+
+
+@given(
+    s=st.integers(min_value=1, max_value=8),
+    c=st.integers(min_value=1, max_value=8),
+)
+@settings(max_examples=40, deadline=None)
+def test_zigzag_perm_properties(s, c):
+    """zigzag_perm invariants for any ring size s and chunk size c:
+    a true permutation; shard i's slice is exactly chunks (i, 2s-1-i); and
+    the first half of each shard slice is the low chunk (ascending), the
+    second half the high chunk — the layout the balanced ring bodies
+    assume (ops/attention.py)."""
+    import numpy as np
+
+    from pytorch_distributed_template_tpu.ops.attention import zigzag_perm
+
+    t = 2 * s * c
+    perm = zigzag_perm(t, s)
+    assert sorted(perm.tolist()) == list(range(t))
+    tl = t // s
+    for i in range(s):
+        shard = perm[i * tl:(i + 1) * tl]
+        lo = np.arange(i * c, (i + 1) * c)
+        hi = np.arange((2 * s - 1 - i) * c, (2 * s - i) * c)
+        np.testing.assert_array_equal(shard[:c], lo)
+        np.testing.assert_array_equal(shard[c:], hi)
