@@ -42,6 +42,31 @@ def _build_test_loader(config):
     )
 
 
+def restore_template_state(config, model, mesh, template=None):
+    """Restore ``config.resume`` into a freshly-built template state.
+
+    The template's tree matches what training saved: optimizer slot shapes
+    depend only on optimizer type + param shapes, and ``ema_params`` is
+    present iff the training config enabled EMA. Shared by the evaluation
+    and sampling CLIs (test.py, generate.py). Returns
+    ``(state, ema_decay)``.
+    """
+    from ..checkpoint import CheckpointManager
+
+    tx, _, _ = build_optimizer(config, steps_per_epoch=1)
+    ema_decay = float(config["trainer"].get("ema_decay", 0.0))
+    if template is None:
+        template = model.batch_template(1)
+    state, _ = create_sharded_train_state(
+        model, tx, template, mesh, with_ema=ema_decay > 0,
+    )
+    manager = CheckpointManager(config.resume.parent)
+    state, _, _ = manager.restore(
+        config.resume, state, config.config, type(model).__name__
+    )
+    return state, ema_decay
+
+
 def evaluate(config, mesh=None) -> dict:
     """Evaluate ``config.resume`` on the config's ``test_loader``."""
     logger = config.get_logger("test")
@@ -58,21 +83,8 @@ def evaluate(config, mesh=None) -> dict:
     input_key = dk.get("input", "image")
     target_key = dk.get("target", "label")
 
-    # Template state for orbax restore: same tree as training saved
-    # (optimizer slots' shapes depend only on optimizer type + param shapes;
-    # ema_params present iff the training config enabled EMA).
-    tx, _, _ = build_optimizer(config, steps_per_epoch=1)
-    ema_decay = float(config["trainer"].get("ema_decay", 0.0))
-    state, _ = create_sharded_train_state(
-        model, tx, test_loader.arrays[input_key][:1], mesh,
-        with_ema=ema_decay > 0,
-    )
-
-    from ..checkpoint import CheckpointManager
-
-    manager = CheckpointManager(config.resume.parent)
-    state, _, _ = manager.restore(
-        config.resume, state, config.config, type(model).__name__
+    state, ema_decay = restore_template_state(
+        config, model, mesh, template=test_loader.arrays[input_key][:1]
     )
 
     eval_step = jax.jit(

@@ -59,8 +59,9 @@ def test_generate_cli_byte_mode(lm_checkpoint):
     r = _run(lm_checkpoint, "--prompt", "12:3", "--max-new-tokens", "4",
              "--temperature", "0.8", "--top-p", "0.9")
     assert r.returncode == 0, r.stdout[-2000:] + r.stderr[-2000:]
-    out = r.stdout.strip().splitlines()[-1]
-    assert out.startswith("12:3")
+    # sampled bytes may include newline-class characters, so don't assume
+    # the output is one line — the prompt prefix must appear somewhere
+    assert "12:3" in r.stdout
 
 
 def test_generate_cli_rejects_out_of_vocab_prompt(lm_checkpoint):
