@@ -3,7 +3,7 @@
 The reference has no attention anywhere (its model zoo is one MNIST CNN,
 SURVEY.md §2.3) — but the BASELINE.json ladder (ViT, GPT-2) and the
 long-context mandate require it, so attention is a first-class op family
-here with three interchangeable implementations:
+here as a family of interchangeable implementations:
 
 - ``multihead_attention``: plain XLA einsum-softmax-einsum. XLA:TPU fuses
   the mask+softmax chain; fine up to moderate T.
@@ -25,11 +25,17 @@ here with three interchangeable implementations:
   sequence with ``zigzag_perm`` once at the input and invert once at the
   output (models/transformer.py does this around the whole block stack —
   two cheap all-to-alls per step, amortized over all layers).
+- ``ulysses_attention``: the all-to-all SP alternative — one tiled
+  all-to-all turns the sequence shard into a head shard, full-sequence
+  attention runs locally, one all-to-all converts back (two collectives
+  per call vs the ring's s ppermutes).
 - ``flash_attention`` (ops/flash.py): fused Pallas TPU kernel for the
-  single-device block-streaming case.
+  single-device block-streaming case; also the per-block kernel inside
+  ``ring_attention(block_impl="flash")`` via ``flash_attention_lse``.
 
-All take/return ``[B, T, H, D]`` ("BTHD") and accumulate in float32
-regardless of input dtype (bf16-safe).
+Sliding-window banding (``window > 0``) threads through the XLA, flash
+(banded grids), and Ulysses paths. All take/return ``[B, T, H, D]``
+("BTHD") and accumulate in float32 regardless of input dtype (bf16-safe).
 """
 from __future__ import annotations
 
