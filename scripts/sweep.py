@@ -1,0 +1,94 @@
+#!/usr/bin/env python
+"""Grid-sweep runner over train.py, tabulating summary.json results.
+
+The run-dir contract makes this trivial: every training run writes a
+machine-readable ``summary.json`` (final metrics + the monitored best),
+so a sweep is just N train.py invocations with ``--set`` overrides and a
+table at the end — no experiment-tracking service required.
+
+Usage:
+    python scripts/sweep.py -c configs/mnist_debug.json \
+        --grid '{"optimizer;args;lr": [1e-3, 3e-3], "trainer;epochs": [2]}' \
+        --save-dir sweeps/lr --seed 1
+    (unrecognized args pass through to train.py)
+
+Each grid point trains into ``<save_dir>/run<i>/`` (sequentially — TPU
+chips don't share well; parallelize across hosts by splitting the grid).
+Prints one row per combo sorted by the monitored metric and exits 0 iff
+every run succeeded.
+"""
+from __future__ import annotations
+
+import argparse
+import itertools
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parents[1]
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description="train.py grid sweep")
+    ap.add_argument("-c", "--config", required=True)
+    ap.add_argument("--grid", required=True,
+                    help="JSON object: keychain -> list of values")
+    ap.add_argument("--save-dir", required=True,
+                    help="sweep root; each combo trains into run<i>/")
+    args, rest = ap.parse_known_args()
+    args.rest = rest  # everything unrecognized passes through to train.py
+
+    grid = json.loads(args.grid)
+    if not isinstance(grid, dict) or not grid:
+        raise SystemExit("--grid must be a non-empty JSON object")
+    keys = list(grid)
+    combos = list(itertools.product(*(grid[k] for k in keys)))
+    print(f"[sweep] {len(combos)} combos over {keys}", file=sys.stderr)
+
+    rows, failed = [], 0
+    for i, values in enumerate(combos):
+        run_dir = Path(args.save_dir) / f"run{i}"
+        cmd = [sys.executable, str(REPO / "train.py"),
+               "-c", args.config, "-s", str(run_dir)]
+        for k, v in zip(keys, values):
+            cmd += ["--set", k, json.dumps(v)]
+        cmd += args.rest
+        print(f"[sweep] run{i}: " + " ".join(map(str, cmd)), file=sys.stderr)
+        # keep OUR stdout pure JSON: the child's output goes to stderr
+        proc = subprocess.run(cmd, cwd=REPO, stdout=sys.stderr.fileno(),
+                              stderr=subprocess.STDOUT)
+        summaries = sorted(run_dir.glob("*/train/*/summary.json"))
+        if proc.returncode != 0 or not summaries:
+            failed += 1
+            rows.append({"run": f"run{i}", "status": "FAILED",
+                         **dict(zip(keys, values))})
+            continue
+        summary = json.loads(summaries[-1].read_text())
+        rows.append({"run": f"run{i}", "status": "ok",
+                     **dict(zip(keys, values)),
+                     "monitor_best": summary.get("monitor_best"),
+                     "epoch": summary.get("epoch"),
+                     "run_dir": summary.get("run_dir")})
+
+    monitor_mode = "min"
+    ok_rows = [r for r in rows if r["status"] == "ok"
+               and r.get("monitor_best") is not None]
+    if ok_rows:
+        # summary.json records "min val_loss"-style monitor strings
+        first = json.loads(
+            Path(ok_rows[0]["run_dir"], "summary.json").read_text()
+        )
+        monitor_mode = str(first.get("monitor", "min")).split()[0]
+        ok_rows.sort(key=lambda r: r["monitor_best"],
+                     reverse=(monitor_mode == "max"))
+    print(json.dumps(
+        {"monitor_mode": monitor_mode, "results": rows,
+         "best": ok_rows[0] if ok_rows else None},
+        indent=2,
+    ))
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
