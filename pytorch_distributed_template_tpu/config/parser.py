@@ -7,7 +7,8 @@ TPU-native re-design of the reference's ``parse_config.py``
   ``{"type": ..., "args": {...}}`` blocks (parse_config.py:79-107) — here
   resolved through registries (see ``config/registry.py``).
 - CLI flags address nested keys with ``;``-separated keychains
-  (parse_config.py:134-156); ``None``-valued modifications are skipped.
+  (parse_config.py:134-156); unset CLI flags are skipped via a sentinel,
+  so an explicit ``--set key null`` override really nulls the key.
 - ``-r`` resume rediscovers the config next to the checkpoint
   (parse_config.py:59-66); passing ``-c`` too overlays the new config's
   top-level keys for fine-tuning (parse_config.py:69-71); ``-s`` overrides
@@ -137,9 +138,12 @@ class ConfigParser:
         if getattr(args, "save_dir", None) is not None:
             config["trainer"]["save_dir"] = args.save_dir
 
-        modification = {
-            opt.target: getattr(args, _get_opt_name(opt.flags)) for opt in options
-        }
+        # Unset argparse flags arrive as None and must be skipped; explicit
+        # ``--set key null`` must APPLY None. Distinguish via _UNSET.
+        modification = {}
+        for opt in options:
+            val = getattr(args, _get_opt_name(opt.flags))
+            modification[opt.target] = _UNSET if val is None else val
         for chain, raw in (getattr(args, "set", None) or ()):
             modification[chain] = _parse_cli_value(raw)
         return args, cls(config, resume, modification, training=training)
@@ -249,11 +253,16 @@ def _resume_config_path(resume: Path) -> Path:
     return resume.parent / "config.json"  # let read_json raise the clear error
 
 
+_UNSET = object()  # unset CLI flag; distinct from an explicit null override
+
+
 def _update_config(config, modification):
     if modification is None:
         return config
     for k, v in modification.items():
-        if v is not None:
+        # Skip only flags never given on the CLI; an explicit None (e.g.
+        # ``--set key null``) is a real override and applies.
+        if v is not _UNSET:
             _set_by_path(config, k, v)
     return config
 

@@ -106,21 +106,26 @@ def sgd(lr=0.1, momentum=0.0, weight_decay=0.0, nesterov=False,
 
 
 @OPTIMIZERS.register("RMSprop")
-def rmsprop(lr=1e-2, alpha=0.99, eps=1e-8, momentum=0.0, learning_rate=None):
-    return optax.rmsprop(_lr(lr, learning_rate), decay=alpha, eps=eps,
+def rmsprop(lr=1e-2, alpha=0.99, eps=1e-8, momentum=0.0, weight_decay=0.0,
+            learning_rate=None, weight_decay_exclude=None):
+    base = optax.rmsprop(_lr(lr, learning_rate), decay=alpha, eps=eps,
                          momentum=momentum or None)
+    return _decayed(weight_decay, base, weight_decay_exclude)
 
 
 @OPTIMIZERS.register("Adagrad")
-def adagrad(lr=1e-2, eps=1e-10, learning_rate=None):
-    return optax.adagrad(_lr(lr, learning_rate), eps=eps)
+def adagrad(lr=1e-2, eps=1e-10, weight_decay=0.0, learning_rate=None,
+            weight_decay_exclude=None):
+    base = optax.adagrad(_lr(lr, learning_rate), eps=eps)
+    return _decayed(weight_decay, base, weight_decay_exclude)
 
 
 @OPTIMIZERS.register("Adadelta")
 def adadelta(lr=1.0, rho=0.9, eps=1e-6, weight_decay=0.0,
-             learning_rate=None):
+             learning_rate=None, weight_decay_exclude=None):
     return optax.adadelta(_lr(lr, learning_rate), rho=rho, eps=eps,
-                          weight_decay=weight_decay)
+                          weight_decay=weight_decay,
+                          weight_decay_mask=_decay_mask(weight_decay_exclude))
 
 
 @OPTIMIZERS.register("Adamax")
@@ -148,13 +153,15 @@ def radam(lr=1e-3, betas=(0.9, 0.999), eps=1e-8, weight_decay=0.0,
 
 
 @OPTIMIZERS.register("Adafactor")
-def adafactor(lr=None, weight_decay=0.0, learning_rate=None):
+def adafactor(lr=None, weight_decay=0.0, learning_rate=None,
+              weight_decay_exclude=None):
     """Factored second-moment Adam (Shazeer & Stern 2018) — the T5/TPU
     recipe: O(n+m) optimizer memory per [n, m] matrix instead of Adam's
     O(n*m). Not in torch.optim; first-class here because optimizer HBM is
     a real TPU ceiling at LM scale."""
     return optax.adafactor(_lr(lr, learning_rate),
-                           weight_decay_rate=weight_decay or None)
+                           weight_decay_rate=weight_decay or None,
+                           weight_decay_mask=_decay_mask(weight_decay_exclude))
 
 
 # --- large-batch optimizers (beyond the reference: the TPU data-parallel

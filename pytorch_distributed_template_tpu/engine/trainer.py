@@ -88,7 +88,9 @@ class BaseTrainer:
             assert self.mnt_mode in ("min", "max")
             self.mnt_best = math.inf if self.mnt_mode == "min" else -math.inf
             self.early_stop = cfg_trainer.get("early_stop", math.inf)
-            if self.early_stop <= 0:
+            # None (e.g. ``--set "trainer;early_stop" null``) or <=0 both
+            # mean "never stop early".
+            if self.early_stop is None or self.early_stop <= 0:
                 self.early_stop = math.inf
 
         self.start_epoch = 1
@@ -203,8 +205,12 @@ class BaseTrainer:
                    for k, v in log.items()},
                 "monitor": f"{self.mnt_mode} {self.mnt_metric}"
                            if self.mnt_mode != "off" else "off",
+                # +/-inf means "no epoch ever improved" (e.g. NaN metrics);
+                # json.dumps would emit non-standard Infinity, so map to None.
                 "monitor_best": (
-                    float(self.mnt_best) if self.mnt_mode != "off" else None
+                    float(self.mnt_best)
+                    if self.mnt_mode != "off" and math.isfinite(self.mnt_best)
+                    else None
                 ),
                 "run_dir": str(self.config.save_dir),
             }

@@ -137,10 +137,18 @@ def import_hf_llama(hf_state_dict, n_layer: int) -> dict:
             "would silently produce wrong logits"
         )
 
+    # Tied-embedding checkpoints (e.g. Llama-3.2-1B) omit lm_head.weight
+    # entirely — HF materializes the head from embed_tokens at load time.
+    emb = g("embed_tokens.weight")
+    if "lm_head.weight" in sd:
+        head = g("lm_head.weight", transpose=True)
+    else:
+        head = emb.T.copy()
+
     params = {
-        "embed_tokens": {"embedding": g("embed_tokens.weight")},
+        "embed_tokens": {"embedding": emb},
         "norm": {"weight": g("norm.weight")},
-        "lm_head": {"kernel": g("lm_head.weight", transpose=True)},
+        "lm_head": {"kernel": head},
     }
     for i in range(n_layer):
         p = f"layers.{i}."

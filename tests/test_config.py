@@ -35,10 +35,16 @@ def test_keychain_override(tmp_path):
     assert out["name"] == "Renamed"
 
 
-def test_keychain_none_skipped(tmp_path):
+def test_keychain_unset_skipped_none_applies(tmp_path):
+    """Unset CLI flags (the _UNSET sentinel) are skipped; an explicit None
+    (``--set key null``) is a real override and applies."""
+    from pytorch_distributed_template_tpu.config.parser import _UNSET
+
     cfg = minimal_config(tmp_path)
-    out = _update_config(cfg, {"arch;args;width": None})
+    out = _update_config(cfg, {"arch;args;width": _UNSET})
     assert out["arch"]["args"]["width"] == 4
+    out = _update_config(cfg, {"arch;args;width": None})
+    assert out["arch"]["args"]["width"] is None
 
 
 def test_set_by_path_nested():
@@ -170,6 +176,34 @@ def test_from_args_config(tmp_path):
     finally:
         sys.argv = argv
     assert parser["arch"]["args"]["width"] == 32
+
+
+def test_set_null_applies_and_unset_flag_skipped(tmp_path):
+    """``--set key null`` must really null the key (explicit override),
+    while a custom flag the user never passed must NOT clobber the config
+    value with None."""
+    cfg = minimal_config(tmp_path)
+    cfg["arch"]["args"]["width"] = 16
+    cfg["trainer"]["early_stop"] = 5
+    cfg_file = tmp_path / "c.json"
+    cfg_file.write_text(json.dumps(cfg))
+    ap = argparse.ArgumentParser()
+    ap.add_argument("-c", "--config", default=None)
+    ap.add_argument("-r", "--resume", default=None)
+    ap.add_argument("-s", "--save_dir", default=None)
+    CustomArgs = collections.namedtuple("CustomArgs", "flags type target")
+    options = [CustomArgs(["--width"], type=int, target="arch;args;width")]
+    import sys
+
+    argv = sys.argv
+    sys.argv = ["prog", "-c", str(cfg_file),
+                "--set", "trainer;early_stop", "null"]
+    try:
+        args, parser = ConfigParser.from_args(ap, options)
+    finally:
+        sys.argv = argv
+    assert parser["trainer"]["early_stop"] is None   # explicit null applied
+    assert parser["arch"]["args"]["width"] == 16     # unset flag skipped
 
 
 def test_from_args_resume_rediscovery_and_finetune_overlay(tmp_path):

@@ -80,6 +80,29 @@ def test_summary_json_written(trained):
     assert summary["run_dir"] == str(config.save_dir)
 
 
+def test_summary_nonfinite_monitor_best_is_null(tmp_path):
+    """When no epoch ever improved, mnt_best stays +/-inf; summary.json
+    must map it to null (json.dumps would otherwise emit non-standard
+    'Infinity', breaking strict JSON consumers like the sweep tooling)."""
+    import logging
+    import math
+
+    from pytorch_distributed_template_tpu.engine.trainer import BaseTrainer
+
+    t = object.__new__(BaseTrainer)
+    t.mnt_mode, t.mnt_metric = "min", "val_loss"
+    t.mnt_best = math.inf
+
+    class _Cfg:
+        save_dir = tmp_path
+
+    t.config = _Cfg()
+    t.logger = logging.getLogger("test_summary")
+    t._write_summary({"epoch": 1, "loss": 1.0})
+    data = json.loads((tmp_path / "summary.json").read_text())
+    assert data["monitor_best"] is None
+
+
 def test_checkpoints_written(trained):
     _, config, _, _ = trained
     d = config.save_dir

@@ -181,6 +181,42 @@ def test_hf_llama_import_logit_parity():
     np.testing.assert_allclose(ours, ref, atol=1e-4, rtol=1e-4)
 
 
+def test_hf_llama_import_tied_embeddings():
+    """Tied-embedding HF checkpoints (Llama-3.2 style) omit lm_head.weight
+    — the importer must fall back to embed_tokens, matching HF's own
+    tie-materialization, and still hit logit parity."""
+    torch = pytest.importorskip("torch")
+    transformers = pytest.importorskip("transformers")
+    from pytorch_distributed_template_tpu.models.hf_import import (
+        import_hf_llama,
+    )
+
+    torch.manual_seed(1)
+    cfg = transformers.LlamaConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=176,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, rms_norm_eps=1e-6, rope_theta=10000.0,
+        attention_bias=False, tie_word_embeddings=True,
+    )
+    hf = transformers.LlamaForCausalLM(cfg).eval()
+    # Tied checkpoints on disk (safetensors) omit lm_head.weight; some
+    # transformers versions still materialize it in state_dict(), so drop
+    # it explicitly to exercise the fallback.
+    sd = {k: v for k, v in hf.state_dict().items()
+          if k != "lm_head.weight"}
+    params = import_hf_llama(sd, n_layer=2)
+    m = MODELS.get("Llama")(vocab_size=128, n_layer=2, n_head=4,
+                            n_kv_head=2, d_model=64, d_ff=176, max_len=64)
+    ids = np.random.default_rng(2).integers(0, 128, (2, 12))
+    with torch.no_grad():
+        ref = hf(torch.tensor(ids)).logits.numpy()
+    ours = np.asarray(
+        m.apply({"params": params}, jnp.asarray(ids, jnp.int32),
+                train=False)
+    )
+    np.testing.assert_allclose(ours, ref, atol=1e-4, rtol=1e-4)
+
+
 class TestSlidingWindow:
     """Mistral-style banded attention: query t sees keys (t-window, t]."""
 

@@ -326,6 +326,10 @@ def test_lr_scale_scales_update():
     ("SGD", {"lr": 0.1, "weight_decay": 0.5}),
     ("LAMB", {"lr": 0.1, "weight_decay": 0.5}),
     ("Lion", {"lr": 0.1, "weight_decay": 0.5}),
+    ("RMSprop", {"lr": 0.1, "weight_decay": 0.5}),
+    ("Adagrad", {"lr": 0.1, "weight_decay": 0.5}),
+    ("Adadelta", {"lr": 1.0, "weight_decay": 0.5}),
+    ("Adafactor", {"lr": 0.1, "weight_decay": 0.5}),
 ])
 def test_weight_decay_exclude(name, kwargs):
     """weight_decay_exclude exempts matching param paths from decay: with
