@@ -34,6 +34,17 @@ from ..parallel import dist
 logger = logging.getLogger(__name__)
 
 
+def _json_safe_best(monitor_best) -> Optional[float]:
+    """Sidecar value for ``monitor_best``: a never-improved +/-inf maps to
+    None (json.dumps would emit non-standard ``Infinity``), and restore()
+    treats None as "keep the fresh +/-inf" — which is also the correct
+    resume semantic."""
+    import math
+
+    v = float(monitor_best)
+    return v if math.isfinite(v) else None
+
+
 class CheckpointManager:
     def __init__(self, checkpoint_dir):
         self.checkpoint_dir = Path(checkpoint_dir)
@@ -44,6 +55,10 @@ class CheckpointManager:
         # per-path cache of the on-disk tree metadata (restore probes it for
         # several optional keys; on remote storage each fetch is a roundtrip)
         self._tree_cache: dict = {}
+        # mid-epoch interval saves: two slots, each with its own async
+        # checkpointer, allocated on first use (see save_interval)
+        self._interval_ckptrs = None
+        self._interval_idx = 0
 
     # -- save ---------------------------------------------------------------
 
@@ -60,7 +75,7 @@ class CheckpointManager:
         meta = {
             "arch": arch,
             "epoch": epoch,
-            "monitor_best": float(monitor_best),
+            "monitor_best": _json_safe_best(monitor_best),
             "config": config,
         }
         self._ckptr.save(path, _saveable(state), force=True)
@@ -86,8 +101,49 @@ class CheckpointManager:
             logger.info("Saving current best: model_best ...")
         return path
 
+    def save_interval(self, epoch: int, step: int, state, arch: str,
+                      config: dict, monitor_best: float) -> Path:
+        """Mid-epoch async save into alternating ``checkpoint-interval-a`` /
+        ``-b`` slots.
+
+        Each slot owns its own async checkpointer, so starting a new
+        interval save never blocks on the previous one (still flushing to
+        the OTHER slot); it can only block when reusing a slot whose write
+        from two intervals ago hasn't finished. This keeps the step loop
+        hot where the old design (overwrite ``checkpoint-epoch{N}`` after a
+        blocking ``wait()``) serialized the async write into the epoch.
+        Two slots also mean a crash mid-write can never destroy the only
+        mid-epoch checkpoint — the other slot is always complete.
+        """
+        if self._interval_ckptrs is None:
+            self._interval_ckptrs = (ocp.StandardCheckpointer(),
+                                     ocp.StandardCheckpointer())
+        i = self._interval_idx
+        self._interval_idx = 1 - i
+        ck = self._interval_ckptrs[i]
+        ck.wait_until_finished()  # no-op unless this slot is still writing
+        path = self.checkpoint_dir / f"checkpoint-interval-{'ab'[i]}"
+        meta = {
+            "arch": arch,
+            "epoch": epoch,
+            "step": step,
+            "monitor_best": _json_safe_best(monitor_best),
+            "config": config,
+        }
+        ck.save(path, _saveable(state), force=True)
+        self._tree_cache.pop(str(path), None)
+        if dist.is_main_process():
+            (self.checkpoint_dir / f"{path.name}.meta.json").write_text(
+                json.dumps(meta, indent=2)
+            )
+        logger.info("Interval checkpoint: %s ...", path)
+        return path
+
     def wait(self) -> None:
         self._ckptr.wait_until_finished()
+        if self._interval_ckptrs is not None:
+            for ck in self._interval_ckptrs:
+                ck.wait_until_finished()
         self._inflight.clear()
 
     def prune(self, keep_last: int) -> None:

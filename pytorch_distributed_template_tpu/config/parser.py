@@ -233,6 +233,11 @@ def find_latest_checkpoint(config: dict):
                     candidates.append(
                         (ck.stat().st_mtime, int(m.group(1)), ck)
                     )
+            # mid-epoch A/B interval slots (epoch recorded in the sidecar;
+            # 0 here is just the mtime tiebreak)
+            for ck in run.glob("checkpoint-interval-[ab]"):
+                if ck.is_dir():
+                    candidates.append((ck.stat().st_mtime, 0, ck))
     if not candidates:
         return None
     return max(candidates)[2]

@@ -20,7 +20,7 @@ from ..parallel import batch_sharding, dist, mesh_from_config
 from .losses import resolve_loss
 from .optim import build_optimizer
 from .state import create_sharded_train_state
-from .steps import finalize_metrics, make_eval_step
+from .steps import _accepts_example_mask, finalize_metrics, make_eval_step
 
 
 def _build_test_loader(config):
@@ -67,8 +67,59 @@ def restore_template_state(config, model, mesh, template=None):
     return state, ema_decay
 
 
-def evaluate(config, mesh=None) -> dict:
-    """Evaluate ``config.resume`` on the config's ``test_loader``."""
+def _make_output_step(model, input_key: str, use_ema: bool):
+    """Jitted raw-output forward for ``--save-outputs``: returns the
+    model's per-example outputs (logits), materializing them even for
+    ``fused_head`` models (the dump is opt-in, so the [B, T, V] cost is
+    accepted)."""
+    pass_example_mask = _accepts_example_mask(model)
+
+    def output_step(state, batch):
+        params = (
+            state.ema_params
+            if use_ema and state.ema_params is not None
+            else state.params
+        )
+        variables = {"params": params}
+        if state.batch_stats:
+            variables["batch_stats"] = state.batch_stats
+        extra = (
+            {"example_mask": batch["mask"]} if pass_example_mask else {}
+        )
+        out = model.apply(variables, batch[input_key], train=False, **extra)
+        if isinstance(out, tuple):  # fused_head: (hidden [B,T,D], w [D,V])
+            hidden, w = out
+            out = hidden @ w
+        return out.astype(jnp.float32)
+
+    return output_step
+
+
+def _host_local_rows(arr) -> np.ndarray:
+    """Rows of a batch-sharded global array that live on THIS host, in
+    batch order, deduplicating replicated shards (e.g. over a tensor
+    axis). The per-host analogue of the reference's gather-to-rank-0
+    (test.py:87-95) — over DCN each host dumps its own rows instead of
+    pickling activations across the network."""
+    by_start = {}
+    for s in arr.addressable_shards:
+        start = s.index[0].start or 0
+        if start not in by_start:
+            by_start[start] = np.asarray(s.data)
+    return np.concatenate(
+        [by_start[k] for k in sorted(by_start)], axis=0
+    )
+
+
+def evaluate(config, mesh=None, save_outputs=None) -> dict:
+    """Evaluate ``config.resume`` on the config's ``test_loader``.
+
+    ``save_outputs``: optional directory; when set, every host writes its
+    per-example model outputs/targets (pad-filtered, eval order) as
+    ``outputs_p{K}.npy`` / ``targets_p{K}.npy`` for post-hoc analysis —
+    the capability the reference exposes by gathering raw predictions
+    (reference test.py:87-95, base_trainer.py:176-181).
+    """
     logger = config.get_logger("test")
     assert config.resume is not None, "evaluation requires a checkpoint (-r)"
 
@@ -96,10 +147,39 @@ def evaluate(config, mesh=None) -> dict:
         )
     )
 
+    output_step = None
+    if save_outputs is not None:
+        output_step = jax.jit(
+            _make_output_step(
+                model, input_key,
+                use_ema=ema_decay > 0
+                and bool(config["trainer"].get("eval_with_ema", True)),
+            )
+        )
+        dumped_out, dumped_tgt = [], []
+
     accum = None
     for batch in prefetch_to_device(test_loader, batch_sharding(mesh)):
         m = eval_step(state, batch)
         accum = m if accum is None else jax.tree.map(jnp.add, accum, m)
+        if output_step is not None:
+            out = _host_local_rows(output_step(state, batch))
+            tgt = _host_local_rows(batch[target_key])
+            keep = _host_local_rows(batch["mask"]).astype(bool)
+            dumped_out.append(out[keep])
+            dumped_tgt.append(tgt[keep])
+
+    if output_step is not None:
+        from pathlib import Path
+
+        out_dir = Path(save_outputs)
+        out_dir.mkdir(parents=True, exist_ok=True)
+        p = dist.process_index()
+        np.save(out_dir / f"outputs_p{p}.npy",
+                np.concatenate(dumped_out) if dumped_out else np.zeros((0,)))
+        np.save(out_dir / f"targets_p{p}.npy",
+                np.concatenate(dumped_tgt) if dumped_tgt else np.zeros((0,)))
+        logger.info("saved per-example outputs to %s", out_dir)
 
     n_samples = int(accum["count"]) if accum else 0
     result = finalize_metrics(jax.tree.map(float, accum)) if accum else {}

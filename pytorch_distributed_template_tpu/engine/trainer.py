@@ -70,8 +70,8 @@ class BaseTrainer:
         self.epochs = cfg_trainer["epochs"]
         self.save_period = cfg_trainer.get("save_period", 1)
         # mid-epoch safety net for long epochs (0 = off): every N batches
-        # the CURRENT epoch's periodic checkpoint is overwritten in place,
-        # so a crash loses at most N steps instead of the whole epoch.
+        # an async save lands in the alternating checkpoint-interval-a/b
+        # slots (manager.save_interval), so a crash loses at most N steps.
         # Deterministic host-side condition -> every host saves together
         # (orbax saves are collective). Same partial-epoch resume semantics
         # as preemption: resume continues at the next epoch.
@@ -472,9 +472,17 @@ class Trainer(BaseTrainer):
 
             if (self.save_interval_steps
                     and (batch_idx + 1) % self.save_interval_steps == 0):
-                # serialize with any in-flight async save of the same path
-                self.ckpt_manager.wait()
-                self._save_checkpoint(epoch, save_best=False)
+                # A/B-slot async save: the step loop continues while the
+                # write flushes in the background (no wait() here)
+                self.ckpt_manager.save_interval(
+                    epoch=epoch, step=batch_idx + 1, state=self.state,
+                    arch=type(self.model).__name__,
+                    config=dict(self.config.config),
+                    monitor_best=(
+                        self.mnt_best
+                        if isinstance(self.mnt_best, (int, float)) else 0.0
+                    ),
+                )
                 if main:
                     self.logger.info(
                         "Interval checkpoint at epoch %d batch %d.",

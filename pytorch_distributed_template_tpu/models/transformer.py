@@ -315,9 +315,15 @@ class TransformerLM(nn.Module):
             # full [B, T, V] logits tensor never materializes — at large
             # vocab it dominates peak HBM. Decode still produces logits
             # (generation needs them token-by-token, where V is cheap).
-            if not self.tie_embeddings:
-                raise ValueError("fused_head requires tie_embeddings=True")
-            w = embed.embedding.T.astype(self.dtype)     # [D, V]
+            if self.tie_embeddings:
+                w = embed.embedding.T.astype(self.dtype)  # [D, V]
+            else:
+                # Same param path as the Dense below ("lm_head/kernel") so
+                # fused and plain modes share checkpoints.
+                from .llama import _HeadKernel
+
+                w = _HeadKernel(self.d_model, self.vocab_size,
+                                name="lm_head")().astype(self.dtype)
             return x.astype(self.dtype), w
         if self.tie_embeddings:
             logits = embed.attend(x.astype(self.dtype))
@@ -387,7 +393,7 @@ def tiny_lm(vocab_size: int = 256, n_layer: int = 2, n_head: int = 4,
             d_model: int = 64, max_len: int = 128, dropout: float = 0.0,
             attn_impl: str = "xla", remat: bool = False, mesh=None,
             bfloat16: bool = False, seq_layout: str = "natural",
-            fused_head: bool = False):
+            fused_head: bool = False, tie_embeddings: bool = True):
     """Small config for tests and the multi-chip dry run."""
     return TransformerLM(
         vocab_size=vocab_size, n_layer=n_layer, n_head=n_head,
@@ -395,4 +401,5 @@ def tiny_lm(vocab_size: int = 256, n_layer: int = 2, n_head: int = 4,
         dtype=jnp.bfloat16 if bfloat16 else jnp.float32,
         attn_impl=attn_impl, remat=remat, mesh=mesh,
         seq_layout=seq_layout, fused_head=fused_head,
+        tie_embeddings=tie_embeddings,
     )

@@ -29,5 +29,6 @@ def setup_logging(save_dir, log_config=DEFAULT_CONFIG,
                 handler["filename"] = str(Path(save_dir) / handler["filename"])
         logging.config.dictConfig(config)
     else:
-        print(f"Warning: logging configuration file is not found in {log_config}.")
+        print(f"logging config {log_config} missing; "
+              "falling back to basicConfig")
         logging.basicConfig(level=default_level)

@@ -1,88 +1,122 @@
-"""Duck-typed TensorBoard writer with graceful degradation.
+"""TensorBoard facade: explicit methods, graceful degradation.
 
-Parity with /root/reference/logger/visualization.py: tries real TensorBoard
-backends in order, no-ops cleanly when disabled or missing, auto-tags scalars
-as ``tag/mode`` for train/valid separation, and emits a ``steps_per_sec``
-throughput scalar from wall-clock deltas in ``set_step``
-(visualization.py:40-48).
-
-Fixed vs reference: non-TB attribute access raised ``TypeError`` there
-(``object.__getattr__(name)`` wrong arity, visualization.py:70); here it
-raises a proper ``AttributeError``.
+Covers the role of /root/reference/logger/visualization.py (mode-suffixed
+scalar tags for train/valid separation, a ``steps_per_sec`` scalar derived
+from ``set_step`` wall-clock deltas, silent no-op when TB is disabled or
+not installed) with an explicit-method design rather than a duck-typed
+``__getattr__`` wrapper: every supported ``add_*`` is a real method, so
+typos raise immediately, signatures are inspectable, and ``add_embedding``
+takes TensorBoard's actual argument order (the wrapper design forced
+``(tag, data)`` first, which does not match ``SummaryWriter.add_embedding``).
 """
 from __future__ import annotations
 
 import importlib
-from datetime import datetime
+import time
 
 
 class TensorboardWriter:
-    TB_MODULES = ["torch.utils.tensorboard", "tensorboardX"]
+    """Rank-0 metrics sink. All methods no-op when ``enabled`` is False or
+    no TB backend imports, so call sites never need to guard."""
 
-    TB_WRITER_FTNS = {
-        "add_scalar", "add_scalars", "add_image", "add_images", "add_audio",
-        "add_text", "add_histogram", "add_pr_curve", "add_embedding",
-    }
-    TAG_MODE_EXCEPTIONS = {"add_histogram", "add_embedding"}
+    TB_MODULES = ("torch.utils.tensorboard", "tensorboardX")
 
     def __init__(self, log_dir, logger, enabled: bool):
         self.writer = None
         self.selected_module = ""
-
         if enabled:
-            log_dir = str(log_dir)
-            succeeded = False
             for module in self.TB_MODULES:
                 try:
-                    self.writer = importlib.import_module(module).SummaryWriter(log_dir)
+                    self.writer = importlib.import_module(
+                        module
+                    ).SummaryWriter(str(log_dir))
                     self.selected_module = module
-                    succeeded = True
                     break
                 except ImportError:
-                    succeeded = False
-
-            if not succeeded:
+                    continue
+            if self.writer is None:
                 logger.warning(
-                    "Warning: visualization (Tensorboard) is configured to use, "
-                    "but currently not installed on this machine. Please install "
-                    "TensorBoard (tensorboard or tensorboardX) to use it, or turn "
-                    "off the option in the config file (trainer.tensorboard)."
+                    "trainer.tensorboard is enabled but no backend could "
+                    "be imported (tried %s); metrics will not be recorded. "
+                    "Install tensorboard/tensorboardX or set "
+                    "trainer.tensorboard to false.",
+                    ", ".join(self.TB_MODULES),
                 )
-
         self.step = 0
         self.mode = ""
-        self.timer = datetime.now()
+        self._last_step_time = time.monotonic()
 
-    def set_step(self, step, mode="train") -> None:
+    def set_step(self, step: int, mode: str = "train") -> None:
+        """Advance the global step; non-zero steps also record the
+        wall-clock-derived ``steps_per_sec`` scalar."""
         self.mode = mode
         self.step = step
+        now = time.monotonic()
         if step == 0:
-            self.timer = datetime.now()
+            self._last_step_time = now
         else:
-            duration = datetime.now() - self.timer
-            self.add_scalar("steps_per_sec", 1 / max(duration.total_seconds(), 1e-12))
-            self.timer = datetime.now()
+            self.add_scalar(
+                "steps_per_sec", 1.0 / max(now - self._last_step_time, 1e-12)
+            )
+            self._last_step_time = now
 
-    def __getattr__(self, name):
-        """Return a wrapped TB method (tagging ``tag/mode``), a no-op when TB
-        is disabled, or raise AttributeError for unknown names."""
-        if name in self.TB_WRITER_FTNS:
-            add_data = getattr(self.writer, name, None)
+    def _emit(self, method: str, tag: str, *args, mode_tag: bool = True,
+              **kwargs):
+        if self.writer is None:
+            return
+        fn = getattr(self.writer, method, None)
+        if fn is None:  # backend lacks this method (old tensorboardX etc.)
+            return
+        if mode_tag and self.mode:
+            tag = f"{tag}/{self.mode}"
+        # global_step always as a keyword: its positional slot differs
+        # across TB methods.
+        kwargs.setdefault("global_step", self.step)
+        fn(tag, *args, **kwargs)
 
-            def wrapper(tag, data, *args, **kwargs):
-                if add_data is not None:
-                    if name not in self.TAG_MODE_EXCEPTIONS and self.mode:
-                        tag = f"{tag}/{self.mode}"
-                    # global_step as a keyword: its positional slot differs
-                    # across TB methods (the reference passed it positionally
-                    # and corrupted add_pr_curve/add_embedding arguments).
-                    kwargs.setdefault("global_step", self.step)
-                    add_data(tag, data, *args, **kwargs)
+    # -- scalars / text ---------------------------------------------------
+    def add_scalar(self, tag, value, **kwargs):
+        self._emit("add_scalar", tag, value, **kwargs)
 
-            return wrapper
-        # Pass through other real writer attributes (e.g. flush, close).
-        if self.writer is not None and hasattr(self.writer, name):
-            return getattr(self.writer, name)
-        if name in ("flush", "close"):
-            return lambda *a, **k: None
-        raise AttributeError(f"type object '{type(self).__name__}' has no attribute '{name}'")
+    def add_scalars(self, tag, value_dict, **kwargs):
+        self._emit("add_scalars", tag, value_dict, **kwargs)
+
+    def add_text(self, tag, text, **kwargs):
+        self._emit("add_text", tag, text, **kwargs)
+
+    # -- media ------------------------------------------------------------
+    def add_image(self, tag, img, **kwargs):
+        self._emit("add_image", tag, img, **kwargs)
+
+    def add_images(self, tag, imgs, **kwargs):
+        self._emit("add_images", tag, imgs, **kwargs)
+
+    def add_audio(self, tag, snd, **kwargs):
+        self._emit("add_audio", tag, snd, **kwargs)
+
+    # -- distributions (tags stay global: the same weights are logged from
+    # train and valid phases and must land in one chart) -------------------
+    def add_histogram(self, tag, values, **kwargs):
+        self._emit("add_histogram", tag, values, mode_tag=False, **kwargs)
+
+    def add_pr_curve(self, tag, labels, predictions, **kwargs):
+        self._emit("add_pr_curve", tag, labels, predictions, **kwargs)
+
+    def add_embedding(self, mat, metadata=None, label_img=None,
+                      tag="default", **kwargs):
+        if self.writer is None:
+            return
+        fn = getattr(self.writer, "add_embedding", None)
+        if fn is None:
+            return
+        kwargs.setdefault("global_step", self.step)
+        fn(mat, metadata=metadata, label_img=label_img, tag=tag, **kwargs)
+
+    # -- lifecycle --------------------------------------------------------
+    def flush(self):
+        if self.writer is not None:
+            self.writer.flush()
+
+    def close(self):
+        if self.writer is not None:
+            self.writer.close()

@@ -24,7 +24,7 @@ from pytorch_distributed_template_tpu.parallel import dist
 
 def main(args, config):
     dist.initialize()
-    evaluate(config)
+    evaluate(config, save_outputs=args.save_outputs)
 
 
 if __name__ == "__main__":
@@ -37,6 +37,10 @@ if __name__ == "__main__":
                         help="accepted for launcher compatibility; unused")
     parser.add_argument("-s", "--save_dir", default=None, type=str)
     parser.add_argument("--seed", type=int, default=None)
+    parser.add_argument("--save-outputs", default=None, type=str,
+                        metavar="DIR",
+                        help="dump per-example outputs/targets (npy) here "
+                             "in addition to metrics")
 
     args, config = ConfigParser.from_args(parser, (), training=False)
     main(args, config)

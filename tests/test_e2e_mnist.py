@@ -80,6 +80,33 @@ def test_summary_json_written(trained):
     assert summary["run_dir"] == str(config.save_dir)
 
 
+def test_save_outputs_cli(trained):
+    """test.py --save-outputs dumps per-example logits/targets that read
+    back consistently: one row per (pad-filtered) example, class axis
+    matching the model, and argmax accuracy in line with the trained
+    model's quality (the reference exposes this via its rank-0 gather of
+    raw predictions, test.py:87-95)."""
+    import subprocess
+    import sys
+
+    _, config, _, _ = trained
+    ckpt = config.save_dir / "model_best"
+    out_dir = config.save_dir / "dump"
+    repo = Path(__file__).parent.parent
+    r = subprocess.run(
+        [sys.executable, str(repo / "test.py"), "-r", str(ckpt),
+         "--save-outputs", str(out_dir)],
+        capture_output=True, text=True, timeout=600, cwd=repo,
+    )
+    assert r.returncode == 0, r.stdout[-2000:] + r.stderr[-2000:]
+    outs = np.load(out_dir / "outputs_p0.npy")
+    tgts = np.load(out_dir / "targets_p0.npy")
+    assert outs.shape[0] == tgts.shape[0] > 0
+    assert outs.ndim == 2 and outs.shape[1] == 10  # MNIST classes
+    acc = float((outs.argmax(1) == tgts).mean())
+    assert acc > 0.5  # model_best beats chance on the synthetic data
+
+
 def test_summary_nonfinite_monitor_best_is_null(tmp_path):
     """When no epoch ever improved, mnt_best stays +/-inf; summary.json
     must map it to null (json.dumps would otherwise emit non-standard

@@ -240,13 +240,17 @@ def test_resolve_loss_form_mismatch_errors():
 
 def test_save_interval_steps(tmp_path):
     """Mid-epoch interval checkpoints: with save_interval_steps=2 and 8
-    batches/epoch, the epoch's checkpoint exists (and is resumable) even
-    if the run dies before the epoch edge."""
+    batches/epoch, saves alternate between the A/B slots WITHOUT blocking
+    the step loop (no manager-level wait() inside the epoch), and the
+    newest slot is resumable even if the run dies before an epoch edge."""
     import json as _json
     from pathlib import Path
 
     from pytorch_distributed_template_tpu.config import (
         ConfigParser, LOADERS, LOSSES, METRICS, MODELS,
+    )
+    from pytorch_distributed_template_tpu.config.parser import (
+        find_latest_checkpoint,
     )
     from pytorch_distributed_template_tpu.engine import Trainer
     from pytorch_distributed_template_tpu.parallel import mesh_from_config
@@ -267,10 +271,40 @@ def test_save_interval_steps(tmp_path):
         train_loader=config.init_obj("train_loader", LOADERS),
         valid_loader=None, mesh=mesh_from_config(config), seed=0,
     )
+    # The hot loop must never call the blocking manager-level wait();
+    # train() calls it exactly once, in the end-of-training finally.
+    waits = []
+    orig_wait = trainer.ckpt_manager.wait
+    trainer.ckpt_manager.wait = lambda: (waits.append(1), orig_wait())[1]
     trainer.train()
-    ck = config.save_dir / "checkpoint-epoch1"
-    assert ck.is_dir()  # written mid-epoch despite save_period never firing
-    meta = _json.loads(
-        (config.save_dir / "checkpoint-epoch1.meta.json").read_text()
+    assert len(waits) == 1
+
+    # 8 batches, interval 2 -> saves at steps 2,4,6,8 alternating a,b,a,b
+    meta_a = _json.loads(
+        (config.save_dir / "checkpoint-interval-a.meta.json").read_text()
     )
-    assert meta["epoch"] == 1
+    meta_b = _json.loads(
+        (config.save_dir / "checkpoint-interval-b.meta.json").read_text()
+    )
+    assert (config.save_dir / "checkpoint-interval-a").is_dir()
+    assert (config.save_dir / "checkpoint-interval-b").is_dir()
+    assert meta_a["epoch"] == meta_b["epoch"] == 1
+    assert {meta_a["step"], meta_b["step"]} == {6, 8}
+
+    # auto-resume rediscovery picks an interval slot (no epoch checkpoint
+    # exists: save_period never fired) and it restores cleanly
+    latest = find_latest_checkpoint(dict(config.config))
+    assert latest is not None and latest.name.startswith(
+        "checkpoint-interval-"
+    )
+    resumed = ConfigParser(
+        dict(config.config), resume=latest, run_id="interval2",
+        training=True,
+    )
+    t2 = Trainer(
+        config.init_obj("arch", MODELS), LOSSES.get(config["loss"]),
+        [METRICS.get(m) for m in config["metrics"]], config=resumed,
+        train_loader=config.init_obj("train_loader", LOADERS),
+        valid_loader=None, mesh=mesh_from_config(config), seed=0,
+    )
+    assert t2.start_epoch == 2  # meta epoch 1 + 1

@@ -566,13 +566,44 @@ class TestFusedHead:
             losses.append(float(m["loss_sum"]) / float(m["count"]))
         assert losses[-1] < losses[0] - 0.3, losses[::5]
 
-    def test_untied_rejected(self):
-        from pytorch_distributed_template_tpu.models.transformer import (
-            TransformerLM,
+    def test_untied_fused_matches_plain(self):
+        """Untied GPT-2 head: the fused path's _HeadKernel shares the
+        ``lm_head/kernel`` param path with the plain Dense, so the same
+        params give identical loss and grads through both routes."""
+        from pytorch_distributed_template_tpu.engine.losses import (
+            resolve_loss,
         )
 
-        bad = TransformerLM(vocab_size=64, n_layer=1, n_head=2, d_model=32,
-                            fused_head=True, tie_embeddings=False)
-        tokens = jnp.zeros((1, 8), jnp.int32)
-        with pytest.raises(ValueError):
-            bad.init(jax.random.key(0), tokens)
+        tokens = jnp.asarray(
+            np.random.default_rng(5).integers(0, 256, (2, 40)), jnp.int32
+        )
+        m_ref = MODELS.get("TinyLM")(tie_embeddings=False)
+        m_fused = MODELS.get("TinyLM")(tie_embeddings=False,
+                                       fused_head=True)
+        s = create_train_state(m_ref, optax.sgd(0.1), tokens, seed=0)
+        # same param tree: fused init must produce identical keys/shapes
+        fused_params = m_fused.init(jax.random.key(0), tokens)["params"]
+        assert (jax.tree.structure(fused_params)
+                == jax.tree.structure(s.params))
+
+        ce = LOSSES.get("lm_cross_entropy")
+        fce = resolve_loss(
+            {"type": "fused_lm_cross_entropy", "args": {"chunk": 16}}
+        )
+
+        def loss_ref(p):
+            return ce(
+                m_ref.apply({"params": p}, tokens, train=False), tokens
+            ).mean()
+
+        def loss_fused(p):
+            return fce(
+                m_fused.apply({"params": p}, tokens, train=False), tokens
+            ).mean()
+
+        l1, g1 = jax.value_and_grad(loss_ref)(s.params)
+        l2, g2 = jax.jit(jax.value_and_grad(loss_fused))(s.params)
+        assert abs(float(l1) - float(l2)) < 1e-5
+        for a, b in zip(jax.tree.leaves(g1), jax.tree.leaves(g2)):
+            np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                       atol=1e-5, rtol=1e-4)
