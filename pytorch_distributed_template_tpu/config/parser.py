@@ -213,34 +213,48 @@ class ConfigParser:
 def find_latest_checkpoint(config: dict):
     """Newest ``checkpoint-epochN`` across the experiment's train runs.
 
-    Scans ``<save_dir>/<name>/train/<run_id>/`` and picks the most
-    recently written checkpoint (directory mtime; epoch breaks ties).
-    Recency comes from mtime, NOT the run-id name — MMDD_HHMMSS ids carry
-    no year, so lexicographic order lies across a New Year boundary.
-    Returns None when the experiment has never checkpointed.
+    Two-level ranking. The RUN is chosen by recency (newest checkpoint
+    mtime in it) — NOT by the run-id name, since MMDD_HHMMSS ids carry no
+    year and lie across a New Year boundary, and NOT by epoch, since a
+    fresh retrain legitimately restarts epoch numbering. WITHIN the
+    chosen run, ``(epoch, completeness, mtime)`` ranks: an epoch-edge
+    checkpoint beats an interval slot of the same epoch (the slot holds
+    mid-epoch state, and async flush order can leave it with the newer
+    mtime), while an interval slot from a later, crashed epoch wins on
+    its epoch. Returns None when the experiment has never checkpointed.
     """
     import re
 
     base = (
         Path(config["trainer"]["save_dir"]) / config["name"] / "train"
     )
-    candidates = []
+    by_run: dict = {}  # run path -> [(epoch, completeness, mtime, path)]
     if base.is_dir():
         for run in base.iterdir():
+            cands = by_run.setdefault(run, [])
             for ck in run.glob("checkpoint-epoch*"):
                 m = re.match(r"checkpoint-epoch(\d+)$", ck.name)
                 if m and ck.is_dir():
-                    candidates.append(
-                        (ck.stat().st_mtime, int(m.group(1)), ck)
+                    cands.append(
+                        (int(m.group(1)), 1, ck.stat().st_mtime, ck)
                     )
-            # mid-epoch A/B interval slots (epoch recorded in the sidecar;
-            # 0 here is just the mtime tiebreak)
+            # mid-epoch A/B interval slots: epoch from the sidecar
             for ck in run.glob("checkpoint-interval-[ab]"):
-                if ck.is_dir():
-                    candidates.append((ck.stat().st_mtime, 0, ck))
-    if not candidates:
+                if not ck.is_dir():
+                    continue
+                epoch = 0
+                try:
+                    epoch = int(json.loads(
+                        (run / f"{ck.name}.meta.json").read_text()
+                    ).get("epoch", 0))
+                except (OSError, ValueError):
+                    pass  # sidecar lost: rank below any epoch checkpoint
+                cands.append((epoch, 0, ck.stat().st_mtime, ck))
+    runs = [c for c in by_run.values() if c]
+    if not runs:
         return None
-    return max(candidates)[2]
+    newest_run = max(runs, key=lambda cands: max(c[2] for c in cands))
+    return max(newest_run, key=lambda c: c[:3])[3]
 
 
 def _resume_config_path(resume: Path) -> Path:

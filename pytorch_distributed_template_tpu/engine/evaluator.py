@@ -67,12 +67,22 @@ def restore_template_state(config, model, mesh, template=None):
     return state, ema_decay
 
 
-def _make_output_step(model, input_key: str, use_ema: bool):
+def _make_output_step(model, input_key: str, use_ema: bool, mesh):
     """Jitted raw-output forward for ``--save-outputs``: returns the
     model's per-example outputs (logits), materializing them even for
-    ``fused_head`` models (the dump is opt-in, so the [B, T, V] cost is
-    accepted)."""
+    ``fused_head`` models. This is a second forward pass on top of
+    ``eval_step`` — accepted: the dump is opt-in, and keeping the metric
+    path's in-graph global reductions untouched beats threading a
+    [B, T, V] residual through it.
+
+    The result is sharding-constrained to batch-only (non-batch dims
+    replicated): under TP the head kernel is vocab-sharded, and without
+    the constraint each host's shards would cover only a V/tp column
+    slice of its rows."""
+    from ..parallel import batch_sharding
+
     pass_example_mask = _accepts_example_mask(model)
+    out_sharding = batch_sharding(mesh)
 
     def output_step(state, batch):
         params = (
@@ -90,7 +100,9 @@ def _make_output_step(model, input_key: str, use_ema: bool):
         if isinstance(out, tuple):  # fused_head: (hidden [B,T,D], w [D,V])
             hidden, w = out
             out = hidden @ w
-        return out.astype(jnp.float32)
+        return jax.lax.with_sharding_constraint(
+            out.astype(jnp.float32), out_sharding
+        )
 
     return output_step
 
@@ -103,6 +115,12 @@ def _host_local_rows(arr) -> np.ndarray:
     pickling activations across the network."""
     by_start = {}
     for s in arr.addressable_shards:
+        # batch-only sharding contract: every non-batch dim must be a full
+        # slice, else dedup-by-row-start would silently drop columns
+        assert all(
+            sl.start in (None, 0) and sl.stop in (None, n)
+            for sl, n in zip(s.index[1:], arr.shape[1:])
+        ), f"shard {s.index} is split along a non-batch axis"
         start = s.index[0].start or 0
         if start not in by_start:
             by_start[start] = np.asarray(s.data)
@@ -154,6 +172,7 @@ def evaluate(config, mesh=None, save_outputs=None) -> dict:
                 model, input_key,
                 use_ema=ema_decay > 0
                 and bool(config["trainer"].get("eval_with_ema", True)),
+                mesh=mesh,
             )
         )
         dumped_out, dumped_tgt = [], []

@@ -30,6 +30,38 @@ if str(REPO_ROOT) not in sys.path:
 
 import pytest  # noqa: E402
 
+# Speed tiers: `pytest -m "not slow"` is the <2 min smoke pass (unit-level
+# config/optim/data/dist/observability plus the torch-parity oracle);
+# the files below are marked slow wholesale (multi-epoch training,
+# subprocess CLIs, big compiles). Heavy outliers inside otherwise-fast
+# modules carry explicit @pytest.mark.slow instead.
+SLOW_FILES = {
+    "test_accum_ema.py",
+    "test_checkpoint_retention.py",
+    "test_e2e_mnist.py",
+    "test_generate.py",
+    "test_generate_cli.py",
+    "test_llama.py",
+    "test_models.py",
+    "test_moe.py",
+    "test_multihost.py",
+    "test_pipeline.py",
+    "test_transformer.py",
+}
+
+
+# Parametrized cases too heavy for the smoke tier (full-size model init).
+SLOW_PARAMS = {
+    "test_config_builds[imagenet_resnet50.json]",
+}
+
+
+def pytest_collection_modifyitems(config, items):
+    for item in items:
+        if (Path(str(item.fspath)).name in SLOW_FILES
+                or item.name in SLOW_PARAMS):
+            item.add_marker(pytest.mark.slow)
+
 
 @pytest.fixture()
 def tmp_run_dir(tmp_path):

@@ -304,3 +304,39 @@ def test_find_latest_checkpoint(tmp_path):
     found = find_latest_checkpoint(cfg)
     # mtime recency wins, not the (year-less) run-id string order
     assert found == base / "0101_080000" / "checkpoint-epoch1"
+
+
+def test_find_latest_checkpoint_interval_ranking(tmp_path):
+    """Within a run: an epoch-edge checkpoint outranks an interval slot of
+    the same epoch even when the slot's async flush gave it a NEWER mtime;
+    an interval slot from a later (crashed) epoch outranks both."""
+    import json as _json
+    import os
+
+    from pytorch_distributed_template_tpu.config.parser import (
+        find_latest_checkpoint,
+    )
+
+    cfg = {"name": "Exp", "trainer": {"save_dir": str(tmp_path)}}
+    run = tmp_path / "Exp" / "train" / "0601_120000"
+    run.mkdir(parents=True)
+
+    edge = run / "checkpoint-epoch3"
+    edge.mkdir()
+    os.utime(edge, (2000, 2000))
+    slot_a = run / "checkpoint-interval-a"
+    slot_a.mkdir()
+    os.utime(slot_a, (2010, 2010))  # flushed AFTER the epoch-edge rename
+    (run / "checkpoint-interval-a.meta.json").write_text(
+        _json.dumps({"epoch": 3, "step": 8})
+    )
+    assert find_latest_checkpoint(cfg) == edge
+
+    # a crash during epoch 4 leaves only an interval slot for it
+    slot_b = run / "checkpoint-interval-b"
+    slot_b.mkdir()
+    os.utime(slot_b, (2005, 2005))  # mtime older than slot_a — epoch wins
+    (run / "checkpoint-interval-b.meta.json").write_text(
+        _json.dumps({"epoch": 4, "step": 2})
+    )
+    assert find_latest_checkpoint(cfg) == slot_b

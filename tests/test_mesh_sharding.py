@@ -108,6 +108,7 @@ def test_psum_grad_equivalence_on_mesh():
     np.testing.assert_allclose(np.asarray(g_ref), np.asarray(g_sharded), rtol=1e-6)
 
 
+@pytest.mark.slow
 def test_three_axis_composition_dp_tp_sp():
     """One mesh, three strategies at once: {data:2, tensor:2, seq:2} —
     batch sharded, params TP-sharded by the model's rules, attention
@@ -196,3 +197,45 @@ def test_three_axis_composition_dp_tp_ulysses():
     )(sharded.params, tokens)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                atol=1e-4, rtol=1e-4)
+
+
+def test_save_outputs_step_tp_sharded_rows_complete():
+    """--save-outputs under TP: the dump step's batch-only sharding
+    constraint must yield host-local rows with the FULL vocab axis (the
+    head kernel is vocab-sharded, so without the constraint each shard
+    would hold a V/tp column slice and the dedup would drop columns)."""
+    import optax
+
+    from pytorch_distributed_template_tpu.config.registry import MODELS
+    import pytorch_distributed_template_tpu.models  # noqa: F401
+    from pytorch_distributed_template_tpu.engine.evaluator import (
+        _host_local_rows, _make_output_step,
+    )
+    from pytorch_distributed_template_tpu.engine.state import (
+        create_train_state,
+    )
+
+    mesh = build_mesh({"data": 2, "tensor": 4})
+    model = MODELS.get("TinyLM")(vocab_size=64, d_model=32, n_layer=1,
+                                 n_head=2, max_len=16)
+    tokens = jnp.asarray(
+        np.random.default_rng(0).integers(0, 64, (8, 12)), jnp.int32
+    )
+    state = create_train_state(model, optax.sgd(0.1),
+                               model.batch_template(1), seed=0)
+    ref = np.asarray(
+        model.apply({"params": state.params}, tokens, train=False)
+    )
+    sharded = jax.device_put(
+        state, apply_rules(state, mesh, model.partition_rules())
+    )
+    batch = {
+        "tokens": jax.device_put(tokens, batch_sharding(mesh)),
+        "mask": jax.device_put(jnp.ones(8, bool), batch_sharding(mesh)),
+    }
+    step = jax.jit(
+        _make_output_step(model, "tokens", use_ema=False, mesh=mesh)
+    )
+    rows = _host_local_rows(step(sharded, batch))
+    assert rows.shape == ref.shape  # full vocab axis, all rows
+    np.testing.assert_allclose(rows, ref, atol=1e-4, rtol=1e-4)

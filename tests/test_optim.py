@@ -240,6 +240,7 @@ def test_build_optimizer_returns_plateau():
     assert none_plateau is None
 
 
+@pytest.mark.slow
 def test_trainer_plateau_integration(tmp_path):
     """Full Trainer wiring: an abs-threshold too large to ever satisfy makes
     every post-first epoch a bad epoch, so patience=0 halves the scale each

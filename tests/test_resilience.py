@@ -238,6 +238,7 @@ def test_resolve_loss_form_mismatch_errors():
         resolve_loss({"type": "cross_entropy", "args": {}})
 
 
+@pytest.mark.slow
 def test_save_interval_steps(tmp_path):
     """Mid-epoch interval checkpoints: with save_interval_steps=2 and 8
     batches/epoch, saves alternate between the A/B slots WITHOUT blocking
