@@ -1,14 +1,31 @@
-"""Benchmark: flagship (ResNet-50) train-step throughput on the accelerator.
+"""Benchmark: two rungs on the accelerator, each with throughput AND MFU.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", "rungs"}.
 
-The reference (Yun-960/Pytorch-Distributed-Template) publishes no benchmark
-numbers (SURVEY.md §6), so the baseline is *measured here*: BASELINE.json's
-headline config is ResNet-50 images/sec, and the only runnable comparison on
-this host is the reference's stack (torch, CPU — torchvision is not
-installed, so the standard bottleneck ResNet-50 is written out below).
-``vs_baseline`` is our TPU-native throughput over that measured torch
-throughput on the same host.
+- ``resnet50``: bf16 ResNet-50 train step at ImageNet shapes. On this
+  slice it is HBM-bandwidth-capped (~260 GB/s measured of the 819 GB/s
+  v5e spec — BASELINE.md's roofline), so its MFU is *expected* low; the
+  images/sec figure is the honest headline and ``vs_baseline`` compares
+  it to the reference's stack runnable on this host (torch CPU; the
+  reference publishes no numbers of its own, SURVEY.md §6).
+- ``gpt2_small``: bf16 GPT-2-small causal-LM train step (Pallas flash
+  attention + fused chunked head loss) — the compute-bound rung whose
+  MFU demonstrates MXU utilization.
+
+MFU here is MODEL flops utilization in the standard (PaLM appendix B)
+sense: analytic useful flops / wall-clock / chip peak. XLA's cost
+analysis of the compiled executable is ALSO reported per rung
+(``xla_flops_per_step``) but is not used for MFU, in both directions of
+error: it counts layout-padded convolutions at padded cost (the ResNet
+stem's 3 input channels pad to an MXU tile, inflating the step ~8x over
+analytic), and it cannot see into Pallas kernels (deflating the flash
+attention rung). Peak comes from the device table in
+observability/profiler.py.
+
+Timing follows the fencing rules this platform requires (see
+BASELINE.md): steps chain through donated state and the fence is a host
+readback of a value depending on the whole chain — block_until_ready on
+tunneled devices can return before execution finishes.
 """
 from __future__ import annotations
 
@@ -48,7 +65,48 @@ def _start_watchdog():
     threading.Thread(target=run, daemon=True).start()
 
 
-def bench_tpu_native(batch: int) -> float:
+def _time_step(step, state, batch_arrays):
+    """(steps_per_sec, xla_flops_per_step) for a donated jitted train step.
+
+    Uses the AOT-compiled executable both for the cost analysis and the
+    timed loop (one compilation, exact correspondence between the FLOPs
+    figure and the program measured). Host readback of loss_sum is the
+    fence — it depends on the whole step chain.
+    """
+    from pytorch_distributed_template_tpu.observability.profiler import (
+        executable_flops,
+    )
+
+    compiled = step.lower(state, batch_arrays).compile()
+    flops = executable_flops(compiled)
+
+    for _ in range(WARMUP):
+        state, m = compiled(state, batch_arrays)
+    float(m["loss_sum"])
+    t0 = time.perf_counter()
+    for _ in range(STEPS):
+        state, m = compiled(state, batch_arrays)
+    float(m["loss_sum"])
+    dt = time.perf_counter() - t0
+    return STEPS / dt, flops
+
+
+# Analytic model flops (multiply-add = 2 flops), train step = 3x forward.
+# ResNet-50 forward at 224x224 is the standard published figure.
+RESNET50_TRAIN_FLOPS_PER_IMAGE = 3 * 4.09e9
+
+
+def gpt2_train_flops_per_token(n_layer: int, d_model: int, seq: int,
+                               vocab: int) -> float:
+    """PaLM-appendix-style accounting: 6 flops/param/token for the dense
+    matmuls (fwd 2 + bwd 4), with the tied head counted once, plus the
+    attention score/value matmuls 12*L*T*D (fwd 4*T*D per layer-token:
+    QK^T and AV at 2*T*D each; x3 for the backward)."""
+    dense_params = 12 * n_layer * d_model * d_model + d_model * vocab
+    return 6.0 * dense_params + 12.0 * n_layer * seq * d_model
+
+
+def bench_resnet50(batch: int) -> dict:
     """Our jitted bf16 ResNet-50 train step, synthetic ImageNet shapes."""
     import jax
     import optax
@@ -59,6 +117,7 @@ def bench_tpu_native(batch: int) -> float:
     )
     from pytorch_distributed_template_tpu.engine.state import create_train_state
     from pytorch_distributed_template_tpu.engine.steps import make_train_step
+    from pytorch_distributed_template_tpu.observability.profiler import mfu
     from pytorch_distributed_template_tpu.parallel.mesh import build_mesh
     from pytorch_distributed_template_tpu.parallel.sharding import (
         apply_rules, batch_sharding,
@@ -84,18 +143,75 @@ def bench_tpu_native(batch: int) -> float:
             rng.integers(0, 1000, size=batch).astype(np.int32), bs),
         "mask": jax.device_put(np.ones(batch, bool), bs),
     }
-    for _ in range(WARMUP):
-        state, m = step(state, batch_arrays)
-    # Host readback, not block_until_ready: on tunneled/virtualized devices
-    # block_until_ready can return before execution finishes; transferring a
-    # value that depends on the whole step chain is the honest fence.
-    float(m["loss_sum"])
-    t0 = time.perf_counter()
-    for _ in range(STEPS):
-        state, m = step(state, batch_arrays)
-    float(m["loss_sum"])
-    dt = time.perf_counter() - t0
-    return batch * STEPS / dt
+    steps_per_sec, xla_flops = _time_step(step, state, batch_arrays)
+    # per-DEVICE model flops: the global batch is split across the mesh,
+    # and mfu() compares against a single chip's peak
+    util = mfu(RESNET50_TRAIN_FLOPS_PER_IMAGE * batch
+               / max(jax.device_count(), 1), steps_per_sec)
+    return {
+        "images_per_sec": round(batch * steps_per_sec, 1),
+        "mfu": round(util, 4) if util is not None else None,
+        "xla_flops_per_step": xla_flops,
+        "batch": batch,
+    }
+
+
+def bench_gpt2(batch: int, seq: int, attn_impl: str = "flash") -> dict:
+    """bf16 GPT-2-small train step: Pallas flash attention + fused chunked
+    LM head loss (logits never materialize), AdamW — the compute-bound
+    rung for the MFU north star."""
+    import jax
+    import optax
+
+    import pytorch_distributed_template_tpu.models  # noqa: F401
+    from pytorch_distributed_template_tpu.config.registry import MODELS
+    from pytorch_distributed_template_tpu.engine.losses import resolve_loss
+    from pytorch_distributed_template_tpu.engine.state import create_train_state
+    from pytorch_distributed_template_tpu.engine.steps import make_train_step
+    from pytorch_distributed_template_tpu.observability.profiler import mfu
+    from pytorch_distributed_template_tpu.parallel.mesh import build_mesh
+    from pytorch_distributed_template_tpu.parallel.sharding import (
+        apply_rules, batch_sharding,
+    )
+
+    mesh = build_mesh({"data": -1}, jax.devices())
+    model = MODELS.get("GPT2")(
+        size="gpt2-small", max_len=seq, dropout=0.0, bfloat16=True,
+        attn_impl=attn_impl, fused_head=True, mesh=mesh,
+    )
+    tx = optax.adamw(3e-4, weight_decay=0.1)
+    criterion = resolve_loss(
+        {"type": "fused_lm_cross_entropy", "args": {"chunk": 512}}
+    )
+    state = create_train_state(model, tx, model.batch_template(1), seed=0)
+    state = jax.device_put(state, apply_rules(state, mesh, []))
+
+    step = jax.jit(
+        make_train_step(model, tx, criterion, [],
+                        input_key="tokens", target_key="tokens"),
+        donate_argnums=0,
+    )
+    rng = np.random.default_rng(0)
+    bs = batch_sharding(mesh)
+    batch_arrays = {
+        "tokens": jax.device_put(
+            rng.integers(0, 50257, size=(batch, seq)).astype(np.int32), bs),
+        "mask": jax.device_put(np.ones(batch, bool), bs),
+    }
+    steps_per_sec, xla_flops = _time_step(step, state, batch_arrays)
+    model_flops_per_step = (
+        gpt2_train_flops_per_token(12, 768, seq, 50257) * batch * seq
+        / max(jax.device_count(), 1)  # per-device share of the global batch
+    )
+    util = mfu(model_flops_per_step, steps_per_sec)
+    return {
+        "tokens_per_sec": round(batch * seq * steps_per_sec, 0),
+        "mfu": round(util, 4) if util is not None else None,
+        "xla_flops_per_step": xla_flops,
+        "batch": batch,
+        "seq": seq,
+        "attn": attn_impl,
+    }
 
 
 def bench_reference_torch(batch: int = 16, steps: int = 3) -> float:
@@ -166,25 +282,39 @@ def bench_reference_torch(batch: int = 16, steps: int = 3) -> float:
 
 def main():
     _start_watchdog()
-    ours = None
+    resnet = None
     for batch in (128, 64, 32):
         try:
-            ours = bench_tpu_native(batch)
+            resnet = bench_resnet50(batch)
             break
         except Exception as e:  # e.g. HBM OOM on small chips — halve batch
             last = e
-    if ours is None:
+    if resnet is None:
         raise last
+
+    gpt2 = None
+    for batch, seq, attn in ((8, 1024, "flash"), (4, 1024, "flash"),
+                             (8, 1024, "xla"), (4, 512, "xla")):
+        try:
+            gpt2 = bench_gpt2(batch, seq, attn)
+            break
+        except Exception as e:
+            last = e
+    if gpt2 is None:
+        print(f"gpt2 rung failed: {last!r}", file=sys.stderr)
+        gpt2 = {"error": str(last)}
+
     try:
         ref = bench_reference_torch()
     except Exception:
         ref = float("nan")
-    vs = ours / ref if ref == ref and ref > 0 else 0.0
+    vs = resnet["images_per_sec"] / ref if ref == ref and ref > 0 else 0.0
     print(json.dumps({
         "metric": "resnet50_train_images_per_sec",
-        "value": round(ours, 1),
+        "value": resnet["images_per_sec"],
         "unit": "images/sec",
         "vs_baseline": round(vs, 3),
+        "rungs": {"resnet50": resnet, "gpt2_small": gpt2},
     }))
     _done.set()
 

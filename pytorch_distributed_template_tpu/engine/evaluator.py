@@ -79,8 +79,6 @@ def _make_output_step(model, input_key: str, use_ema: bool, mesh):
     replicated): under TP the head kernel is vocab-sharded, and without
     the constraint each host's shards would cover only a V/tp column
     slice of its rows."""
-    from ..parallel import batch_sharding
-
     pass_example_mask = _accepts_example_mask(model)
     out_sharding = batch_sharding(mesh)
 

@@ -58,6 +58,20 @@ def peak_flops_per_device(device=None) -> Optional[float]:
     return None
 
 
+def executable_flops(compiled) -> Optional[float]:
+    """FLOPs of one invocation of an already-compiled executable, from
+    XLA's cost analysis (post-fusion). Returns None when the backend
+    doesn't report."""
+    try:
+        cost = compiled.cost_analysis()
+        if isinstance(cost, (list, tuple)):  # older jax returns [dict]
+            cost = cost[0] if cost else {}
+        flops = cost.get("flops")
+        return float(flops) if flops else None
+    except Exception:
+        return None
+
+
 def compiled_flops(jitted_fn, *args, **kwargs) -> Optional[float]:
     """FLOPs of one invocation, from XLA's cost analysis of the compiled
     executable (post-fusion). Returns None when the backend doesn't report.
@@ -67,12 +81,7 @@ def compiled_flops(jitted_fn, *args, **kwargs) -> Optional[float]:
     backends, but do not put this in the hot loop).
     """
     try:
-        compiled = jitted_fn.lower(*args, **kwargs).compile()
-        cost = compiled.cost_analysis()
-        if isinstance(cost, (list, tuple)):  # older jax returns [dict]
-            cost = cost[0] if cost else {}
-        flops = cost.get("flops")
-        return float(flops) if flops else None
+        return executable_flops(jitted_fn.lower(*args, **kwargs).compile())
     except Exception:
         return None
 
