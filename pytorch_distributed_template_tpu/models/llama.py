@@ -118,19 +118,17 @@ class LlamaAttention(nn.Module):
                 if self.mesh is None:
                     raise ValueError(
                         f"attn_impl={self.attn_impl!r} requires a mesh")
-                if self.window > 0:
-                    raise ValueError(
-                        "window (sliding-window attention) is not "
-                        "supported with the ring impls; use "
-                        "'ulysses'/'flash'/'xla' (a window bounds memory "
-                        "by itself, so the ring is rarely needed with it)"
-                    )
+                # window > 0 forces the contiguous layout: the band
+                # balances the causal triangle by itself and enables the
+                # ring's banded-skip early exit (LlamaLM skips the zigzag
+                # permutation accordingly).
                 ctx = ring_attention(
                     q, k, v, self.mesh, causal=True,
                     layout=("zigzag" if self.seq_layout == "zigzag"
-                            else "contig"),
+                            and self.window == 0 else "contig"),
                     block_impl=("flash" if self.attn_impl == "ring_flash"
                                 else "einsum"),
+                    window=self.window,
                 )
             elif self.attn_impl in ("ulysses", "ulysses_flash"):
                 if self.mesh is None:
@@ -359,6 +357,7 @@ class LlamaLM(nn.Module):
         zperm = None
         if (
             self.seq_layout == "zigzag" and not decode
+            and self.window == 0  # SWA rides the contiguous banded ring
             and self.attn_impl in ("ring", "ring_flash")
             and self.mesh is not None
             and "seq" in self.mesh.axis_names

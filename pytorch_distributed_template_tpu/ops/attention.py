@@ -34,8 +34,10 @@ here as a family of interchangeable implementations:
   ``ring_attention(block_impl="flash")`` via ``flash_attention_lse``.
 
 Sliding-window banding (``window > 0``) threads through the XLA, flash
-(banded grids), and Ulysses paths. All take/return ``[B, T, H, D]``
-("BTHD") and accumulate in float32 regardless of input dtype (bf16-safe).
+(banded grids), Ulysses, and contiguous-ring paths — the ring adds the
+banded-skip schedule (stop after ~window/Tl hops; see ``ring_attention``).
+All take/return ``[B, T, H, D]`` ("BTHD") and accumulate in float32
+regardless of input dtype (bf16-safe).
 """
 from __future__ import annotations
 
@@ -130,8 +132,31 @@ def _merge_blocks(o, lse, o_b, lse_b):
     return o * w + o_b.astype(jnp.float32) * w_b, lse_new
 
 
+def _einsum_block_lse(q, kb, vb, visible):
+    """(out, lse) of one attention block with an explicit [Tq, Tk] mask.
+
+    The band-edge fallback for the windowed flash ring: Pallas banding
+    assumes same-origin positions, so the O(1) ring blocks straddling the
+    window edge run as a masked einsum instead (their [Tl x Tl] scores DO
+    materialize — acceptable for the one or two such blocks). Fully-masked
+    rows get lse = NEG_INF, making the subsequent merge a no-op there.
+    """
+    d = q.shape[-1]
+    qf = q.astype(jnp.float32) * (d ** -0.5)
+    scores = jnp.einsum("bqhd,bkhd->bhqk", qf, kb.astype(jnp.float32))
+    scores = jnp.where(visible[None, None], scores, NEG_INF)
+    m = jnp.max(scores, axis=-1)
+    p = jnp.exp(scores - m[..., None])
+    l = jnp.sum(p, axis=-1)
+    o = jnp.einsum("bhqk,bkhd->bhqd", p, vb.astype(jnp.float32))
+    o = o / jnp.maximum(l, 1e-30)[..., None]
+    lse = jnp.where(m <= NEG_INF / 2, NEG_INF,
+                    m + jnp.log(jnp.maximum(l, 1e-30)))
+    return jnp.transpose(o, (0, 2, 1, 3)), lse  # [B,T,H,D], [B,H,T]
+
+
 def _ring_attention_local_flash(q, k, v, *, axis_name: str, axis_size: int,
-                                causal: bool):
+                                causal: bool, window: int = 0):
     """Contiguous-layout ring body with the Pallas flash kernel per block.
 
     Same ring schedule as ``_ring_attention_local``, but each [Tl x Tl]
@@ -141,13 +166,24 @@ def _ring_attention_local_flash(q, k, v, *, axis_name: str, axis_size: int,
     the local (diagonal) block — the only one needing causal masking;
     every later block is fully visible or fully masked (gated by
     lse = NEG_INF, which also zeroes its gradient).
+
+    ``window > 0`` (causal): three-tier banded-skip schedule —
+    1. the diagonal block runs banded INSIDE the flash kernel;
+    2. ring distances fully inside the band run maskless flash exactly as
+       the unwindowed path;
+    3. the O(1) distances straddling the band edge run as masked einsum
+       blocks (``_einsum_block_lse``);
+    4. distances beyond the band don't run — the ring stops early
+       (``_ring_steps_needed``), so K/V hops, compute and the scan length
+       are all O(window / Tl), not O(s).
     """
     from .flash import flash_attention_lse
 
     dtype = q.dtype
     s = axis_size
+    tl = q.shape[1]
     my = lax.axis_index(axis_name)
-    out0, lse0 = flash_attention_lse(q, k, v, causal=causal)
+    out0, lse0 = flash_attention_lse(q, k, v, causal=causal, window=window)
     carry0 = (k, v, out0.astype(jnp.float32), lse0)
     perm = [(i, (i + 1) % s) for i in range(s)]
 
@@ -162,7 +198,32 @@ def _ring_attention_local_flash(q, k, v, *, axis_name: str, axis_size: int,
         o, lse = _merge_blocks(o, lse, out_b, lse_b)
         return (kb, vb, o, lse), None
 
-    (_, _, o, _), _ = lax.scan(step, carry0, jnp.arange(1, s))
+    if window <= 0 or not causal:
+        (_, _, o, _), _ = lax.scan(step, carry0, jnp.arange(1, s))
+        return o.astype(dtype)
+
+    # causal sliding window: distance-t keys span offsets
+    # [t*tl - (tl-1), t*tl + (tl-1)] behind the query
+    n = _ring_steps_needed(tl, s, window)
+    full = [t for t in range(1, n) if t * tl + tl - 1 < window]
+    edge = [t for t in range(1, n) if t * tl + tl - 1 >= window]
+    assert full == list(range(1, len(full) + 1)) and len(edge) <= 2
+
+    carry = carry0
+    if full:
+        carry, _ = lax.scan(step, carry, jnp.arange(1, len(full) + 1))
+    kb, vb, o, lse = carry
+    q_pos = my * tl + jnp.arange(tl)
+    for t in edge:
+        kb = lax.ppermute(kb, axis_name, perm)
+        vb = lax.ppermute(vb, axis_name, perm)
+        src = (my - t) % s
+        k_pos = src * tl + jnp.arange(tl)
+        visible = (q_pos[:, None] >= k_pos[None, :]) & (
+            q_pos[:, None] - k_pos[None, :] < window
+        )  # wrapped sources (src > my) mask out entirely via positions
+        out_b, lse_b = _einsum_block_lse(q, kb, vb, visible)
+        o, lse = _merge_blocks(o, lse, out_b, lse_b)
     return o.astype(dtype)
 
 
@@ -394,14 +455,31 @@ def ulysses_attention(q, k, v, mesh: Mesh, causal: bool = True,
     )(q, k, v)
 
 
+def _ring_steps_needed(tl: int, axis_size: int, window: int) -> int:
+    """Ring steps with any in-band key for sliding window ``window``.
+
+    Block at ring distance ``t`` holds keys ``t*tl`` to ``t*tl - (tl-1)``
+    positions behind the nearest query, so it is fully out of the band
+    once ``t*tl - (tl-1) >= window``. Static — the scan just gets shorter
+    (the banded-skip optimization: a narrow window stops the ring after
+    ``~window/tl`` hops instead of circling all ``s`` shards).
+    """
+    if window <= 0:
+        return axis_size
+    return min(axis_size, (window + tl - 2) // tl + 1)
+
+
 def _ring_attention_local(q, k, v, *, axis_name: str, axis_size: int,
-                          causal: bool, vary_axes: tuple = ()):
+                          causal: bool, vary_axes: tuple = (),
+                          window: int = 0):
     """Per-shard ring attention body (runs inside shard_map).
 
     q,k,v: local [B, Tl, H, D] slices of the global [B, T, H, D] arrays,
     sharded along T over ``axis_name``. Rotates K/V blocks around the ring
     with an online-softmax accumulator: after ``axis_size`` steps every query
-    has attended to every (visible) key.
+    has attended to every (visible) key. ``window > 0`` adds the
+    sliding-window band to the position mask and shortens the scan to the
+    in-band ring distance (``_ring_steps_needed``).
     """
     dtype = q.dtype
     b, tl, h, d = q.shape
@@ -416,8 +494,13 @@ def _ring_attention_local(q, k, v, *, axis_name: str, axis_size: int,
         src = (my - t) % axis_size  # origin shard of the current K/V block
         k_pos = src * tl + jnp.arange(tl)
         scores = jnp.einsum("bqhd,bkhd->bhqk", qf, kb.astype(jnp.float32))
+        visible = None
         if causal:
             visible = q_pos[:, None] >= k_pos[None, :]  # [Tl_q, Tl_k]
+        if window > 0:
+            band = q_pos[:, None] - k_pos[None, :] < window
+            visible = band if visible is None else visible & band
+        if visible is not None:
             scores = jnp.where(visible[None, None], scores, NEG_INF)
         m_new, l_new, o_new = _online_update(m, l, o, scores, vb)
         kb = lax.ppermute(kb, axis_name, perm)
@@ -433,8 +516,12 @@ def _ring_attention_local(q, k, v, *, axis_name: str, axis_size: int,
     if vary_axes:
         vary = lambda x: lax.pcast(x, vary_axes, to="varying")
         m0, l0, o0 = vary(m0), vary(l0), vary(o0)
+    # banded-skip is only sound under causal masking: without it the band
+    # q_pos - k_pos < window keeps every FUTURE block visible
+    n_steps = (_ring_steps_needed(tl, axis_size, window) if causal
+               else axis_size)
     (kb, vb, m, l, o), _ = lax.scan(
-        step, (k, v, m0, l0, o0), jnp.arange(axis_size)
+        step, (k, v, m0, l0, o0), jnp.arange(n_steps)
     )
     out = o / jnp.maximum(l, 1e-30)[..., None]        # [B, H, Tq, D]
     return jnp.transpose(out, (0, 2, 1, 3)).astype(dtype)
@@ -443,7 +530,7 @@ def _ring_attention_local(q, k, v, *, axis_name: str, axis_size: int,
 def ring_attention(q, k, v, mesh: Mesh, causal: bool = True,
                    seq_axis: str = "seq", data_axes=("data", "fsdp"),
                    head_axis: str = "tensor", layout: str = "contig",
-                   block_impl: str = "einsum"):
+                   block_impl: str = "einsum", window: int = 0):
     """Sequence-parallel attention over the mesh's ``seq`` axis.
 
     q,k,v are global ``[B, T, H, D]`` arrays (T sharded over ``seq``); the
@@ -460,9 +547,18 @@ def ring_attention(q, k, v, mesh: Mesh, causal: bool = True,
     logsumexp — per-device score tiles stream through VMEM instead of
     materializing [Tl x Tl], so long per-device slices stay HBM-light.
     ``"einsum"`` (default) is the plain-XLA body, best for short slices.
+
+    ``window > 0`` (with ``causal``): sliding-window banding with the
+    banded-skip schedule — the ring stops after ``~window/Tl`` hops
+    because farther blocks are fully out of band (``_ring_steps_needed``),
+    so a narrow window makes ring cost O(T·window / s) per device.
+    Contiguous layout only: zigzag exists to balance the full causal
+    triangle, which a band already balances (and a banded zigzag would
+    put BOTH of each device's chunks on the band edge — strictly more
+    masked work than contiguous).
     """
     if seq_axis not in mesh.axis_names or mesh.shape[seq_axis] == 1:
-        return multihead_attention(q, k, v, causal=causal)
+        return multihead_attention(q, k, v, causal=causal, window=window)
     axis_size = mesh.shape[seq_axis]
     zigzag = layout == "zigzag"
     if zigzag and (not causal or q.shape[1] % (2 * axis_size) != 0):
@@ -470,10 +566,17 @@ def ring_attention(q, k, v, mesh: Mesh, causal: bool = True,
             "layout='zigzag' needs causal=True and T divisible by "
             f"2*seq ({2 * axis_size}); got causal={causal}, T={q.shape[1]}"
         )
+    if zigzag and window > 0:
+        raise ValueError(
+            "layout='zigzag' does not compose with window (sliding-window "
+            "attention): the band already load-balances the causal "
+            "triangle, so use layout='contig', which also enables the "
+            "banded-skip early ring exit"
+        )
     if q.shape[1] % axis_size != 0:
         # Sequence not evenly shardable (e.g. a probe batch at init time):
         # the dense path is always correct, just not sequence-parallel.
-        return multihead_attention(q, k, v, causal=causal)
+        return multihead_attention(q, k, v, causal=causal, window=window)
 
     dp, hp, spec = _sp_partition(mesh, q, seq_axis, data_axes, head_axis)
 
@@ -482,6 +585,11 @@ def ring_attention(q, k, v, mesh: Mesh, causal: bool = True,
             f"block_impl={block_impl!r}; expected 'einsum' or 'flash'"
         )
     flash_blocks = block_impl == "flash"
+    if flash_blocks and window > 0 and not causal:
+        # the flash body's banded-skip schedule is causal-only (a
+        # non-causal band keeps every future block visible); the einsum
+        # body applies the band independently of causal, so use it
+        flash_blocks = False
     if zigzag:
         fn = functools.partial(
             _ring_attention_zigzag_local_flash if flash_blocks
@@ -491,13 +599,13 @@ def ring_attention(q, k, v, mesh: Mesh, causal: bool = True,
     elif flash_blocks:
         fn = functools.partial(
             _ring_attention_local_flash, axis_name=seq_axis,
-            axis_size=axis_size, causal=causal,
+            axis_size=axis_size, causal=causal, window=window,
         )
     else:
         vary_axes = tuple(dp) + (seq_axis,) + ((hp,) if hp else ())
         fn = functools.partial(
             _ring_attention_local, axis_name=seq_axis, axis_size=axis_size,
-            causal=causal, vary_axes=vary_axes,
+            causal=causal, vary_axes=vary_axes, window=window,
         )
     # Pallas calls don't annotate varying-mesh-axes metadata on their
     # outputs, so the flash bodies run with the vma check off (the einsum

@@ -549,28 +549,30 @@ def _flash_3d_bwd(causal, block_q, block_k, t_valid, interpret, window,
 _flash_3d.defvjp(_flash_3d_fwd, _flash_3d_bwd)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash_3d_lse(q, k, v, causal, block_q, block_k, t_valid, interpret):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _flash_3d_lse(q, k, v, causal, block_q, block_k, t_valid, interpret,
+                  window=0):
     """Like ``_flash_3d`` but also returns the logsumexp rows [BH, T] —
     the composition primitive: softmaxes over disjoint key blocks merge
     exactly from (out, lse) pairs (ops/attention.py ring 'flash' bodies)."""
     return _flash_fwd_3d(q, k, v, causal=causal, block_q=block_q,
                          block_k=block_k, t_valid=t_valid,
-                         interpret=interpret)
+                         interpret=interpret, window=window)
 
 
-def _flash_3d_lse_fwd(q, k, v, causal, block_q, block_k, t_valid, interpret):
+def _flash_3d_lse_fwd(q, k, v, causal, block_q, block_k, t_valid, interpret,
+                      window=0):
     out, lse = _flash_fwd_3d(q, k, v, causal=causal, block_q=block_q,
                              block_k=block_k, t_valid=t_valid,
-                             interpret=interpret)
+                             interpret=interpret, window=window)
     return (out, lse), (q, k, v, out, lse)
 
 
-def _flash_3d_lse_bwd(causal, block_q, block_k, t_valid, interpret,
+def _flash_3d_lse_bwd(causal, block_q, block_k, t_valid, interpret, window,
                       residuals, cotangents):
     g, g_lse = cotangents
     return _bwd_pallas_3d(causal, block_q, block_k, t_valid, interpret,
-                          residuals, g, g_lse=g_lse)
+                          residuals, g, g_lse=g_lse, window=window)
 
 
 _flash_3d_lse.defvjp(_flash_3d_lse_fwd, _flash_3d_lse_bwd)
@@ -623,8 +625,16 @@ def flash_attention(q, k, v, causal: bool = True,
 def flash_attention_lse(q, k, v, causal: bool = False,
                         block_q: int = DEFAULT_BLOCK_Q,
                         block_k: int = DEFAULT_BLOCK_K,
-                        interpret: bool | None = None):
+                        interpret: bool | None = None,
+                        window: int = 0):
     """Fused attention returning ``(out, lse)``.
+
+    ``window > 0`` (with ``causal``) applies the same-origin sliding-window
+    band ``q_pos - k_pos < window`` with the banded grid of
+    ``flash_attention`` — used by the ring bodies for the DIAGONAL block
+    (off-diagonal ring blocks have shifted position origins and are
+    handled by the callers: fully-visible blocks need no mask, band-edge
+    blocks go through a masked einsum merge).
 
     q, k, v: [B, T, H, D]; out: [B, T, H, D]; lse: [B, H, T] float32 —
     ``logsumexp_k(q·k/sqrt(d))`` per query row. Disjoint-key-block results
@@ -655,7 +665,7 @@ def flash_attention_lse(q, k, v, causal: bool = False,
         pad = ((0, 0), (0, t_pad - t), (0, 0))
         qf, kf, vf = jnp.pad(qf, pad), jnp.pad(kf, pad), jnp.pad(vf, pad)
     out, lse = _flash_3d_lse(qf, kf, vf, causal, block_q, block_k,
-                             t, interpret)
+                             t, interpret, window)
     out = out[:, :t]
     lse = lse[:, :t]
     return (jnp.moveaxis(out.reshape(b, h, t, d), 1, 2),

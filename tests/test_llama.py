@@ -306,11 +306,40 @@ class TestSlidingWindow:
         np.testing.assert_allclose(np.asarray(out), np.asarray(full),
                                    atol=1e-5, rtol=1e-5)
 
-    def test_ring_rejects_window(self):
+    @pytest.mark.parametrize("impl", ["ring", "ring_flash"])
+    def test_llama_window_ring_matches_dense(self, impl):
+        """Windowed Llama over the banded contiguous ring == the dense
+        windowed model (the SWA x SP composition VERDICT r1 flagged)."""
         mesh = build_mesh({"data": 2, "seq": 4})
-        m = MODELS.get("TinyLlama")(window=8, attn_impl="ring", mesh=mesh)
-        with pytest.raises(ValueError):
-            m.init(jax.random.key(0), jnp.zeros((1, 32), jnp.int32))
+        tokens = _tokens(b=1, t=32)
+        m = MODELS.get("TinyLlama")(window=8)
+        m_ring = MODELS.get("TinyLlama")(window=8, attn_impl=impl,
+                                         mesh=mesh)
+        s = _state(m, tokens)
+        full = m.apply({"params": s.params}, tokens, train=False)
+        ring = jax.jit(
+            lambda p, t: m_ring.apply({"params": p}, t, train=False)
+        )(s.params, tokens)
+        np.testing.assert_allclose(np.asarray(ring), np.asarray(full),
+                                   atol=1e-4, rtol=1e-4)
+
+    def test_llama_window_ring_ignores_zigzag_layout(self):
+        """seq_layout='zigzag' + window falls back to the contiguous
+        banded ring (zigzag exists to balance the full causal triangle);
+        logits must still match the dense windowed model — i.e. the model
+        must NOT zigzag-permute its inputs in this configuration."""
+        mesh = build_mesh({"data": 2, "seq": 4})
+        tokens = _tokens(b=1, t=32)
+        m = MODELS.get("TinyLlama")(window=8)
+        m_zz = MODELS.get("TinyLlama")(window=8, attn_impl="ring",
+                                       seq_layout="zigzag", mesh=mesh)
+        s = _state(m, tokens)
+        full = m.apply({"params": s.params}, tokens, train=False)
+        out = jax.jit(
+            lambda p, t: m_zz.apply({"params": p}, t, train=False)
+        )(s.params, tokens)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(full),
+                                   atol=1e-4, rtol=1e-4)
 
 
 def test_fused_head_matches_plain():
