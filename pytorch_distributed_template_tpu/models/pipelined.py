@@ -13,11 +13,26 @@ pipeline-parallelism story (parallel/pipeline.py). Structure:
   schedule; combine ``pipe`` with ``data`` mesh axes for DP x PP.
 
 The block math matches models/transformer.py's ``Block`` (pre-LN, causal
-MHA, GeLU MLP) but is written as pure functions over raw tensors because
+MHA, GeLU MLP) EXACTLY — same layer norm epsilon, qkv packing, init
+scales and head tying — so this IS the GPT-2 family through the pipe:
+``stack_dense_params`` converts a trained ``TransformerLM``/``GPT2``
+param tree into the stacked layout (and the loss-parity test pins the
+equivalence). It is written as pure functions over raw tensors because
 the pipeline needs the per-layer weights as stacked arrays, not module
 instances. Dropout is intentionally unsupported in the pipelined trunk
 (keep ``dropout=0``): per-(stage, tick) RNG plumbing is provided by
 ``pipeline_apply`` but the parity-tested path is deterministic.
+
+Production levers: ``remat=True`` wraps each pipeline tick in
+``jax.checkpoint`` so the GPipe schedule's activation footprint drops
+from O(all ticks) to O(live ticks) with backward recompute — the TPU
+answer to 1F1B's memory motivation; ``n_chunks=V`` switches to the
+circular (interleaved) schedule, cutting the bubble fraction to
+``(S-1)/(M*V + S - 1)``; ``fused_head=True`` hands ``(hidden, head_w)``
+to the chunked ``fused_lm_cross_entropy`` so [B, T, V] logits never
+materialize; grad accumulation composes from outside (the trainer's
+``grad_accum_steps`` scan splits the batch before the model microbatches
+each piece).
 """
 from __future__ import annotations
 
@@ -74,19 +89,37 @@ class PipelinedLM(nn.Module):
     max_len: int = 1024
     n_stages: int = 2
     n_microbatches: int = 4
+    n_chunks: int = 1                # >1: circular (interleaved) schedule
+    remat: bool = False              # checkpoint each pipeline tick
+    fused_head: bool = False         # return (hidden, head_w), no logits
     dtype: Any = jnp.float32
     mesh: Optional[Any] = None
 
-    def _stacked(self, name, init_std, shape):
-        return self.param(name, _init(init_std), (self.n_layer,) + shape,
-                          jnp.float32)
+    def _lead(self):
+        """Leading dims of the stacked trunk params.
+
+        ``n_chunks == 1``: ``[L]`` — ``P('pipe')`` shards it into the S
+        contiguous blocks the GPipe regroup needs, so the [S, L/S]
+        reshape is local. ``n_chunks == V > 1``: created DIRECTLY in the
+        interleaved ``[S, V, L/(S*V)]`` pipeline layout (entry [s, v] =
+        virtual stage v*S + s) — sharding dim 0 over ``pipe`` is then
+        exactly the circular schedule's placement, with no per-step
+        resharding of trunk weights.
+        """
+        S, V = self.n_stages, self.n_chunks
+        if V == 1:
+            return (self.n_layer,)
+        return (S, V, self.n_layer // (S * V))
+
+    def _stacked(self, name, init, shape):
+        return self.param(name, init, self._lead() + shape, jnp.float32)
 
     @nn.compact
     def __call__(self, tokens, train: bool = False):
-        if self.n_layer % self.n_stages:
+        if self.n_layer % (self.n_stages * self.n_chunks):
             raise ValueError(
-                f"n_layer {self.n_layer} not divisible by n_stages "
-                f"{self.n_stages}"
+                f"n_layer {self.n_layer} not divisible by "
+                f"n_stages*n_chunks {self.n_stages * self.n_chunks}"
             )
         d, f = self.d_model, self.d_ff or 4 * self.d_model
         L, S = self.n_layer, self.n_stages
@@ -99,34 +132,49 @@ class PipelinedLM(nn.Module):
 
         ones = nn.initializers.ones
         zeros = nn.initializers.zeros
+        res_std = 0.02 / (2 * L) ** 0.5
         blocks = {
-            "ln1_g": self.param("ln1_g", ones, (L, d), jnp.float32),
-            "ln1_b": self.param("ln1_b", zeros, (L, d), jnp.float32),
-            "qkv_k": self._stacked("qkv_k", 0.02, (d, 3 * d)),
-            "qkv_b": self.param("qkv_b", zeros, (L, 3 * d), jnp.float32),
-            "out_k": self._stacked("out_k", 0.02 / (2 * L) ** 0.5, (d, d)),
-            "out_b": self.param("out_b", zeros, (L, d), jnp.float32),
-            "ln2_g": self.param("ln2_g", ones, (L, d), jnp.float32),
-            "ln2_b": self.param("ln2_b", zeros, (L, d), jnp.float32),
-            "up_k": self._stacked("up_k", 0.02, (d, f)),
-            "up_b": self.param("up_b", zeros, (L, f), jnp.float32),
-            "down_k": self._stacked("down_k", 0.02 / (2 * L) ** 0.5, (f, d)),
-            "down_b": self.param("down_b", zeros, (L, d), jnp.float32),
+            "ln1_g": self._stacked("ln1_g", ones, (d,)),
+            "ln1_b": self._stacked("ln1_b", zeros, (d,)),
+            "qkv_k": self._stacked("qkv_k", _init(0.02), (d, 3 * d)),
+            "qkv_b": self._stacked("qkv_b", zeros, (3 * d,)),
+            "out_k": self._stacked("out_k", _init(res_std), (d, d)),
+            "out_b": self._stacked("out_b", zeros, (d,)),
+            "ln2_g": self._stacked("ln2_g", ones, (d,)),
+            "ln2_b": self._stacked("ln2_b", zeros, (d,)),
+            "up_k": self._stacked("up_k", _init(0.02), (d, f)),
+            "up_b": self._stacked("up_b", zeros, (f,)),
+            "down_k": self._stacked("down_k", _init(res_std), (f, d)),
+            "down_b": self._stacked("down_b", zeros, (d,)),
         }
-        # [L, ...] -> [S, L/S, ...]: stage s holds layers [s*L/S, (s+1)*L/S)
-        staged = jax.tree.map(
-            lambda a: a.reshape((S, L // S) + a.shape[1:]), blocks
-        )
+        from ..parallel.pipeline import regroup_for_pipeline
+
+        if self.n_chunks == 1:
+            # [L] -> [S, L/S, ...]: contiguous local reshape under the
+            # P('pipe') sharding of dim 0
+            staged = regroup_for_pipeline(blocks, S, 1)
+        else:
+            # already created in the [S, V, Lc, ...] pipeline layout
+            staged = blocks
 
         n_head = self.n_head
 
-        def stage_fn(p_stage, mb, _rng):
-            # apply this stage's L/S consecutive layers
+        def stage_fn(p_chunk, mb, _rng):
+            # apply this chunk's consecutive layers
             def layer(x, p_layer):
                 return _block_apply(p_layer, x, n_head), None
 
-            out, _ = jax.lax.scan(layer, mb, p_stage)
+            out, _ = jax.lax.scan(layer, mb, p_chunk)
             return out
+
+        if self.remat:
+            # each tick recomputes its internals in the backward: the
+            # schedule's live-activation footprint stops growing with the
+            # microbatch count
+            stage_fn = jax.checkpoint(
+                stage_fn, policy=jax.checkpoint_policies.nothing_saveable,
+                static_argnums=(),
+            )
 
         m = min(self.n_microbatches, b)
         if b % m:
@@ -138,14 +186,38 @@ class PipelinedLM(nn.Module):
         if self.mesh is not None and "pipe" in self.mesh.axis_names:
             from ..parallel.pipeline import pipeline_apply
 
-            y = pipeline_apply(stage_fn, staged, micro, self.mesh)
+            y = pipeline_apply(stage_fn, staged, micro, self.mesh,
+                               n_chunks=self.n_chunks)
         else:
-            # no mesh: sequential trunk (same math, no pipelining)
-            def run_one(mb):
-                def st(x, p_stage):
-                    return stage_fn(p_stage, x, None), None
+            # no mesh: sequential trunk in plain layer order (same math,
+            # no pipelining). V>1 params are in pipeline layout
+            # [S, V, Lc, ...]; flatten back to [L] layer order (local
+            # transpose — there is no pipe axis to reshard over).
+            if self.n_chunks == 1:
+                flat = blocks
+            else:
+                flat = jax.tree.map(
+                    lambda a: jnp.transpose(
+                        a, (1, 0) + tuple(range(2, a.ndim))
+                    ).reshape((L,) + a.shape[3:]),
+                    blocks,
+                )
 
-                out, _ = jax.lax.scan(st, mb, staged)
+            body = _block_apply
+            if self.remat:
+                # keep the remat promise off-mesh too: per-layer
+                # recompute instead of storing all L layers' activations
+                body = jax.checkpoint(
+                    _block_apply,
+                    policy=jax.checkpoint_policies.nothing_saveable,
+                    static_argnums=(2,),
+                )
+
+            def run_one(mb):
+                def layer(x, p_layer):
+                    return body(p_layer, x, n_head), None
+
+                out, _ = jax.lax.scan(layer, mb, flat)
                 return out
 
             y = jax.vmap(run_one)(micro)
@@ -154,6 +226,10 @@ class PipelinedLM(nn.Module):
         ln_g = self.param("lnf_g", ones, (d,), jnp.float32)
         ln_b = self.param("lnf_b", zeros, (d,), jnp.float32)
         x = _layer_norm(x, ln_g, ln_b)
+        if self.fused_head:
+            # chunked head+loss (engine/losses.fused_lm_cross_entropy):
+            # the [B, T, V] logits tensor never materializes
+            return x.astype(self.dtype), wte.T.astype(self.dtype)
         logits = x.astype(self.dtype) @ wte.T.astype(self.dtype)
         return logits.astype(jnp.float32)
 
@@ -161,37 +237,125 @@ class PipelinedLM(nn.Module):
         return jnp.zeros((batch_size, min(self.max_len, 16)), jnp.int32)
 
     def partition_rules(self):
-        """Stacked trunk tensors shard their layer dim over ``pipe`` (the
-        [L] -> [S, L/S] regroup is a contiguous local reshape on each
-        stage); embeddings/head replicate (sharded variants are the
-        TP rules' job in the dense family)."""
+        """Stacked trunk tensors shard dim 0 over ``pipe``. For
+        ``n_chunks == 1`` that is the [L] layer dim (the [S, L/S] regroup
+        is then a contiguous local reshape); for ``n_chunks > 1`` the
+        params are created directly in the interleaved [S, V, Lc] layout,
+        so dim 0 IS the stage placement — either way no trunk weight
+        crosses the pipe axis at step time. Embeddings/head replicate
+        (sharded variants are the TP rules' job in the dense family)."""
         return [
             (r"(ln1|ln2|qkv|out|up|down)_[kgb]", P("pipe")),
             (r"wte|wpe|lnf_[gb]", P()),
         ]
 
 
+def stack_dense_params(dense_params: dict, n_stages: int = 1,
+                       n_chunks: int = 1) -> dict:
+    """``TransformerLM``/``GPT2`` param tree -> ``PipelinedLM`` params.
+
+    The two families share the exact block math (pre-LN GPT-2 block,
+    tied head), differing only in layout: per-layer ``h_{i}/...``
+    submodules vs stacked raw tensors. This converts a trained dense
+    checkpoint for pipelined fine-tuning/serving (and powers the
+    loss-parity test pinning the math equivalence). For a circular-
+    schedule model pass its ``n_stages``/``n_chunks`` so the trunk lands
+    in the interleaved [S, V, Lc, ...] layout the model declares.
+    """
+    if "lm_head" in dense_params:
+        raise ValueError(
+            "dense checkpoint has an untied lm_head; PipelinedLM ties "
+            "its head to wte, so converting would silently change the "
+            "logits — untie is not supported in the pipelined family"
+        )
+    layers = sorted(
+        (int(k.split("_")[1]) for k in dense_params if k.startswith("h_")),
+    )
+    if layers != list(range(len(layers))):
+        raise ValueError(f"non-contiguous dense layer indices: {layers}")
+    S, V = int(n_stages), int(n_chunks)
+    L = len(layers)
+    if V > 1 and L % (S * V):
+        raise ValueError(
+            f"n_layer {L} not divisible by n_stages*n_chunks {S * V}"
+        )
+
+    def stacked(path_fn):
+        flat = jnp.stack([path_fn(dense_params[f"h_{i}"]) for i in layers])
+        if V == 1:
+            return flat
+        lc = L // (S * V)
+        # layer i -> virtual stage g = i // lc -> entry [g % S, g // S]
+        g_major = flat.reshape((V * S, lc) + flat.shape[1:])
+        vs = g_major.reshape((V, S, lc) + flat.shape[1:])
+        return jnp.transpose(vs, (1, 0) + tuple(range(2, vs.ndim)))
+
+    return {
+        "wte": jnp.asarray(dense_params["wte"]["embedding"]),
+        "wpe": jnp.asarray(dense_params["wpe"]),
+        "ln1_g": stacked(lambda h: h["ln_1"]["scale"]),
+        "ln1_b": stacked(lambda h: h["ln_1"]["bias"]),
+        "qkv_k": stacked(lambda h: h["attn"]["qkv"]["kernel"]),
+        "qkv_b": stacked(lambda h: h["attn"]["qkv"]["bias"]),
+        "out_k": stacked(lambda h: h["attn"]["out"]["kernel"]),
+        "out_b": stacked(lambda h: h["attn"]["out"]["bias"]),
+        "ln2_g": stacked(lambda h: h["ln_2"]["scale"]),
+        "ln2_b": stacked(lambda h: h["ln_2"]["bias"]),
+        "up_k": stacked(lambda h: h["mlp"]["up"]["kernel"]),
+        "up_b": stacked(lambda h: h["mlp"]["up"]["bias"]),
+        "down_k": stacked(lambda h: h["mlp"]["down"]["kernel"]),
+        "down_b": stacked(lambda h: h["mlp"]["down"]["bias"]),
+        "lnf_g": jnp.asarray(dense_params["ln_f"]["scale"]),
+        "lnf_b": jnp.asarray(dense_params["ln_f"]["bias"]),
+    }
+
+
 @MODELS.register("PipelinedLM")
 def pipelined_lm(vocab_size: int = 50257, n_layer: int = 12,
                  n_head: int = 12, d_model: int = 768, max_len: int = 1024,
                  n_stages: int = 2, n_microbatches: int = 4,
-                 bfloat16: bool = False, mesh=None, **overrides):
+                 n_chunks: int = 1, remat: bool = False,
+                 fused_head: bool = False, bfloat16: bool = False,
+                 mesh=None, **overrides):
     return PipelinedLM(
         vocab_size=vocab_size, n_layer=n_layer, n_head=n_head,
         d_model=d_model, max_len=max_len, n_stages=n_stages,
-        n_microbatches=n_microbatches,
+        n_microbatches=n_microbatches, n_chunks=n_chunks, remat=remat,
+        fused_head=fused_head,
         dtype=jnp.bfloat16 if bfloat16 else jnp.float32, mesh=mesh,
         **overrides,
+    )
+
+
+@MODELS.register("GPT2Pipelined")
+def gpt2_pipelined(size: str = "gpt2-small", vocab_size: int = 50257,
+                   max_len: int = 1024, n_stages: int = 4,
+                   n_microbatches: int = 8, n_chunks: int = 1,
+                   remat: bool = True, fused_head: bool = True,
+                   bfloat16: bool = True, mesh=None, **overrides):
+    """GPT-2 family sizes through the pipeline (same math and convertible
+    weights as ``GPT2`` via ``stack_dense_params``)."""
+    from .transformer import _GPT2_SIZES
+
+    cfg = dict(_GPT2_SIZES[size])
+    cfg.update(overrides)
+    return pipelined_lm(
+        vocab_size=vocab_size, max_len=max_len, n_stages=n_stages,
+        n_microbatches=n_microbatches, n_chunks=n_chunks, remat=remat,
+        fused_head=fused_head, bfloat16=bfloat16, mesh=mesh, **cfg,
     )
 
 
 @MODELS.register("TinyPipeLM")
 def tiny_pipe_lm(vocab_size: int = 256, n_layer: int = 4, n_head: int = 4,
                  d_model: int = 64, max_len: int = 128, n_stages: int = 2,
-                 n_microbatches: int = 4, bfloat16: bool = False, mesh=None):
+                 n_microbatches: int = 4, n_chunks: int = 1,
+                 remat: bool = False, fused_head: bool = False,
+                 bfloat16: bool = False, mesh=None):
     """Small pipelined config for tests and the multi-chip dry run."""
     return pipelined_lm(
         vocab_size=vocab_size, n_layer=n_layer, n_head=n_head,
         d_model=d_model, max_len=max_len, n_stages=n_stages,
-        n_microbatches=n_microbatches, bfloat16=bfloat16, mesh=mesh,
+        n_microbatches=n_microbatches, n_chunks=n_chunks, remat=remat,
+        fused_head=fused_head, bfloat16=bfloat16, mesh=mesh,
     )
