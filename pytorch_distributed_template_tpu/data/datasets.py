@@ -298,6 +298,57 @@ def npy_loader(data_dir: str = "data/", batch_size: int = 128,
                               normalize=normalize)
 
 
+@LOADERS.register("ShardedImageNetLoader")
+def sharded_imagenet_loader(data_dir: str = "data/imagenet_shards/",
+                            batch_size: int = 128, shuffle: bool = True,
+                            num_workers: int = 0, training: bool = True,
+                            normalize: Optional[dict] = None,
+                            synthetic_n: int = 1024,
+                            image_size: int = 224, num_classes: int = 1000,
+                            seed: int = 0):
+    """Out-of-core ImageNet-scale loader over uint8 mmap shards.
+
+    Expects ``{split}_images_NNNN.npy`` / ``{split}_labels_NNNN.npy``
+    under ``data_dir`` (write them with ``scripts/make_image_shards.py``
+    or ``data.sharded.write_image_shards``). The shard set is presented
+    as one virtual array (``data/sharded.ShardedU8Array``): batches are
+    gathered straight out of the memory-mapped pages by the C++ batcher
+    with the fused uint8 -> normalized float32 conversion, so a dataset
+    bigger than host RAM trains from disk with the OS page cache as the
+    working set. Composes with ShardedSampler (multi-host),
+    host_prefetch and prefetch_to_device unchanged. Falls back to the
+    synthetic in-memory ImageNet when no shards exist (the degradation
+    contract every loader here follows).
+
+    Default ``normalize`` is the standard ImageNet mean/std.
+    """
+    del num_workers
+    from .sharded import open_sharded_split
+
+    if normalize is None:
+        # on_device: uint8 crosses the host->device link (4x less
+        # traffic) and the normalize fuses into the first conv under jit
+        normalize = {"mean": [0.485, 0.456, 0.406],
+                     "std": [0.229, 0.224, 0.225], "on_device": True}
+    pair = open_sharded_split(data_dir, training)
+    if pair is None:
+        logger.warning(
+            "ShardedImageNetLoader: no shards under %s; using synthetic "
+            "ImageNet (n=%d). Convert real data with "
+            "scripts/make_image_shards.py.", data_dir, synthetic_n,
+        )
+        data = synthetic_imagenet(
+            n=synthetic_n, image_size=image_size, seed=seed,
+            training=training, num_classes=num_classes,
+        )
+        return _make_image_loader(data, batch_size, shuffle, seed=seed)
+    images, labels = pair
+    return _make_image_loader(
+        {"image": images, "label": labels}, batch_size, shuffle,
+        seed=seed, normalize=normalize,
+    )
+
+
 @LOADERS.register("SyntheticImageNetLoader")
 def imagenet_loader(data_dir: str = "data/", batch_size: int = 128,
                     shuffle: bool = True, num_workers: int = 0,

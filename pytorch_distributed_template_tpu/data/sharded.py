@@ -1,0 +1,187 @@
+"""Out-of-core sharded image datasets (uint8 mmap shards).
+
+The image analogue of ``ByteLMLoader``'s beyond-RAM contract
+(datasets.py): a dataset too big for host memory (real ImageNet is
+~150 GB as uint8 224^2) lives on disk as N aligned ``.npy`` shards
+
+    <data_dir>/<split>_images_0000.npy   uint8 [n_i, H, W, C]
+    <data_dir>/<split>_labels_0000.npy   int   [n_i]
+    ...
+
+each memory-mapped, never materialized. ``ShardedU8Array`` presents the
+shard set as one virtual [N, H, W, C] array whose ``gather`` /
+``gather_normalize`` group a batch's global indices by shard and copy
+rows straight out of the mapped pages with the C++ multithreaded
+batcher (data/native) — the OS page cache is the working set, so
+sequential epochs over a dataset larger than RAM stream at disk/cache
+speed while the fused uint8 -> normalized-float32 conversion still
+happens in one pass. Composes unchanged with ``ShardedSampler``
+(per-host index shards), ``host_prefetch`` (gather on a background
+thread) and ``prefetch_to_device`` (async H2D) — the full SURVEY §7
+hard-part (b) overlap story.
+
+``write_image_shards`` is the converter (also exposed as
+``scripts/make_image_shards.py``); it streams, so the source can be a
+generator and never needs to fit in memory either.
+"""
+from __future__ import annotations
+
+import re
+from pathlib import Path
+from typing import Iterable, Optional, Sequence, Tuple
+
+import numpy as np
+
+from . import native
+
+
+class ShardedU8Array:
+    """Read-only virtual concatenation of aligned uint8 ``.npy`` shards.
+
+    Supports exactly what ``ArrayDataLoader`` needs: ``len``, ``shape``,
+    ``dtype``, and batched row ``gather``/``gather_normalize`` by global
+    index. Shards are memory-mapped lazily at construction and stay
+    mapped (cheap: address space, not RAM).
+    """
+
+    def __init__(self, paths: Sequence[Path]):
+        if not paths:
+            raise ValueError("ShardedU8Array needs at least one shard")
+        self.shards = [np.load(p, mmap_mode="r") for p in paths]
+        base = self.shards[0]
+        if base.dtype != np.uint8:
+            raise ValueError(
+                f"image shards must be uint8, got {base.dtype} ({paths[0]})"
+            )
+        for p, s in zip(paths, self.shards):
+            if s.shape[1:] != base.shape[1:] or s.dtype != base.dtype:
+                raise ValueError(
+                    f"shard {p} shape {s.shape}/{s.dtype} mismatches "
+                    f"{base.shape}/{base.dtype}"
+                )
+        # offsets[i] = first global index of shard i; searchsorted maps
+        # global index -> shard
+        counts = np.asarray([len(s) for s in self.shards], np.int64)
+        self.offsets = np.concatenate([[0], np.cumsum(counts)])
+        self.shape = (int(self.offsets[-1]),) + base.shape[1:]
+        self.dtype = base.dtype
+
+    def __len__(self) -> int:
+        return self.shape[0]
+
+    def __getitem__(self, key):
+        """Row slicing/fancy indexing, materialized via ``gather`` — the
+        trainer/evaluator take ``arrays[key][:1]`` as the model-init
+        template, and numpy-style access keeps the virtual array a
+        drop-in for a real one in any read-only use."""
+        if isinstance(key, slice):
+            return self.gather(np.arange(*key.indices(len(self))))
+        if isinstance(key, (int, np.integer)):
+            return self.gather(np.asarray([key]))[0]
+        return self.gather(np.asarray(key))
+
+    def _per_shard(self, idx: np.ndarray):
+        """Yield (shard_array, local_indices, dest_positions) groups."""
+        idx = np.asarray(idx, np.int64)
+        if len(idx) and (idx.min() < 0 or idx.max() >= len(self)):
+            raise IndexError("sharded gather index out of range")
+        shard_of = np.searchsorted(self.offsets, idx, side="right") - 1
+        for s in np.unique(shard_of):
+            pos = np.nonzero(shard_of == s)[0]
+            yield self.shards[s], idx[pos] - self.offsets[s], pos
+
+    def gather(self, idx: np.ndarray) -> np.ndarray:
+        out = np.empty((len(idx),) + self.shape[1:], self.dtype)
+        for shard, local, pos in self._per_shard(idx):
+            out[pos] = native.gather(shard, local)
+        return out
+
+    def gather_normalize(self, idx: np.ndarray, mean: np.ndarray,
+                         std: np.ndarray) -> np.ndarray:
+        out = np.empty((len(idx),) + self.shape[1:], np.float32)
+        for shard, local, pos in self._per_shard(idx):
+            out[pos] = native.gather_normalize_u8(shard, local, mean, std)
+        return out
+
+
+def find_shards(data_dir, split: str,
+                kind: str = "images") -> list:
+    """Sorted shard paths ``<split>_<kind>_<NNNN>.npy`` under ``data_dir``."""
+    pat = re.compile(rf"{split}_{kind}_(\d+)\.npy$")
+    hits = []
+    for p in Path(data_dir).glob(f"{split}_{kind}_*.npy"):
+        m = pat.search(p.name)
+        if m:
+            hits.append((int(m.group(1)), p))
+    return [p for _, p in sorted(hits)]
+
+
+def load_sharded_labels(paths: Sequence[Path]) -> np.ndarray:
+    """Concatenate label shards, materialized as int32 (labels are ~4 B
+    per sample — resident even at ImageNet scale)."""
+    return np.concatenate(
+        [np.asarray(np.load(p, mmap_mode="r"), np.int32) for p in paths]
+    )
+
+
+def write_image_shards(samples: Iterable[Tuple[np.ndarray, int]],
+                       out_dir, split: str = "train",
+                       shard_size: int = 8192) -> int:
+    """Stream ``(uint8 image, int label)`` samples into aligned shards.
+
+    Returns the number of samples written. Only one shard's images are
+    ever buffered (shard_size * image bytes), so arbitrarily large
+    datasets convert in bounded memory.
+    """
+    out = Path(out_dir)
+    out.mkdir(parents=True, exist_ok=True)
+    buf_x: list = []
+    buf_y: list = []
+    shard = 0
+    total = 0
+
+    def flush():
+        nonlocal shard, buf_x, buf_y
+        if not buf_x:
+            return
+        x = np.stack(buf_x).astype(np.uint8)
+        y = np.asarray(buf_y, np.int32)
+        np.save(out / f"{split}_images_{shard:04d}.npy", x)
+        np.save(out / f"{split}_labels_{shard:04d}.npy", y)
+        shard += 1
+        buf_x, buf_y = [], []
+
+    for img, label in samples:
+        buf_x.append(np.asarray(img, np.uint8))
+        buf_y.append(int(label))
+        total += 1
+        if len(buf_x) >= shard_size:
+            flush()
+    flush()
+    return total
+
+
+def open_sharded_split(data_dir, training: bool
+                       ) -> Optional[Tuple[ShardedU8Array, np.ndarray]]:
+    """(images, labels) for a split's shard set, or None when absent."""
+    split = "train" if training else "val"
+    img_paths = find_shards(data_dir, split, "images")
+    lbl_paths = find_shards(data_dir, split, "labels")
+    if not img_paths and not lbl_paths:
+        return None  # genuinely no shards: caller may fall back
+    if len(img_paths) != len(lbl_paths):
+        # shards EXIST but are unpaired (interrupted converter run):
+        # silent synthetic fallback would train on the wrong data
+        raise ValueError(
+            f"sharded split {split} under {data_dir} is corrupt: "
+            f"{len(img_paths)} image shards vs {len(lbl_paths)} label "
+            "shards — re-run scripts/make_image_shards.py"
+        )
+    images = ShardedU8Array(img_paths)
+    labels = load_sharded_labels(lbl_paths)
+    if len(images) != len(labels):
+        raise ValueError(
+            f"sharded split {split}: {len(images)} images vs "
+            f"{len(labels)} labels"
+        )
+    return images, labels

@@ -150,8 +150,14 @@ def evaluate(config, mesh=None, save_outputs=None) -> dict:
     input_key = dk.get("input", "image")
     target_key = dk.get("target", "label")
 
+    template = test_loader.arrays[input_key][:1]
+    device_transform = getattr(test_loader, "device_transform", None)
+    if device_transform is not None:
+        template = np.asarray(
+            device_transform({input_key: template})[input_key]
+        )
     state, ema_decay = restore_template_state(
-        config, model, mesh, template=test_loader.arrays[input_key][:1]
+        config, model, mesh, template=template
     )
 
     eval_step = jax.jit(
@@ -176,7 +182,8 @@ def evaluate(config, mesh=None, save_outputs=None) -> dict:
         dumped_out, dumped_tgt = [], []
 
     accum = None
-    for batch in prefetch_to_device(test_loader, batch_sharding(mesh)):
+    for batch in prefetch_to_device(test_loader, batch_sharding(mesh),
+                                    transform=device_transform):
         m = eval_step(state, batch)
         accum = m if accum is None else jax.tree.map(jnp.add, accum, m)
         if output_step is not None:

@@ -272,8 +272,20 @@ class Trainer(BaseTrainer):
         # --- state init + placement (multi-host-legal jit creation; see
         # engine/state.create_sharded_train_state) --------------------------
         ema_decay = float(config["trainer"].get("ema_decay", 0.0))
+        template = train_loader.arrays[self.input_key][:1]
+        self._device_transform = getattr(
+            train_loader, "device_transform", None
+        )
+        if self._device_transform is not None:
+            # init must trace the model with the dtype it will actually
+            # see (e.g. float32 after on-device uint8 normalization)
+            template = np.asarray(
+                self._device_transform({self.input_key: template})[
+                    self.input_key
+                ]
+            )
         self.state, self.state_sharding = create_sharded_train_state(
-            model, self.tx, train_loader.arrays[self.input_key][:1],
+            model, self.tx, template,
             self.mesh, seed=seed, with_ema=ema_decay > 0,
         )
         self.batch_sharding = batch_sharding(self.mesh)
@@ -396,7 +408,8 @@ class Trainer(BaseTrainer):
         depth = int(self.config["trainer"].get("host_prefetch", 2))
         if depth > 0:
             batches = host_prefetch(batches, depth)
-        prefetched = prefetch_to_device(batches, self.batch_sharding)
+        prefetched = prefetch_to_device(batches, self.batch_sharding,
+                                        transform=self._device_transform)
         main = dist.is_main_process()
         # Mid-epoch preemption polling: the SIGTERM notice window (~30s on
         # cloud TPUs) is far shorter than an ImageNet epoch, so waiting for
@@ -559,7 +572,10 @@ class Trainer(BaseTrainer):
         if hasattr(self.valid_loader, "set_epoch"):
             self.valid_loader.set_epoch(epoch)
         accum = None
-        for batch in prefetch_to_device(self.valid_loader, self.batch_sharding):
+        for batch in prefetch_to_device(
+            self.valid_loader, self.batch_sharding,
+            transform=getattr(self.valid_loader, "device_transform", None),
+        ):
             m = self._eval_step(self.state, batch)
             accum = m if accum is None else jax.tree.map(jnp.add, accum, m)
             self.watchdog.beat()

@@ -1,0 +1,142 @@
+#!/usr/bin/env python
+"""Convert an image dataset into out-of-core uint8 mmap shards.
+
+Produces the on-disk layout ``ShardedImageNetLoader`` trains from:
+
+    <out>/{split}_images_0000.npy  uint8 [n, H, W, C]
+    <out>/{split}_labels_0000.npy  int32 [n]
+    ...
+
+Sources (pick one):
+
+- ``--from-npy IMAGES.npy LABELS.npy``: re-shard one big aligned pair
+  (images uint8 or float in [0, 1]/[0, 255]; streamed via mmap, so the
+  input may exceed RAM).
+- ``--from-folder DIR``: an ImageFolder-style tree ``DIR/<class>/<img>``
+  decoded with Pillow and resized (requires ``pillow``; not baked into
+  every image — the npy path has no dependencies).
+- ``--synthetic N``: deterministic synthetic ImageNet (smoke tests and
+  loader benchmarks without real data).
+
+Conversion streams one shard at a time — bounded memory at any dataset
+size.
+
+Examples:
+    python scripts/make_image_shards.py --synthetic 4096 \
+        --out data/imagenet_shards --split train
+    python scripts/make_image_shards.py \
+        --from-npy train_images.npy train_labels.npy \
+        --out data/imagenet_shards --split train --shard-size 8192
+"""
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+import numpy as np
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from pytorch_distributed_template_tpu.data.sharded import (  # noqa: E402
+    write_image_shards,
+)
+
+
+def _float_scale(images, probe: int = 256) -> float:
+    """ONE dataset-level decision for float sources: values look like
+    [0, 1] (scale by 255) or already [0, 255] (scale by 1). Probing a
+    sample prefix instead of per-image keeps dark images from being
+    scaled differently than their neighbors."""
+    hi = float(np.max(np.abs(np.asarray(images[:probe], np.float32))))
+    return 255.0 if hi <= 1.0 else 1.0
+
+
+def _to_u8(img: np.ndarray, scale: float = 1.0) -> np.ndarray:
+    if img.dtype == np.uint8:
+        return img
+    x = np.asarray(img, np.float32) * scale
+    return np.clip(x, 0, 255).astype(np.uint8)
+
+
+def _iter_npy(images_path: str, labels_path: str):
+    images = np.load(images_path, mmap_mode="r")
+    labels = np.load(labels_path, mmap_mode="r")
+    if len(images) != len(labels):
+        raise SystemExit(
+            f"{images_path} has {len(images)} samples but {labels_path} "
+            f"has {len(labels)}"
+        )
+    scale = 1.0 if images.dtype == np.uint8 else _float_scale(images)
+    for i in range(len(images)):
+        yield _to_u8(images[i], scale), int(labels[i])
+
+
+def _iter_folder(root: str, image_size: int):
+    try:
+        from PIL import Image
+    except ImportError:
+        raise SystemExit(
+            "--from-folder needs pillow (pip install pillow); for a "
+            "dependency-free path preprocess to .npy and use --from-npy"
+        )
+    exts = {".jpg", ".jpeg", ".png", ".bmp", ".webp", ".gif", ".tiff"}
+    root_p = Path(root)
+    classes = sorted(p.name for p in root_p.iterdir() if p.is_dir())
+    for label, cls in enumerate(classes):
+        for img_path in sorted((root_p / cls).iterdir()):
+            # skip .DS_Store/Thumbs.db/READMEs etc. instead of aborting
+            # mid-conversion with a partial shard set on disk
+            if img_path.suffix.lower() not in exts:
+                continue
+            with Image.open(img_path) as im:
+                im = im.convert("RGB").resize((image_size, image_size))
+                yield np.asarray(im, np.uint8), label
+
+
+def _iter_synthetic(n: int, image_size: int, split: str):
+    from pytorch_distributed_template_tpu.data.datasets import (
+        synthetic_imagenet,
+    )
+
+    data = synthetic_imagenet(n=n, image_size=image_size,
+                              training=split == "train")
+    # synthetic pixels are ~N(0,1); min-max rescale the dataset into the
+    # uint8 range so the learnable class structure survives quantization
+    x = data["image"]
+    lo, hi = float(np.min(x)), float(np.max(x))
+    span = max(hi - lo, 1e-9)
+    for i in range(n):
+        img = (np.asarray(x[i], np.float32) - lo) / span * 255.0
+        yield img.astype(np.uint8), int(data["label"][i])
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(
+        description=__doc__, formatter_class=argparse.RawDescriptionHelpFormatter
+    )
+    src = ap.add_mutually_exclusive_group(required=True)
+    src.add_argument("--from-npy", nargs=2, metavar=("IMAGES", "LABELS"))
+    src.add_argument("--from-folder", metavar="DIR")
+    src.add_argument("--synthetic", type=int, metavar="N")
+    ap.add_argument("--out", required=True)
+    ap.add_argument("--split", default="train", choices=["train", "val"])
+    ap.add_argument("--shard-size", type=int, default=8192)
+    ap.add_argument("--image-size", type=int, default=224)
+    args = ap.parse_args()
+
+    if args.from_npy:
+        it = _iter_npy(*args.from_npy)
+    elif args.from_folder:
+        it = _iter_folder(args.from_folder, args.image_size)
+    else:
+        it = _iter_synthetic(args.synthetic, args.image_size, args.split)
+
+    n = write_image_shards(it, args.out, split=args.split,
+                           shard_size=args.shard_size)
+    print(f"wrote {n} samples to {args.out} "
+          f"({-(-n // args.shard_size)} shards of <= {args.shard_size})")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,193 @@
+"""Out-of-core sharded image pipeline (data/sharded.py).
+
+The beyond-RAM contract: uint8 mmap shards -> virtual concatenation ->
+C++ fused gather-normalize -> ShardedSampler / host_prefetch
+composition, plus the loader-only throughput proof that batch assembly
+sustains the accelerator's ResNet-50 step rate (VERDICT r1 item 2).
+"""
+import time
+
+import numpy as np
+import pytest
+
+from pytorch_distributed_template_tpu.config.registry import LOADERS
+import pytorch_distributed_template_tpu.data  # noqa: F401
+from pytorch_distributed_template_tpu.data.loader import (
+    ArrayDataLoader, host_prefetch,
+)
+from pytorch_distributed_template_tpu.data.sampler import ShardedSampler
+from pytorch_distributed_template_tpu.data.sharded import (
+    ShardedU8Array, find_shards, open_sharded_split, write_image_shards,
+)
+
+H = W = 8
+C = 3
+
+
+def _write_split(tmp_path, n=50, split="train", shard_size=16, seed=0):
+    rng = np.random.default_rng(seed)
+    images = rng.integers(0, 256, (n, H, W, C)).astype(np.uint8)
+    labels = rng.integers(0, 10, n).astype(np.int32)
+    count = write_image_shards(
+        zip(images, labels), tmp_path, split=split, shard_size=shard_size
+    )
+    assert count == n
+    return images, labels
+
+
+def test_gather_crosses_shard_boundaries(tmp_path):
+    images, labels = _write_split(tmp_path, n=50, shard_size=16)  # 4 shards
+    paths = find_shards(tmp_path, "train", "images")
+    assert len(paths) == 4  # 16+16+16+2
+    virt = ShardedU8Array(paths)
+    assert len(virt) == 50 and virt.shape == (50, H, W, C)
+    # indices deliberately straddling every boundary, unsorted, repeated
+    idx = np.asarray([0, 15, 16, 17, 31, 32, 47, 48, 49, 3, 48, 0])
+    np.testing.assert_array_equal(virt.gather(idx), images[idx])
+
+    mean = np.asarray([0.5, 0.4, 0.3], np.float32)
+    std = np.asarray([0.2, 0.3, 0.4], np.float32)
+    ref = (images[idx].astype(np.float32) / 255.0 - mean) / std
+    np.testing.assert_allclose(virt.gather_normalize(idx, mean, std), ref,
+                               rtol=1e-6, atol=1e-6)
+
+    with pytest.raises(IndexError):
+        virt.gather(np.asarray([50]))
+
+
+def test_open_split_and_loader_end_to_end(tmp_path):
+    images, labels = _write_split(tmp_path, n=40, shard_size=16)
+    virt, lbl = open_sharded_split(tmp_path, training=True)
+    np.testing.assert_array_equal(lbl, labels)
+
+    loader = ArrayDataLoader(
+        {"image": virt, "label": lbl}, batch_size=16, shuffle=True, seed=3,
+        normalize={"mean": [0.5, 0.5, 0.5], "std": [0.25, 0.25, 0.25]},
+    )
+    seen = []
+    for batch in loader:
+        assert batch["image"].dtype == np.float32
+        assert batch["image"].shape == (16, H, W, C)
+        seen.extend(np.asarray(
+            batch["label"][batch["mask"]]
+        ).tolist())
+    # every sample exactly once despite padding of the last batch
+    assert len(seen) == 40
+
+
+def test_composes_with_sharded_sampler(tmp_path):
+    """Two simulated hosts: their sharded loaders jointly cover the
+    dataset exactly once, each gathering only its own index shard."""
+    images, labels = _write_split(tmp_path, n=48, shard_size=16)
+    virt, lbl = open_sharded_split(tmp_path, training=True)
+    got = []
+    for host in range(2):
+        sampler = ShardedSampler(num_samples=48, num_shards=2,
+                                 shard_index=host, shuffle=True, seed=5)
+        loader = ArrayDataLoader({"image": virt, "label": lbl},
+                                 batch_size=8, sampler=sampler)
+        for batch in host_prefetch(iter(loader)):
+            got.extend(np.asarray(batch["label"][batch["mask"]]).tolist())
+    assert sorted(got) == sorted(labels.tolist())
+
+
+def test_loader_registry_fallback_and_real(tmp_path):
+    # no shards -> synthetic fallback, still iterable
+    loader = LOADERS.get("ShardedImageNetLoader")(
+        data_dir=str(tmp_path / "missing"), batch_size=8, synthetic_n=16,
+        image_size=32,
+    )
+    batch = next(iter(loader))
+    assert batch["image"].shape[0] == 8
+
+    # real shards -> the virtual mmap array; default normalization is
+    # on-device, so batches stay uint8 on the host (4x less H2D traffic)
+    # and device_transform carries the ImageNet mean/std
+    _write_split(tmp_path, n=32, shard_size=16)
+    loader = LOADERS.get("ShardedImageNetLoader")(
+        data_dir=str(tmp_path), batch_size=8,
+    )
+    batch = next(iter(loader))
+    assert batch["image"].dtype == np.uint8
+    assert batch["image"].shape == (8, H, W, C)
+    assert loader.device_transform is not None
+    assert len(loader) == 4
+
+    # host-side normalization still selectable
+    loader_h = LOADERS.get("ShardedImageNetLoader")(
+        data_dir=str(tmp_path), batch_size=8,
+        normalize={"mean": [0.485, 0.456, 0.406],
+                   "std": [0.229, 0.224, 0.225]},
+    )
+    batch = next(iter(loader_h))
+    assert batch["image"].dtype == np.float32
+    assert loader_h.device_transform is None
+
+
+@pytest.mark.slow
+def test_throughput_sustains_bench_step_rate(tmp_path):
+    """Loader-only assembly rate at ImageNet shapes must beat the
+    accelerator's measured ResNet-50 train step rate (~666 img/s on one
+    v5e chip, BENCH r2), else the input pipeline would starve the TPU.
+    Measured through the full production path: mmap shards -> fused C++
+    gather-normalize -> host_prefetch, batch 128 at 224x224x3."""
+    n, bs = 1024, 128
+    rng = np.random.default_rng(0)
+
+    def samples():
+        for i in range(n):
+            yield rng.integers(0, 256, (224, 224, 3), np.uint8), i % 1000
+
+    write_image_shards(samples(), tmp_path, split="train", shard_size=256)
+    virt, lbl = open_sharded_split(tmp_path, training=True)
+    loader = ArrayDataLoader(
+        {"image": virt, "label": lbl}, batch_size=bs, shuffle=True,
+        normalize={"mean": [0.485, 0.456, 0.406],
+                   "std": [0.229, 0.224, 0.225]},
+    )
+    # warm the page cache (freshly written files are usually cached
+    # anyway; steady-state training reads cached + readahead pages)
+    for _ in host_prefetch(iter(loader)):
+        pass
+    t0 = time.perf_counter()
+    count = 0
+    for batch in host_prefetch(iter(loader)):
+        count += int(batch["mask"].sum())
+    rate = count / (time.perf_counter() - t0)
+    assert count == n
+    assert rate > 666, f"loader assembles only {rate:.0f} img/s"
+
+
+def test_on_device_normalize_matches_host(tmp_path):
+    """normalize.on_device: the loader emits raw uint8 and
+    device_transform reproduces the host-side fused normalization
+    exactly; prefetch_to_device applies it post-transfer."""
+    import jax
+
+    from pytorch_distributed_template_tpu.data.loader import (
+        prefetch_to_device,
+    )
+    from pytorch_distributed_template_tpu.parallel import (
+        batch_sharding, build_mesh,
+    )
+
+    images, labels = _write_split(tmp_path, n=32, shard_size=16)
+    virt, lbl = open_sharded_split(tmp_path, training=True)
+    norm = {"mean": [0.485, 0.456, 0.406], "std": [0.229, 0.224, 0.225]}
+
+    host = ArrayDataLoader({"image": virt, "label": lbl}, batch_size=8,
+                           shuffle=False, normalize=dict(norm))
+    dev = ArrayDataLoader({"image": virt, "label": lbl}, batch_size=8,
+                          shuffle=False,
+                          normalize={**norm, "on_device": True})
+    raw = next(iter(dev))
+    assert raw["image"].dtype == np.uint8  # uint8 over the link
+
+    mesh = build_mesh({"data": 8})
+    got = next(iter(prefetch_to_device(
+        iter(dev), batch_sharding(mesh), transform=dev.device_transform
+    )))
+    ref = next(iter(host))
+    np.testing.assert_allclose(np.asarray(got["image"]), ref["image"],
+                               rtol=1e-6, atol=1e-6)
+    np.testing.assert_array_equal(np.asarray(got["label"]), ref["label"])
