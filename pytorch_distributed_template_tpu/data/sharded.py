@@ -83,6 +83,8 @@ class ShardedU8Array:
     def _per_shard(self, idx: np.ndarray):
         """Yield (shard_array, local_indices, dest_positions) groups."""
         idx = np.asarray(idx, np.int64)
+        if len(idx) and idx.min() < 0:
+            idx = np.where(idx < 0, idx + len(self), idx)  # numpy semantics
         if len(idx) and (idx.min() < 0 or idx.max() >= len(self)):
             raise IndexError("sharded gather index out of range")
         shard_of = np.searchsorted(self.offsets, idx, side="right") - 1

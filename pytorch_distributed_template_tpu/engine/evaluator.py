@@ -181,9 +181,16 @@ def evaluate(config, mesh=None, save_outputs=None) -> dict:
         )
         dumped_out, dumped_tgt = [], []
 
+    from ..utils.util import maybe_tqdm
+
+    batches = prefetch_to_device(test_loader, batch_sharding(mesh),
+                                 transform=device_transform)
+    if dist.is_main_process():
+        # reference test.py:71 wraps the eval loop in tqdm (TTY-gated)
+        batches = maybe_tqdm(batches, total=len(test_loader), desc="eval",
+                             enable=config["trainer"].get("progress"))
     accum = None
-    for batch in prefetch_to_device(test_loader, batch_sharding(mesh),
-                                    transform=device_transform):
+    for batch in batches:
         m = eval_step(state, batch)
         accum = m if accum is None else jax.tree.map(jnp.add, accum, m)
         if output_step is not None:

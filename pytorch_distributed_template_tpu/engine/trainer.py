@@ -39,6 +39,7 @@ from ..observability.profiler import (
 from ..parallel import batch_sharding, dist, mesh_from_config
 from ..utils import preemption
 from ..utils.debug import configure_debug
+from ..utils.util import maybe_tqdm
 from ..utils.watchdog import StepWatchdog
 from .optim import build_optimizer
 from .state import create_sharded_train_state
@@ -411,6 +412,14 @@ class Trainer(BaseTrainer):
         prefetched = prefetch_to_device(batches, self.batch_sharding,
                                         transform=self._device_transform)
         main = dist.is_main_process()
+        if main:
+            # reference trainer/trainer.py:45 wraps the hot loop in tqdm;
+            # auto-gated on a TTY (or trainer.progress true/false)
+            prefetched = maybe_tqdm(
+                prefetched, total=self.len_epoch,
+                desc=f"train {epoch}",
+                enable=self.config["trainer"].get("progress"),
+            )
         # Mid-epoch preemption polling: the SIGTERM notice window (~30s on
         # cloud TPUs) is far shorter than an ImageNet epoch, so waiting for
         # the epoch edge would forfeit the save. Single-host polls the free
@@ -572,10 +581,17 @@ class Trainer(BaseTrainer):
         if hasattr(self.valid_loader, "set_epoch"):
             self.valid_loader.set_epoch(epoch)
         accum = None
-        for batch in prefetch_to_device(
+        val_batches = prefetch_to_device(
             self.valid_loader, self.batch_sharding,
             transform=getattr(self.valid_loader, "device_transform", None),
-        ):
+        )
+        if dist.is_main_process():
+            val_batches = maybe_tqdm(
+                val_batches, total=len(self.valid_loader),
+                desc=f"valid {epoch}",
+                enable=self.config["trainer"].get("progress"),
+            )
+        for batch in val_batches:
             m = self._eval_step(self.state, batch)
             accum = m if accum is None else jax.tree.map(jnp.add, accum, m)
             self.watchdog.beat()

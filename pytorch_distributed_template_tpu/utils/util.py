@@ -39,6 +39,28 @@ def inf_loop(data_loader):
         yield from loader
 
 
+def maybe_tqdm(iterable, total=None, desc: str = "", enable=None):
+    """Wrap in a tqdm progress bar like the reference's hot loops
+    (reference trainer/trainer.py:45, test.py:71), TPU-appropriately
+    gated: only when explicitly enabled or stderr is a TTY (log files
+    must not fill with carriage-return frames), and tqdm stays an
+    optional dependency. ``enable=None`` means auto (TTY detection);
+    callers additionally gate on process 0.
+    """
+    import sys
+
+    if enable is None:
+        enable = getattr(sys.stderr, "isatty", lambda: False)()
+    if not enable:
+        return iterable
+    try:
+        from tqdm import tqdm
+    except ImportError:
+        return iterable
+    return tqdm(iterable, total=total, desc=desc, leave=False,
+                dynamic_ncols=True)
+
+
 def flatten_dict(d, parent_key: str = "", sep: str = "."):
     """Flatten a nested dict: {'a': {'b': 1}} -> {'a.b': 1}."""
     items = {}

@@ -43,13 +43,19 @@ from pytorch_distributed_template_tpu.data.sharded import (  # noqa: E402
 )
 
 
-def _float_scale(images, probe: int = 256) -> float:
+def _float_scale(images, chunk: int = 1024) -> float:
     """ONE dataset-level decision for float sources: values look like
-    [0, 1] (scale by 255) or already [0, 255] (scale by 1). Probing a
-    sample prefix instead of per-image keeps dark images from being
-    scaled differently than their neighbors."""
-    hi = float(np.max(np.abs(np.asarray(images[:probe], np.float32))))
-    return 255.0 if hi <= 1.0 else 1.0
+    [0, 1] (scale by 255) or already [0, 255] (scale by 1). The max is
+    streamed over the FULL mmap in chunks — a prefix probe could decide
+    from unrepresentative (e.g. class-sorted dark) samples and silently
+    corrupt the rest."""
+    hi = 0.0
+    for start in range(0, len(images), chunk):
+        part = np.asarray(images[start:start + chunk], np.float32)
+        hi = max(hi, float(np.max(np.abs(part))))
+    scale = 255.0 if hi <= 1.0 else 1.0
+    print(f"float source: |max| = {hi:.3f} -> scale {scale:g}")
+    return scale
 
 
 def _to_u8(img: np.ndarray, scale: float = 1.0) -> np.ndarray:

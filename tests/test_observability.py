@@ -76,3 +76,19 @@ def test_tb_writer_real_backend(tmp_path):
     w.set_step(1, mode="valid")
     w.add_scalar("loss", 0.4)
     w.close()
+
+
+def test_maybe_tqdm_gating():
+    """Progress bars: off for non-TTY auto mode, on when forced, and a
+    transparent pass-through for the iterable's contents either way."""
+    from pytorch_distributed_template_tpu.utils.util import maybe_tqdm
+
+    data = [1, 2, 3]
+    auto = maybe_tqdm(iter(data))          # stderr is not a TTY in tests
+    assert list(auto) == data
+    off = maybe_tqdm(iter(data), enable=False)
+    assert list(off) == data
+    pytest.importorskip("tqdm")            # optional dependency
+    forced = maybe_tqdm(iter(data), total=3, desc="t", enable=True)
+    assert type(forced).__name__ == "tqdm"
+    assert list(forced) == data
