@@ -45,6 +45,7 @@ from jax.sharding import PartitionSpec as P
 
 from ..config.registry import MODELS
 from ..ops.attention import multihead_attention
+from .llama import apply_rope, rope_tables
 
 
 def _init(stddev):
@@ -56,6 +57,12 @@ def _layer_norm(x, g, b, eps=1e-5):
     mu = xf.mean(-1, keepdims=True)
     var = ((xf - mu) ** 2).mean(-1, keepdims=True)
     return ((xf - mu) / jnp.sqrt(var + eps) * g + b).astype(x.dtype)
+
+
+def _rms_norm(x, g, eps=1e-6):
+    xf = x.astype(jnp.float32)
+    y = xf * jax.lax.rsqrt(jnp.mean(xf * xf, axis=-1, keepdims=True) + eps)
+    return (y * g).astype(x.dtype)
 
 
 def _block_apply(p, x, n_head):
@@ -72,6 +79,130 @@ def _block_apply(p, x, n_head):
     y = nn.gelu(h @ p["up_k"].astype(h.dtype) + p["up_b"].astype(h.dtype))
     x = x + y @ p["down_k"].astype(x.dtype) + p["down_b"].astype(x.dtype)
     return x
+
+
+def _llama_block_apply(p, x, cos, sin, n_head, n_kv_head, eps=1e-6):
+    """One Llama block (pre-RMSNorm, RoPE GQA attention, SwiGLU MLP)
+    from a dict of raw tensors — the exact math of models/llama.py's
+    ``LlamaBlock`` (same rms eps, rotate-half RoPE, silu gating)."""
+    b, t, d = x.shape
+    hd = d // n_head
+    h = _rms_norm(x, p["ln1_g"], eps)
+    q = (h @ p["q_k"].astype(h.dtype)).reshape(b, t, n_head, hd)
+    k = (h @ p["k_k"].astype(h.dtype)).reshape(b, t, n_kv_head, hd)
+    v = (h @ p["v_k"].astype(h.dtype)).reshape(b, t, n_kv_head, hd)
+    q = apply_rope(q, cos, sin)
+    k = apply_rope(k, cos, sin)
+    groups = n_head // n_kv_head
+    if groups > 1:
+        k = jnp.repeat(k, groups, axis=2)
+        v = jnp.repeat(v, groups, axis=2)
+    ctx = multihead_attention(q, k, v, causal=True).reshape(b, t, d)
+    x = x + ctx @ p["o_k"].astype(x.dtype)
+    h = _rms_norm(x, p["ln2_g"], eps)
+    y = nn.silu(h @ p["gate_k"].astype(h.dtype)) * (
+        h @ p["up_k"].astype(h.dtype)
+    )
+    x = x + y @ p["down_k"].astype(x.dtype)
+    return x
+
+
+def _stacked_lead(n_layer: int, n_stages: int, n_chunks: int) -> tuple:
+    """Leading dims of the stacked trunk params (shared by both
+    pipelined families — keep the layout logic in ONE place).
+
+    ``n_chunks == 1``: ``[L]`` — ``P('pipe')`` shards it into the S
+    contiguous blocks the GPipe regroup needs, so the [S, L/S] reshape
+    is local. ``n_chunks == V > 1``: created DIRECTLY in the interleaved
+    ``[S, V, L/(S*V)]`` pipeline layout (entry [s, v] = virtual stage
+    v*S + s) — sharding dim 0 over ``pipe`` is then exactly the circular
+    schedule's placement, with no per-step resharding of trunk weights.
+    """
+    if n_layer % (n_stages * n_chunks):
+        raise ValueError(
+            f"n_layer {n_layer} not divisible by n_stages*n_chunks "
+            f"{n_stages * n_chunks}"
+        )
+    if n_chunks == 1:
+        return (n_layer,)
+    return (n_stages, n_chunks, n_layer // (n_stages * n_chunks))
+
+
+def _microbatch(x, n_microbatches: int):
+    """[B, T, D] -> [M, B/M, T, D] with the shared clamp/divisibility
+    policy (M never exceeds the batch)."""
+    b = x.shape[0]
+    m = min(n_microbatches, b)
+    if b % m:
+        raise ValueError(f"batch {b} not divisible by n_microbatches {m}")
+    return x.reshape((m, b // m) + x.shape[1:])
+
+
+def _run_trunk(blocks, micro, mesh, n_stages: int, n_chunks: int,
+               remat: bool, layer_fn, extras=()):
+    """Shared trunk dispatch for the pipelined families.
+
+    ``blocks``: [L]-stacked (``n_chunks==1``) or [S, V, Lc]-stacked
+    params; ``micro``: [M, mb, T, D] microbatches; ``layer_fn(p_layer, x,
+    extras) -> x`` applies ONE layer. Routes through ``pipeline_apply``
+    when the mesh has a pipe axis, else runs the layers sequentially in
+    layer order; ``remat`` checkpoints each tick either way.
+    """
+    from ..parallel.pipeline import pipeline_apply, regroup_for_pipeline
+
+    L = (jax.tree.leaves(blocks)[0].shape[0] if n_chunks == 1 else
+         n_stages * n_chunks * jax.tree.leaves(blocks)[0].shape[2])
+
+    def stage_fn(p_chunk, mb, ex, _rng):
+        def layer(x, p_layer):
+            return layer_fn(p_layer, x, ex), None
+
+        out, _ = jax.lax.scan(layer, mb, p_chunk)
+        return out
+
+    if remat:
+        # each tick recomputes its internals in the backward: the
+        # schedule's live-activation footprint stops growing with the
+        # microbatch count
+        stage_fn = jax.checkpoint(
+            stage_fn, policy=jax.checkpoint_policies.nothing_saveable,
+        )
+
+    if mesh is not None and "pipe" in mesh.axis_names:
+        staged = (regroup_for_pipeline(blocks, n_stages, 1)
+                  if n_chunks == 1 else blocks)
+        return pipeline_apply(stage_fn, staged, micro, mesh,
+                              n_chunks=n_chunks, extras=extras)
+
+    # no mesh: sequential trunk in plain layer order (same math, no
+    # pipelining). V>1 params are in pipeline layout [S, V, Lc, ...];
+    # flatten back to [L] layer order (local transpose — there is no
+    # pipe axis to reshard over).
+    if n_chunks == 1:
+        flat = blocks
+    else:
+        flat = jax.tree.map(
+            lambda a: jnp.transpose(
+                a, (1, 0) + tuple(range(2, a.ndim))
+            ).reshape((L,) + a.shape[3:]),
+            blocks,
+        )
+
+    body = layer_fn
+    if remat:
+        # keep the remat promise off-mesh too
+        body = jax.checkpoint(
+            layer_fn, policy=jax.checkpoint_policies.nothing_saveable,
+        )
+
+    def run_one(mb):
+        def layer(x, p_layer):
+            return body(p_layer, x, extras), None
+
+        out, _ = jax.lax.scan(layer, mb, flat)
+        return out
+
+    return jax.vmap(run_one)(micro)
 
 
 class PipelinedLM(nn.Module):
@@ -95,32 +226,12 @@ class PipelinedLM(nn.Module):
     dtype: Any = jnp.float32
     mesh: Optional[Any] = None
 
-    def _lead(self):
-        """Leading dims of the stacked trunk params.
-
-        ``n_chunks == 1``: ``[L]`` — ``P('pipe')`` shards it into the S
-        contiguous blocks the GPipe regroup needs, so the [S, L/S]
-        reshape is local. ``n_chunks == V > 1``: created DIRECTLY in the
-        interleaved ``[S, V, L/(S*V)]`` pipeline layout (entry [s, v] =
-        virtual stage v*S + s) — sharding dim 0 over ``pipe`` is then
-        exactly the circular schedule's placement, with no per-step
-        resharding of trunk weights.
-        """
-        S, V = self.n_stages, self.n_chunks
-        if V == 1:
-            return (self.n_layer,)
-        return (S, V, self.n_layer // (S * V))
-
     def _stacked(self, name, init, shape):
-        return self.param(name, init, self._lead() + shape, jnp.float32)
+        lead = _stacked_lead(self.n_layer, self.n_stages, self.n_chunks)
+        return self.param(name, init, lead + shape, jnp.float32)
 
     @nn.compact
     def __call__(self, tokens, train: bool = False):
-        if self.n_layer % (self.n_stages * self.n_chunks):
-            raise ValueError(
-                f"n_layer {self.n_layer} not divisible by "
-                f"n_stages*n_chunks {self.n_stages * self.n_chunks}"
-            )
         d, f = self.d_model, self.d_ff or 4 * self.d_model
         L, S = self.n_layer, self.n_stages
         b, t = tokens.shape
@@ -147,80 +258,13 @@ class PipelinedLM(nn.Module):
             "down_k": self._stacked("down_k", _init(res_std), (f, d)),
             "down_b": self._stacked("down_b", zeros, (d,)),
         }
-        from ..parallel.pipeline import regroup_for_pipeline
-
-        if self.n_chunks == 1:
-            # [L] -> [S, L/S, ...]: contiguous local reshape under the
-            # P('pipe') sharding of dim 0
-            staged = regroup_for_pipeline(blocks, S, 1)
-        else:
-            # already created in the [S, V, Lc, ...] pipeline layout
-            staged = blocks
+        micro = _microbatch(x, self.n_microbatches)
 
         n_head = self.n_head
-
-        def stage_fn(p_chunk, mb, _rng):
-            # apply this chunk's consecutive layers
-            def layer(x, p_layer):
-                return _block_apply(p_layer, x, n_head), None
-
-            out, _ = jax.lax.scan(layer, mb, p_chunk)
-            return out
-
-        if self.remat:
-            # each tick recomputes its internals in the backward: the
-            # schedule's live-activation footprint stops growing with the
-            # microbatch count
-            stage_fn = jax.checkpoint(
-                stage_fn, policy=jax.checkpoint_policies.nothing_saveable,
-                static_argnums=(),
-            )
-
-        m = min(self.n_microbatches, b)
-        if b % m:
-            raise ValueError(
-                f"batch {b} not divisible by n_microbatches {m}"
-            )
-        micro = x.reshape((m, b // m, t, d))
-
-        if self.mesh is not None and "pipe" in self.mesh.axis_names:
-            from ..parallel.pipeline import pipeline_apply
-
-            y = pipeline_apply(stage_fn, staged, micro, self.mesh,
-                               n_chunks=self.n_chunks)
-        else:
-            # no mesh: sequential trunk in plain layer order (same math,
-            # no pipelining). V>1 params are in pipeline layout
-            # [S, V, Lc, ...]; flatten back to [L] layer order (local
-            # transpose — there is no pipe axis to reshard over).
-            if self.n_chunks == 1:
-                flat = blocks
-            else:
-                flat = jax.tree.map(
-                    lambda a: jnp.transpose(
-                        a, (1, 0) + tuple(range(2, a.ndim))
-                    ).reshape((L,) + a.shape[3:]),
-                    blocks,
-                )
-
-            body = _block_apply
-            if self.remat:
-                # keep the remat promise off-mesh too: per-layer
-                # recompute instead of storing all L layers' activations
-                body = jax.checkpoint(
-                    _block_apply,
-                    policy=jax.checkpoint_policies.nothing_saveable,
-                    static_argnums=(2,),
-                )
-
-            def run_one(mb):
-                def layer(x, p_layer):
-                    return body(p_layer, x, n_head), None
-
-                out, _ = jax.lax.scan(layer, mb, flat)
-                return out
-
-            y = jax.vmap(run_one)(micro)
+        y = _run_trunk(
+            blocks, micro, self.mesh, S, self.n_chunks, self.remat,
+            lambda p, xx, _ex: _block_apply(p, xx, n_head),
+        )
 
         x = y.reshape(b, t, d)
         ln_g = self.param("lnf_g", ones, (d,), jnp.float32)
@@ -308,6 +352,166 @@ def stack_dense_params(dense_params: dict, n_stages: int = 1,
         "lnf_g": jnp.asarray(dense_params["ln_f"]["scale"]),
         "lnf_b": jnp.asarray(dense_params["ln_f"]["bias"]),
     }
+
+
+class PipelinedLlama(nn.Module):
+    """Llama architecture (RMSNorm + RoPE GQA + SwiGLU, untied head)
+    with a pipeline-parallel trunk — the Llama counterpart of
+    ``PipelinedLM``; ``stack_dense_llama_params`` converts a trained
+    ``LlamaLM`` tree (logit parity pinned by tests/test_pipeline.py).
+    RoPE cos/sin tables ride ``pipeline_apply``'s replicated ``extras``
+    channel into every stage."""
+
+    vocab_size: int = 32000
+    n_layer: int = 12
+    n_head: int = 12
+    n_kv_head: int = 0               # 0 -> n_head (no GQA)
+    d_model: int = 768
+    d_ff: int = 0                    # 0 -> Llama's ~8/3 rounded to 16
+    max_len: int = 2048
+    n_stages: int = 2
+    n_microbatches: int = 4
+    n_chunks: int = 1
+    remat: bool = False
+    fused_head: bool = False
+    rope_base: float = 10000.0
+    rms_eps: float = 1e-6
+    dtype: Any = jnp.float32
+    mesh: Optional[Any] = None
+
+    def _stacked(self, name, init, shape):
+        lead = _stacked_lead(self.n_layer, self.n_stages, self.n_chunks)
+        return self.param(name, init, lead + shape, jnp.float32)
+
+    @nn.compact
+    def __call__(self, tokens, train: bool = False):
+        n_kv = self.n_kv_head or self.n_head
+        if self.n_head % n_kv:
+            raise ValueError(
+                f"n_head {self.n_head} not divisible by n_kv_head {n_kv}"
+            )
+        d = self.d_model
+        f = self.d_ff or -(-int(d * 8 / 3) // 16) * 16
+        hd = d // self.n_head
+        b, t = tokens.shape
+
+        embed = self.param("embed_tokens", _init(0.02),
+                           (self.vocab_size, d), jnp.float32)
+        x = embed[tokens].astype(self.dtype)
+
+        blocks = {
+            "ln1_g": self._stacked("ln1_g", nn.initializers.ones, (d,)),
+            "q_k": self._stacked("q_k", _init(0.02), (d, d)),
+            "k_k": self._stacked("k_k", _init(0.02), (d, n_kv * hd)),
+            "v_k": self._stacked("v_k", _init(0.02), (d, n_kv * hd)),
+            "o_k": self._stacked("o_k", _init(0.02), (d, d)),
+            "ln2_g": self._stacked("ln2_g", nn.initializers.ones, (d,)),
+            "gate_k": self._stacked("gate_k", _init(0.02), (d, f)),
+            "up_k": self._stacked("up_k", _init(0.02), (d, f)),
+            "down_k": self._stacked("down_k", _init(0.02), (f, d)),
+        }
+
+        micro = _microbatch(x, self.n_microbatches)
+
+        cos, sin = rope_tables(jnp.arange(t), hd, self.rope_base)
+        n_head, eps = self.n_head, self.rms_eps
+
+        def layer_fn(p, xx, ex):
+            return _llama_block_apply(p, xx, ex[0], ex[1], n_head, n_kv,
+                                      eps)
+
+        y = _run_trunk(
+            blocks, micro, self.mesh, self.n_stages, self.n_chunks,
+            self.remat, layer_fn, extras=(cos, sin),
+        )
+
+        x = y.reshape(b, t, d)
+        norm_g = self.param("norm_g", nn.initializers.ones, (d,),
+                            jnp.float32)
+        x = _rms_norm(x, norm_g, self.rms_eps)
+        head = self.param("head_k", _init(0.02), (d, self.vocab_size),
+                          jnp.float32)
+        if self.fused_head:
+            return x.astype(self.dtype), head.astype(self.dtype)
+        logits = x.astype(self.dtype) @ head.astype(self.dtype)
+        return logits.astype(jnp.float32)
+
+    def batch_template(self, batch_size: int = 1):
+        return jnp.zeros((batch_size, min(self.max_len, 16)), jnp.int32)
+
+    def partition_rules(self):
+        return [
+            (r"(ln1|ln2)_g|(q|k|v|o|gate|up|down)_k", P("pipe")),
+            (r"embed_tokens|norm_g|head_k", P()),
+        ]
+
+
+def stack_dense_llama_params(dense_params: dict, n_stages: int = 1,
+                             n_chunks: int = 1) -> dict:
+    """``LlamaLM`` param tree -> ``PipelinedLlama`` params (same math,
+    stacked layout; circular models get the interleaved [S, V, Lc]
+    arrangement, like ``stack_dense_params``)."""
+    layers = sorted(
+        int(k.split("_")[1]) for k in dense_params
+        if k.startswith("layers_")
+    )
+    if layers != list(range(len(layers))):
+        raise ValueError(f"non-contiguous dense layer indices: {layers}")
+    S, V = int(n_stages), int(n_chunks)
+    L = len(layers)
+    if V > 1 and L % (S * V):
+        raise ValueError(
+            f"n_layer {L} not divisible by n_stages*n_chunks {S * V}"
+        )
+
+    def stacked(path_fn):
+        flat = jnp.stack(
+            [path_fn(dense_params[f"layers_{i}"]) for i in layers]
+        )
+        if V == 1:
+            return flat
+        lc = L // (S * V)
+        g_major = flat.reshape((V * S, lc) + flat.shape[1:])
+        vs = g_major.reshape((V, S, lc) + flat.shape[1:])
+        return jnp.transpose(vs, (1, 0) + tuple(range(2, vs.ndim)))
+
+    return {
+        "embed_tokens": jnp.asarray(
+            dense_params["embed_tokens"]["embedding"]
+        ),
+        "ln1_g": stacked(lambda h: h["input_layernorm"]["weight"]),
+        "q_k": stacked(lambda h: h["self_attn"]["q_proj"]["kernel"]),
+        "k_k": stacked(lambda h: h["self_attn"]["k_proj"]["kernel"]),
+        "v_k": stacked(lambda h: h["self_attn"]["v_proj"]["kernel"]),
+        "o_k": stacked(lambda h: h["self_attn"]["o_proj"]["kernel"]),
+        "ln2_g": stacked(
+            lambda h: h["post_attention_layernorm"]["weight"]
+        ),
+        "gate_k": stacked(lambda h: h["mlp"]["gate_proj"]["kernel"]),
+        "up_k": stacked(lambda h: h["mlp"]["up_proj"]["kernel"]),
+        "down_k": stacked(lambda h: h["mlp"]["down_proj"]["kernel"]),
+        "norm_g": jnp.asarray(dense_params["norm"]["weight"]),
+        "head_k": jnp.asarray(dense_params["lm_head"]["kernel"]),
+    }
+
+
+@MODELS.register("LlamaPipelined")
+def llama_pipelined(vocab_size: int = 32000, n_layer: int = 12,
+                    n_head: int = 12, n_kv_head: int = 0,
+                    d_model: int = 768, d_ff: int = 0,
+                    max_len: int = 2048, n_stages: int = 4,
+                    n_microbatches: int = 8, n_chunks: int = 1,
+                    remat: bool = True, fused_head: bool = True,
+                    rope_base: float = 10000.0, rms_eps: float = 1e-6,
+                    bfloat16: bool = True, mesh=None):
+    return PipelinedLlama(
+        vocab_size=vocab_size, n_layer=n_layer, n_head=n_head,
+        n_kv_head=n_kv_head, d_model=d_model, d_ff=d_ff, max_len=max_len,
+        n_stages=n_stages, n_microbatches=n_microbatches,
+        n_chunks=n_chunks, remat=remat, fused_head=fused_head,
+        rope_base=rope_base, rms_eps=rms_eps,
+        dtype=jnp.bfloat16 if bfloat16 else jnp.float32, mesh=mesh,
+    )
 
 
 @MODELS.register("PipelinedLM")

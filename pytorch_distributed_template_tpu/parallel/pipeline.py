@@ -46,13 +46,15 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 def pipeline_apply(stage_fn: Callable, stage_params, microbatches,
                    mesh: Mesh, axis_name: str = "pipe",
-                   rng: Optional[jax.Array] = None, n_chunks: int = 1):
+                   rng: Optional[jax.Array] = None, n_chunks: int = 1,
+                   extras=None):
     """Run ``microbatches`` through ``S`` pipeline stages.
 
     :param stage_fn: ``(params_one_chunk, x, rng_or_None) -> y`` applying
         ONE stage chunk to ONE microbatch; ``y`` must have ``x``'s
         shape/dtype (a homogeneous trunk — embeddings/heads live outside
-        the pipeline).
+        the pipeline). With ``extras`` the signature becomes
+        ``(params_one_chunk, x, extras, rng_or_None) -> y``.
     :param stage_params: pytree whose leaves have leading dim ``S`` (the
         stacked per-stage weights), sharded ``P('pipe', ...)``. With
         ``n_chunks=V > 1`` the leading dims are ``[S, V]`` where entry
@@ -63,11 +65,23 @@ def pipeline_apply(stage_fn: Callable, stage_params, microbatches,
         in its own subkey so dropout differs per stage and microbatch.
     :param n_chunks: virtual chunks per device (circular schedule); 1 =
         GPipe.
+    :param extras: optional pytree of arrays every stage needs whole and
+        identical (e.g. RoPE cos/sin tables) — replicated over the mesh
+        and handed to each ``stage_fn`` call. Closure capture would not
+        survive ``shard_map``, hence the explicit channel.
     :returns: ``[M, mb, ...]`` outputs, replicated over ``axis_name``.
     """
     V = int(n_chunks)
     if V < 1:
         raise ValueError(f"n_chunks must be >= 1, got {n_chunks}")
+    has_extras = extras is not None
+    if has_extras:
+        call = stage_fn
+    else:
+        def call(p, x, _e, r):
+            return stage_fn(p, x, r)
+
+        extras = jnp.zeros(())  # placeholder riding the replicated spec
     if axis_name not in mesh.axis_names or mesh.shape[axis_name] == 1:
         # No pipe axis: run all virtual stages sequentially, in virtual
         # stage order g = v*S + s. With S absent the stacked leading dims
@@ -86,7 +100,7 @@ def pipeline_apply(stage_fn: Callable, stage_params, microbatches,
         def body(x, args):
             p, g_idx = args
             r = _stage_rng(rng, g_idx, jnp.int32(0))
-            return stage_fn(p, x, r), None
+            return call(p, x, extras, r), None
 
         def run_one(mb):
             out, _ = lax.scan(body, mb, (flat, jnp.arange(n_virtual)))
@@ -103,7 +117,7 @@ def pipeline_apply(stage_fn: Callable, stage_params, microbatches,
     groups = -(-m_total // S)
     total_ticks = groups * S * V + S - 1
 
-    def per_stage(params, x_all, rngs):
+    def per_stage(params, x_all, extras_r, rngs):
         s = lax.axis_index(axis_name)
         # shard_map hands this stage its own params slice with a leading
         # stage dim of 1; drop it. Leaves: [V, Lc, ...] (V=1: [Lc, ...]
@@ -142,7 +156,7 @@ def pipeline_apply(stage_fn: Callable, stage_params, microbatches,
             else:
                 p_chunk = p_local
             r = _stage_rng(rngs, v * S + s, t) if has_rng else None
-            y = stage_fn(p_chunk, x_in, r)
+            y = call(p_chunk, x_in, extras_r, r)
             # the LAST virtual stage (device S-1, chunk V-1) finishes
             # microbatch mb_idx at this tick
             valid = (s == S - 1) & (v == V - 1) & (tau >= 0) & (mb_idx < m)
@@ -182,12 +196,13 @@ def pipeline_apply(stage_fn: Callable, stage_params, microbatches,
     in_specs = (
         jax.tree.map(lambda _: P(axis_name), stage_params),
         mb_spec,        # replicated over pipe, sharded over data axes
+        jax.tree.map(lambda _: P(), extras),  # whole and identical
         P(),
     )
     return shard_map(
         per_stage, mesh=mesh, in_specs=in_specs, out_specs=mb_spec,
         check_vma=False,
-    )(stage_params, microbatches, rng_in)
+    )(stage_params, microbatches, extras, rng_in)
 
 
 def regroup_for_pipeline(stacked, n_stages: int, n_chunks: int = 1):
