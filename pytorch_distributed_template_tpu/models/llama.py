@@ -287,6 +287,8 @@ class LlamaBlock(nn.Module):
     rope_base: float
     rms_eps: float
     window: int = 0
+    moe: Optional[dict] = None      # MoeMlp kwargs; None -> dense SwiGLU
+    n_layer: int = 1                # model depth, for residual-init scaling
 
     @nn.compact
     def __call__(self, x, positions, train: bool, example_mask=None,
@@ -298,6 +300,17 @@ class LlamaBlock(nn.Module):
             window=self.window, name="self_attn",
         )(h, positions, train, decode, decode_index)
         h = RMSNorm(self.rms_eps, name="post_attention_layernorm")(x)
+        if self.moe:
+            # Mixtral-style sparse FFN: routed SwiGLU experts over the
+            # ``expert`` mesh axis (models/moe.py)
+            from .moe import MoeMlp
+
+            return x + MoeMlp(
+                d_model=self.d_model, d_ff=self.d_ff,
+                dropout=0.0, n_layer=self.n_layer, dtype=self.dtype,
+                mesh=self.mesh, expert_act="swiglu", **self.moe,
+                name="moe",
+            )(h, train, example_mask)
         return x + SwiGLU(self.d_model, self.d_ff, self.dtype,
                           name="mlp")(h)
 
@@ -337,6 +350,21 @@ class LlamaLM(nn.Module):
     rms_eps: float = 1e-6
     window: int = 0                 # sliding-window attention; 0 = full
     fused_head: bool = False        # return (hidden, head_w) for chunked loss
+    # --- MoE (models/moe.py, swiglu experts); 0 -> all-dense blocks -------
+    moe_experts: int = 0
+    moe_top_k: int = 2
+    moe_every: int = 1              # Mixtral: every block is sparse
+    moe_capacity_factor: float = 1.25
+    moe_aux_loss_weight: float = 0.01
+
+    def _moe_kwargs(self, layer_idx: int):
+        if self.moe_experts <= 0 or (layer_idx + 1) % self.moe_every != 0:
+            return None
+        return dict(
+            num_experts=self.moe_experts, top_k=self.moe_top_k,
+            capacity_factor=self.moe_capacity_factor,
+            aux_loss_weight=self.moe_aux_loss_weight,
+        )
 
     @nn.compact
     def __call__(self, tokens, train: bool = False, example_mask=None,
@@ -358,6 +386,7 @@ class LlamaLM(nn.Module):
         if (
             self.seq_layout == "zigzag" and not decode
             and self.window == 0  # SWA rides the contiguous banded ring
+            and self.moe_experts <= 0  # MoE routing stays natural-order
             and self.attn_impl in ("ring", "ring_flash")
             and self.mesh is not None
             and "seq" in self.mesh.axis_names
@@ -403,7 +432,8 @@ class LlamaLM(nn.Module):
                     "zigzag" if zperm is not None else "natural"
                 ),
                 rope_base=self.rope_base, rms_eps=self.rms_eps,
-                window=self.window,
+                window=self.window, moe=self._moe_kwargs(i),
+                n_layer=self.n_layer,
                 name=f"layers_{i}",
             )(x, positions, train, example_mask, decode, start)
         x = RMSNorm(self.rms_eps, name="norm")(x)
@@ -425,8 +455,9 @@ class LlamaLM(nn.Module):
 
     def partition_rules(self):
         """Megatron TP over ``tensor``: column-parallel q/k/v/gate/up,
-        row-parallel o/down, vocab-sharded embedding + lm_head columns."""
-        return [
+        row-parallel o/down, vocab-sharded embedding + lm_head columns;
+        expert-parallel rules join when the model is sparse."""
+        rules = [
             (r"embed_tokens/embedding", P("tensor", None)),
             (r"self_attn/(q_proj|k_proj|v_proj)/kernel", P(None, "tensor")),
             (r"self_attn/o_proj/kernel", P("tensor", None)),
@@ -434,6 +465,11 @@ class LlamaLM(nn.Module):
             (r"mlp/down_proj/kernel", P("tensor", None)),
             (r"lm_head/kernel", P(None, "tensor")),
         ]
+        if self.moe_experts > 0:
+            from .moe import MoeMlp
+
+            rules = MoeMlp.partition_rules() + rules
+        return rules
 
 
 @MODELS.register("Llama")
@@ -470,6 +506,34 @@ def mistral(vocab_size: int = 32000, n_layer: int = 32, n_head: int = 32,
         dtype=jnp.bfloat16 if bfloat16 else jnp.float32,
         attn_impl=attn_impl, remat=remat, mesh=mesh, window=window,
         rope_base=rope_base, rms_eps=rms_eps, fused_head=fused_head,
+    )
+
+
+@MODELS.register("MixtralMoE")
+def mixtral_moe(vocab_size: int = 32000, n_layer: int = 32, n_head: int = 32,
+                n_kv_head: int = 8, d_model: int = 4096, d_ff: int = 14336,
+                max_len: int = 32768, window: int = 4096,
+                num_experts: int = 8, top_k: int = 2, moe_every: int = 1,
+                capacity_factor: float = 1.25,
+                aux_loss_weight: float = 0.01,
+                rope_base: float = 1e6, rms_eps: float = 1e-5,
+                bfloat16: bool = True, attn_impl: str = "flash",
+                remat: bool = True, mesh=None, fused_head: bool = True,
+                **overrides):
+    """Mixtral-8x7B-shaped defaults: the Mistral trunk (4:1 GQA, sliding
+    window) with every FFN replaced by 8 routed SwiGLU experts, top-2
+    gating (models/moe.py, ``expert_act='swiglu'``). Expert weights
+    shard over the ``expert`` mesh axis; combine with ``data``/``seq``
+    axes for dp x ep x sp."""
+    return LlamaLM(
+        vocab_size=vocab_size, n_layer=n_layer, n_head=n_head,
+        n_kv_head=n_kv_head, d_model=d_model, d_ff=d_ff, max_len=max_len,
+        dtype=jnp.bfloat16 if bfloat16 else jnp.float32,
+        attn_impl=attn_impl, remat=remat, mesh=mesh, window=window,
+        rope_base=rope_base, rms_eps=rms_eps, fused_head=fused_head,
+        moe_experts=num_experts, moe_top_k=top_k, moe_every=moe_every,
+        moe_capacity_factor=capacity_factor,
+        moe_aux_loss_weight=aux_loss_weight, **overrides,
     )
 
 

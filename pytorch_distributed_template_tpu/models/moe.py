@@ -56,6 +56,10 @@ class MoeMlp(nn.Module):
     n_layer: int = 1
     dtype: Any = jnp.float32
     mesh: Optional[Any] = None
+    # "gelu": wi/gelu/wo (GShard/Switch). "swiglu": adds a stacked gate
+    # weight wg and computes silu(x@wg) * (x@wi) @ wo — the Mixtral-style
+    # expert for the Llama family (biasless, like its dense SwiGLU).
+    expert_act: str = "gelu"
 
     @nn.compact
     def __call__(self, x, train: bool, example_mask=None):
@@ -132,18 +136,34 @@ class MoeMlp(nn.Module):
             "wo", _init(0.02 / (2 * self.n_layer) ** 0.5),
             (e, self.d_ff, d), jnp.float32,
         )
-        bi = self.param("bi", nn.initializers.zeros, (e, self.d_ff),
-                        jnp.float32)
-        bo = self.param("bo", nn.initializers.zeros, (e, d), jnp.float32)
 
         expert_in = jnp.einsum("sec,sd->ecd", dispatch,
                                xf.astype(self.dtype))       # [E, C, d]
         expert_in = self._constrain(expert_in, P("expert", None, None))
-        h = jnp.einsum("ecd,edf->ecf", expert_in,
-                       wi.astype(self.dtype)) + bi.astype(self.dtype)[:, None]
-        h = nn.gelu(h)
-        out = jnp.einsum("ecf,efd->ecd", h,
-                         wo.astype(self.dtype)) + bo.astype(self.dtype)[:, None]
+        if self.expert_act == "swiglu":
+            wg = self.param("wg", _init(0.02), (e, d, self.d_ff),
+                            jnp.float32)
+            gate = jnp.einsum("ecd,edf->ecf", expert_in,
+                              wg.astype(self.dtype))
+            up = jnp.einsum("ecd,edf->ecf", expert_in,
+                            wi.astype(self.dtype))
+            h = nn.silu(gate) * up
+            out = jnp.einsum("ecf,efd->ecd", h, wo.astype(self.dtype))
+        elif self.expert_act == "gelu":
+            bi = self.param("bi", nn.initializers.zeros, (e, self.d_ff),
+                            jnp.float32)
+            bo = self.param("bo", nn.initializers.zeros, (e, d),
+                            jnp.float32)
+            h = jnp.einsum("ecd,edf->ecf", expert_in,
+                           wi.astype(self.dtype)) + bi.astype(
+                               self.dtype)[:, None]
+            h = nn.gelu(h)
+            out = jnp.einsum("ecf,efd->ecd", h, wo.astype(
+                self.dtype)) + bo.astype(self.dtype)[:, None]
+        else:
+            raise ValueError(
+                f"expert_act={self.expert_act!r}; expected 'gelu'/'swiglu'"
+            )
         out = self._constrain(out, P("expert", None, None))
         y = jnp.einsum("sec,ecd->sd", combine.astype(self.dtype), out)
         y = nn.Dropout(self.dropout, deterministic=not train)(y)
@@ -172,6 +192,7 @@ class MoeMlp(nn.Module):
         stays replicated."""
         return [
             (r"moe/wi", P("expert", None, "tensor")),
+            (r"moe/wg", P("expert", None, "tensor")),
             (r"moe/wo", P("expert", "tensor", None)),
             (r"moe/bi", P("expert", "tensor")),
             (r"moe/bo", P("expert", None)),
