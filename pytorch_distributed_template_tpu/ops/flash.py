@@ -34,10 +34,14 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 NEG_INF = -1e30
-# Measured on TPU v5e (b=4, h=12, d=64): 512x512 beats 128x128 by 2.4x at
-# t=2048 and XLA full attention by 26x at t=8192 — streaming K/V makes VMEM
-# independent of T, so blocks this large are safe and amortize the per-grid-
-# step overhead. Sequences shorter than a block fall back to one block.
+# Measured on TPU v5e (d=64): 512x512 beats 128x128 by 2.4x at t=2048 —
+# streaming K/V makes VMEM independent of T, so blocks this large are safe
+# and amortize the per-grid-step overhead. Sequences shorter than a block
+# fall back to one block. End-to-end vs XLA attention (in-jit chained
+# scan, the honest timing on this platform — see bench.py): ~3x on
+# fwd+bwd at t=8192 (b=1, h=12), 1.6x on the full GPT-2-small train step
+# at t=1024; XLA full attention additionally OOMs where flash streams
+# (e.g. b=4, t=8192 materializes a 6.4 GB score tensor).
 DEFAULT_BLOCK_Q = 512
 DEFAULT_BLOCK_K = 512
 
