@@ -242,10 +242,16 @@ def bench_flash_long_context(t: int = 8192, b: int = 1, h: int = 12,
 
     def timed(attn):
         def one(c):
-            g = jax.grad(
-                lambda qq: jnp.sum(attn(qq, k, v).astype(jnp.float32) ** 2)
-            )(c)
-            return c + g.astype(c.dtype) * 1e-6
+            # grad wrt ALL of (q, k, v): differentiating only q would let
+            # XLA dead-code-eliminate its dk/dv matmuls while the flash
+            # custom_vjp still computes them — an asymmetric comparison
+            gq, gk, gv = jax.grad(
+                lambda qq, kk, vv: jnp.sum(
+                    attn(qq, kk, vv).astype(jnp.float32) ** 2
+                ),
+                argnums=(0, 1, 2),
+            )(c, k, v)
+            return c + (gq + gk + gv).astype(c.dtype) * 1e-6
 
         @jax.jit
         def many(q):
@@ -256,7 +262,9 @@ def bench_flash_long_context(t: int = 8192, b: int = 1, h: int = 12,
         x = many(q)  # compile + warm
         float(jnp.sum(x.astype(jnp.float32)))
         t0 = time.perf_counter()
-        x = many(q)
+        # feed the warm output back in: a repeat of the warm-up input
+        # would be deduplicated by the tunnel (the docstring hazard)
+        x = many(x)
         float(jnp.sum(x.astype(jnp.float32)))
         return (time.perf_counter() - t0) / n_steps
 

@@ -1,10 +1,12 @@
-"""Import HuggingFace GPT-2 / Llama weights into the in-tree LM families.
+"""Import/export HuggingFace GPT-2 / Llama weights for the LM families.
 
-Interop with the torch ecosystem the reference lives in: a user can take
-any HF ``GPT2LMHeadModel`` checkpoint (torch, CPU — never in the compute
-path) and obtain a params pytree for :class:`..models.transformer.TransformerLM`,
-then train/generate TPU-natively. Verified by logit-parity tests against
-``transformers`` (tests/test_hf_import.py).
+Interop with the torch ecosystem the reference lives in, both ways: a
+user can take any HF ``GPT2LMHeadModel`` checkpoint (torch, CPU — never
+in the compute path) and obtain a params pytree for
+:class:`..models.transformer.TransformerLM`, train TPU-natively, then
+``export_hf_*`` the result back into an HF state dict for torch serving.
+Verified by logit-parity and round-trip tests against ``transformers``
+(tests/test_hf_import.py).
 
 Layout mapping (HF ``Conv1D`` stores ``[in, out]`` — the same orientation
 as a flax ``Dense`` kernel, so no transposes are needed anywhere):
@@ -169,3 +171,86 @@ def import_hf_llama(hf_state_dict, n_layer: int) -> dict:
             },
         }
     return params
+
+
+def export_hf_gpt2(params: dict) -> dict:
+    """``TransformerLM`` params -> HF ``GPT2LMHeadModel`` state-dict
+    arrays (numpy; wrap in torch tensors to ``load_state_dict``).
+
+    The inverse of :func:`import_hf_gpt2` — train TPU-natively, serve
+    with the torch ecosystem the reference lives in. Round-trip and
+    HF-logit-parity tested (tests/test_hf_import.py). Only the tied-head
+    layout is produced (``lm_head.weight`` aliases ``wte``), matching
+    ``tie_embeddings=True``; attention mask buffers (``attn.bias``) are
+    HF-internal and not emitted — load with ``strict=False``.
+    """
+    if "lm_head" in params:
+        raise ValueError(
+            "params tree has an untied lm_head; export_hf_gpt2 emits the "
+            "tied layout (lm_head.weight = wte), so exporting would "
+            "silently serve wrong logits — untie export is not supported"
+        )
+    a = lambda x: np.asarray(x, np.float32)  # noqa: E731
+    layers = sorted(
+        int(k.split("_")[1]) for k in params if k.startswith("h_")
+    )
+    if layers != list(range(len(layers))):
+        raise ValueError(f"non-contiguous layer indices: {layers}")
+    sd = {
+        "transformer.wte.weight": a(params["wte"]["embedding"]),
+        "transformer.wpe.weight": a(params["wpe"]),
+        "transformer.ln_f.weight": a(params["ln_f"]["scale"]),
+        "transformer.ln_f.bias": a(params["ln_f"]["bias"]),
+        "lm_head.weight": a(params["wte"]["embedding"]),
+    }
+    for i in layers:
+        h = params[f"h_{i}"]
+        p = f"transformer.h.{i}."
+        sd[p + "ln_1.weight"] = a(h["ln_1"]["scale"])
+        sd[p + "ln_1.bias"] = a(h["ln_1"]["bias"])
+        sd[p + "ln_2.weight"] = a(h["ln_2"]["scale"])
+        sd[p + "ln_2.bias"] = a(h["ln_2"]["bias"])
+        sd[p + "attn.c_attn.weight"] = a(h["attn"]["qkv"]["kernel"])
+        sd[p + "attn.c_attn.bias"] = a(h["attn"]["qkv"]["bias"])
+        sd[p + "attn.c_proj.weight"] = a(h["attn"]["out"]["kernel"])
+        sd[p + "attn.c_proj.bias"] = a(h["attn"]["out"]["bias"])
+        sd[p + "mlp.c_fc.weight"] = a(h["mlp"]["up"]["kernel"])
+        sd[p + "mlp.c_fc.bias"] = a(h["mlp"]["up"]["bias"])
+        sd[p + "mlp.c_proj.weight"] = a(h["mlp"]["down"]["kernel"])
+        sd[p + "mlp.c_proj.bias"] = a(h["mlp"]["down"]["bias"])
+    return sd
+
+
+def export_hf_llama(params: dict) -> dict:
+    """``LlamaLM`` params -> HF ``LlamaForCausalLM`` state-dict arrays.
+
+    The inverse of :func:`import_hf_llama` (kernels transpose back to
+    HF's ``[out, in]`` ``nn.Linear`` orientation). Emits an explicit
+    ``lm_head.weight`` — correct for both tied and untied HF configs
+    (tied models simply ignore/alias it on load).
+    """
+    a = lambda x: np.asarray(x, np.float32)  # noqa: E731
+    at = lambda x: np.ascontiguousarray(a(x).T)  # noqa: E731
+    layers = sorted(
+        int(k.split("_")[1]) for k in params if k.startswith("layers_")
+    )
+    if layers != list(range(len(layers))):
+        raise ValueError(f"non-contiguous layer indices: {layers}")
+    sd = {
+        "model.embed_tokens.weight": a(params["embed_tokens"]["embedding"]),
+        "model.norm.weight": a(params["norm"]["weight"]),
+        "lm_head.weight": at(params["lm_head"]["kernel"]),
+    }
+    for i in layers:
+        h = params[f"layers_{i}"]
+        p = f"model.layers.{i}."
+        sd[p + "input_layernorm.weight"] = a(
+            h["input_layernorm"]["weight"])
+        sd[p + "post_attention_layernorm.weight"] = a(
+            h["post_attention_layernorm"]["weight"])
+        for name in ("q_proj", "k_proj", "v_proj", "o_proj"):
+            sd[p + f"self_attn.{name}.weight"] = at(
+                h["self_attn"][name]["kernel"])
+        for name in ("gate_proj", "up_proj", "down_proj"):
+            sd[p + f"mlp.{name}.weight"] = at(h["mlp"][name]["kernel"])
+    return sd

@@ -38,10 +38,12 @@ NEG_INF = -1e30
 # streaming K/V makes VMEM independent of T, so blocks this large are safe
 # and amortize the per-grid-step overhead. Sequences shorter than a block
 # fall back to one block. End-to-end vs XLA attention (in-jit chained
-# scan, the honest timing on this platform — see bench.py): ~3x on
-# fwd+bwd at t=8192 (b=1, h=12), 1.6x on the full GPT-2-small train step
-# at t=1024; XLA full attention additionally OOMs where flash streams
-# (e.g. b=4, t=8192 materializes a 6.4 GB score tensor).
+# scan, the honest timing on this platform — see bench.py): ~2x on full
+# fwd+bwd (grads wrt q,k,v) at t=8192 (b=1, h=12), 1.6x on the full
+# GPT-2-small train step at t=1024; XLA attention additionally OOMs
+# where flash streams
+# (b=4, t=8192 materializes a ~12.9 GB float32 score tensor — scores
+# upcast to f32 for the softmax — plus a same-size probs tensor).
 DEFAULT_BLOCK_Q = 512
 DEFAULT_BLOCK_K = 512
 

@@ -94,3 +94,90 @@ def test_structure_matches_model_init(hf_model):
 def test_oversized_checkpoint_rejected(hf_model):
     with pytest.raises(ValueError, match="more than n_layer"):
         import_hf_gpt2(hf_model.state_dict(), n_layer=1)
+
+
+def test_export_round_trip_and_hf_parity(hf_model):
+    """export_hf_gpt2 is import's inverse: importing the export
+    reproduces the tree bit-for-bit, and loading the export into a fresh
+    HF model reproduces the in-tree logits."""
+    from pytorch_distributed_template_tpu.models.hf_import import (
+        export_hf_gpt2,
+    )
+
+    params = import_hf_gpt2(hf_model.state_dict(), n_layer=N_LAYER)
+    sd = export_hf_gpt2(params)
+    rt = import_hf_gpt2(sd, n_layer=N_LAYER)
+    for (ka, va), (kb, vb) in zip(
+        sorted(jax.tree_util.tree_leaves_with_path(params),
+               key=lambda t: str(t[0])),
+        sorted(jax.tree_util.tree_leaves_with_path(rt),
+               key=lambda t: str(t[0])),
+    ):
+        np.testing.assert_array_equal(np.asarray(va), np.asarray(vb))
+
+    fresh = transformers.GPT2LMHeadModel(hf_model.config).eval()
+    missing, unexpected = fresh.load_state_dict(
+        {k: torch.from_numpy(v) for k, v in sd.items()}, strict=False
+    )
+    assert not unexpected
+    assert all(".attn.bias" in k or ".attn.masked_bias" in k
+               for k in missing), missing
+    model = MODELS.get("TinyLM")(
+        vocab_size=VOCAB, n_layer=N_LAYER, n_head=N_HEAD, d_model=D,
+        max_len=MAXLEN, dropout=0.0,
+    )
+    tokens = np.random.default_rng(7).integers(0, VOCAB, (2, 10))
+    ours = np.asarray(model.apply(
+        {"params": params}, jnp.asarray(tokens, jnp.int32), train=False))
+    with torch.no_grad():
+        theirs = fresh(torch.from_numpy(tokens)).logits.numpy()
+    np.testing.assert_allclose(ours, theirs, rtol=2e-3, atol=2e-4)
+
+
+def test_export_llama_round_trip():
+    """export_hf_llama round-trips through import_hf_llama exactly and
+    loads into a fresh HF Llama with logit parity."""
+    from pytorch_distributed_template_tpu.models.hf_import import (
+        export_hf_llama, import_hf_llama,
+    )
+
+    torch.manual_seed(2)
+    cfg = transformers.LlamaConfig(
+        vocab_size=96, hidden_size=32, intermediate_size=96,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=32, attention_bias=False,
+        tie_word_embeddings=False,
+    )
+    hf = transformers.LlamaForCausalLM(cfg).eval()
+    params = import_hf_llama(hf.state_dict(), n_layer=2)
+    sd = export_hf_llama(params)
+    rt = import_hf_llama(sd, n_layer=2)
+    for va, vb in zip(jax.tree_util.tree_leaves(params),
+                      jax.tree_util.tree_leaves(rt)):
+        np.testing.assert_array_equal(np.asarray(va), np.asarray(vb))
+
+    fresh = transformers.LlamaForCausalLM(cfg).eval()
+    missing, unexpected = fresh.load_state_dict(
+        {k: torch.from_numpy(v) for k, v in sd.items()}, strict=False
+    )
+    assert not unexpected and not missing, (missing, unexpected)
+    tokens = np.random.default_rng(8).integers(0, 96, (2, 10))
+    with torch.no_grad():
+        ref = hf(torch.from_numpy(tokens)).logits.numpy()
+        got = fresh(torch.from_numpy(tokens)).logits.numpy()
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_export_untied_rejected():
+    from pytorch_distributed_template_tpu.models.hf_import import (
+        export_hf_gpt2,
+    )
+
+    model = MODELS.get("TinyLM")(
+        vocab_size=VOCAB, n_layer=1, n_head=2, d_model=32, max_len=16,
+        tie_embeddings=False,
+    )
+    params = model.init(jax.random.key(0),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    with pytest.raises(ValueError, match="untied"):
+        export_hf_gpt2(params)
