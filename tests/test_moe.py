@@ -337,3 +337,25 @@ class TestSparseLlama:
                              train=False, decode=True, mutable=["cache"])
         np.testing.assert_allclose(np.asarray(out), np.asarray(full),
                                    atol=1e-5, rtol=1e-5)
+
+
+def test_sparse_llama_generate_zeros_pytree_cache():
+    """MixtralMoE through engine.generate(): the zeros-pytree cache
+    allocation path (init fns never run there) must reproduce the
+    uncached forward's logits-argmax behavior end-to-end."""
+    from pytorch_distributed_template_tpu.engine.generate import generate
+
+    model = MODELS.get("MixtralMoE")(
+        vocab_size=64, n_layer=2, n_head=4, n_kv_head=2, d_model=32,
+        d_ff=64, max_len=32, window=8, num_experts=4, top_k=2,
+        capacity_factor=4.0, bfloat16=False, attn_impl="xla",
+        remat=False, fused_head=False, mesh=None,
+    )
+    tokens = jnp.asarray(
+        np.random.default_rng(9).integers(0, 64, (1, 6)), jnp.int32)
+    state = create_train_state(model, optax.sgd(0.1), tokens, seed=0)
+    out = generate(model, state.params, tokens, max_new_tokens=5,
+                   temperature=0.0)
+    assert out.shape == (1, 11)
+    np.testing.assert_array_equal(np.asarray(out[:, :6]),
+                                  np.asarray(tokens))
