@@ -1,0 +1,136 @@
+"""Checkpoint averaging (model soups / post-hoc Polyak).
+
+The training-time shadow average is ``trainer.ema_decay``; this is the
+post-hoc complement: average the PARAMS of several saved checkpoints
+(e.g. the last k epoch checkpoints, or a grid of fine-tunes — the
+"model soup" recipe) into a new checkpoint directory that ``test.py``
+and ``generate.py`` consume like any other. Weights are averaged in
+float64 and cast back; every non-param field (step, opt_state, rng,
+batch_stats) is taken from the LAST checkpoint given, so resuming
+training from a soup behaves like resuming from that checkpoint with
+swapped weights.
+"""
+from __future__ import annotations
+
+import json
+from pathlib import Path
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+import orbax.checkpoint as ocp
+
+
+def average_checkpoints(paths: Sequence, out_path,
+                        weights: Optional[Sequence[float]] = None) -> Path:
+    """Average ``params`` (and ``ema_params``/``batch_stats`` when
+    present) across orbax checkpoints; write a new checkpoint to
+    ``out_path`` with the last input's remaining fields and a meta
+    sidecar recording the provenance.
+
+    :param weights: optional per-checkpoint weights (normalized here);
+        default uniform.
+    """
+    paths = [Path(p) for p in paths]
+    if not paths:
+        raise ValueError("need at least one checkpoint to average")
+    out_path = Path(out_path)
+    if out_path.exists():
+        raise FileExistsError(f"{out_path} already exists")
+    w = np.asarray(
+        [1.0] * len(paths) if weights is None else list(weights), np.float64
+    )
+    if len(w) != len(paths) or not np.all(w > 0):
+        raise ValueError(f"bad weights {weights!r} for {len(paths)} ckpts")
+    w = w / w.sum()
+
+    ckptr = ocp.StandardCheckpointer()
+    # Restore ONE checkpoint at a time: opt_state etc. are never averaged,
+    # so holding all k full trees would cost ~k * 4x params of host RAM;
+    # only the LAST tree is kept whole (its non-averaged fields ship).
+    ref = ckptr.restore(paths[-1].resolve())
+    averaged_keys = [k for k in ("params", "ema_params", "batch_stats")
+                     if k in ref and jax.tree.leaves(ref[k])]
+
+    def signature(tree):
+        return jax.tree.structure(tree), [
+            (np.shape(x)) for x in jax.tree.leaves(tree)
+        ]
+
+    ref_sig = {k: signature(ref[k]) for k in averaged_keys}
+    acc = {
+        k: jax.tree.map(
+            lambda x: np.asarray(x, np.float64) * w[-1], ref[k]
+        )
+        for k in averaged_keys
+    }
+    for p, wi in zip(paths[:-1], w[:-1]):
+        t = ckptr.restore(p.resolve())
+        for key in averaged_keys:
+            # structure AND leaf shapes must match: a broadcastable shape
+            # mismatch (e.g. different widths) would silently average
+            # garbage instead of erroring
+            if key not in t or signature(t[key]) != ref_sig[key]:
+                raise ValueError(
+                    f"checkpoint {p} has a different '{key}' tree than "
+                    f"{paths[-1]} — can only average same-architecture "
+                    "checkpoints"
+                )
+            acc[key] = jax.tree.map(
+                lambda a, x, _wi=wi: a + np.asarray(x, np.float64) * _wi,
+                acc[key], t[key],
+            )
+        del t
+
+    out_tree = dict(ref)
+    for key in averaged_keys:
+        out_tree[key] = jax.tree.map(
+            lambda a, x: np.asarray(a, x.dtype), acc[key], ref[key]
+        )
+
+    ckptr.save(out_path.resolve(), out_tree)
+    ckptr.wait_until_finished()
+
+    # provenance + compat sidecar: reuse the last checkpoint's meta (the
+    # restore compat checks key off it) and record the soup inputs. When
+    # the source has NO sidecar, keep the soup sidecar-less too (restore's
+    # honest missing-sidecar recovery beats a sidecar with no epoch/arch)
+    # and record provenance in a separate file.
+    from .manager import CheckpointManager
+
+    provenance = {
+        "averaged_from": [str(p) for p in paths],
+        "average_weights": [float(x) for x in w],
+    }
+    meta = CheckpointManager.load_meta(paths[-1])
+    if meta is not None:
+        meta.update(provenance)
+        (out_path.parent / f"{out_path.name}.meta.json").write_text(
+            json.dumps(meta, indent=2)
+        )
+    else:
+        (out_path.parent / f"{out_path.name}.provenance.json").write_text(
+            json.dumps(provenance, indent=2)
+        )
+    return out_path
+
+
+def main(argv=None) -> None:
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        description="Average checkpoint params into a model soup."
+    )
+    ap.add_argument("checkpoints", nargs="+",
+                    help="orbax checkpoint dirs (order matters: non-param "
+                         "state comes from the LAST one)")
+    ap.add_argument("-o", "--out", required=True,
+                    help="output checkpoint dir (must not exist)")
+    ap.add_argument("--weights", type=float, nargs="+", default=None)
+    args = ap.parse_args(argv)
+    out = average_checkpoints(args.checkpoints, args.out, args.weights)
+    print(f"wrote soup of {len(args.checkpoints)} checkpoints to {out}")
+
+
+if __name__ == "__main__":
+    main()
