@@ -76,25 +76,40 @@ def _decayed(weight_decay, base, exclude=None):
     )
 
 
+def _mu_dtype(name):
+    """Optional reduced-precision first moment (``mu_dtype: "bfloat16"``):
+    halves one of Adam's two moment buffers in HBM — an optimizer-memory
+    lever at LM scale (the second moment stays f32; its dynamic range is
+    the numerically fragile one). Measured neutral-to-slightly-slower on
+    a compute-bound step, so it is opt-in, not a default."""
+    import jax.numpy as jnp
+
+    return jnp.dtype(name) if name else None
+
+
 @OPTIMIZERS.register("Adam")
 def adam(lr=1e-3, betas=(0.9, 0.999), eps=1e-8, weight_decay=0.0,
-         amsgrad=False, learning_rate=None, weight_decay_exclude=None):
+         amsgrad=False, learning_rate=None, weight_decay_exclude=None,
+         mu_dtype=None):
     lr = _lr(lr, learning_rate)
     b1, b2 = betas
     if amsgrad:
-        base = optax.amsgrad(lr, b1=b1, b2=b2, eps=eps)
+        base = optax.amsgrad(lr, b1=b1, b2=b2, eps=eps,
+                             mu_dtype=_mu_dtype(mu_dtype))
     else:
-        base = optax.adam(lr, b1=b1, b2=b2, eps=eps)
+        base = optax.adam(lr, b1=b1, b2=b2, eps=eps,
+                          mu_dtype=_mu_dtype(mu_dtype))
     return _decayed(weight_decay, base, weight_decay_exclude)
 
 
 @OPTIMIZERS.register("AdamW")
 def adamw(lr=1e-3, betas=(0.9, 0.999), eps=1e-8, weight_decay=0.01,
-          learning_rate=None, weight_decay_exclude=None):
+          learning_rate=None, weight_decay_exclude=None, mu_dtype=None):
     b1, b2 = betas
     return optax.adamw(_lr(lr, learning_rate), b1=b1, b2=b2, eps=eps,
                        weight_decay=weight_decay,
-                       mask=_decay_mask(weight_decay_exclude))
+                       mask=_decay_mask(weight_decay_exclude),
+                       mu_dtype=_mu_dtype(mu_dtype))
 
 
 @OPTIMIZERS.register("SGD")

@@ -395,3 +395,18 @@ def test_step_unit_rejects_plateau():
     }
     with pytest.raises(ValueError):
         build_optimizer(cfg, steps_per_epoch=10)
+
+
+def test_adam_mu_dtype_option():
+    """mu_dtype: "bfloat16" stores the first moment reduced (optimizer
+    HBM lever); update math still runs and the state reflects the dtype."""
+    params = {"w": jnp.ones((4, 4))}
+    grads = {"w": jnp.full((4, 4), 0.1)}
+    for name in ("Adam", "AdamW"):
+        tx = OPTIMIZERS.get(name)(lr=0.1, mu_dtype="bfloat16")
+        state = tx.init(params)
+        mu_leaves = [x for x in jax.tree.leaves(state)
+                     if hasattr(x, "dtype") and x.dtype == jnp.bfloat16]
+        assert mu_leaves, f"{name}: no bf16 moment buffers in state"
+        updates, _ = tx.update(grads, state, params)
+        assert all(jnp.isfinite(u).all() for u in jax.tree.leaves(updates))
