@@ -82,8 +82,6 @@ def _mu_dtype(name):
     lever at LM scale (the second moment stays f32; its dynamic range is
     the numerically fragile one). Measured neutral-to-slightly-slower on
     a compute-bound step, so it is opt-in, not a default."""
-    import jax.numpy as jnp
-
     return jnp.dtype(name) if name else None
 
 
