@@ -41,7 +41,8 @@ def main():
 
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     cfg = json.load(open(os.path.join(repo, "configs", "mnist_debug.json")))
-    cfg["trainer"].update(epochs=2, save_dir=save_dir, tensorboard=False)
+    cfg["trainer"].update(epochs=2, save_dir=save_dir, tensorboard=False,
+                          save_interval_steps=3)
     config = ConfigParser(cfg, run_id="mh", training=True)
 
     model = config.init_obj("arch", MODELS)
@@ -72,6 +73,16 @@ def main():
     meta = config.save_dir / "checkpoint-epoch2.meta.json"
     # rank-0-only sidecar I/O
     assert meta.exists()
+
+    # mid-epoch A/B interval saves are COLLECTIVE orbax writes (every
+    # host participates); with 8 batches/epoch and interval 3 both slots
+    # must exist and carry rank-0 sidecars
+    for slot in ("a", "b"):
+        assert (config.save_dir / f"checkpoint-interval-{slot}").is_dir(), (
+            f"multi-host interval slot {slot} missing"
+        )
+        assert (config.save_dir
+                / f"checkpoint-interval-{slot}.meta.json").exists()
 
     dist.synchronize("train-test-end")
     print(f"MULTIHOST_TRAIN_OK rank={rank}", flush=True)
