@@ -132,6 +132,13 @@ def _merge_blocks(o, lse, o_b, lse_b):
     return o * w + o_b.astype(jnp.float32) * w_b, lse_new
 
 
+def _expand_kv(x, groups: int):
+    """GQA: broadcast compact [B, T, Hkv, D] K/V to the query head count
+    for one block's compute. The ring bodies carry the COMPACT tensors
+    around the ring (groups x less ICI traffic) and expand per hop."""
+    return x if groups == 1 else jnp.repeat(x, groups, axis=2)
+
+
 def _einsum_block_lse(q, kb, vb, visible):
     """(out, lse) of one attention block with an explicit [Tq, Tk] mask.
 
@@ -156,7 +163,8 @@ def _einsum_block_lse(q, kb, vb, visible):
 
 
 def _ring_attention_local_flash(q, k, v, *, axis_name: str, axis_size: int,
-                                causal: bool, window: int = 0):
+                                causal: bool, window: int = 0,
+                                kv_groups: int = 1):
     """Contiguous-layout ring body with the Pallas flash kernel per block.
 
     Same ring schedule as ``_ring_attention_local``, but each [Tl x Tl]
@@ -183,7 +191,10 @@ def _ring_attention_local_flash(q, k, v, *, axis_name: str, axis_size: int,
     s = axis_size
     tl = q.shape[1]
     my = lax.axis_index(axis_name)
-    out0, lse0 = flash_attention_lse(q, k, v, causal=causal, window=window)
+    out0, lse0 = flash_attention_lse(
+        q, _expand_kv(k, kv_groups), _expand_kv(v, kv_groups),
+        causal=causal, window=window,
+    )
     carry0 = (k, v, out0.astype(jnp.float32), lse0)
     perm = [(i, (i + 1) % s) for i in range(s)]
 
@@ -191,7 +202,10 @@ def _ring_attention_local_flash(q, k, v, *, axis_name: str, axis_size: int,
         kb, vb, o, lse = carry
         kb = lax.ppermute(kb, axis_name, perm)
         vb = lax.ppermute(vb, axis_name, perm)
-        out_b, lse_b = flash_attention_lse(q, kb, vb, causal=False)
+        out_b, lse_b = flash_attention_lse(
+            q, _expand_kv(kb, kv_groups), _expand_kv(vb, kv_groups),
+            causal=False,
+        )
         if causal:
             src = (my - t) % s
             lse_b = jnp.where(src < my, lse_b, NEG_INF)
@@ -222,13 +236,16 @@ def _ring_attention_local_flash(q, k, v, *, axis_name: str, axis_size: int,
         visible = (q_pos[:, None] >= k_pos[None, :]) & (
             q_pos[:, None] - k_pos[None, :] < window
         )  # wrapped sources (src > my) mask out entirely via positions
-        out_b, lse_b = _einsum_block_lse(q, kb, vb, visible)
+        out_b, lse_b = _einsum_block_lse(
+            q, _expand_kv(kb, kv_groups), _expand_kv(vb, kv_groups),
+            visible,
+        )
         o, lse = _merge_blocks(o, lse, out_b, lse_b)
     return o.astype(dtype)
 
 
 def _ring_attention_zigzag_local_flash(q, k, v, *, axis_name: str,
-                                       axis_size: int):
+                                       axis_size: int, kv_groups: int = 1):
     """Zigzag ring body with the Pallas flash kernel per quarter block.
 
     The balanced schedule of ``_ring_attention_zigzag_local`` (same chunk
@@ -245,10 +262,14 @@ def _ring_attention_zigzag_local_flash(q, k, v, *, axis_name: str,
     s = axis_size
     my = lax.axis_index(axis_name)
     q_lo, q_hi = q[:, :c], q[:, c:]
+    kx, vx = _expand_kv(k, kv_groups), _expand_kv(v, kv_groups)
 
-    o_ll, l_ll = flash_attention_lse(q_lo, k[:, :c], v[:, :c], causal=True)
-    o_hl, l_hl = flash_attention_lse(q_hi, k[:, :c], v[:, :c], causal=False)
-    o_hh, l_hh = flash_attention_lse(q_hi, k[:, c:], v[:, c:], causal=True)
+    o_ll, l_ll = flash_attention_lse(q_lo, kx[:, :c], vx[:, :c],
+                                     causal=True)
+    o_hl, l_hl = flash_attention_lse(q_hi, kx[:, :c], vx[:, :c],
+                                     causal=False)
+    o_hh, l_hh = flash_attention_lse(q_hi, kx[:, c:], vx[:, c:],
+                                     causal=True)
     o_lo, l_lo = o_ll.astype(jnp.float32), l_ll
     o_hi, l_hi = _merge_blocks(o_hl.astype(jnp.float32), l_hl, o_hh, l_hh)
 
@@ -260,8 +281,10 @@ def _ring_attention_zigzag_local_flash(q, k, v, *, axis_name: str,
         vb = lax.ppermute(vb, axis_name, perm)
         src = (my - t) % s
         pred = src < my
-        k_lo, k_hi = kb[:, :c], kb[:, c:]
-        v_lo, v_hi = vb[:, :c], vb[:, c:]
+        kbx = _expand_kv(kb, kv_groups)
+        vbx = _expand_kv(vb, kv_groups)
+        k_lo, k_hi = kbx[:, :c], kbx[:, c:]
+        v_lo, v_hi = vbx[:, :c], vbx[:, c:]
         sel_q = jnp.where(pred, q_lo, q_hi)
         sel_k = jnp.where(pred, k_lo, k_hi)
         sel_v = jnp.where(pred, v_lo, v_hi)
@@ -283,7 +306,8 @@ def _ring_attention_zigzag_local_flash(q, k, v, *, axis_name: str,
     return jnp.concatenate([o_lo, o_hi], axis=1).astype(dtype)
 
 
-def _ring_attention_zigzag_local(q, k, v, *, axis_name: str, axis_size: int):
+def _ring_attention_zigzag_local(q, k, v, *, axis_name: str, axis_size: int,
+                                 kv_groups: int = 1):
     """Causal zigzag ring attention body (runs inside shard_map).
 
     Local ``[B, Tl, H, D]`` slices are in zigzag layout: the first half is
@@ -313,13 +337,15 @@ def _ring_attention_zigzag_local(q, k, v, *, axis_name: str, axis_size: int):
     q_pos = jnp.concatenate([lo_pos, hi_pos])
 
     # ---- step 0: local block, position-masked (the only diagonals) ------
-    scores0 = jnp.einsum("bqhd,bkhd->bhqk", qf, k.astype(jnp.float32))
+    scores0 = jnp.einsum("bqhd,bkhd->bhqk", qf,
+                         _expand_kv(k, kv_groups).astype(jnp.float32))
     visible0 = q_pos[:, None] >= q_pos[None, :]
     scores0 = jnp.where(visible0[None, None], scores0, NEG_INF)
     m0 = jnp.max(scores0, axis=-1)                 # [B, H, Tl]
     p0 = jnp.exp(scores0 - m0[..., None])
     l0 = jnp.sum(p0, axis=-1)
-    o0 = jnp.einsum("bhqk,bkhd->bhqd", p0, v.astype(jnp.float32))
+    o0 = jnp.einsum("bhqk,bkhd->bhqd", p0,
+                    _expand_kv(v, kv_groups).astype(jnp.float32))
 
     q_lo, q_hi = qf[:, :c], qf[:, c:]
     # Unlike the contiguous body, every carry derives from device-varying
@@ -337,8 +363,10 @@ def _ring_attention_zigzag_local(q, k, v, *, axis_name: str, axis_size: int):
         vb = lax.ppermute(vb, axis_name, perm)
         src = (my - t) % s
         pred = src < my
-        k_lo, k_hi = kb[:, :c], kb[:, c:]
-        v_lo, v_hi = vb[:, :c], vb[:, c:]
+        kbx = _expand_kv(kb, kv_groups)
+        vbx = _expand_kv(vb, kv_groups)
+        k_lo, k_hi = kbx[:, :c], kbx[:, c:]
+        v_lo, v_hi = vbx[:, :c], vbx[:, c:]
         # E2: the step's second visible quarter — lo×lo below the ring
         # diagonal, hi×hi above it. Selects are on inputs (cheap); both
         # cases are FULLY visible so no mask is ever applied.
@@ -471,7 +499,7 @@ def _ring_steps_needed(tl: int, axis_size: int, window: int) -> int:
 
 def _ring_attention_local(q, k, v, *, axis_name: str, axis_size: int,
                           causal: bool, vary_axes: tuple = (),
-                          window: int = 0):
+                          window: int = 0, kv_groups: int = 1):
     """Per-shard ring attention body (runs inside shard_map).
 
     q,k,v: local [B, Tl, H, D] slices of the global [B, T, H, D] arrays,
@@ -493,7 +521,8 @@ def _ring_attention_local(q, k, v, *, axis_name: str, axis_size: int,
         kb, vb, m, l, o = carry
         src = (my - t) % axis_size  # origin shard of the current K/V block
         k_pos = src * tl + jnp.arange(tl)
-        scores = jnp.einsum("bqhd,bkhd->bhqk", qf, kb.astype(jnp.float32))
+        scores = jnp.einsum("bqhd,bkhd->bhqk", qf,
+                            _expand_kv(kb, kv_groups).astype(jnp.float32))
         visible = None
         if causal:
             visible = q_pos[:, None] >= k_pos[None, :]  # [Tl_q, Tl_k]
@@ -502,7 +531,9 @@ def _ring_attention_local(q, k, v, *, axis_name: str, axis_size: int,
             visible = band if visible is None else visible & band
         if visible is not None:
             scores = jnp.where(visible[None, None], scores, NEG_INF)
-        m_new, l_new, o_new = _online_update(m, l, o, scores, vb)
+        m_new, l_new, o_new = _online_update(
+            m, l, o, scores, _expand_kv(vb, kv_groups)
+        )
         kb = lax.ppermute(kb, axis_name, perm)
         vb = lax.ppermute(vb, axis_name, perm)
         return (kb, vb, m_new, l_new, o_new), None
@@ -556,9 +587,26 @@ def ring_attention(q, k, v, mesh: Mesh, causal: bool = True,
     triangle, which a band already balances (and a banded zigzag would
     put BOTH of each device's chunks on the band edge — strictly more
     masked work than contiguous).
+
+    GQA: ``k``/``v`` may carry FEWER heads than ``q`` (``Hq % Hkv == 0``)
+    — the compact K/V rotates around the ring (``groups``× less ICI
+    traffic than pre-repeating) and each hop broadcasts locally for its
+    block compute. When a ``tensor`` head sharding doesn't divide the KV
+    head count, K/V are pre-expanded instead (a sharded-q/replicated-kv
+    split would mis-pair heads).
     """
+    kv_groups = 1
+    if k.shape[2] != q.shape[2]:
+        if q.shape[2] % k.shape[2] or v.shape[2] != k.shape[2]:
+            raise ValueError(
+                f"GQA head counts must divide: q has {q.shape[2]}, "
+                f"k/v have {k.shape[2]}/{v.shape[2]}"
+            )
+        kv_groups = q.shape[2] // k.shape[2]
     if seq_axis not in mesh.axis_names or mesh.shape[seq_axis] == 1:
-        return multihead_attention(q, k, v, causal=causal, window=window)
+        return multihead_attention(q, _expand_kv(k, kv_groups),
+                                   _expand_kv(v, kv_groups),
+                                   causal=causal, window=window)
     axis_size = mesh.shape[seq_axis]
     zigzag = layout == "zigzag"
     if zigzag and (not causal or q.shape[1] % (2 * axis_size) != 0):
@@ -576,9 +624,23 @@ def ring_attention(q, k, v, mesh: Mesh, causal: bool = True,
     if q.shape[1] % axis_size != 0:
         # Sequence not evenly shardable (e.g. a probe batch at init time):
         # the dense path is always correct, just not sequence-parallel.
-        return multihead_attention(q, k, v, causal=causal, window=window)
+        return multihead_attention(q, _expand_kv(k, kv_groups),
+                                   _expand_kv(v, kv_groups),
+                                   causal=causal, window=window)
 
     dp, hp, spec = _sp_partition(mesh, q, seq_axis, data_axes, head_axis)
+
+    if kv_groups > 1 and hp is not None and (
+        k.shape[2] % mesh.shape[hp] != 0
+    ):
+        # head-sharded q with a KV head count the tensor axis doesn't
+        # divide would mis-pair local q heads with kv heads: pre-expand
+        k, v = _expand_kv(k, kv_groups), _expand_kv(v, kv_groups)
+        kv_groups = 1
+    # The KV spec equals q's (same dp/seq/head axes — only the head
+    # COUNT differs); each shard's local q:kv ratio stays kv_groups
+    # because both shard heads over the same axis.
+    spec_kv = spec
 
     if block_impl not in ("einsum", "flash"):
         raise ValueError(
@@ -594,23 +656,25 @@ def ring_attention(q, k, v, mesh: Mesh, causal: bool = True,
         fn = functools.partial(
             _ring_attention_zigzag_local_flash if flash_blocks
             else _ring_attention_zigzag_local,
-            axis_name=seq_axis, axis_size=axis_size,
+            axis_name=seq_axis, axis_size=axis_size, kv_groups=kv_groups,
         )
     elif flash_blocks:
         fn = functools.partial(
             _ring_attention_local_flash, axis_name=seq_axis,
             axis_size=axis_size, causal=causal, window=window,
+            kv_groups=kv_groups,
         )
     else:
         vary_axes = tuple(dp) + (seq_axis,) + ((hp,) if hp else ())
         fn = functools.partial(
             _ring_attention_local, axis_name=seq_axis, axis_size=axis_size,
             causal=causal, vary_axes=vary_axes, window=window,
+            kv_groups=kv_groups,
         )
     # Pallas calls don't annotate varying-mesh-axes metadata on their
     # outputs, so the flash bodies run with the vma check off (the einsum
     # bodies keep it, with explicit pcasts where carries start replicated).
     return shard_map(
-        fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        fn, mesh=mesh, in_specs=(spec, spec_kv, spec_kv), out_specs=spec,
         check_vma=not flash_blocks,
     )(q, k, v)
