@@ -110,10 +110,12 @@ class LlamaAttention(nn.Module):
             cos, sin = rope_tables(positions, hd, self.rope_base)
             q = apply_rope(q, cos, sin)
             k = apply_rope(k, cos, sin)
-            # GQA: the ring impls take COMPACT K/V (n_kv heads rotate the
-            # ring — groups x less ICI traffic — and expand per hop); the
-            # local impls get the broadcast here
-            if groups > 1 and self.attn_impl not in ("ring", "ring_flash"):
+            # GQA: the SP impls take COMPACT K/V (n_kv heads cross the
+            # interconnect — groups x less traffic — and expand locally);
+            # the single-device impls get the broadcast here
+            if groups > 1 and self.attn_impl not in (
+                "ring", "ring_flash", "ulysses", "ulysses_flash"
+            ):
                 k = jnp.repeat(k, groups, axis=2)
                 v = jnp.repeat(v, groups, axis=2)
             if self.attn_impl in ("ring", "ring_flash"):

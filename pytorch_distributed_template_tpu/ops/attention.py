@@ -139,6 +139,18 @@ def _expand_kv(x, groups: int):
     return x if groups == 1 else jnp.repeat(x, groups, axis=2)
 
 
+def _gqa_groups(q, k, v) -> int:
+    """Validated q-to-kv head ratio (1 when heads match)."""
+    if k.shape[2] == q.shape[2]:
+        return 1
+    if q.shape[2] % k.shape[2] or v.shape[2] != k.shape[2]:
+        raise ValueError(
+            f"GQA head counts must divide: q has {q.shape[2]}, "
+            f"k/v have {k.shape[2]}/{v.shape[2]}"
+        )
+    return q.shape[2] // k.shape[2]
+
+
 def _einsum_block_lse(q, kb, vb, visible):
     """(out, lse) of one attention block with an explicit [Tq, Tk] mask.
 
@@ -433,8 +445,14 @@ def _ulysses_local(q, k, v, *, axis_name: str, axis_size: int,
     """
     a2a = functools.partial(lax.all_to_all, axis_name=axis_name, tiled=True)
     q = a2a(q, split_axis=2, concat_axis=1)        # [B, T, H/s, D]
+    # GQA: compact K/V cross the all-to-all at n_kv heads (groups x less
+    # traffic) and broadcast locally after — shard j's q heads
+    # [j*Hq/s, (j+1)*Hq/s) pair with kv heads [j*Hkv/s, ...): the repeat
+    # mapping i -> i // groups preserves contiguous-block alignment.
     k = a2a(k, split_axis=2, concat_axis=1)
     v = a2a(v, split_axis=2, concat_axis=1)
+    k, v = (_expand_kv(k, q.shape[2] // k.shape[2]),
+            _expand_kv(v, q.shape[2] // v.shape[2]))
     if inner == "flash":
         from .flash import flash_attention
 
@@ -459,19 +477,39 @@ def ulysses_attention(q, k, v, mesh: Mesh, causal: bool = True,
     Local head count (after any ``tensor`` sharding) must divide by the
     seq-axis size; otherwise — and for probe shapes — falls back dense.
 
+    GQA: ``k``/``v`` may carry FEWER heads than ``q`` — the compact K/V
+    cross the all-to-alls (``groups``× less traffic) and broadcast
+    locally after, provided the KV head count also splits over the
+    involved axes; otherwise they pre-expand.
+
     ``inner`` selects the local kernel: "xla" einsum or "flash" (Pallas).
     """
+    kv_groups = _gqa_groups(q, k, v)
+
+    def dense():
+        return multihead_attention(q, _expand_kv(k, kv_groups),
+                                   _expand_kv(v, kv_groups),
+                                   causal=causal, window=window)
+
     if seq_axis not in mesh.axis_names or mesh.shape[seq_axis] == 1:
-        return multihead_attention(q, k, v, causal=causal, window=window)
+        return dense()
     s = mesh.shape[seq_axis]
     if q.shape[1] % s != 0:
-        return multihead_attention(q, k, v, causal=causal, window=window)
+        return dense()
 
     dp, hp, spec = _sp_partition(mesh, q, seq_axis, data_axes, head_axis)
     local_heads = q.shape[2] // (mesh.shape[hp] if hp else 1)
     if local_heads % s != 0:
         # not enough heads per device to split across the seq axis
-        return multihead_attention(q, k, v, causal=causal, window=window)
+        return dense()
+    if kv_groups > 1:
+        # the compact KV heads must split over the SAME axes as q's
+        # (tensor sharding, then the a2a's seq split); else pre-expand
+        hp_size = mesh.shape[hp] if hp else 1
+        if k.shape[2] % hp_size or (k.shape[2] // hp_size) % s:
+            k = _expand_kv(k, kv_groups)
+            v = _expand_kv(v, kv_groups)
+            kv_groups = 1
 
     fn = functools.partial(
         _ulysses_local, axis_name=seq_axis, axis_size=s, causal=causal,
@@ -595,18 +633,15 @@ def ring_attention(q, k, v, mesh: Mesh, causal: bool = True,
     head count, K/V are pre-expanded instead (a sharded-q/replicated-kv
     split would mis-pair heads).
     """
-    kv_groups = 1
-    if k.shape[2] != q.shape[2]:
-        if q.shape[2] % k.shape[2] or v.shape[2] != k.shape[2]:
-            raise ValueError(
-                f"GQA head counts must divide: q has {q.shape[2]}, "
-                f"k/v have {k.shape[2]}/{v.shape[2]}"
-            )
-        kv_groups = q.shape[2] // k.shape[2]
-    if seq_axis not in mesh.axis_names or mesh.shape[seq_axis] == 1:
+    kv_groups = _gqa_groups(q, k, v)
+
+    def dense():
         return multihead_attention(q, _expand_kv(k, kv_groups),
                                    _expand_kv(v, kv_groups),
                                    causal=causal, window=window)
+
+    if seq_axis not in mesh.axis_names or mesh.shape[seq_axis] == 1:
+        return dense()
     axis_size = mesh.shape[seq_axis]
     zigzag = layout == "zigzag"
     if zigzag and (not causal or q.shape[1] % (2 * axis_size) != 0):
@@ -624,9 +659,7 @@ def ring_attention(q, k, v, mesh: Mesh, causal: bool = True,
     if q.shape[1] % axis_size != 0:
         # Sequence not evenly shardable (e.g. a probe batch at init time):
         # the dense path is always correct, just not sequence-parallel.
-        return multihead_attention(q, _expand_kv(k, kv_groups),
-                                   _expand_kv(v, kv_groups),
-                                   causal=causal, window=window)
+        return dense()
 
     dp, hp, spec = _sp_partition(mesh, q, seq_axis, data_axes, head_axis)
 
