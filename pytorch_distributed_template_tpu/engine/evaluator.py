@@ -114,11 +114,16 @@ def _host_local_rows(arr) -> np.ndarray:
     by_start = {}
     for s in arr.addressable_shards:
         # batch-only sharding contract: every non-batch dim must be a full
-        # slice, else dedup-by-row-start would silently drop columns
-        assert all(
+        # slice, else dedup-by-row-start would silently drop columns.
+        # A real error (not an assert) so the contract survives `python -O`.
+        if not all(
             sl.start in (None, 0) and sl.stop in (None, n)
             for sl, n in zip(s.index[1:], arr.shape[1:])
-        ), f"shard {s.index} is split along a non-batch axis"
+        ):
+            raise ValueError(
+                f"shard {s.index} is split along a non-batch axis; "
+                "save_outputs requires batch-only sharding"
+            )
         start = s.index[0].start or 0
         if start not in by_start:
             by_start[start] = np.asarray(s.data)
@@ -206,11 +211,17 @@ def evaluate(config, mesh=None, save_outputs=None) -> dict:
         out_dir = Path(save_outputs)
         out_dir.mkdir(parents=True, exist_ok=True)
         p = dist.process_index()
-        np.save(out_dir / f"outputs_p{p}.npy",
-                np.concatenate(dumped_out) if dumped_out else np.zeros((0,)))
-        np.save(out_dir / f"targets_p{p}.npy",
-                np.concatenate(dumped_tgt) if dumped_tgt else np.zeros((0,)))
-        logger.info("saved per-example outputs to %s", out_dir)
+        if dumped_out:
+            np.save(out_dir / f"outputs_p{p}.npy", np.concatenate(dumped_out))
+            np.save(out_dir / f"targets_p{p}.npy", np.concatenate(dumped_tgt))
+            logger.info("saved per-example outputs to %s", out_dir)
+        else:
+            # No local batches at all: writing a shape/dtype-less
+            # placeholder would poison post-hoc cross-host concatenation
+            # of outputs_p*.npy, so skip the files and say so.
+            logger.info(
+                "no local eval rows on process %d; skipping output dump", p
+            )
 
     n_samples = int(accum["count"]) if accum else 0
     result = finalize_metrics(jax.tree.map(float, accum)) if accum else {}

@@ -57,3 +57,20 @@ def lm_token_accuracy(output, target):
     pred = jnp.argmax(output[:, :-1], axis=-1)
     hit = (pred == target[:, 1:]).astype(jnp.float32)
     return hit.mean(axis=-1)
+
+
+@METRICS.register("lm_bits_per_byte")
+def lm_bits_per_byte(output, target):
+    """Per-example next-token cross entropy in BITS — the standard
+    byte-LM quality number when tokens are raw bytes (vocab 256), e.g.
+    the real-corpus runs behind BASELINE.md's learning evidence
+    (8.0 = uniform random, lower is better). Accepts the same plain
+    [B,T,V] or fused-head ``(hidden, head_w)`` outputs as
+    ``lm_token_accuracy``, delegating the CE math to the loss
+    implementations so the two can never drift."""
+    from .losses import fused_lm_cross_entropy, lm_cross_entropy
+
+    ln2 = 0.6931471805599453
+    if isinstance(output, tuple):
+        return fused_lm_cross_entropy(chunk=256)(output, target) / ln2
+    return lm_cross_entropy(output, target) / ln2

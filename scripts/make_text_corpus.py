@@ -1,0 +1,65 @@
+#!/usr/bin/env python
+"""Build a real-text byte-LM corpus from local Python source.
+
+Zero-egress analogue of downloading WikiText: the Python standard
+library shipped in this image (tens of MB of real, human-written code +
+docstrings) becomes the training corpus for ``ByteLMLoader``
+(data/datasets.py). Deterministic: files are gathered in sorted order
+with a small header line per file, so the same interpreter version
+always produces byte-identical output — the held-out tail split
+(ByteLMLoader's ``val_fraction``) is therefore stable across runs.
+
+Usage:
+    python scripts/make_text_corpus.py [--out data/pystdlib.txt]
+        [--max-mb 64]
+"""
+from __future__ import annotations
+
+import argparse
+import sysconfig
+from pathlib import Path
+
+EXCLUDE_DIRS = {"site-packages", "dist-packages", "__pycache__",
+                "test", "tests", "idle_test"}
+
+
+def iter_source_files(root: Path):
+    for p in sorted(root.rglob("*.py")):
+        if any(part in EXCLUDE_DIRS for part in p.parts):
+            continue
+        yield p
+
+
+def build(out: Path, max_bytes: int) -> dict:
+    root = Path(sysconfig.get_paths()["stdlib"])
+    n_files = 0
+    total = 0
+    out.parent.mkdir(parents=True, exist_ok=True)
+    with open(out, "wb") as f:
+        for p in iter_source_files(root):
+            try:
+                data = p.read_bytes()
+            except OSError:
+                continue
+            header = f"\n# ==== {p.relative_to(root)} ====\n".encode()
+            if total + len(header) + len(data) > max_bytes:
+                break
+            f.write(header)
+            f.write(data)
+            total += len(header) + len(data)
+            n_files += 1
+    return {"out": str(out), "files": n_files, "bytes": total,
+            "source": str(root)}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default="data/pystdlib.txt")
+    ap.add_argument("--max-mb", type=float, default=64.0)
+    args = ap.parse_args()
+    info = build(Path(args.out), int(args.max_mb * 1e6))
+    print(info)
+
+
+if __name__ == "__main__":
+    main()

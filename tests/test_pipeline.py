@@ -8,6 +8,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import optax
+import pytest
 
 import pytorch_distributed_template_tpu.models  # noqa: F401
 from pytorch_distributed_template_tpu.config.registry import LOSSES, MODELS
@@ -449,3 +450,53 @@ def test_llama_pipelined_trains_dp_x_pp():
         state, m = step(state, batch)
         losses.append(float(m["loss_sum"]) / float(m["count"]))
     assert losses[-1] < losses[0] * 0.7, losses[::10]
+
+
+def _pp_step_memory(n_chunks, remat, *, n_layer=8, d_model=128, seq=128,
+                    batch=8, microbatches=4):
+    """Peak temp (activation/scratch) bytes of the compiled dp2 x pp4
+    train step, via XLA's memory_analysis on the AOT executable."""
+    mesh = build_mesh({"data": 2, "pipe": 4}, jax.devices()[:8])
+    model = MODELS.get("TinyPipeLM")(
+        vocab_size=64, n_layer=n_layer, n_head=4, d_model=d_model,
+        max_len=seq, n_stages=4, n_microbatches=microbatches,
+        n_chunks=n_chunks, remat=remat, mesh=mesh,
+    )
+    state = create_train_state(
+        model, optax.sgd(0.1), jnp.zeros((1, seq), jnp.int32), seed=0
+    )
+    state = jax.device_put(
+        state, apply_rules(state, mesh, model.partition_rules())
+    )
+    rng = np.random.default_rng(0)
+    bs = batch_sharding(mesh)
+    batch_arrays = {
+        "tokens": jax.device_put(
+            rng.integers(0, 64, (batch, seq)).astype(np.int32), bs),
+        "mask": jax.device_put(np.ones((batch,), bool), bs),
+    }
+    step = jax.jit(
+        make_train_step(model, optax.sgd(0.1),
+                        LOSSES.get("lm_cross_entropy"),
+                        input_key="tokens", target_key="tokens"),
+        donate_argnums=0,
+    )
+    compiled = step.lower(state, batch_arrays).compile()
+    return compiled.memory_analysis().temp_size_in_bytes
+
+
+@pytest.mark.slow
+def test_circular_remat_bounds_activation_memory():
+    """The circular schedule's memory claim (pipeline.py:25-33), measured
+    instead of asserted: at fixed (S=4, M=4) the circular V=2 + per-tick
+    remat train step's peak temp memory is strictly below GPipe (V=1)
+    without remat, and remat alone already beats no-remat. XLA's
+    memory_analysis of the compiled executable is the arbiter (the same
+    stats the TPU compiler schedules real HBM by)."""
+    gpipe_noremat = _pp_step_memory(1, False)
+    gpipe_remat = _pp_step_memory(1, True)
+    circular_remat = _pp_step_memory(2, True)
+    # remat trades activations for recompute: strictly less temp memory
+    assert gpipe_remat < gpipe_noremat, (gpipe_remat, gpipe_noremat)
+    # the production config (circular + remat) must hold the bound too
+    assert circular_remat < gpipe_noremat, (circular_remat, gpipe_noremat)
