@@ -119,9 +119,12 @@ def _decode_fns(model, temperature: float, top_k: int, top_p: float = 0.0):
 
     @jax.jit
     def prefill(params, cache, tokens):
+        # prefill=True (static): fresh cache at position 0, so attention
+        # routes through the flash kernel instead of the cached-einsum
+        # path — the [T0, cache_len] f32 score tensor never materializes
         logits, vs = model.apply(
             {"params": params, "cache": cache}, tokens,
-            train=False, decode=True, mutable=["cache"],
+            train=False, decode=True, prefill=True, mutable=["cache"],
         )
         return logits[:, -1], vs["cache"]
 

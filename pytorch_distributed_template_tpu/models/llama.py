@@ -90,7 +90,7 @@ class LlamaAttention(nn.Module):
 
     @nn.compact
     def __call__(self, x, positions, train: bool, decode: bool = False,
-                 decode_index=None):
+                 decode_index=None, prefill: bool = False):
         b, t, _ = x.shape
         hd = self.d_model // self.n_head
         groups = self.n_head // self.n_kv_head
@@ -105,7 +105,8 @@ class LlamaAttention(nn.Module):
             b, t, self.n_kv_head, hd)
 
         if decode:
-            ctx = self._cached_attention(q, k, v, decode_index, groups)
+            ctx = self._cached_attention(q, k, v, decode_index, groups,
+                                         prefill)
         else:
             cos, sin = rope_tables(positions, hd, self.rope_base)
             q = apply_rope(q, cos, sin)
@@ -155,7 +156,8 @@ class LlamaAttention(nn.Module):
         ctx = ctx.reshape(b, t, self.n_head * hd)
         return dense(self.d_model, "o_proj")(ctx)
 
-    def _cached_attention(self, q, k, v, cur, groups: int):
+    def _cached_attention(self, q, k, v, cur, groups: int,
+                          prefill: bool = False):
         """Incremental decode against a K/V cache stored at the KV-head
         count (GQA memory win; same single-position-counter contract as
         models/transformer.SelfAttention._cached_attention). RoPE rotates
@@ -168,6 +170,23 @@ class LlamaAttention(nn.Module):
         visibility mask — decode memory is O(window), independent of how
         long generation runs."""
         b, t, hq, d = q.shape
+
+        def _fresh_prefill_ctx():
+            # cur == 0 with an empty cache (generate()'s prefill): the
+            # call's own tokens are the ENTIRE visible context, so run
+            # the Pallas flash kernel (causal + window band) instead of
+            # materializing the [t, hist + t] f32 score tensor — measured
+            # round 3: einsum prefill of 8x1024 was ~320 ms vs ~30 ms
+            # through flash (the score/prob tensors are pure HBM traffic
+            # on this slice). Only reachable when t > 1 (static) and
+            # cur == 0 (runtime cond below).
+            from ..ops.flash import flash_attention
+
+            kr = jnp.repeat(k, groups, axis=2) if groups > 1 else k
+            vr = jnp.repeat(v, groups, axis=2) if groups > 1 else v
+            return flash_attention(q, kr, vr, causal=True,
+                                   window=self.window)
+
         # The ALLOCATION call (generate's zeros pass over [B, total]) sizes
         # the cache: min(window, total) slots when windowed. Later calls
         # must derive `rolling` from the allocated length — their own t is
@@ -237,6 +256,8 @@ class LlamaAttention(nn.Module):
             if groups > 1:
                 k_all = jnp.repeat(k_all, groups, axis=2)
                 v_all = jnp.repeat(v_all, groups, axis=2)
+            if t > 1 and prefill:
+                return _fresh_prefill_ctx()
             return multihead_attention(
                 q, k_all, v_all, causal=False, mask=visible[None, None]
             )
@@ -258,6 +279,8 @@ class LlamaAttention(nn.Module):
         if groups > 1:
             k_all = jnp.repeat(k_all, groups, axis=2)
             v_all = jnp.repeat(v_all, groups, axis=2)
+        if t > 1 and prefill:
+            return _fresh_prefill_ctx()
         return multihead_attention(
             q, k_all, v_all, causal=False, mask=visible[None, None]
         )
@@ -296,13 +319,14 @@ class LlamaBlock(nn.Module):
 
     @nn.compact
     def __call__(self, x, positions, train: bool, example_mask=None,
-                 decode: bool = False, decode_index=None):
+                 decode: bool = False, decode_index=None,
+                 prefill: bool = False):
         h = RMSNorm(self.rms_eps, name="input_layernorm")(x)
         x = x + LlamaAttention(
             self.d_model, self.n_head, self.n_kv_head, self.dtype,
             self.attn_impl, self.mesh, self.seq_layout, self.rope_base,
             window=self.window, name="self_attn",
-        )(h, positions, train, decode, decode_index)
+        )(h, positions, train, decode, decode_index, prefill)
         h = RMSNorm(self.rms_eps, name="post_attention_layernorm")(x)
         if self.moe:
             # Mixtral-style sparse FFN: routed SwiGLU experts over the
@@ -372,7 +396,7 @@ class LlamaLM(nn.Module):
 
     @nn.compact
     def __call__(self, tokens, train: bool = False, example_mask=None,
-                 decode: bool = False):
+                 decode: bool = False, prefill: bool = False):
         b, t = tokens.shape
         n_kv = self.n_kv_head or self.n_head
         if self.n_head % n_kv != 0:
@@ -425,7 +449,7 @@ class LlamaLM(nn.Module):
             # static_argnums count self as 0: train=3 / decode=5 are Python
             # bools; positions (2) and example_mask (4) are traced
             block_cls = nn.remat(
-                LlamaBlock, static_argnums=(3, 5),
+                LlamaBlock, static_argnums=(3, 5, 7),
                 policy=jax.checkpoint_policies.nothing_saveable,
             )
         for i in range(self.n_layer):
@@ -439,7 +463,7 @@ class LlamaLM(nn.Module):
                 window=self.window, moe=self._moe_kwargs(i),
                 n_layer=self.n_layer,
                 name=f"layers_{i}",
-            )(x, positions, train, example_mask, decode, start)
+            )(x, positions, train, example_mask, decode, start, prefill)
         x = RMSNorm(self.rms_eps, name="norm")(x)
         if zperm is not None:
             x = x[:, np.argsort(zperm)]

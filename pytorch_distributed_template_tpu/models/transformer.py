@@ -68,7 +68,7 @@ class SelfAttention(nn.Module):
 
     @nn.compact
     def __call__(self, x, train: bool, decode: bool = False,
-                 decode_index=None):
+                 decode_index=None, prefill: bool = False):
         b, t, _ = x.shape
         head_dim = self.d_model // self.n_head
         qkv = nn.Dense(3 * self.d_model, dtype=self.dtype,
@@ -76,7 +76,7 @@ class SelfAttention(nn.Module):
         qkv = qkv.reshape(b, t, 3, self.n_head, head_dim)
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
         if decode:
-            ctx = self._cached_attention(q, k, v, decode_index)
+            ctx = self._cached_attention(q, k, v, decode_index, prefill)
         elif self.attn_impl in ("ring", "ring_flash"):
             if self.mesh is None:
                 raise ValueError(f"attn_impl={self.attn_impl!r} requires a mesh")
@@ -109,7 +109,7 @@ class SelfAttention(nn.Module):
                        name="out")(ctx)
         return nn.Dropout(self.dropout, deterministic=not train)(out)
 
-    def _cached_attention(self, q, k, v, cur):
+    def _cached_attention(self, q, k, v, cur, prefill: bool = False):
         """Incremental attention against a KV cache (flax decode pattern).
 
         ``cur`` is the write position — the model-level ``pos_index``
@@ -144,6 +144,16 @@ class SelfAttention(nn.Module):
         cached_v.value = v_all
         q_pos = cur + jnp.arange(t)                       # [t]
         visible = jnp.arange(max_len)[None, :] <= q_pos[:, None]  # [t, L]
+        if prefill and t > 1:
+            # STATIC prefill fast path (generate() passes prefill=True:
+            # fresh cache, cur == 0, the call's own tokens are the whole
+            # visible context): the flash kernel avoids the [t, max_len]
+            # f32 score/prob tensors — pure HBM traffic. Static (not a
+            # lax.cond on cur == 0) so XLA never traces — or reserves
+            # temp memory for — the einsum branch.
+            from ..ops.flash import flash_attention
+
+            return flash_attention(q, k, v, causal=True)
         return multihead_attention(
             q, k_all, v_all, causal=False, mask=visible[None, None]
         )
@@ -164,14 +174,15 @@ class Block(nn.Module):
 
     @nn.compact
     def __call__(self, x, train: bool, example_mask=None,
-                 decode: bool = False, decode_index=None):
+                 decode: bool = False, decode_index=None,
+                 prefill: bool = False):
         h = nn.LayerNorm(epsilon=self.ln_eps, dtype=jnp.float32,
                          name="ln_1")(x)
         x = x + SelfAttention(
             self.d_model, self.n_head, self.dropout, self.n_layer,
             self.dtype, self.attn_impl, self.mesh,
             seq_layout=self.seq_layout, name="attn",
-        )(h, train, decode, decode_index)
+        )(h, train, decode, decode_index, prefill)
         h = nn.LayerNorm(epsilon=self.ln_eps, dtype=jnp.float32,
                          name="ln_2")(x)
         if self.moe:
@@ -226,7 +237,7 @@ class TransformerLM(nn.Module):
 
     @nn.compact
     def __call__(self, tokens, train: bool = False, example_mask=None,
-                 decode: bool = False):
+                 decode: bool = False, prefill: bool = False):
         """``example_mask`` ([B] bool): marks padded examples so MoE blocks
         keep them out of expert capacity/balance statistics (dense blocks
         are per-token and need no mask — the loss masking suffices).
@@ -293,7 +304,7 @@ class TransformerLM(nn.Module):
             # Python bools and must stay static; example_mask (3) is a
             # traced [B] array and must NOT be listed
             block_cls = nn.remat(
-                Block, static_argnums=(2, 4),
+                Block, static_argnums=(2, 4, 6),
                 policy=jax.checkpoint_policies.nothing_saveable,
             )
         for i in range(self.n_layer):
@@ -304,7 +315,7 @@ class TransformerLM(nn.Module):
                 moe=self._moe_kwargs(i), ln_eps=self.ln_eps,
                 seq_layout="zigzag" if zperm is not None else "natural",
                 name=f"h_{i}",
-            )(x, train, example_mask, decode, start)
+            )(x, train, example_mask, decode, start, prefill)
         x = nn.LayerNorm(epsilon=self.ln_eps, dtype=jnp.float32,
                          name="ln_f")(x)
         if zperm is not None:

@@ -43,7 +43,10 @@ def build(out: Path, max_bytes: int) -> dict:
                 continue
             header = f"\n# ==== {p.relative_to(root)} ====\n".encode()
             if total + len(header) + len(data) > max_bytes:
-                break
+                # skip just this file — smaller later files may still fit
+                # (a `break` here would silently truncate the corpus at
+                # the first large file and make the total layout-dependent)
+                continue
             f.write(header)
             f.write(data)
             total += len(header) + len(data)

@@ -86,6 +86,57 @@ def test_lm_bits_per_byte_metric_parity():
 
 
 @pytest.mark.slow
+def test_byte_lm_learns_real_text(tmp_path):
+    """A byte-LM trained on REAL local text (Python stdlib source via
+    scripts/make_text_corpus.py — deterministic, zero-egress) through the
+    full config -> ByteLMLoader -> Trainer path beats a meaningful
+    bits-per-byte bar on the held-out tail split. Uniform-random is 8.0
+    bpb; printed-English/code unigram entropy is ~4.5 — the bar requires
+    genuine sequence modeling, and the TPU artifact
+    (artifacts/bytelm_r3) shows the full-size config reaching far lower."""
+    import sys
+    sys.path.insert(0, str(Path(__file__).parent.parent / "scripts"))
+    from make_text_corpus import build
+
+    corpus = tmp_path / "corpus.txt"
+    info = build(corpus, int(0.5e6))
+    assert info["bytes"] > 400_000, info
+
+    cfg = json.loads(
+        (Path(__file__).parent.parent / "configs" / "bytelm_stdlib.json")
+        .read_text()
+    )
+    cfg["arch"]["args"].update(
+        n_layer=2, n_head=4, d_model=128, max_len=256, bfloat16=False,
+        attn_impl="xla", dropout=0.0,
+    )
+    for split in ("train_loader", "valid_loader"):
+        cfg[split]["args"].update(
+            data_dir=str(tmp_path), file="corpus.txt", seq_len=256,
+            batch_size=16,
+        )
+    cfg["loss"] = {"type": "fused_lm_cross_entropy", "args": {"chunk": 128}}
+    cfg["trainer"].update(epochs=3, save_dir=str(tmp_path), early_stop=0,
+                          tensorboard=False)
+    cfg["lr_scheduler"] = {"type": "WarmupCosine",
+                           "args": {"warmup_epochs": 1, "total_epochs": 3}}
+    config = ConfigParser(cfg, run_id="real_text")
+    model = config.init_obj("arch", MODELS)
+    from pytorch_distributed_template_tpu.engine.losses import resolve_loss
+
+    trainer = Trainer(
+        model, resolve_loss(config["loss"]),
+        [METRICS.get(m) for m in config["metrics"]],
+        config=config,
+        train_loader=config.init_obj("train_loader", LOADERS),
+        valid_loader=config.init_obj("valid_loader", LOADERS),
+        mesh=mesh_from_config(config), seed=0,
+    )
+    log = trainer.train()
+    assert log["val_lm_bits_per_byte"] < 4.5, log
+
+
+@pytest.mark.slow
 def test_digits_lenet_reaches_95pct(tmp_path):
     """LeNet on the real digits reaches >= 95% held-out accuracy through
     the full config -> Trainer -> sharded jitted step path. This is a
