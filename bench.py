@@ -342,7 +342,16 @@ def bench_decode(batch: int = 8, prompt_len: int = 1024,
     step's sampled token and cache feed the next step — the platform's
     required in-jit chaining); prefill repeats chain through a
     carry-perturbed prompt so no two calls see identical inputs (the
-    tunnel dedups identical dispatches). Decode is HBM-bound (every step
+    tunnel dedups identical dispatches). Known platform anomaly, round 3:
+    prefill at THIS config (12 layers x 32k vocab x rolling window)
+    compiles to a ~10x-slower-than-expected program (~290 ms vs the
+    ~30 ms the same model costs with 6 layers, a 256 vocab, or no
+    window — each alone is fast; ablation in BASELINE.md). The cost is
+    NOT attention (static flash path), the ring-buffer write (roll/DUS,
+    no scatter), or the head (last-position only): it is an XLA
+    scheduling cliff on this tunnel, reported as measured.
+
+    Decode is HBM-bound (every step
     re-reads all weights), so ``model_bw_frac`` reports achieved bytes/s
     against BASELINE.md's measured ~260 GB/s slice bandwidth, counting
     2 bytes/param: params are STORED f32 (flax param_dtype) but the
@@ -609,15 +618,21 @@ def bench_reference_torch(batch: int = 16, steps: int = 3) -> float:
 
 def _try_ladder(name: str, attempts) -> dict:
     """Run the first config of ``attempts`` that fits (OOM fallback),
-    recording which one ran; a rung never kills the whole bench."""
+    recording which one ran; a rung never kills the whole bench. The
+    last exception OBJECT rides along under ``_exc`` (stripped before
+    JSON) so a headline-rung failure re-raises with its real class and
+    chained traceback instead of a stringified shadow."""
     last = None
     for fn, kwargs in attempts:
         try:
             return fn(**kwargs)
         except Exception as e:
             last = e
+    import traceback
+
     print(f"{name} rung failed: {last!r}", file=sys.stderr)
-    return {"error": str(last)}
+    traceback.print_exception(last, file=sys.stderr)
+    return {"error": str(last), "_exc": last}
 
 
 def main():
@@ -658,7 +673,11 @@ def main():
         ref = float("nan")
     resnet = rungs["resnet50"]
     if "error" in resnet:
-        raise RuntimeError(f"headline rung failed: {resnet['error']}")
+        raise RuntimeError(
+            f"headline rung failed: {resnet['error']}"
+        ) from resnet.get("_exc")
+    for r in rungs.values():
+        r.pop("_exc", None)  # exception objects are not JSON
     vs = resnet["images_per_sec"] / ref if ref == ref and ref > 0 else 0.0
     print(json.dumps({
         "metric": "resnet50_train_images_per_sec",

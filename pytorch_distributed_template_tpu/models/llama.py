@@ -172,14 +172,15 @@ class LlamaAttention(nn.Module):
         b, t, hq, d = q.shape
 
         def _fresh_prefill_ctx():
-            # cur == 0 with an empty cache (generate()'s prefill): the
-            # call's own tokens are the ENTIRE visible context, so run
-            # the Pallas flash kernel (causal + window band) instead of
-            # materializing the [t, hist + t] f32 score tensor — measured
-            # round 3: einsum prefill of 8x1024 was ~320 ms vs ~30 ms
-            # through flash (the score/prob tensors are pure HBM traffic
-            # on this slice). Only reachable when t > 1 (static) and
-            # cur == 0 (runtime cond below).
+            # STATIC prefill contract (same as transformer.py): the
+            # caller asserts via prefill=True that the cache is FRESH
+            # (cur == 0, nothing decoded yet — generate() guarantees
+            # this), so the call's own tokens are the ENTIRE visible
+            # context and the Pallas flash kernel (causal + window band)
+            # replaces the [t, hist + t] f32 einsum score tensor, which
+            # is pure HBM traffic. UNCHECKED at runtime: prefill=True on
+            # a warm cache silently ignores history — do not reuse the
+            # prefill fn for chunked continuation.
             from ..ops.flash import flash_attention
 
             kr = jnp.repeat(k, groups, axis=2) if groups > 1 else k
@@ -247,12 +248,42 @@ class LlamaAttention(nn.Module):
                     pos[-cache_len:]
             else:
                 kw, vw, wpos = k, v, pos
-            slots = wpos % cache_len
-            cached_k.value = cached_k.value.at[:, slots].set(
-                kw.astype(cached_k.value.dtype))
-            cached_v.value = cached_v.value.at[:, slots].set(
-                vw.astype(cached_v.value.dtype))
-            slot_pos.value = slot_pos.value.at[slots].set(wpos + 1)
+            # The write positions are CONTIGUOUS (wpos is a range), so a
+            # ring-buffer write never needs a gather/scatter — it is a
+            # roll and/or one dynamic_update_slice. The previous
+            # `.at[:, wpos % W].set(...)` multi-index scatter compiled
+            # into a pathologically serialized program on TPU (measured
+            # round 3: 12-layer 8x1024 prefill 328 ms vs 33 ms without
+            # it — ~28 ms PER LAYER for a 2 MB write).
+            start = wpos[0] % cache_len
+            kw = kw.astype(cached_k.value.dtype)
+            vw = vw.astype(cached_v.value.dtype)
+            if kw.shape[1] == cache_len:
+                # full replace: slot s must hold the row with pos % W == s,
+                # i.e. kw rolled by start (kw[i] lands at (start + i) % W)
+                cached_k.value = jnp.roll(kw, start, axis=1)
+                cached_v.value = jnp.roll(vw, start, axis=1)
+                slot_pos.value = jnp.roll(wpos + 1, start)
+            elif kw.shape[1] == 1:
+                # single-token decode step: one row, cannot wrap
+                cached_k.value = jax.lax.dynamic_update_slice(
+                    cached_k.value, kw, (0, start, 0, 0))
+                cached_v.value = jax.lax.dynamic_update_slice(
+                    cached_v.value, vw, (0, start, 0, 0))
+                slot_pos.value = jax.lax.dynamic_update_slice(
+                    slot_pos.value, wpos + 1, (start,))
+            else:
+                # partial contiguous write that may wrap once: rotate the
+                # ring so the span is slice [0, n), write, rotate back
+                def write(buf, new, axis):
+                    rolled = jnp.roll(buf, -start, axis=axis)
+                    rolled = jax.lax.dynamic_update_slice(
+                        rolled, new, (0,) * buf.ndim)
+                    return jnp.roll(rolled, start, axis=axis)
+
+                cached_k.value = write(cached_k.value, kw, 1)
+                cached_v.value = write(cached_v.value, vw, 1)
+                slot_pos.value = write(slot_pos.value, wpos + 1, 0)
             if groups > 1:
                 k_all = jnp.repeat(k_all, groups, axis=2)
                 v_all = jnp.repeat(v_all, groups, axis=2)
@@ -467,6 +498,11 @@ class LlamaLM(nn.Module):
         x = RMSNorm(self.rms_eps, name="norm")(x)
         if zperm is not None:
             x = x[:, np.argsort(zperm)]
+        if decode and prefill and t > 1:
+            # generate()'s prefill samples only from the LAST position:
+            # skip the [B, T-1, V] logits rows — ~1 GB of f32 HBM writes
+            # per 8x1024 prefill at 32k vocab
+            x = x[:, -1:]
         if self.fused_head and not decode:
             # chunked head+loss (engine/losses.fused_lm_cross_entropy):
             # [B, T, V] logits never materialize. Same param path as the

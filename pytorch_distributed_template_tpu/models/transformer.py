@@ -320,6 +320,11 @@ class TransformerLM(nn.Module):
                          name="ln_f")(x)
         if zperm is not None:
             x = x[:, np.argsort(zperm)]  # back to natural order pre-head
+        if decode and prefill and t > 1:
+            # generate()'s prefill samples only from the LAST position:
+            # skip the [B, T-1, V] logits rows — ~1 GB of f32 HBM writes
+            # per 8x1024 prefill at GPT-2 vocab
+            x = x[:, -1:]
         if self.fused_head and not decode:
             # Memory-efficient head: hand (hidden, head weights) to a fused
             # chunked loss (engine/losses.fused_lm_cross_entropy) so the

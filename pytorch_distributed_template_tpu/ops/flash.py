@@ -606,8 +606,13 @@ def pick_block_sizes(t: int, d: int) -> tuple:
       more work in each program.
 
     Sequences shorter than a block fall back to one block (the ``min``
-    in the caller)."""
+    in the caller). Lengths that don't divide the asymmetric pair's
+    lcm (1024) keep the old square 512x512 — the caller pads to the
+    block lcm, and taxing a t=1536 call with 512 columns of masked
+    padding would cost more than the block win."""
     del d  # same winner at d=64 and d=128 everywhere measured
+    if t % 1024:
+        return DEFAULT_BLOCK_Q, DEFAULT_BLOCK_K
     if t >= 8192:
         return 1024, 512
     return 512, 1024

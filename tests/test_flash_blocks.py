@@ -1,0 +1,23 @@
+"""pick_block_sizes: the measured auto-block table (ops/flash.py).
+
+Pure host-side contract checks — the performance claims behind the
+table are measured on hardware (BASELINE.md), but the divisibility
+fallback is a correctness-of-performance rule pinnable on CPU: lengths
+that don't divide the asymmetric pair's lcm must keep the square
+default, or the caller's lcm padding would add masked work.
+"""
+from pytorch_distributed_template_tpu.ops.flash import (
+    DEFAULT_BLOCK_Q, DEFAULT_BLOCK_K, pick_block_sizes,
+)
+
+
+def test_measured_winners():
+    assert pick_block_sizes(1024, 64) == (512, 1024)
+    assert pick_block_sizes(2048, 128) == (512, 1024)
+    assert pick_block_sizes(4096, 64) == (512, 1024)
+    assert pick_block_sizes(8192, 64) == (1024, 512)
+
+
+def test_non_lcm_lengths_keep_square_default():
+    for t in (512, 1536, 2560, 3584, 100):
+        assert pick_block_sizes(t, 64) == (DEFAULT_BLOCK_Q, DEFAULT_BLOCK_K)
