@@ -1,0 +1,42 @@
+"""Host-side bench.py helpers (the measurement machinery itself).
+
+The rungs need hardware, but the dispersion math and the OOM-fallback
+ladder are pure logic — regressions here corrupt every number the
+driver records, so they get CPU tests.
+"""
+import sys
+from pathlib import Path
+
+import pytest
+
+sys.path.insert(0, str(Path(__file__).parent.parent))
+import bench  # noqa: E402
+
+
+def test_dispersion_stats():
+    d = bench._dispersion([10.0, 12.0, 11.0])
+    assert d["repeats"] == 3
+    assert d["steps_per_sec_median"] == 11.0
+    assert d["steps_per_sec_min"] == 10.0
+    assert d["steps_per_sec_max"] == 12.0
+    assert d["spread_pct"] == pytest.approx(100 * 2.0 / 11.0, abs=0.01)
+
+
+def test_try_ladder_falls_through_and_keeps_exception():
+    calls = []
+
+    def fail(**kw):
+        calls.append(kw)
+        raise MemoryError(f"oom at {kw}")
+
+    def ok(**kw):
+        return {"ran": kw}
+
+    out = bench._try_ladder("r", [(fail, {"b": 8}), (ok, {"b": 4})])
+    assert out == {"ran": {"b": 4}} and calls == [{"b": 8}]
+
+    out = bench._try_ladder("r", [(fail, {"b": 8}), (fail, {"b": 4})])
+    assert "error" in out
+    # the real exception object survives for the headline re-raise
+    assert isinstance(out["_exc"], MemoryError)
+    assert "b': 4" in str(out["_exc"])
