@@ -332,7 +332,8 @@ def bench_vit_b16(batch: int) -> dict:
 
 
 def bench_decode(batch: int = 8, prompt_len: int = 1024,
-                 new_tokens: int = 256, window: int = 1024) -> dict:
+                 new_tokens: int = 256, window: int = 1024,
+                 quant: str = "") -> dict:
     """Serving rung: prefill tok/s and steady-state decode tok/s through
     the incremental-decoding path (engine/generate._decode_fns) on a
     GPT-2-small-scale Llama with GQA (12 heads over 4 KV heads) and a
@@ -353,13 +354,13 @@ def bench_decode(batch: int = 8, prompt_len: int = 1024,
 
     Decode is HBM-bound (every step
     re-reads all weights), so ``model_bw_frac`` reports achieved bytes/s
-    against BASELINE.md's measured ~260 GB/s slice bandwidth, counting
-    2 bytes/param: params are STORED f32 (flax param_dtype) but the
-    model computes in bf16, and the f32 interpretation is refuted by the
+    against BASELINE.md's measured ~260 GB/s slice bandwidth. Byte
+    accounting: int8 kernels (``quant="w8a16"``, models/quant.py) count
+    1 byte; float leaves count 2 (params are STORED f32 but the model
+    computes in bf16, and the f32 interpretation is refuted by the
     measurement itself — 4 bytes/param at the observed step rate would
-    exceed the slice's measured HBM ceiling (~294 GB/s > 260), so XLA
-    demonstrably hoists one bf16 cast out of the decode loop and streams
-    the bf16 copies.
+    exceed the slice's measured HBM ceiling, so XLA demonstrably hoists
+    one bf16 cast out of the decode loop and streams the bf16 copies).
     """
     import jax
     import jax.numpy as jnp
@@ -372,16 +373,38 @@ def bench_decode(batch: int = 8, prompt_len: int = 1024,
     model = MODELS.get("Llama")(
         vocab_size=32000, n_layer=12, n_head=12, n_kv_head=4,
         d_model=768, max_len=prompt_len + new_tokens, window=window,
-        bfloat16=True,
+        bfloat16=True, quant=quant,
     )
     rng = np.random.default_rng(0)
     prompt = jnp.asarray(
         rng.integers(0, 32000, size=(batch, prompt_len)), jnp.int32
     )
-    params = model.init(
-        jax.random.key(0), jnp.zeros((1, 8), jnp.int32)
-    )["params"]
+    if quant == "w8a16":
+        # quantize a DENSE init to the serving layout (models/quant.py):
+        # int8 kernels stream half the bytes of the bf16 copies
+        from pytorch_distributed_template_tpu.models.quant import (
+            quantize_params_w8,
+        )
+
+        dense_model = MODELS.get("Llama")(
+            vocab_size=32000, n_layer=12, n_head=12, n_kv_head=4,
+            d_model=768, max_len=prompt_len + new_tokens, window=window,
+            bfloat16=True,
+        )
+        params = quantize_params_w8(dense_model.init(
+            jax.random.key(0), jnp.zeros((1, 8), jnp.int32)
+        )["params"])
+    else:
+        params = model.init(
+            jax.random.key(0), jnp.zeros((1, 8), jnp.int32)
+        )["params"]
     n_params = sum(x.size for x in jax.tree.leaves(params))
+    # streamed bytes per decode step: int8 kernels 1 B, floats as bf16
+    # compute copies 2 B (see model_bw_frac note below)
+    n_bytes = sum(
+        x.size * (1 if x.dtype == jnp.int8 else 2)
+        for x in jax.tree.leaves(params)
+    )
 
     shapes = jax.eval_shape(
         lambda p: model.apply(
@@ -468,8 +491,8 @@ def bench_decode(batch: int = 8, prompt_len: int = 1024,
     disp = _dispersion(reps)
     step_ms = 1e3 / disp["steps_per_sec_median"]
     decode_tps = batch * disp["steps_per_sec_median"]
-    # decode reads all params (bf16 = 2 bytes) once per step
-    bw = n_params * 2 * disp["steps_per_sec_median"]
+    # decode re-reads all weights once per step (n_bytes above)
+    bw = n_bytes * disp["steps_per_sec_median"]
     return {
         "prefill_tokens_per_sec": round(prefill_tps, 0),
         "decode_tokens_per_sec": round(decode_tps, 0),
@@ -481,6 +504,7 @@ def bench_decode(batch: int = 8, prompt_len: int = 1024,
         "new_tokens": new_tokens,
         "window": window,
         "n_params": n_params,
+        "quant": quant or "none",
     }
 
 
@@ -660,6 +684,12 @@ def main():
     rungs["decode"] = _try_ladder("decode", [
         (bench_decode, {}),
         (bench_decode, {"batch": 4, "new_tokens": 128}),
+    ])
+    # int8 weight-only serving: decode is HBM-bound, so streaming int8
+    # kernels instead of bf16 copies should approach 2x (models/quant.py)
+    rungs["decode_w8"] = _try_ladder("decode_w8", [
+        (bench_decode, {"quant": "w8a16"}),
+        (bench_decode, {"quant": "w8a16", "batch": 4, "new_tokens": 128}),
     ])
     try:
         rungs["flash_attention_8k"] = bench_flash_long_context()

@@ -252,10 +252,15 @@ class Trainer(BaseTrainer):
 
         self.train_loader = train_loader
         if len_epoch is None:
+            # config-level opt-in to iteration-based training (the
+            # reference enables it by passing len_epoch to its Trainer;
+            # here `trainer.len_epoch` in the JSON reaches the CLI path)
+            len_epoch = config["trainer"].get("len_epoch")
+        if len_epoch is None:
             self.len_epoch = len(train_loader)
             self._train_iter = None
         else:
-            self.len_epoch = len_epoch
+            self.len_epoch = int(len_epoch)
             self._train_iter = iter(_endless_reshuffling(train_loader))
         self.valid_loader = valid_loader
         self.do_validation = valid_loader is not None

@@ -38,6 +38,15 @@ def _dense_init(stddev=0.02):
     return nn.initializers.normal(stddev=stddev)
 
 
+def _dense_or_quant(dtype, quant: str):
+    """Bias-free Dense factory honoring the serving quantization mode
+    (single dispatch point: models/quant.dense_factory)."""
+    from .quant import dense_factory
+
+    return dense_factory(dtype, quant, use_bias=False,
+                         kernel_init=_dense_init())
+
+
 class RMSNorm(nn.Module):
     eps: float = 1e-6
 
@@ -87,6 +96,7 @@ class LlamaAttention(nn.Module):
     seq_layout: str = "natural"
     rope_base: float = 10000.0
     window: int = 0                 # sliding-window size; 0 = full causal
+    quant: str = ""                 # "" | "w8a16" (models/quant.py)
 
     @nn.compact
     def __call__(self, x, positions, train: bool, decode: bool = False,
@@ -94,10 +104,7 @@ class LlamaAttention(nn.Module):
         b, t, _ = x.shape
         hd = self.d_model // self.n_head
         groups = self.n_head // self.n_kv_head
-        dense = lambda feats, name: nn.Dense(
-            feats, use_bias=False, dtype=self.dtype,
-            kernel_init=_dense_init(), name=name,
-        )
+        dense = _dense_or_quant(self.dtype, self.quant)
         q = dense(self.n_head * hd, "q_proj")(x).reshape(b, t, self.n_head, hd)
         k = dense(self.n_kv_head * hd, "k_proj")(x).reshape(
             b, t, self.n_kv_head, hd)
@@ -321,13 +328,11 @@ class SwiGLU(nn.Module):
     d_model: int
     d_ff: int
     dtype: Any
+    quant: str = ""
 
     @nn.compact
     def __call__(self, x):
-        dense = lambda feats, name: nn.Dense(
-            feats, use_bias=False, dtype=self.dtype,
-            kernel_init=_dense_init(), name=name,
-        )
+        dense = _dense_or_quant(self.dtype, self.quant)
         gate = nn.silu(dense(self.d_ff, "gate_proj")(x))
         up = dense(self.d_ff, "up_proj")(x)
         return dense(self.d_model, "down_proj")(gate * up)
@@ -347,6 +352,7 @@ class LlamaBlock(nn.Module):
     window: int = 0
     moe: Optional[dict] = None      # MoeMlp kwargs; None -> dense SwiGLU
     n_layer: int = 1                # model depth, for residual-init scaling
+    quant: str = ""                 # "" | "w8a16" (serving; models/quant.py)
 
     @nn.compact
     def __call__(self, x, positions, train: bool, example_mask=None,
@@ -356,7 +362,7 @@ class LlamaBlock(nn.Module):
         x = x + LlamaAttention(
             self.d_model, self.n_head, self.n_kv_head, self.dtype,
             self.attn_impl, self.mesh, self.seq_layout, self.rope_base,
-            window=self.window, name="self_attn",
+            window=self.window, quant=self.quant, name="self_attn",
         )(h, positions, train, decode, decode_index, prefill)
         h = RMSNorm(self.rms_eps, name="post_attention_layernorm")(x)
         if self.moe:
@@ -371,7 +377,7 @@ class LlamaBlock(nn.Module):
                 name="moe",
             )(h, train, example_mask)
         return x + SwiGLU(self.d_model, self.d_ff, self.dtype,
-                          name="mlp")(h)
+                          quant=self.quant, name="mlp")(h)
 
 
 class _HeadKernel(nn.Module):
@@ -409,6 +415,7 @@ class LlamaLM(nn.Module):
     rms_eps: float = 1e-6
     window: int = 0                 # sliding-window attention; 0 = full
     fused_head: bool = False        # return (hidden, head_w) for chunked loss
+    quant: str = ""                 # "w8a16": int8 serving weights (quant.py)
     # --- MoE (models/moe.py, swiglu experts); 0 -> all-dense blocks -------
     moe_experts: int = 0
     moe_top_k: int = 2
@@ -428,6 +435,11 @@ class LlamaLM(nn.Module):
     @nn.compact
     def __call__(self, tokens, train: bool = False, example_mask=None,
                  decode: bool = False, prefill: bool = False):
+        if self.quant:
+            from .quant import validate_quant_config
+
+            validate_quant_config(self.quant, self.fused_head,
+                                  self.moe_experts)
         b, t = tokens.shape
         n_kv = self.n_kv_head or self.n_head
         if self.n_head % n_kv != 0:
@@ -492,7 +504,7 @@ class LlamaLM(nn.Module):
                 ),
                 rope_base=self.rope_base, rms_eps=self.rms_eps,
                 window=self.window, moe=self._moe_kwargs(i),
-                n_layer=self.n_layer,
+                n_layer=self.n_layer, quant=self.quant,
                 name=f"layers_{i}",
             )(x, positions, train, example_mask, decode, start, prefill)
         x = RMSNorm(self.rms_eps, name="norm")(x)
@@ -510,8 +522,8 @@ class LlamaLM(nn.Module):
             w = _HeadKernel(self.d_model, self.vocab_size,
                             name="lm_head")()
             return x.astype(self.dtype), w.astype(self.dtype)
-        logits = nn.Dense(self.vocab_size, use_bias=False, dtype=self.dtype,
-                          kernel_init=_dense_init(), name="lm_head")(x)
+        head = _dense_or_quant(self.dtype, self.quant)
+        logits = head(self.vocab_size, "lm_head")(x)
         return logits.astype(jnp.float32)
 
     def batch_template(self, batch_size: int = 1):
@@ -542,14 +554,15 @@ def llama(vocab_size: int = 32000, n_layer: int = 12, n_head: int = 12,
           max_len: int = 2048, bfloat16: bool = False,
           attn_impl: str = "xla", remat: bool = False, mesh=None,
           seq_layout: str = "natural", rope_base: float = 10000.0,
-          rms_eps: float = 1e-6, window: int = 0, fused_head: bool = False):
+          rms_eps: float = 1e-6, window: int = 0, fused_head: bool = False,
+          quant: str = ""):
     return LlamaLM(
         vocab_size=vocab_size, n_layer=n_layer, n_head=n_head,
         n_kv_head=n_kv_head, d_model=d_model, d_ff=d_ff, max_len=max_len,
         dtype=jnp.bfloat16 if bfloat16 else jnp.float32,
         attn_impl=attn_impl, remat=remat, mesh=mesh, seq_layout=seq_layout,
         rope_base=rope_base, rms_eps=rms_eps, window=window,
-        fused_head=fused_head,
+        fused_head=fused_head, quant=quant,
     )
 
 

@@ -1,0 +1,173 @@
+"""Weight-only int8 quantization (w8a16) for serving.
+
+Decode is HBM-bandwidth-bound — every step streams all weights, and
+BASELINE.md's decode rung measures ~59% of this slice's bandwidth with
+bf16 weight copies. Storing matmul kernels as int8 + a per-output-
+channel f32 scale halves the streamed bytes; the dequant is algebraic
+(``x @ (w8 * s) == (x @ w8) * s`` for per-column scales), so the
+matmul runs on the int8->bf16 converted operand (XLA fuses the convert
+into the dot's operand read) and the scale folds into the epilogue.
+No activation quantization — accuracy-sensitive paths (embeddings,
+norms, the residual stream) stay untouched, which is why byte-exact
+quality bars are per-channel-error-bounded, not bit-exact.
+
+The reference has no serving path at all (SURVEY §2.1); this is part
+of the framework's beyond-reference serving story alongside
+``engine/generate.py``.
+
+Usage:
+    model = MODELS.get("Llama")(..., quant="w8a16")
+    qparams = quantize_params_w8(trained_params)
+    generate(model, qparams, prompt, ...)
+"""
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+class W8A16Dense(nn.Module):
+    """Bias-free Dense over an int8 kernel + per-output-channel scale.
+
+    Param layout: ``kernel_q`` int8 [in, out], ``scale`` f32 [out] —
+    produced from a trained ``kernel`` by ``quantize_params_w8``. The
+    zero-init params are placeholders (real values always come from the
+    converter); init exists so ``model.init``/``eval_shape`` yield the
+    right tree structure for checkpoint restore and generate()'s
+    zeros-pytree cache allocation.
+    """
+
+    features: int
+    dtype: Any = jnp.bfloat16
+    use_bias: bool = False          # GPT-2-family Denses carry biases
+
+    @nn.compact
+    def __call__(self, x):
+        d = x.shape[-1]
+        w8 = self.param(
+            "kernel_q",
+            lambda key, shape: jnp.zeros(shape, jnp.int8),
+            (d, self.features),
+        )
+        scale = self.param(
+            "scale", nn.initializers.ones, (self.features,), jnp.float32
+        )
+        y = x.astype(self.dtype) @ w8.astype(self.dtype)
+        y = y * scale.astype(self.dtype)[None, :]
+        if self.use_bias:
+            b = self.param(
+                "bias", nn.initializers.zeros, (self.features,), jnp.float32
+            )
+            y = y + b.astype(self.dtype)[None, :]
+        return y
+
+
+def dense_factory(dtype, quant: str, use_bias: bool = False,
+                  kernel_init=None):
+    """THE quant-dispatch point for every Dense in the LM families.
+
+    Returns ``f(features, name) -> module`` (or ``f(features,
+    kernel_init, name)`` compatibility is the caller's concern — pass
+    ``kernel_init`` here instead). One site to extend when a new quant
+    mode lands, instead of per-model factory copies drifting apart.
+    """
+    if quant == "w8a16":
+        return lambda feats, name: W8A16Dense(
+            feats, dtype=dtype, use_bias=use_bias, name=name)
+    if kernel_init is None:
+        kernel_init = nn.initializers.normal(stddev=0.02)
+    return lambda feats, name: nn.Dense(
+        feats, use_bias=use_bias, dtype=dtype,
+        kernel_init=kernel_init, name=name)
+
+
+def validate_quant_config(quant: str, fused_head: bool = False,
+                          moe_experts: int = 0) -> None:
+    """w8a16 is a SERVING mode: combinations whose param trees the
+    converter cannot express are rejected up front instead of failing
+    with a ScopeParamNotFoundError deep inside apply. fused_head hands
+    the raw lm_head kernel to the chunked loss (same param path the
+    quant head would claim), and MoE experts/routers are not quantized."""
+    if quant and (fused_head or moe_experts > 0):
+        raise ValueError(
+            f"quant={quant!r} supports plain serving models only — "
+            "not fused_head (training-loss path) or MoE "
+            f"(moe_experts={moe_experts})"
+        )
+
+
+def quantize_kernel_w8(w) -> dict:
+    """f32/bf16 [in, out] kernel -> {"kernel_q": int8, "scale": f32}.
+
+    Symmetric per-output-channel: scale_j = max_i |w_ij| / 127, chosen
+    so the largest magnitude in each column maps to ±127 exactly.
+    All-zero columns get scale 1 (quantized zeros decode to zeros).
+    """
+    w = jnp.asarray(w, jnp.float32)
+    amax = jnp.max(jnp.abs(w), axis=0)
+    scale = jnp.where(amax > 0, amax / 127.0, 1.0)
+    q = jnp.clip(jnp.round(w / scale[None, :]), -127, 127).astype(jnp.int8)
+    return {"kernel_q": q, "scale": scale.astype(jnp.float32)}
+
+
+def quantize_params_w8(params) -> dict:
+    """Trained dense-model params -> the w8a16 model's param tree.
+
+    Every ``{"kernel": w}`` dict leaf (bias-free Dense) with a 2-D
+    floating kernel becomes ``{"kernel_q", "scale"}``; everything else
+    (embeddings, norms, biased Denses) passes through unchanged — the
+    quantized model keeps those modules in their original form.
+    """
+
+    def is_dense_kernel(node):
+        return (
+            set(node.keys()) in ({"kernel"}, {"kernel", "bias"})
+            and hasattr(node.get("kernel"), "ndim")
+            and node["kernel"].ndim == 2
+            and jnp.issubdtype(
+                jnp.asarray(node["kernel"]).dtype, jnp.floating
+            )
+        )
+
+    def walk(node, key=""):
+        if isinstance(node, dict):
+            if key == "router":
+                # MoE routers stay dense in the quant models (tiny,
+                # accuracy-critical); see validate_quant_config — MoE
+                # models are rejected anyway, but the converter must
+                # not corrupt a tree it is handed regardless
+                return node
+            if is_dense_kernel(node):
+                q = quantize_kernel_w8(node["kernel"])
+                if "bias" in node:
+                    q["bias"] = jnp.asarray(node["bias"], jnp.float32)
+                return q
+            return {k: walk(v, k) for k, v in node.items()}
+        return node
+
+    return walk(params)
+
+
+def dequantize_params_w8(qparams) -> dict:
+    """Inverse layout transform (lossy values: returns the dequantized
+    f32 kernels) — for parity testing and debugging."""
+
+    def walk(node):
+        if isinstance(node, dict):
+            if set(node.keys()) in ({"kernel_q", "scale"},
+                                    {"kernel_q", "scale", "bias"}):
+                w = (
+                    jnp.asarray(node["kernel_q"], jnp.float32)
+                    * jnp.asarray(node["scale"], jnp.float32)[None, :]
+                )
+                out = {"kernel": w}
+                if "bias" in node:
+                    out["bias"] = node["bias"]
+                return out
+            return {k: walk(v) for k, v in node.items()}
+        return node
+
+    return walk(qparams)

@@ -37,21 +37,31 @@ def _dense_init(stddev):
     return nn.initializers.normal(stddev=stddev)
 
 
+def _dense_or_quant_biased(dtype, quant: str):
+    """Biased Dense factory honoring the serving quantization mode (the
+    GPT-2 family's projections carry biases, unlike Llama's; single
+    dispatch point: models/quant.dense_factory)."""
+    from .quant import dense_factory
+
+    return lambda feats, init, name: dense_factory(
+        dtype, quant, use_bias=True, kernel_init=init)(feats, name)
+
+
 class MlpBlock(nn.Module):
     d_model: int
     d_ff: int
     dropout: float
     n_layer: int
     dtype: Any
+    quant: str = ""
 
     @nn.compact
     def __call__(self, x, train: bool):
-        y = nn.Dense(self.d_ff, dtype=self.dtype,
-                     kernel_init=_dense_init(0.02), name="up")(x)
+        dense = _dense_or_quant_biased(self.dtype, self.quant)
+        y = dense(self.d_ff, _dense_init(0.02), "up")(x)
         y = nn.gelu(y)
-        y = nn.Dense(self.d_model, dtype=self.dtype,
-                     kernel_init=_dense_init(0.02 / (2 * self.n_layer) ** 0.5),
-                     name="down")(y)
+        y = dense(self.d_model,
+                  _dense_init(0.02 / (2 * self.n_layer) ** 0.5), "down")(y)
         return nn.Dropout(self.dropout, deterministic=not train)(y)
 
 
@@ -65,14 +75,15 @@ class SelfAttention(nn.Module):
     attn_impl: str = "xla"
     mesh: Optional[Any] = None      # required for 'ring*' / 'ulysses*'
     seq_layout: str = "natural"     # 'zigzag' -> inputs are zigzag-permuted
+    quant: str = ""                 # "" | "w8a16" (serving; models/quant.py)
 
     @nn.compact
     def __call__(self, x, train: bool, decode: bool = False,
                  decode_index=None, prefill: bool = False):
         b, t, _ = x.shape
         head_dim = self.d_model // self.n_head
-        qkv = nn.Dense(3 * self.d_model, dtype=self.dtype,
-                       kernel_init=_dense_init(0.02), name="qkv")(x)
+        dense = _dense_or_quant_biased(self.dtype, self.quant)
+        qkv = dense(3 * self.d_model, _dense_init(0.02), "qkv")(x)
         qkv = qkv.reshape(b, t, 3, self.n_head, head_dim)
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
         if decode:
@@ -104,9 +115,9 @@ class SelfAttention(nn.Module):
         else:
             ctx = multihead_attention(q, k, v, causal=True)
         ctx = ctx.reshape(b, t, self.d_model)
-        out = nn.Dense(self.d_model, dtype=self.dtype,
-                       kernel_init=_dense_init(0.02 / (2 * self.n_layer) ** 0.5),
-                       name="out")(ctx)
+        out = dense(self.d_model,
+                    _dense_init(0.02 / (2 * self.n_layer) ** 0.5),
+                    "out")(ctx)
         return nn.Dropout(self.dropout, deterministic=not train)(out)
 
     def _cached_attention(self, q, k, v, cur, prefill: bool = False):
@@ -171,6 +182,7 @@ class Block(nn.Module):
     moe: Optional[dict] = None      # MoeMlp kwargs; None -> dense MLP
     ln_eps: float = 1e-5
     seq_layout: str = "natural"
+    quant: str = ""                 # "" | "w8a16" (serving; models/quant.py)
 
     @nn.compact
     def __call__(self, x, train: bool, example_mask=None,
@@ -181,7 +193,7 @@ class Block(nn.Module):
         x = x + SelfAttention(
             self.d_model, self.n_head, self.dropout, self.n_layer,
             self.dtype, self.attn_impl, self.mesh,
-            seq_layout=self.seq_layout, name="attn",
+            seq_layout=self.seq_layout, quant=self.quant, name="attn",
         )(h, train, decode, decode_index, prefill)
         h = nn.LayerNorm(epsilon=self.ln_eps, dtype=jnp.float32,
                          name="ln_2")(x)
@@ -197,7 +209,7 @@ class Block(nn.Module):
         else:
             x = x + MlpBlock(
                 self.d_model, self.d_ff, self.dropout, self.n_layer,
-                self.dtype, name="mlp",
+                self.dtype, quant=self.quant, name="mlp",
             )(h, train)
         return x
 
@@ -219,6 +231,8 @@ class TransformerLM(nn.Module):
     fused_head: bool = False        # return (hidden, head_w) for chunked loss
     tie_embeddings: bool = True
     ln_eps: float = 1e-5            # GPT-2's layer_norm_epsilon
+    quant: str = ""                 # "w8a16": int8 serving weights (quant.py)
+    #   (the tied head attends through the float embedding either way)
     # --- MoE (models/moe.py); moe_experts == 0 -> all-dense blocks --------
     moe_experts: int = 0
     moe_top_k: int = 2
@@ -246,6 +260,11 @@ class TransformerLM(nn.Module):
         decode call (over ``[B, total_len]`` zeros, mutable=["cache"])
         allocates the caches, later calls consume new tokens at the cached
         position (engine/generate.py drives this)."""
+        if self.quant:
+            from .quant import validate_quant_config
+
+            validate_quant_config(self.quant, self.fused_head,
+                                  self.moe_experts)
         d_ff = self.d_ff or 4 * self.d_model
         b, t = tokens.shape
         # Zigzag sequence layout for balanced causal ring attention: permute
@@ -314,7 +333,7 @@ class TransformerLM(nn.Module):
                 dtype=self.dtype, attn_impl=self.attn_impl, mesh=self.mesh,
                 moe=self._moe_kwargs(i), ln_eps=self.ln_eps,
                 seq_layout="zigzag" if zperm is not None else "natural",
-                name=f"h_{i}",
+                quant=self.quant, name=f"h_{i}",
             )(x, train, example_mask, decode, start, prefill)
         x = nn.LayerNorm(epsilon=self.ln_eps, dtype=jnp.float32,
                          name="ln_f")(x)
@@ -344,10 +363,12 @@ class TransformerLM(nn.Module):
         if self.tie_embeddings:
             logits = embed.attend(x.astype(self.dtype))
         else:
-            logits = nn.Dense(self.vocab_size, use_bias=False,
-                              dtype=self.dtype,
-                              kernel_init=_dense_init(0.02),
-                              name="lm_head")(x)
+            from .quant import dense_factory
+
+            logits = dense_factory(
+                self.dtype, self.quant, use_bias=False,
+                kernel_init=_dense_init(0.02),
+            )(self.vocab_size, "lm_head")(x)
         return logits.astype(jnp.float32)
 
     def batch_template(self, batch_size: int = 1):
@@ -409,7 +430,8 @@ def tiny_lm(vocab_size: int = 256, n_layer: int = 2, n_head: int = 4,
             d_model: int = 64, max_len: int = 128, dropout: float = 0.0,
             attn_impl: str = "xla", remat: bool = False, mesh=None,
             bfloat16: bool = False, seq_layout: str = "natural",
-            fused_head: bool = False, tie_embeddings: bool = True):
+            fused_head: bool = False, tie_embeddings: bool = True,
+            quant: str = ""):
     """Small config for tests and the multi-chip dry run."""
     return TransformerLM(
         vocab_size=vocab_size, n_layer=n_layer, n_head=n_head,
@@ -417,5 +439,5 @@ def tiny_lm(vocab_size: int = 256, n_layer: int = 2, n_head: int = 4,
         dtype=jnp.bfloat16 if bfloat16 else jnp.float32,
         attn_impl=attn_impl, remat=remat, mesh=mesh,
         seq_layout=seq_layout, fused_head=fused_head,
-        tie_embeddings=tie_embeddings,
+        tie_embeddings=tie_embeddings, quant=quant,
     )

@@ -234,3 +234,24 @@ def test_monitor_early_stop(tmp_path):
     t = build_trainer(config)
     t.train()
     assert not (config.save_dir / "model_best").exists()
+
+
+@pytest.mark.slow
+def test_iteration_mode_via_config(tmp_path):
+    """`trainer.len_epoch` in the JSON switches to iteration-based
+    training over an endless reshuffling loader (the reference's
+    inf_loop mode, utils/util.py:24-27): each 'epoch' runs exactly
+    len_epoch steps regardless of dataset size, and the loader
+    reshuffles across re-iterations."""
+    config = make_config(
+        tmp_path, run_id="iter",
+        **{"trainer;len_epoch": 3, "trainer;epochs": 2,
+           "trainer;save_period": 10},
+    )
+    trainer = build_trainer(config)
+    assert trainer.len_epoch == 3
+    log = trainer.train()
+    assert log["epoch"] == 2
+    # 3 steps/epoch x 64 batch = 192 examples counted per epoch, far
+    # fewer than the 512-sample dataset's 8 full batches
+    assert "loss" in log and np.isfinite(log["loss"])

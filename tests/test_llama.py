@@ -453,3 +453,46 @@ def test_rolling_cache_zeros_pytree_short_prompt():
         np.testing.assert_allclose(np.asarray(out[:, -1]),
                                    np.asarray(ref[:, -1]),
                                    atol=1e-5, rtol=1e-5)
+
+
+def test_rolling_cache_chunked_continuation_wraps():
+    """Multi-token continuation on a WARM rolling cache — the ring write
+    that starts mid-buffer and wraps around the end (the one write
+    branch prefill and single-token decode never hit). Feed the sequence
+    in chunks (5, then 4 — the second write spans slots 5,6,7,0 of an
+    8-slot ring), then single-token steps; every stage must reproduce
+    the full-forward logits."""
+    W = 8
+    m = MODELS.get("TinyLlama")(window=W, max_len=128)
+    tokens = _tokens(b=2, t=9)
+    s = _state(m, tokens)
+
+    total = 16
+    shapes = jax.eval_shape(
+        lambda p: m.apply(
+            {"params": p}, jnp.zeros((2, total), jnp.int32),
+            train=False, decode=True, mutable=["cache"],
+        ),
+        s.params,
+    )
+    v = {"cache": jax.tree.map(
+        lambda x: jnp.zeros(x.shape, x.dtype), shapes[1]["cache"]
+    )}
+    out, v = m.apply({"params": s.params, **v}, tokens[:, :5],
+                     train=False, decode=True, mutable=["cache"])
+    out, v = m.apply({"params": s.params, **v}, tokens[:, 5:],
+                     train=False, decode=True, mutable=["cache"])
+    full = m.apply({"params": s.params}, tokens, train=False)
+    np.testing.assert_allclose(np.asarray(out[:, -1]),
+                               np.asarray(full[:, -1]),
+                               atol=1e-5, rtol=1e-5)
+    cur = tokens
+    for _ in range(4):
+        nxt = jnp.argmax(out[:, -1], axis=-1)[:, None]
+        out, v = m.apply({"params": s.params, **v}, nxt,
+                         train=False, decode=True, mutable=["cache"])
+        cur = jnp.concatenate([cur, nxt], axis=1)
+    ref = m.apply({"params": s.params}, cur, train=False)
+    np.testing.assert_allclose(np.asarray(out[:, -1]),
+                               np.asarray(ref[:, -1]),
+                               atol=1e-5, rtol=1e-5)

@@ -59,6 +59,28 @@ def test_digits_loader_is_real_and_disjoint():
     assert all(r.tobytes() not in tr_keys for r in va)
 
 
+def test_corpus_builder_deterministic_and_skips_oversize(tmp_path):
+    """make_text_corpus: byte-identical across runs (the held-out tail
+    split depends on it) and a file that would blow the budget is
+    SKIPPED (not a truncation point — smaller later files still land)."""
+    import sys
+    sys.path.insert(0, str(Path(__file__).parent.parent / "scripts"))
+    from make_text_corpus import build
+
+    a, b = tmp_path / "a.txt", tmp_path / "b.txt"
+    info_a = build(a, int(0.3e6))
+    info_b = build(b, int(0.3e6))
+    assert info_a["bytes"] == info_b["bytes"] > 200_000
+    assert a.read_bytes() == b.read_bytes()
+
+    # tiny budget: the first files alphabetically are NOT all small, so a
+    # break-on-first-overflow would stop early; skipping must keep going
+    # and pack more files than the break semantics would
+    small = build(tmp_path / "c.txt", 30_000)
+    assert small["files"] >= 2
+    assert small["bytes"] <= 30_000
+
+
 def test_lm_bits_per_byte_metric_parity():
     """bpb == CE/ln2 on plain logits, and the fused-head (hidden, w)
     path matches materializing the logits."""
