@@ -333,7 +333,7 @@ def bench_vit_b16(batch: int) -> dict:
 
 def bench_decode(batch: int = 8, prompt_len: int = 1024,
                  new_tokens: int = 256, window: int = 1024,
-                 quant: str = "") -> dict:
+                 quant: str = "", kv_quant: str = "") -> dict:
     """Serving rung: prefill tok/s and steady-state decode tok/s through
     the incremental-decoding path (engine/generate._decode_fns) on a
     GPT-2-small-scale Llama with GQA (12 heads over 4 KV heads) and a
@@ -373,7 +373,7 @@ def bench_decode(batch: int = 8, prompt_len: int = 1024,
     model = MODELS.get("Llama")(
         vocab_size=32000, n_layer=12, n_head=12, n_kv_head=4,
         d_model=768, max_len=prompt_len + new_tokens, window=window,
-        bfloat16=True, quant=quant,
+        bfloat16=True, quant=quant, kv_quant=kv_quant,
     )
     rng = np.random.default_rng(0)
     prompt = jnp.asarray(
@@ -386,11 +386,7 @@ def bench_decode(batch: int = 8, prompt_len: int = 1024,
             quantize_params_w8,
         )
 
-        dense_model = MODELS.get("Llama")(
-            vocab_size=32000, n_layer=12, n_head=12, n_kv_head=4,
-            d_model=768, max_len=prompt_len + new_tokens, window=window,
-            bfloat16=True,
-        )
+        dense_model = model.clone(quant="", kv_quant="")
         params = quantize_params_w8(dense_model.init(
             jax.random.key(0), jnp.zeros((1, 8), jnp.int32)
         )["params"])
@@ -416,6 +412,11 @@ def bench_decode(batch: int = 8, prompt_len: int = 1024,
     )
     fresh_cache = jax.tree.map(
         lambda s: jnp.zeros(s.shape, s.dtype), shapes[1]["cache"]
+    )
+    # the decode loop re-reads the WHOLE cache every step (kv_quant="int8"
+    # stores the K/V rows as int8 + f32 row scales — models/quant.py)
+    kv_bytes = sum(
+        x.size * x.dtype.itemsize for x in jax.tree.leaves(fresh_cache)
     )
 
     @jax.jit
@@ -493,18 +494,23 @@ def bench_decode(batch: int = 8, prompt_len: int = 1024,
     decode_tps = batch * disp["steps_per_sec_median"]
     # decode re-reads all weights once per step (n_bytes above)
     bw = n_bytes * disp["steps_per_sec_median"]
+    # ...and the whole KV cache (kv_bytes): the all-in accounted traffic
+    total_bw = (n_bytes + kv_bytes) * disp["steps_per_sec_median"]
     return {
         "prefill_tokens_per_sec": round(prefill_tps, 0),
         "decode_tokens_per_sec": round(decode_tps, 0),
         "decode_step_ms": round(step_ms, 2),
         "spread_pct": disp["spread_pct"],
         "model_bw_frac": round(bw / 260e9, 3),
+        "kv_cache_mb": round(kv_bytes / 1e6, 1),
+        "total_bw_frac": round(total_bw / 260e9, 3),
         "batch": batch,
         "prompt_len": prompt_len,
         "new_tokens": new_tokens,
         "window": window,
         "n_params": n_params,
         "quant": quant or "none",
+        "kv_quant": kv_quant or "none",
     }
 
 
@@ -690,6 +696,21 @@ def main():
     rungs["decode_w8"] = _try_ladder("decode_w8", [
         (bench_decode, {"quant": "w8a16"}),
         (bench_decode, {"quant": "w8a16", "batch": 4, "new_tokens": 128}),
+    ])
+    # int8 KV cache alone: at batch 8 the cache (~104 MB bf16) out-weighs
+    # the weights, so this is the bigger byte lever of the two
+    rungs["decode_kv8"] = _try_ladder("decode_kv8", [
+        (bench_decode, {"kv_quant": "int8"}),
+        (bench_decode, {"kv_quant": "int8", "batch": 4,
+                        "new_tokens": 128}),
+    ])
+    # full int8 serving stack: int8 weights AND int8 KV cache — the
+    # decode -> decode_w8 -> decode_kv8 -> decode_w8kv8 ladder isolates
+    # the weight and cache levers and exposes the fixed-cost floor
+    rungs["decode_w8kv8"] = _try_ladder("decode_w8kv8", [
+        (bench_decode, {"quant": "w8a16", "kv_quant": "int8"}),
+        (bench_decode, {"quant": "w8a16", "kv_quant": "int8",
+                        "batch": 4, "new_tokens": 128}),
     ])
     try:
         rungs["flash_attention_8k"] = bench_flash_long_context()

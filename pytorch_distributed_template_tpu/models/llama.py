@@ -97,6 +97,7 @@ class LlamaAttention(nn.Module):
     rope_base: float = 10000.0
     window: int = 0                 # sliding-window size; 0 = full causal
     quant: str = ""                 # "" | "w8a16" (models/quant.py)
+    kv_quant: str = ""              # "" | "int8" (decode cache; quant.py)
 
     @nn.compact
     def __call__(self, x, positions, train: bool, decode: bool = False,
@@ -175,7 +176,14 @@ class LlamaAttention(nn.Module):
         position ``p``, old keys are overwritten as they fall out of the
         band, and an explicit per-slot position buffer drives the
         visibility mask — decode memory is O(window), independent of how
-        long generation runs."""
+        long generation runs.
+
+        With ``kv_quant == "int8"`` the cache stores int8 rows + a f32
+        scale per (token, kv-head) (models/quant.quantize_kv): decode
+        re-reads the whole cache every step, so this halves the cache's
+        HBM traffic the way w8a16 halves the weights'. New rows are
+        quantized at the WRITE; the call's own tokens attend in full
+        precision (only history rows round-trip through int8)."""
         b, t, hq, d = q.shape
 
         def _fresh_prefill_ctx():
@@ -202,15 +210,27 @@ class LlamaAttention(nn.Module):
         alloc_len = (
             min(self.window, k.shape[1]) if self.window > 0 else k.shape[1]
         )
+        kvq = self.kv_quant == "int8"
+        store_dtype = jnp.int8 if kvq else k.dtype
         is_init = self.has_variable("cache", "cached_key")
         cached_k = self.variable(
             "cache", "cached_key", jnp.zeros,
-            (b, alloc_len, k.shape[2], d), k.dtype,
+            (b, alloc_len, k.shape[2], d), store_dtype,
         )
         cached_v = self.variable(
             "cache", "cached_value", jnp.zeros,
-            (b, alloc_len, v.shape[2], d), v.dtype,
+            (b, alloc_len, v.shape[2], d), store_dtype,
         )
+        k_scale = v_scale = None
+        if kvq:
+            k_scale = self.variable(
+                "cache", "cached_key_scale", jnp.zeros,
+                (b, alloc_len, k.shape[2]), jnp.float32,
+            )
+            v_scale = self.variable(
+                "cache", "cached_value_scale", jnp.zeros,
+                (b, alloc_len, v.shape[2]), jnp.float32,
+            )
         cache_len = cached_k.value.shape[1]
         rolling = self.window > 0 and cache_len == self.window
         slot_pos = None
@@ -233,16 +253,25 @@ class LlamaAttention(nn.Module):
         cos, sin = rope_tables(pos, d, self.rope_base)
         q = apply_rope(q, cos, sin)
         k = apply_rope(k, cos, sin)
+        if kvq:
+            from .quant import dequantize_kv, quantize_kv
+
+            hist_k = dequantize_kv(cached_k.value, k_scale.value, k.dtype)
+            hist_v = dequantize_kv(cached_v.value, v_scale.value, v.dtype)
+            to_store = quantize_kv           # row -> (int8, f32 scale)
+        else:
+            hist_k, hist_v = cached_k.value, cached_v.value
+            to_store = lambda x: (x.astype(store_dtype), None)  # noqa: E731
         if rolling:
             # Attend over HISTORY (ring buffer) + the call's own tokens —
             # every query sees its full band even when the call is longer
             # than the window; eviction applies only to the cache WRITE.
             hist_pos = slot_pos.value - 1                # [W], -1 = empty
             k_all = jnp.concatenate(
-                [cached_k.value, k.astype(cached_k.value.dtype)], axis=1
+                [hist_k, k.astype(hist_k.dtype)], axis=1
             )                                            # [B, W + t, ...]
             v_all = jnp.concatenate(
-                [cached_v.value, v.astype(cached_v.value.dtype)], axis=1
+                [hist_v, v.astype(hist_v.dtype)], axis=1
             )
             k_pos = jnp.concatenate([hist_pos, pos])[None, :]  # [1, W + t]
             visible = (k_pos >= 0) & (k_pos <= pos[:, None]) & (
@@ -263,20 +292,23 @@ class LlamaAttention(nn.Module):
             # round 3: 12-layer 8x1024 prefill 328 ms vs 33 ms without
             # it — ~28 ms PER LAYER for a 2 MB write).
             start = wpos[0] % cache_len
-            kw = kw.astype(cached_k.value.dtype)
-            vw = vw.astype(cached_v.value.dtype)
-            if kw.shape[1] == cache_len:
+            qkw, skw = to_store(kw)
+            qvw, svw = to_store(vw)
+            writes = [(cached_k, qkw), (cached_v, qvw)]
+            if kvq:
+                writes += [(k_scale, skw), (v_scale, svw)]
+            n_new = qkw.shape[1]
+            if n_new == cache_len:
                 # full replace: slot s must hold the row with pos % W == s,
                 # i.e. kw rolled by start (kw[i] lands at (start + i) % W)
-                cached_k.value = jnp.roll(kw, start, axis=1)
-                cached_v.value = jnp.roll(vw, start, axis=1)
+                for var, new in writes:
+                    var.value = jnp.roll(new, start, axis=1)
                 slot_pos.value = jnp.roll(wpos + 1, start)
-            elif kw.shape[1] == 1:
+            elif n_new == 1:
                 # single-token decode step: one row, cannot wrap
-                cached_k.value = jax.lax.dynamic_update_slice(
-                    cached_k.value, kw, (0, start, 0, 0))
-                cached_v.value = jax.lax.dynamic_update_slice(
-                    cached_v.value, vw, (0, start, 0, 0))
+                for var, new in writes:
+                    var.value = jax.lax.dynamic_update_slice(
+                        var.value, new, (0, start) + (0,) * (new.ndim - 2))
                 slot_pos.value = jax.lax.dynamic_update_slice(
                     slot_pos.value, wpos + 1, (start,))
             else:
@@ -288,8 +320,8 @@ class LlamaAttention(nn.Module):
                         rolled, new, (0,) * buf.ndim)
                     return jnp.roll(rolled, start, axis=axis)
 
-                cached_k.value = write(cached_k.value, kw, 1)
-                cached_v.value = write(cached_v.value, vw, 1)
+                for var, new in writes:
+                    var.value = write(var.value, new, 1)
                 slot_pos.value = write(slot_pos.value, wpos + 1, 0)
             if groups > 1:
                 k_all = jnp.repeat(k_all, groups, axis=2)
@@ -300,20 +332,30 @@ class LlamaAttention(nn.Module):
                 q, k_all, v_all, causal=False, mask=visible[None, None]
             )
         else:
+            # attention reads the DUS'd full-precision view (history rows
+            # dequantized when kvq; the call's own rows always exact) ...
             k_all = jax.lax.dynamic_update_slice(
-                cached_k.value, k.astype(cached_k.value.dtype),
-                (0, cur, 0, 0)
+                hist_k, k.astype(hist_k.dtype), (0, cur, 0, 0)
             )
             v_all = jax.lax.dynamic_update_slice(
-                cached_v.value, v.astype(cached_v.value.dtype),
-                (0, cur, 0, 0)
+                hist_v, v.astype(hist_v.dtype), (0, cur, 0, 0)
             )
             k_pos = jnp.arange(cache_len)[None, :]
             visible = k_pos <= pos[:, None]
             if self.window > 0:
                 visible = visible & (pos[:, None] - k_pos < self.window)
-        cached_k.value = k_all
-        cached_v.value = v_all
+            # ... and the WRITE stores the rows in cache form
+            qk, sk = to_store(k)
+            qv, sv = to_store(v)
+            cached_k.value = jax.lax.dynamic_update_slice(
+                cached_k.value, qk, (0, cur, 0, 0))
+            cached_v.value = jax.lax.dynamic_update_slice(
+                cached_v.value, qv, (0, cur, 0, 0))
+            if kvq:
+                k_scale.value = jax.lax.dynamic_update_slice(
+                    k_scale.value, sk, (0, cur, 0))
+                v_scale.value = jax.lax.dynamic_update_slice(
+                    v_scale.value, sv, (0, cur, 0))
         if groups > 1:
             k_all = jnp.repeat(k_all, groups, axis=2)
             v_all = jnp.repeat(v_all, groups, axis=2)
@@ -353,6 +395,7 @@ class LlamaBlock(nn.Module):
     moe: Optional[dict] = None      # MoeMlp kwargs; None -> dense SwiGLU
     n_layer: int = 1                # model depth, for residual-init scaling
     quant: str = ""                 # "" | "w8a16" (serving; models/quant.py)
+    kv_quant: str = ""              # "" | "int8" (decode cache; quant.py)
 
     @nn.compact
     def __call__(self, x, positions, train: bool, example_mask=None,
@@ -362,7 +405,8 @@ class LlamaBlock(nn.Module):
         x = x + LlamaAttention(
             self.d_model, self.n_head, self.n_kv_head, self.dtype,
             self.attn_impl, self.mesh, self.seq_layout, self.rope_base,
-            window=self.window, quant=self.quant, name="self_attn",
+            window=self.window, quant=self.quant, kv_quant=self.kv_quant,
+            name="self_attn",
         )(h, positions, train, decode, decode_index, prefill)
         h = RMSNorm(self.rms_eps, name="post_attention_layernorm")(x)
         if self.moe:
@@ -416,6 +460,7 @@ class LlamaLM(nn.Module):
     window: int = 0                 # sliding-window attention; 0 = full
     fused_head: bool = False        # return (hidden, head_w) for chunked loss
     quant: str = ""                 # "w8a16": int8 serving weights (quant.py)
+    kv_quant: str = ""              # "int8": int8 decode KV cache (quant.py)
     # --- MoE (models/moe.py, swiglu experts); 0 -> all-dense blocks -------
     moe_experts: int = 0
     moe_top_k: int = 2
@@ -440,6 +485,8 @@ class LlamaLM(nn.Module):
 
             validate_quant_config(self.quant, self.fused_head,
                                   self.moe_experts)
+        if self.kv_quant not in ("", "int8"):
+            raise ValueError(f"unknown kv_quant {self.kv_quant!r}")
         b, t = tokens.shape
         n_kv = self.n_kv_head or self.n_head
         if self.n_head % n_kv != 0:
@@ -505,6 +552,7 @@ class LlamaLM(nn.Module):
                 rope_base=self.rope_base, rms_eps=self.rms_eps,
                 window=self.window, moe=self._moe_kwargs(i),
                 n_layer=self.n_layer, quant=self.quant,
+                kv_quant=self.kv_quant,
                 name=f"layers_{i}",
             )(x, positions, train, example_mask, decode, start, prefill)
         x = RMSNorm(self.rms_eps, name="norm")(x)
@@ -555,14 +603,14 @@ def llama(vocab_size: int = 32000, n_layer: int = 12, n_head: int = 12,
           attn_impl: str = "xla", remat: bool = False, mesh=None,
           seq_layout: str = "natural", rope_base: float = 10000.0,
           rms_eps: float = 1e-6, window: int = 0, fused_head: bool = False,
-          quant: str = ""):
+          quant: str = "", kv_quant: str = ""):
     return LlamaLM(
         vocab_size=vocab_size, n_layer=n_layer, n_head=n_head,
         n_kv_head=n_kv_head, d_model=d_model, d_ff=d_ff, max_len=max_len,
         dtype=jnp.bfloat16 if bfloat16 else jnp.float32,
         attn_impl=attn_impl, remat=remat, mesh=mesh, seq_layout=seq_layout,
         rope_base=rope_base, rms_eps=rms_eps, window=window,
-        fused_head=fused_head, quant=quant,
+        fused_head=fused_head, quant=quant, kv_quant=kv_quant,
     )
 
 
@@ -572,7 +620,8 @@ def mistral(vocab_size: int = 32000, n_layer: int = 32, n_head: int = 32,
             max_len: int = 32768, window: int = 4096,
             rope_base: float = 10000.0, rms_eps: float = 1e-5,
             bfloat16: bool = True, attn_impl: str = "flash",
-            remat: bool = True, mesh=None, fused_head: bool = False):
+            remat: bool = True, mesh=None, fused_head: bool = False,
+            quant: str = "", kv_quant: str = ""):
     """Mistral-7B-shaped defaults: the Llama architecture with 4:1 GQA and
     a 4096-token sliding window (banded flash kernels + rolling decode
     cache). Same param tree as ``Llama``, so ``import_hf_llama`` applies
@@ -583,6 +632,7 @@ def mistral(vocab_size: int = 32000, n_layer: int = 32, n_head: int = 32,
         dtype=jnp.bfloat16 if bfloat16 else jnp.float32,
         attn_impl=attn_impl, remat=remat, mesh=mesh, window=window,
         rope_base=rope_base, rms_eps=rms_eps, fused_head=fused_head,
+        quant=quant, kv_quant=kv_quant,
     )
 
 
@@ -620,12 +670,14 @@ def tiny_llama(vocab_size: int = 256, n_layer: int = 2, n_head: int = 4,
                max_len: int = 128, attn_impl: str = "xla",
                remat: bool = False, mesh=None, bfloat16: bool = False,
                seq_layout: str = "natural", window: int = 0,
-               fused_head: bool = False):
+               fused_head: bool = False, quant: str = "",
+               kv_quant: str = ""):
     """Small GQA config for tests and dry runs."""
     return LlamaLM(
         vocab_size=vocab_size, n_layer=n_layer, n_head=n_head,
         n_kv_head=n_kv_head, d_model=d_model, d_ff=d_ff, max_len=max_len,
         dtype=jnp.bfloat16 if bfloat16 else jnp.float32,
         attn_impl=attn_impl, remat=remat, mesh=mesh, seq_layout=seq_layout,
-        window=window, fused_head=fused_head,
+        window=window, fused_head=fused_head, quant=quant,
+        kv_quant=kv_quant,
     )

@@ -151,6 +151,34 @@ def quantize_params_w8(params) -> dict:
     return walk(params)
 
 
+def quantize_kv(x):
+    """Float K/V rows ``[..., D]`` -> ``(int8 [..., D], f32 scale [...])``.
+
+    Symmetric per-row (per token x kv-head) int8: one scale per head-dim
+    vector, chosen so the row's max magnitude maps to ±127. All-zero
+    rows get scale 1 (zeros decode to zeros — generate()'s zeros-pytree
+    cache allocation stays a valid empty cache).
+
+    This is the KV-CACHE leg of the serving quantization story
+    (``W8A16Dense`` is the weight leg): decode streams the whole cache
+    every step, so storing it int8 halves those bytes. Per-row (not
+    per-channel like the weights) because K/V magnitudes vary by token,
+    and a row scale keeps the dequant a rank-preserving broadcast.
+    """
+    xf = jnp.asarray(x, jnp.float32)
+    amax = jnp.max(jnp.abs(xf), axis=-1)
+    scale = jnp.where(amax > 0, amax / 127.0, 1.0)
+    q = jnp.clip(jnp.round(xf / scale[..., None]), -127, 127)
+    return q.astype(jnp.int8), scale.astype(jnp.float32)
+
+
+def dequantize_kv(q, scale, dtype):
+    """Inverse of ``quantize_kv``: f32 multiply, then cast to ``dtype``
+    (the attention compute dtype) — XLA fuses the convert+scale into the
+    consumer, so the bf16 copy never lands in HBM."""
+    return (q.astype(jnp.float32) * scale[..., None]).astype(dtype)
+
+
 def dequantize_params_w8(qparams) -> dict:
     """Inverse layout transform (lossy values: returns the dequantized
     f32 kernels) — for parity testing and debugging."""
