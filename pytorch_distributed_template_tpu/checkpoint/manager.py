@@ -398,3 +398,65 @@ def _saveable(state) -> dict:
     if state.lr_scale is not None:
         out["lr_scale"] = state.lr_scale
     return out
+
+
+# ---------------------------------------------------------------------------
+# Params-only SERVING artifacts (scripts/quantize_checkpoint.py writes them,
+# generate.py restores them). Distinct from training checkpoints: no
+# optimizer/RNG/EMA state, and the sidecar carries ``params_only: true`` so
+# the sampling CLI knows to skip the TrainState template. The reference has
+# no serving path at all (SURVEY §2.1) — this completes the beyond-reference
+# serving story (train -> quantize -> sample) at the CLI level.
+# ---------------------------------------------------------------------------
+
+
+def save_serving_params(path, params, meta: dict) -> Path:
+    """Write a params-only orbax tree + ``<name>.meta.json`` sidecar.
+
+    Blocks until the write is durable (serving artifacts are produced by
+    a one-shot CLI, not inside a hot training loop — nothing overlaps)."""
+    path = Path(path).resolve()
+    ckptr = ocp.StandardCheckpointer()
+    ckptr.save(path, params, force=True)
+    ckptr.wait_until_finished()
+    meta = dict(meta, params_only=True)
+    if dist.is_main_process():
+        (path.parent / f"{path.name}.meta.json").write_text(
+            json.dumps(meta, indent=2)
+        )
+    logger.info("Saved serving params: %s", path)
+    return path
+
+
+def load_serving_meta(path) -> Optional[dict]:
+    """The artifact's sidecar iff ``path`` is a params-only serving
+    artifact; None for training checkpoints (or a missing sidecar)."""
+    meta = CheckpointManager.load_meta(path)
+    return meta if meta and meta.get("params_only") else None
+
+
+def restore_serving_params(path, template_params, shardings=None):
+    """Restore a params-only artifact into ``template_params``'s
+    shapes/dtypes (accepts abstract leaves, e.g. ``jax.eval_shape`` of
+    ``model.init`` — the int8/scale leaves of a quantized tree restore
+    by dtype like any other array).
+
+    ``shardings``: optional tree of NamedShardings matching
+    ``template_params`` (parallel/sharding.apply_rules). Passing it makes
+    orbax materialize each leaf ALREADY sharded over the mesh — required
+    on multi-host meshes, where a host-local restore + device_put cannot
+    address other hosts' devices (same constraint as
+    engine/state.create_sharded_train_state)."""
+    if shardings is None:
+        abstract = jax.tree.map(
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype),
+            template_params,
+        )
+    else:
+        abstract = jax.tree.map(
+            lambda x, s: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=s),
+            template_params, shardings,
+        )
+    return ocp.StandardCheckpointer().restore(
+        Path(path).resolve(), abstract
+    )

@@ -76,6 +76,7 @@ class SelfAttention(nn.Module):
     mesh: Optional[Any] = None      # required for 'ring*' / 'ulysses*'
     seq_layout: str = "natural"     # 'zigzag' -> inputs are zigzag-permuted
     quant: str = ""                 # "" | "w8a16" (serving; models/quant.py)
+    kv_quant: str = ""              # "" | "int8" (decode cache; quant.py)
 
     @nn.compact
     def __call__(self, x, train: bool, decode: bool = False,
@@ -132,27 +133,62 @@ class SelfAttention(nn.Module):
         multi-token prefill and single-token steps. The attention math is
         the shared ``ops.attention.multihead_attention`` with a visibility
         mask.
+
+        ``kv_quant == "int8"`` stores the cache rows int8 with a f32
+        scale per (token, head) — same contract as the Llama family
+        (models/llama._cached_attention): history rows round-trip int8,
+        the call's own rows attend exactly, writes quantize.
         """
         b, t, h, d = q.shape
+        kvq = self.kv_quant == "int8"
+        store_dtype = jnp.int8 if kvq else k.dtype
         is_init = self.has_variable("cache", "cached_key")
         cached_k = self.variable("cache", "cached_key", jnp.zeros,
-                                 k.shape, k.dtype)
+                                 k.shape, store_dtype)
         cached_v = self.variable("cache", "cached_value", jnp.zeros,
-                                 v.shape, v.dtype)
+                                 v.shape, store_dtype)
+        k_scale = v_scale = None
+        if kvq:
+            k_scale = self.variable("cache", "cached_key_scale", jnp.zeros,
+                                    k.shape[:3], jnp.float32)
+            v_scale = self.variable("cache", "cached_value_scale",
+                                    jnp.zeros, v.shape[:3], jnp.float32)
         if not is_init:
             # shape-setting pass: allocate the cache, no attention needed
             return jnp.zeros((b, t, h, d), q.dtype)
         max_len = cached_k.value.shape[1]
         if t > max_len:
             raise ValueError(f"decode input {t} exceeds cache {max_len}")
+        if kvq:
+            from .quant import dequantize_kv, quantize_kv
+
+            hist_k = dequantize_kv(cached_k.value, k_scale.value, k.dtype)
+            hist_v = dequantize_kv(cached_v.value, v_scale.value, v.dtype)
+        else:
+            hist_k, hist_v = cached_k.value, cached_v.value
+        # attention reads the full-precision view (history dequantized
+        # when kvq; the call's own rows always exact)...
         k_all = jax.lax.dynamic_update_slice(
-            cached_k.value, k.astype(cached_k.value.dtype), (0, cur, 0, 0)
+            hist_k, k.astype(hist_k.dtype), (0, cur, 0, 0)
         )
         v_all = jax.lax.dynamic_update_slice(
-            cached_v.value, v.astype(cached_v.value.dtype), (0, cur, 0, 0)
+            hist_v, v.astype(hist_v.dtype), (0, cur, 0, 0)
         )
-        cached_k.value = k_all
-        cached_v.value = v_all
+        # ...and the WRITE stores the new rows in cache form
+        if kvq:
+            qk, sk = quantize_kv(k)
+            qv, sv = quantize_kv(v)
+            cached_k.value = jax.lax.dynamic_update_slice(
+                cached_k.value, qk, (0, cur, 0, 0))
+            cached_v.value = jax.lax.dynamic_update_slice(
+                cached_v.value, qv, (0, cur, 0, 0))
+            k_scale.value = jax.lax.dynamic_update_slice(
+                k_scale.value, sk, (0, cur, 0))
+            v_scale.value = jax.lax.dynamic_update_slice(
+                v_scale.value, sv, (0, cur, 0))
+        else:
+            cached_k.value = k_all
+            cached_v.value = v_all
         q_pos = cur + jnp.arange(t)                       # [t]
         visible = jnp.arange(max_len)[None, :] <= q_pos[:, None]  # [t, L]
         if prefill and t > 1:
@@ -183,6 +219,7 @@ class Block(nn.Module):
     ln_eps: float = 1e-5
     seq_layout: str = "natural"
     quant: str = ""                 # "" | "w8a16" (serving; models/quant.py)
+    kv_quant: str = ""              # "" | "int8" (decode cache; quant.py)
 
     @nn.compact
     def __call__(self, x, train: bool, example_mask=None,
@@ -193,7 +230,8 @@ class Block(nn.Module):
         x = x + SelfAttention(
             self.d_model, self.n_head, self.dropout, self.n_layer,
             self.dtype, self.attn_impl, self.mesh,
-            seq_layout=self.seq_layout, quant=self.quant, name="attn",
+            seq_layout=self.seq_layout, quant=self.quant,
+            kv_quant=self.kv_quant, name="attn",
         )(h, train, decode, decode_index, prefill)
         h = nn.LayerNorm(epsilon=self.ln_eps, dtype=jnp.float32,
                          name="ln_2")(x)
@@ -232,6 +270,7 @@ class TransformerLM(nn.Module):
     tie_embeddings: bool = True
     ln_eps: float = 1e-5            # GPT-2's layer_norm_epsilon
     quant: str = ""                 # "w8a16": int8 serving weights (quant.py)
+    kv_quant: str = ""              # "int8": int8 decode KV cache (quant.py)
     #   (the tied head attends through the float embedding either way)
     # --- MoE (models/moe.py); moe_experts == 0 -> all-dense blocks --------
     moe_experts: int = 0
@@ -265,6 +304,8 @@ class TransformerLM(nn.Module):
 
             validate_quant_config(self.quant, self.fused_head,
                                   self.moe_experts)
+        if self.kv_quant not in ("", "int8"):
+            raise ValueError(f"unknown kv_quant {self.kv_quant!r}")
         d_ff = self.d_ff or 4 * self.d_model
         b, t = tokens.shape
         # Zigzag sequence layout for balanced causal ring attention: permute
@@ -333,7 +374,7 @@ class TransformerLM(nn.Module):
                 dtype=self.dtype, attn_impl=self.attn_impl, mesh=self.mesh,
                 moe=self._moe_kwargs(i), ln_eps=self.ln_eps,
                 seq_layout="zigzag" if zperm is not None else "natural",
-                quant=self.quant, name=f"h_{i}",
+                quant=self.quant, kv_quant=self.kv_quant, name=f"h_{i}",
             )(x, train, example_mask, decode, start, prefill)
         x = nn.LayerNorm(epsilon=self.ln_eps, dtype=jnp.float32,
                          name="ln_f")(x)
@@ -431,7 +472,7 @@ def tiny_lm(vocab_size: int = 256, n_layer: int = 2, n_head: int = 4,
             attn_impl: str = "xla", remat: bool = False, mesh=None,
             bfloat16: bool = False, seq_layout: str = "natural",
             fused_head: bool = False, tie_embeddings: bool = True,
-            quant: str = ""):
+            quant: str = "", kv_quant: str = ""):
     """Small config for tests and the multi-chip dry run."""
     return TransformerLM(
         vocab_size=vocab_size, n_layer=n_layer, n_head=n_head,
@@ -439,5 +480,5 @@ def tiny_lm(vocab_size: int = 256, n_layer: int = 2, n_head: int = 4,
         dtype=jnp.bfloat16 if bfloat16 else jnp.float32,
         attn_impl=attn_impl, remat=remat, mesh=mesh,
         seq_layout=seq_layout, fused_head=fused_head,
-        tie_embeddings=tie_embeddings, quant=quant,
+        tie_embeddings=tie_embeddings, quant=quant, kv_quant=kv_quant,
     )

@@ -68,3 +68,41 @@ def test_generate_cli_rejects_out_of_vocab_prompt(lm_checkpoint):
     r = _run(lm_checkpoint, "--prompt", "ab", "--max-new-tokens", "2")
     assert r.returncode != 0
     assert "vocab" in (r.stdout + r.stderr)
+
+
+@pytest.fixture(scope="module")
+def quantized_artifact(lm_checkpoint):
+    """Drive scripts/quantize_checkpoint.py as a user would: trained
+    checkpoint -> int8 serving artifact directory."""
+    r = subprocess.run(
+        [sys.executable, str(REPO / "scripts" / "quantize_checkpoint.py"),
+         "-r", str(lm_checkpoint)],
+        capture_output=True, text=True, timeout=420, cwd=REPO, env=None,
+    )
+    assert r.returncode == 0, r.stdout[-2000:] + r.stderr[-2000:]
+    artifact = lm_checkpoint.parent / "serving_w8a16" / "model_w8a16"
+    assert artifact.is_dir()
+    return artifact
+
+
+def test_quantize_checkpoint_writes_serving_artifact(quantized_artifact):
+    out_dir = quantized_artifact.parent
+    cfg = json.loads((out_dir / "config.json").read_text())
+    assert cfg["arch"]["args"]["quant"] == "w8a16"
+    meta = json.loads(
+        (out_dir / "model_w8a16.meta.json").read_text()
+    )
+    assert meta["params_only"] is True and meta["quant"] == "w8a16"
+
+
+def test_generate_cli_serves_quantized_artifact(quantized_artifact):
+    """The full serving workflow: generate.py on the artifact picks up
+    the quant config via resume rediscovery, restores the params-only
+    tree, and samples — with the int8 KV cache switched on as a
+    serving-time override."""
+    r = _run(quantized_artifact, "--prompt-ids", "1,2,3,4",
+             "--max-new-tokens", "6",
+             "--set", "arch;args;kv_quant", "int8")
+    assert r.returncode == 0, r.stdout[-2000:] + r.stderr[-2000:]
+    ids = [int(x) for x in r.stdout.strip().splitlines()[-1].split(",")]
+    assert len(ids) == 6

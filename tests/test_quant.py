@@ -191,6 +191,33 @@ def test_kv_cache_int8_decode_tracks_dense(window):
 
 
 @pytest.mark.slow
+def test_kv_cache_int8_gpt2_family():
+    """The GPT-2 family shares the kv_quant='int8' contract: greedy
+    decode on the SAME params agrees with the bf16 cache token-for-token
+    and the cache tree carries int8 rows + f32 scales."""
+    from pytorch_distributed_template_tpu.engine.generate import generate
+
+    kw = dict(vocab_size=128, n_layer=2, n_head=4, d_model=64, max_len=64)
+    m = MODELS.get("TinyLM")(**kw)
+    mq = MODELS.get("TinyLM")(**kw, kv_quant="int8")
+    tok = jnp.asarray(
+        np.random.default_rng(4).integers(0, 128, (2, 10)), jnp.int32
+    )
+    params = m.init(jax.random.key(0), tok)["params"]
+    out_d = generate(m, params, tok[:, :6], max_new_tokens=16,
+                     temperature=0)
+    out_q = generate(mq, params, tok[:, :6], max_new_tokens=16,
+                     temperature=0)
+    np.testing.assert_array_equal(np.asarray(out_d), np.asarray(out_q))
+    shapes = jax.eval_shape(
+        lambda p: mq.apply({"params": p}, jnp.zeros((2, 22), jnp.int32),
+                           train=False, decode=True, mutable=["cache"]),
+        params)
+    dts = {str(s.dtype) for s in jax.tree.leaves(shapes[1]["cache"])}
+    assert "int8" in dts and "float32" in dts
+
+
+@pytest.mark.slow
 def test_w8a16_composes_with_int8_kv_cache():
     """The full int8 serving stack — w8a16 weights AND int8 KV cache —
     runs through generate()'s rolling-window path and stays on the dense
