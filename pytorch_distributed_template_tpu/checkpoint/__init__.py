@@ -1,4 +1,4 @@
 from .manager import (
     CheckpointManager, load_serving_meta, restore_serving_params,
-    save_serving_params,
+    save_serving_params, warm_start_params,
 )

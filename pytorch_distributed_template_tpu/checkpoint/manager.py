@@ -460,3 +460,86 @@ def restore_serving_params(path, template_params, shardings=None):
     return ocp.StandardCheckpointer().restore(
         Path(path).resolve(), abstract
     )
+
+
+def warm_start_params(resume_path, current_params):
+    """Graft a training checkpoint's params into freshly-initialized
+    (possibly differently-structured) params — the transfer/fine-tune
+    primitive behind ``trainer.init_from``.
+
+    Every leaf whose path and shape match the checkpoint restores from
+    disk directly into the current leaf's sharding (multi-host-legal:
+    no host-local detour); everything else — fresh LoRA adapters
+    (models/lora.py), a swapped classification head — keeps its
+    initialization. Params ONLY: optimizer state, epoch, and RNG do not
+    travel (that is resume's job; reference fine-tune semantics,
+    /root/reference/parse_config.py:69-71, carry the config overlay but
+    restart optimization).
+
+    Returns ``(params, restored_paths, skipped_paths)`` where skipped =
+    current-tree leaves that did NOT match (kept their init).
+    """
+    resume_path = Path(resume_path).resolve()
+    mgr = CheckpointManager(resume_path.parent)
+    disk = mgr._ckpt_tree(resume_path)
+    if disk is None or "params" not in disk:
+        raise FileNotFoundError(
+            f"no readable params tree in checkpoint {resume_path}"
+        )
+
+    def leaf_paths(tree):
+        flat = jax.tree_util.tree_flatten_with_path(
+            tree, is_leaf=lambda x: hasattr(x, "shape")
+        )[0]
+        return {
+            "/".join(str(getattr(k, "key", k)) for k in path): leaf
+            for path, leaf in flat
+        }
+
+    disk_flat = leaf_paths(disk["params"])
+    cur_flat = leaf_paths(current_params)
+    matched = {
+        p for p, leaf in cur_flat.items()
+        if p in disk_flat and tuple(disk_flat[p].shape) == tuple(leaf.shape)
+    }
+
+    # Abstract restore tree holding ONLY the matched leaves, each with
+    # the current tree's dtype+sharding (orbax casts/shards on read).
+    # Unmatched disk leaves — e.g. a swapped head's old vocab-sized
+    # kernels — are pruned from the item entirely: partial_restore
+    # skips reading them, instead of materializing hundreds of MB
+    # host-local just to discard them at graft time. (Param trees are
+    # nested dicts throughout this codebase — the path join below
+    # assumes that.)
+    abstract: dict = {}
+    for name in matched:
+        node = abstract
+        parts = name.split("/")
+        for part in parts[:-1]:
+            node = node.setdefault(part, {})
+        cur = cur_flat[name]
+        node[parts[-1]] = jax.ShapeDtypeStruct(
+            cur.shape, cur.dtype, sharding=getattr(cur, "sharding", None)
+        )
+    # Partial restore: PyTreeRestore is the one restore-args type
+    # carrying ``partial_restore`` in this orbax line;
+    # construct_restore_args turns the ShapeDtypeStructs (incl. their
+    # shardings) into per-leaf ArrayRestoreArgs.
+    item = {"params": abstract}
+    restored = ocp.PyTreeCheckpointer().restore(
+        resume_path,
+        args=ocp.args.PyTreeRestore(
+            item=item,
+            restore_args=ocp.checkpoint_utils.construct_restore_args(item),
+            partial_restore=True,
+        ),
+    )["params"]
+    restored_flat = leaf_paths(restored)
+
+    def graft(path, cur_leaf):
+        name = "/".join(str(getattr(k, "key", k)) for k in path)
+        return restored_flat[name] if name in matched else cur_leaf
+
+    out = jax.tree_util.tree_map_with_path(graft, current_params)
+    skipped = sorted(set(cur_flat) - matched)
+    return out, sorted(matched), skipped

@@ -65,6 +65,35 @@ def _decay_mask(exclude):
     return mask
 
 
+def _trainable_only(tx, patterns):
+    """Freeze every param whose ``/``-joined path matches NO regex in
+    ``patterns`` — the parameter-efficient fine-tuning switch
+    (``"optimizer": {"args": {"trainable": ["lora_"]}}``).
+
+    ``optax.multi_transform`` routes trainable leaves through ``tx`` and
+    frozen leaves through ``set_to_zero`` (NOT ``optax.masked``, which
+    passes masked-out gradients through as raw updates). Frozen leaves
+    therefore receive exactly zero updates AND allocate no moment
+    buffers (Adam state is 2x params — the real memory cost of "train
+    everything"). Complements models/lora.LoRADense's in-graph
+    ``stop_gradient`` (which prunes the frozen dW matmuls from the
+    backward); this switch alone also freezes non-LoRA leaves like
+    embeddings and norms."""
+    pats = [re.compile(p) for p in patterns]
+
+    def labels(params):
+        def decide(path, _):
+            name = "/".join(str(getattr(k, "key", k)) for k in path)
+            return "train" if any(p.search(name) for p in pats) \
+                else "freeze"
+
+        return jax.tree_util.tree_map_with_path(decide, params)
+
+    return optax.multi_transform(
+        {"train": tx, "freeze": optax.set_to_zero()}, labels
+    )
+
+
 def _decayed(weight_decay, base, exclude=None):
     """``add_decayed_weights`` (coupled, torch-style) chained before
     ``base``, honoring an optional exclusion mask."""
@@ -487,7 +516,10 @@ def build_optimizer(config, steps_per_epoch: int):
 
     opt_args.pop("lr", None)
     opt_args["learning_rate"] = schedule
+    trainable = opt_args.pop("trainable", None)
     tx = OPTIMIZERS.get(opt_cfg["type"])(**opt_args)
+    if trainable:
+        tx = _trainable_only(tx, trainable)
     lr_fn = schedule if schedule is not None else (
         lambda step: float("nan")
     )

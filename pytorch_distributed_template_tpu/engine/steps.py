@@ -52,7 +52,8 @@ def make_train_step(model, tx, criterion: Callable,
                     skip_nonfinite: bool = False,
                     augment=None,
                     mixup_alpha: float = 0.0,
-                    log_grad_norm: bool = False):
+                    log_grad_norm: bool = False,
+                    trainable_patterns=None):
     """Build ``train_step(state, batch) -> (state, metrics)``.
 
     ``metrics`` holds scalar sums + count; callers divide after accumulating
@@ -225,6 +226,27 @@ def make_train_step(model, tx, criterion: Callable,
         grads = jax.tree.map(
             lambda g: (g / denom).astype(g.dtype), grads
         )
+
+        if trainable_patterns:
+            # Mirror the optimizer's ``trainable`` freeze (optim.py
+            # _trainable_only) on the gradients themselves: frozen leaves
+            # still produce real grads (only LoRADense's base kernels are
+            # stop_gradient-pruned in-graph — embeddings, norms, biases
+            # are not), and counting those soon-to-be-discarded grads in
+            # the global norm below would over-clip the surviving updates
+            # and misreport grad_norm. The mask is static (Python bools at
+            # trace time), so the zeroed branches fold away.
+            import re as _re
+
+            pats = [_re.compile(p) for p in trainable_patterns]
+
+            def _freeze(path, g):
+                name = "/".join(str(getattr(kk, "key", kk)) for kk in path)
+                if any(p.search(name) for p in pats):
+                    return g
+                return jnp.zeros_like(g)
+
+            grads = jax.tree_util.tree_map_with_path(_freeze, grads)
 
         if log_grad_norm or grad_clip_norm > 0:
             # pre-clip global norm of the mean gradient

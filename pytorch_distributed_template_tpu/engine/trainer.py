@@ -308,6 +308,31 @@ class Trainer(BaseTrainer):
             )
             if restored_best is not None:
                 self.mnt_best = restored_best
+        elif config["trainer"].get("init_from"):
+            # params-only warm start (``trainer.init_from`` in the JSON or
+            # --set): graft matching param leaves from a checkpoint into
+            # the fresh state — the transfer/LoRA-fine-tune primitive.
+            # Unlike resume, optimizer state and epoch restart from zero.
+            from ..checkpoint import warm_start_params
+
+            params, restored, skipped = warm_start_params(
+                config["trainer"]["init_from"], self.state.params
+            )
+            self.state = self.state.replace(
+                params=params,
+                # EMA shadows start at the warm-started weights, not at
+                # the discarded fresh init (leaves are immutable jax
+                # Arrays — sharing them is safe)
+                **({"ema_params": params}
+                   if self.state.ema_params is not None else {}),
+            )
+            self.logger.info(
+                "Warm start from %s: %d param tensors restored, %d kept "
+                "their init%s", config["trainer"]["init_from"],
+                len(restored), len(skipped),
+                (" (e.g. " + ", ".join(skipped[:3]) + ")") if skipped
+                else "",
+            )
 
         # host-side mirror of state.lr_scale (plateau LR control; survives
         # resume via the checkpointed state)
@@ -340,6 +365,9 @@ class Trainer(BaseTrainer):
             augment=build_augment(config["trainer"].get("augment")),
             mixup_alpha=float(config["trainer"].get("mixup_alpha", 0.0)),
             log_grad_norm=self.log_grad_norm,
+            trainable_patterns=config["optimizer"].get("args", {}).get(
+                "trainable"
+            ),
         )
         metric_sharding = jax.sharding.NamedSharding(
             self.mesh, jax.sharding.PartitionSpec()

@@ -38,13 +38,16 @@ def _dense_init(stddev=0.02):
     return nn.initializers.normal(stddev=stddev)
 
 
-def _dense_or_quant(dtype, quant: str):
-    """Bias-free Dense factory honoring the serving quantization mode
-    (single dispatch point: models/quant.dense_factory)."""
+def _dense_or_quant(dtype, quant: str, lora_rank: int = 0,
+                    lora_alpha: float = 16.0):
+    """Bias-free Dense factory honoring the serving-quantization and
+    LoRA fine-tuning modes (single dispatch point:
+    models/quant.dense_factory)."""
     from .quant import dense_factory
 
     return dense_factory(dtype, quant, use_bias=False,
-                         kernel_init=_dense_init())
+                         kernel_init=_dense_init(), lora_rank=lora_rank,
+                         lora_alpha=lora_alpha)
 
 
 class RMSNorm(nn.Module):
@@ -98,6 +101,8 @@ class LlamaAttention(nn.Module):
     window: int = 0                 # sliding-window size; 0 = full causal
     quant: str = ""                 # "" | "w8a16" (models/quant.py)
     kv_quant: str = ""              # "" | "int8" (decode cache; quant.py)
+    lora_rank: int = 0              # >0: LoRA fine-tuning (models/lora.py)
+    lora_alpha: float = 16.0
 
     @nn.compact
     def __call__(self, x, positions, train: bool, decode: bool = False,
@@ -105,7 +110,8 @@ class LlamaAttention(nn.Module):
         b, t, _ = x.shape
         hd = self.d_model // self.n_head
         groups = self.n_head // self.n_kv_head
-        dense = _dense_or_quant(self.dtype, self.quant)
+        dense = _dense_or_quant(self.dtype, self.quant, self.lora_rank,
+                                self.lora_alpha)
         q = dense(self.n_head * hd, "q_proj")(x).reshape(b, t, self.n_head, hd)
         k = dense(self.n_kv_head * hd, "k_proj")(x).reshape(
             b, t, self.n_kv_head, hd)
@@ -371,10 +377,13 @@ class SwiGLU(nn.Module):
     d_ff: int
     dtype: Any
     quant: str = ""
+    lora_rank: int = 0
+    lora_alpha: float = 16.0
 
     @nn.compact
     def __call__(self, x):
-        dense = _dense_or_quant(self.dtype, self.quant)
+        dense = _dense_or_quant(self.dtype, self.quant, self.lora_rank,
+                                self.lora_alpha)
         gate = nn.silu(dense(self.d_ff, "gate_proj")(x))
         up = dense(self.d_ff, "up_proj")(x)
         return dense(self.d_model, "down_proj")(gate * up)
@@ -396,6 +405,8 @@ class LlamaBlock(nn.Module):
     n_layer: int = 1                # model depth, for residual-init scaling
     quant: str = ""                 # "" | "w8a16" (serving; models/quant.py)
     kv_quant: str = ""              # "" | "int8" (decode cache; quant.py)
+    lora_rank: int = 0              # >0: LoRA fine-tuning (models/lora.py)
+    lora_alpha: float = 16.0
 
     @nn.compact
     def __call__(self, x, positions, train: bool, example_mask=None,
@@ -406,6 +417,7 @@ class LlamaBlock(nn.Module):
             self.d_model, self.n_head, self.n_kv_head, self.dtype,
             self.attn_impl, self.mesh, self.seq_layout, self.rope_base,
             window=self.window, quant=self.quant, kv_quant=self.kv_quant,
+            lora_rank=self.lora_rank, lora_alpha=self.lora_alpha,
             name="self_attn",
         )(h, positions, train, decode, decode_index, prefill)
         h = RMSNorm(self.rms_eps, name="post_attention_layernorm")(x)
@@ -421,7 +433,8 @@ class LlamaBlock(nn.Module):
                 name="moe",
             )(h, train, example_mask)
         return x + SwiGLU(self.d_model, self.d_ff, self.dtype,
-                          quant=self.quant, name="mlp")(h)
+                          quant=self.quant, lora_rank=self.lora_rank,
+                          lora_alpha=self.lora_alpha, name="mlp")(h)
 
 
 class _HeadKernel(nn.Module):
@@ -461,6 +474,8 @@ class LlamaLM(nn.Module):
     fused_head: bool = False        # return (hidden, head_w) for chunked loss
     quant: str = ""                 # "w8a16": int8 serving weights (quant.py)
     kv_quant: str = ""              # "int8": int8 decode KV cache (quant.py)
+    lora_rank: int = 0              # >0: LoRA fine-tuning (models/lora.py)
+    lora_alpha: float = 16.0
     # --- MoE (models/moe.py, swiglu experts); 0 -> all-dense blocks -------
     moe_experts: int = 0
     moe_top_k: int = 2
@@ -552,7 +567,8 @@ class LlamaLM(nn.Module):
                 rope_base=self.rope_base, rms_eps=self.rms_eps,
                 window=self.window, moe=self._moe_kwargs(i),
                 n_layer=self.n_layer, quant=self.quant,
-                kv_quant=self.kv_quant,
+                kv_quant=self.kv_quant, lora_rank=self.lora_rank,
+                lora_alpha=self.lora_alpha,
                 name=f"layers_{i}",
             )(x, positions, train, example_mask, decode, start, prefill)
         x = RMSNorm(self.rms_eps, name="norm")(x)
@@ -570,7 +586,8 @@ class LlamaLM(nn.Module):
             w = _HeadKernel(self.d_model, self.vocab_size,
                             name="lm_head")()
             return x.astype(self.dtype), w.astype(self.dtype)
-        head = _dense_or_quant(self.dtype, self.quant)
+        head = _dense_or_quant(self.dtype, self.quant, self.lora_rank,
+                               self.lora_alpha)
         logits = head(self.vocab_size, "lm_head")(x)
         return logits.astype(jnp.float32)
 
@@ -603,7 +620,8 @@ def llama(vocab_size: int = 32000, n_layer: int = 12, n_head: int = 12,
           attn_impl: str = "xla", remat: bool = False, mesh=None,
           seq_layout: str = "natural", rope_base: float = 10000.0,
           rms_eps: float = 1e-6, window: int = 0, fused_head: bool = False,
-          quant: str = "", kv_quant: str = ""):
+          quant: str = "", kv_quant: str = "", lora_rank: int = 0,
+          lora_alpha: float = 16.0):
     return LlamaLM(
         vocab_size=vocab_size, n_layer=n_layer, n_head=n_head,
         n_kv_head=n_kv_head, d_model=d_model, d_ff=d_ff, max_len=max_len,
@@ -611,6 +629,7 @@ def llama(vocab_size: int = 32000, n_layer: int = 12, n_head: int = 12,
         attn_impl=attn_impl, remat=remat, mesh=mesh, seq_layout=seq_layout,
         rope_base=rope_base, rms_eps=rms_eps, window=window,
         fused_head=fused_head, quant=quant, kv_quant=kv_quant,
+        lora_rank=lora_rank, lora_alpha=lora_alpha,
     )
 
 
@@ -621,7 +640,8 @@ def mistral(vocab_size: int = 32000, n_layer: int = 32, n_head: int = 32,
             rope_base: float = 10000.0, rms_eps: float = 1e-5,
             bfloat16: bool = True, attn_impl: str = "flash",
             remat: bool = True, mesh=None, fused_head: bool = False,
-            quant: str = "", kv_quant: str = ""):
+            quant: str = "", kv_quant: str = "", lora_rank: int = 0,
+            lora_alpha: float = 16.0):
     """Mistral-7B-shaped defaults: the Llama architecture with 4:1 GQA and
     a 4096-token sliding window (banded flash kernels + rolling decode
     cache). Same param tree as ``Llama``, so ``import_hf_llama`` applies
@@ -632,7 +652,8 @@ def mistral(vocab_size: int = 32000, n_layer: int = 32, n_head: int = 32,
         dtype=jnp.bfloat16 if bfloat16 else jnp.float32,
         attn_impl=attn_impl, remat=remat, mesh=mesh, window=window,
         rope_base=rope_base, rms_eps=rms_eps, fused_head=fused_head,
-        quant=quant, kv_quant=kv_quant,
+        quant=quant, kv_quant=kv_quant, lora_rank=lora_rank,
+        lora_alpha=lora_alpha,
     )
 
 
@@ -671,7 +692,8 @@ def tiny_llama(vocab_size: int = 256, n_layer: int = 2, n_head: int = 4,
                remat: bool = False, mesh=None, bfloat16: bool = False,
                seq_layout: str = "natural", window: int = 0,
                fused_head: bool = False, quant: str = "",
-               kv_quant: str = ""):
+               kv_quant: str = "", lora_rank: int = 0,
+               lora_alpha: float = 16.0):
     """Small GQA config for tests and dry runs."""
     return LlamaLM(
         vocab_size=vocab_size, n_layer=n_layer, n_head=n_head,
@@ -679,5 +701,5 @@ def tiny_llama(vocab_size: int = 256, n_layer: int = 2, n_head: int = 4,
         dtype=jnp.bfloat16 if bfloat16 else jnp.float32,
         attn_impl=attn_impl, remat=remat, mesh=mesh, seq_layout=seq_layout,
         window=window, fused_head=fused_head, quant=quant,
-        kv_quant=kv_quant,
+        kv_quant=kv_quant, lora_rank=lora_rank, lora_alpha=lora_alpha,
     )

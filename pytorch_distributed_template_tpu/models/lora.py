@@ -1,0 +1,102 @@
+"""LoRA: low-rank adaptation for parameter-efficient fine-tuning.
+
+The reference's fine-tune story is "resume + config overlay"
+(/root/reference/parse_config.py:69-71 — a new config over an old
+checkpoint); this module is the modern extension of that workflow: keep
+the pretrained weights FROZEN and train only a rank-``r`` update
+``dW = (alpha / r) * A @ B`` per linear layer. Workflow:
+
+    python train.py -c configs/<finetune>.json \
+        --set "arch;args;lora_rank" 8 \
+        --set "optimizer;args;trainable" '["lora_"]' \
+        --set "trainer;init_from" saved/<base>/model_best
+    python scripts/merge_lora.py -r saved/<ft>/train/<run>/model_best
+    python generate.py -r saved/<ft>/.../serving_merged/model_merged ...
+
+Design notes (TPU-first):
+- The base kernel/bias pass through ``lax.stop_gradient`` INSIDE the
+  module: XLA prunes their dW matmuls from the backward pass entirely —
+  the freeze is a compile-time graph property, not just an optimizer
+  mask. The optimizer-side ``trainable`` mask (engine/optim.py) is
+  still wanted: it drops the frozen leaves' moment buffers (2x params
+  of Adam state at bf16/f32) from the opt_state.
+- ``lora_b`` starts at zero, so step 0 reproduces the base model
+  exactly (the standard LoRA identity-at-init property).
+- Under TP the small ``lora_a/lora_b`` factors replicate (no partition
+  rules claim them): at ranks ~8-64 the extra bytes are noise next to
+  the frozen kernels, and replication keeps the adapter math local.
+"""
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+class LoRADense(nn.Module):
+    """Dense layer with a frozen base kernel and a trainable low-rank
+    update: ``y = x @ stop_grad(W) + (alpha / rank) * (x @ A) @ B``.
+
+    Param layout: ``kernel`` (and optional ``bias``) keep the same path
+    as the ``nn.Dense`` they replace — so a pretrained dense checkpoint
+    grafts straight in (checkpoint/manager.warm_start_params) — plus
+    ``lora_a [in, rank]`` and ``lora_b [rank, out]``.
+    """
+
+    features: int
+    rank: int
+    alpha: float = 16.0
+    dtype: Any = jnp.float32
+    use_bias: bool = False
+    kernel_init: Any = None
+
+    @nn.compact
+    def __call__(self, x):
+        d = x.shape[-1]
+        kinit = self.kernel_init or nn.initializers.normal(stddev=0.02)
+        w = self.param("kernel", kinit, (d, self.features))
+        a = self.param("lora_a", nn.initializers.normal(stddev=0.02),
+                       (d, self.rank))
+        b = self.param("lora_b", nn.initializers.zeros,
+                       (self.rank, self.features))
+        # the frozen-base contract (see module docstring)
+        w = jax.lax.stop_gradient(w)
+        xd = x.astype(self.dtype)
+        y = xd @ w.astype(self.dtype)
+        y = y + (xd @ a.astype(self.dtype)) @ b.astype(self.dtype) * (
+            self.alpha / self.rank
+        )
+        if self.use_bias:
+            bias = self.param("bias", nn.initializers.zeros,
+                              (self.features,), jnp.float32)
+            y = y + jax.lax.stop_gradient(bias).astype(self.dtype)[None, :]
+        return y
+
+
+def merge_lora_params(params, alpha: float = 16.0):
+    """Fold trained adapters into the base weights:
+    ``kernel + (alpha / rank) * A @ B`` — the serving/export form (the
+    merged tree is a plain dense tree; LoRA costs nothing at inference).
+
+    ``alpha`` must match the model's ``lora_alpha`` (the rank is read
+    from ``lora_a``'s shape).
+    """
+
+    def walk(node):
+        if isinstance(node, dict):
+            if {"kernel", "lora_a", "lora_b"} <= set(node.keys()):
+                a = jnp.asarray(node["lora_a"], jnp.float32)
+                b = jnp.asarray(node["lora_b"], jnp.float32)
+                rank = a.shape[1]
+                w = jnp.asarray(node["kernel"], jnp.float32)
+                out = {"kernel": (w + a @ b * (alpha / rank)).astype(
+                    node["kernel"].dtype)}
+                if "bias" in node:
+                    out["bias"] = node["bias"]
+                return out
+            return {k: walk(v) for k, v in node.items()}
+        return node
+
+    return walk(params)

@@ -66,17 +66,32 @@ class W8A16Dense(nn.Module):
 
 
 def dense_factory(dtype, quant: str, use_bias: bool = False,
-                  kernel_init=None):
-    """THE quant-dispatch point for every Dense in the LM families.
+                  kernel_init=None, lora_rank: int = 0,
+                  lora_alpha: float = 16.0):
+    """THE linear-layer dispatch point for every Dense in the LM
+    families: plain / int8-serving (``quant="w8a16"``) / LoRA
+    fine-tuning (``lora_rank > 0``, models/lora.py).
 
     Returns ``f(features, name) -> module`` (or ``f(features,
     kernel_init, name)`` compatibility is the caller's concern — pass
-    ``kernel_init`` here instead). One site to extend when a new quant
-    mode lands, instead of per-model factory copies drifting apart.
+    ``kernel_init`` here instead). One site to extend when a new mode
+    lands, instead of per-model factory copies drifting apart.
     """
+    if quant and lora_rank:
+        raise ValueError(
+            "lora_rank is a FINE-TUNING mode and quant a SERVING mode: "
+            "merge the adapters first (scripts/merge_lora.py), then "
+            "quantize the merged checkpoint"
+        )
     if quant == "w8a16":
         return lambda feats, name: W8A16Dense(
             feats, dtype=dtype, use_bias=use_bias, name=name)
+    if lora_rank:
+        from .lora import LoRADense
+
+        return lambda feats, name: LoRADense(
+            feats, rank=lora_rank, alpha=lora_alpha, dtype=dtype,
+            use_bias=use_bias, kernel_init=kernel_init, name=name)
     if kernel_init is None:
         kernel_init = nn.initializers.normal(stddev=0.02)
     return lambda feats, name: nn.Dense(

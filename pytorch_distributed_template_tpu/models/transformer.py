@@ -37,14 +37,16 @@ def _dense_init(stddev):
     return nn.initializers.normal(stddev=stddev)
 
 
-def _dense_or_quant_biased(dtype, quant: str):
-    """Biased Dense factory honoring the serving quantization mode (the
-    GPT-2 family's projections carry biases, unlike Llama's; single
-    dispatch point: models/quant.dense_factory)."""
+def _dense_or_quant_biased(dtype, quant: str, lora_rank: int = 0,
+                           lora_alpha: float = 16.0):
+    """Biased Dense factory honoring the serving-quantization and LoRA
+    fine-tuning modes (the GPT-2 family's projections carry biases,
+    unlike Llama's; single dispatch point: models/quant.dense_factory)."""
     from .quant import dense_factory
 
     return lambda feats, init, name: dense_factory(
-        dtype, quant, use_bias=True, kernel_init=init)(feats, name)
+        dtype, quant, use_bias=True, kernel_init=init,
+        lora_rank=lora_rank, lora_alpha=lora_alpha)(feats, name)
 
 
 class MlpBlock(nn.Module):
@@ -54,10 +56,13 @@ class MlpBlock(nn.Module):
     n_layer: int
     dtype: Any
     quant: str = ""
+    lora_rank: int = 0
+    lora_alpha: float = 16.0
 
     @nn.compact
     def __call__(self, x, train: bool):
-        dense = _dense_or_quant_biased(self.dtype, self.quant)
+        dense = _dense_or_quant_biased(self.dtype, self.quant,
+                                       self.lora_rank, self.lora_alpha)
         y = dense(self.d_ff, _dense_init(0.02), "up")(x)
         y = nn.gelu(y)
         y = dense(self.d_model,
@@ -77,13 +82,16 @@ class SelfAttention(nn.Module):
     seq_layout: str = "natural"     # 'zigzag' -> inputs are zigzag-permuted
     quant: str = ""                 # "" | "w8a16" (serving; models/quant.py)
     kv_quant: str = ""              # "" | "int8" (decode cache; quant.py)
+    lora_rank: int = 0              # >0: LoRA fine-tuning (models/lora.py)
+    lora_alpha: float = 16.0
 
     @nn.compact
     def __call__(self, x, train: bool, decode: bool = False,
                  decode_index=None, prefill: bool = False):
         b, t, _ = x.shape
         head_dim = self.d_model // self.n_head
-        dense = _dense_or_quant_biased(self.dtype, self.quant)
+        dense = _dense_or_quant_biased(self.dtype, self.quant,
+                                       self.lora_rank, self.lora_alpha)
         qkv = dense(3 * self.d_model, _dense_init(0.02), "qkv")(x)
         qkv = qkv.reshape(b, t, 3, self.n_head, head_dim)
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
@@ -220,6 +228,8 @@ class Block(nn.Module):
     seq_layout: str = "natural"
     quant: str = ""                 # "" | "w8a16" (serving; models/quant.py)
     kv_quant: str = ""              # "" | "int8" (decode cache; quant.py)
+    lora_rank: int = 0              # >0: LoRA fine-tuning (models/lora.py)
+    lora_alpha: float = 16.0
 
     @nn.compact
     def __call__(self, x, train: bool, example_mask=None,
@@ -231,7 +241,8 @@ class Block(nn.Module):
             self.d_model, self.n_head, self.dropout, self.n_layer,
             self.dtype, self.attn_impl, self.mesh,
             seq_layout=self.seq_layout, quant=self.quant,
-            kv_quant=self.kv_quant, name="attn",
+            kv_quant=self.kv_quant, lora_rank=self.lora_rank,
+            lora_alpha=self.lora_alpha, name="attn",
         )(h, train, decode, decode_index, prefill)
         h = nn.LayerNorm(epsilon=self.ln_eps, dtype=jnp.float32,
                          name="ln_2")(x)
@@ -247,7 +258,8 @@ class Block(nn.Module):
         else:
             x = x + MlpBlock(
                 self.d_model, self.d_ff, self.dropout, self.n_layer,
-                self.dtype, quant=self.quant, name="mlp",
+                self.dtype, quant=self.quant, lora_rank=self.lora_rank,
+                lora_alpha=self.lora_alpha, name="mlp",
             )(h, train)
         return x
 
@@ -271,6 +283,8 @@ class TransformerLM(nn.Module):
     ln_eps: float = 1e-5            # GPT-2's layer_norm_epsilon
     quant: str = ""                 # "w8a16": int8 serving weights (quant.py)
     kv_quant: str = ""              # "int8": int8 decode KV cache (quant.py)
+    lora_rank: int = 0              # >0: LoRA fine-tuning (models/lora.py)
+    lora_alpha: float = 16.0
     #   (the tied head attends through the float embedding either way)
     # --- MoE (models/moe.py); moe_experts == 0 -> all-dense blocks --------
     moe_experts: int = 0
@@ -374,7 +388,9 @@ class TransformerLM(nn.Module):
                 dtype=self.dtype, attn_impl=self.attn_impl, mesh=self.mesh,
                 moe=self._moe_kwargs(i), ln_eps=self.ln_eps,
                 seq_layout="zigzag" if zperm is not None else "natural",
-                quant=self.quant, kv_quant=self.kv_quant, name=f"h_{i}",
+                quant=self.quant, kv_quant=self.kv_quant,
+                lora_rank=self.lora_rank, lora_alpha=self.lora_alpha,
+                name=f"h_{i}",
             )(x, train, example_mask, decode, start, prefill)
         x = nn.LayerNorm(epsilon=self.ln_eps, dtype=jnp.float32,
                          name="ln_f")(x)
@@ -408,7 +424,8 @@ class TransformerLM(nn.Module):
 
             logits = dense_factory(
                 self.dtype, self.quant, use_bias=False,
-                kernel_init=_dense_init(0.02),
+                kernel_init=_dense_init(0.02), lora_rank=self.lora_rank,
+                lora_alpha=self.lora_alpha,
             )(self.vocab_size, "lm_head")(x)
         return logits.astype(jnp.float32)
 
@@ -472,7 +489,8 @@ def tiny_lm(vocab_size: int = 256, n_layer: int = 2, n_head: int = 4,
             attn_impl: str = "xla", remat: bool = False, mesh=None,
             bfloat16: bool = False, seq_layout: str = "natural",
             fused_head: bool = False, tie_embeddings: bool = True,
-            quant: str = "", kv_quant: str = ""):
+            quant: str = "", kv_quant: str = "", lora_rank: int = 0,
+            lora_alpha: float = 16.0):
     """Small config for tests and the multi-chip dry run."""
     return TransformerLM(
         vocab_size=vocab_size, n_layer=n_layer, n_head=n_head,
@@ -481,4 +499,5 @@ def tiny_lm(vocab_size: int = 256, n_layer: int = 2, n_head: int = 4,
         attn_impl=attn_impl, remat=remat, mesh=mesh,
         seq_layout=seq_layout, fused_head=fused_head,
         tie_embeddings=tie_embeddings, quant=quant, kv_quant=kv_quant,
+        lora_rank=lora_rank, lora_alpha=lora_alpha,
     )

@@ -40,7 +40,7 @@ if os.environ.get("JAX_PLATFORMS"):
 import jax  # noqa: E402
 
 from pytorch_distributed_template_tpu.checkpoint import (  # noqa: E402
-    save_serving_params,
+    load_serving_meta, restore_serving_params, save_serving_params,
 )
 from pytorch_distributed_template_tpu.config import (  # noqa: E402
     ConfigParser, MODELS,
@@ -74,10 +74,26 @@ def main(args, config):
     # training-loss mode and is stripped from the serving config below).
     validate_quant_config("w8a16", False, getattr(model, "moe_experts", 0))
 
-    state, _ = restore_template_state(config, model, mesh)
-    src = "ema_params" if args.ema and state.ema_params is not None \
-        else "params"
-    params = getattr(state, src)
+    if load_serving_meta(config.resume) is not None:
+        # already a params-only artifact (e.g. scripts/merge_lora.py
+        # output) — quantize it directly
+        if args.ema:
+            raise SystemExit(
+                f"--ema has no effect on {config.resume}: it is a "
+                "params-only serving artifact (the EMA-or-not choice was "
+                "baked in when the artifact was produced — re-run its "
+                "producer with --ema instead)"
+            )
+        src = "params"
+        template = jax.eval_shape(
+            lambda: model.init(jax.random.key(0), model.batch_template(1))
+        )["params"]
+        params = restore_serving_params(config.resume, template)
+    else:
+        state, _ = restore_template_state(config, model, mesh)
+        src = "ema_params" if args.ema and state.ema_params is not None \
+            else "params"
+        params = getattr(state, src)
     qparams = quantize_params_w8(jax.device_get(params))
 
     out_dir = (

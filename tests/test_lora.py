@@ -1,0 +1,263 @@
+"""LoRA fine-tuning (models/lora.py) + warm start (trainer.init_from).
+
+Contracts: identity at init (lora_b = 0); the frozen-base guarantee
+(stop_gradient in-graph + the optimizer ``trainable`` switch); merged
+weights reproduce the adapted model exactly; warm_start_params grafts
+matching leaves and leaves adapters fresh; and the whole workflow runs
+config-driven end to end (train base -> LoRA fine-tune -> merge CLI ->
+sample CLI).
+"""
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+import jax.tree_util as jtu
+import numpy as np
+import optax
+import pytest
+
+import pytorch_distributed_template_tpu.models  # noqa: F401
+from pytorch_distributed_template_tpu.config.registry import MODELS
+from pytorch_distributed_template_tpu.models.lora import (
+    LoRADense, merge_lora_params,
+)
+
+REPO = Path(__file__).parent.parent
+KW = dict(vocab_size=64, n_layer=2, n_head=4, n_kv_head=2, d_model=32,
+          max_len=32)
+
+
+def _tok(n=8):
+    return jnp.asarray(
+        np.random.default_rng(0).integers(0, 64, (2, n)), jnp.int32
+    )
+
+
+def _strip_lora(tree):
+    if isinstance(tree, dict):
+        return {k: _strip_lora(v) for k, v in tree.items()
+                if not k.startswith("lora_")}
+    return tree
+
+
+def _split_moved(before, after):
+    """Max |delta| over (non-lora, lora) leaves, matched by path."""
+    fb = jtu.tree_flatten_with_path(before)[0]
+    fa = jtu.tree_flatten_with_path(after)[0]
+    frozen, lora = 0.0, 0.0
+    for (pa, a), (_, b) in zip(fb, fa):
+        d = float(np.abs(np.asarray(a) - np.asarray(b)).max())
+        if "lora" in str(pa):
+            lora = max(lora, d)
+        else:
+            frozen = max(frozen, d)
+    return frozen, lora
+
+
+def test_lora_dense_identity_and_grads():
+    """lora_b = 0 at init -> the module IS the base Dense; base
+    kernel/bias gradients are pruned in-graph (stop_gradient) while the
+    adapter gradients flow."""
+    x = jnp.asarray(np.random.default_rng(1).normal(size=(4, 16)),
+                    jnp.float32)
+    mod = LoRADense(8, rank=2, use_bias=True)
+    p = mod.init(jax.random.key(0), x)["params"]
+    import flax.linen as nn
+
+    dense = nn.Dense(8)
+    y_lora = mod.apply({"params": p}, x)
+    y_dense = dense.apply(
+        {"params": {"kernel": p["kernel"], "bias": p["bias"]}}, x
+    )
+    np.testing.assert_allclose(np.asarray(y_lora), np.asarray(y_dense),
+                               atol=1e-6)
+    g = jax.grad(lambda pp: jnp.sum(mod.apply({"params": pp}, x) ** 2))(p)
+    assert float(np.abs(np.asarray(g["kernel"])).max()) == 0.0
+    assert float(np.abs(np.asarray(g["bias"])).max()) == 0.0
+    assert float(np.abs(np.asarray(g["lora_b"])).max()) > 0.0
+
+
+def test_lora_model_identity_at_init():
+    m = MODELS.get("Llama")(**KW)
+    ml = MODELS.get("Llama")(**KW, lora_rank=4)
+    tok = _tok()
+    pl = ml.init(jax.random.key(0), tok)["params"]
+    ld = m.apply({"params": _strip_lora(pl)}, tok, train=False)
+    ll = ml.apply({"params": pl}, tok, train=False)
+    np.testing.assert_array_equal(np.asarray(ld), np.asarray(ll))
+
+
+def test_trainable_switch_freezes_and_shrinks_opt_state():
+    """optimizer ``trainable: ["lora_"]`` -> frozen leaves take EXACTLY
+    zero updates (multi_transform + set_to_zero, not optax.masked's
+    pass-through) and the moment buffers cover only the adapters."""
+    from pytorch_distributed_template_tpu.engine.optim import (
+        _trainable_only,
+    )
+
+    ml = MODELS.get("Llama")(**KW, lora_rank=4)
+    tok = _tok()
+    pl = ml.init(jax.random.key(0), tok)["params"]
+
+    def loss(p):
+        return jnp.mean(ml.apply({"params": p}, tok, train=False) ** 2)
+
+    tx = _trainable_only(optax.adam(1e-2), ["lora_"])
+    st = tx.init(pl)
+    p = pl
+    for _ in range(3):
+        up, st = tx.update(jax.grad(loss)(p), st, p)
+        p = optax.apply_updates(p, up)
+    frozen_moved, lora_moved = _split_moved(pl, p)
+    assert frozen_moved == 0.0
+    assert lora_moved > 0.0
+    n_lora = sum(x.size for path, x in jtu.tree_flatten_with_path(pl)[0]
+                 if "lora" in str(path))
+    n_state = sum(x.size for x in jtu.tree_leaves(st)
+                  if hasattr(x, "size"))
+    # Adam: mu + nu per trainable leaf, plus O(1) counters
+    assert n_state <= 2 * n_lora + 8
+
+
+def test_merge_reproduces_adapted_model():
+    ml = MODELS.get("Llama")(**KW, lora_rank=4, lora_alpha=8.0)
+    m = MODELS.get("Llama")(**KW)
+    tok = _tok()
+    pl = ml.init(jax.random.key(0), tok)["params"]
+    # give the adapters non-trivial values
+    pl = jtu.tree_map_with_path(
+        lambda path, x: (
+            jnp.asarray(
+                np.random.default_rng(abs(hash(str(path))) % 2**31)
+                .normal(scale=0.05, size=x.shape), x.dtype
+            ) if "lora" in str(path) else x
+        ), pl,
+    )
+    merged = merge_lora_params(pl, alpha=8.0)
+    out_l = ml.apply({"params": pl}, tok, train=False)
+    out_m = m.apply({"params": merged}, tok, train=False)
+    np.testing.assert_allclose(np.asarray(out_l), np.asarray(out_m),
+                               atol=2e-5, rtol=2e-5)
+    # merged tree is a plain dense tree
+    assert not any("lora" in str(p)
+                   for p, _ in jtu.tree_flatten_with_path(merged)[0])
+
+
+def test_gpt2_family_lora():
+    """The biased GPT-2 projections get the same treatment."""
+    kw = dict(vocab_size=64, n_layer=1, n_head=4, d_model=32, max_len=32)
+    m = MODELS.get("TinyLM")(**kw)
+    ml = MODELS.get("TinyLM")(**kw, lora_rank=4)
+    tok = _tok()
+    pl = ml.init(jax.random.key(0), tok)["params"]
+    np.testing.assert_array_equal(
+        np.asarray(m.apply({"params": _strip_lora(pl)}, tok, train=False)),
+        np.asarray(ml.apply({"params": pl}, tok, train=False)),
+    )
+    g = jax.grad(lambda p: jnp.mean(
+        ml.apply({"params": p}, tok, train=False) ** 2))(pl)
+    qkv = g["h_0"]["attn"]["qkv"]
+    assert float(np.abs(np.asarray(qkv["kernel"])).max()) == 0.0
+    assert float(np.abs(np.asarray(qkv["bias"])).max()) == 0.0
+
+
+def test_lora_quant_combo_rejected():
+    with pytest.raises(ValueError, match="FINE-TUNING"):
+        MODELS.get("Llama")(**KW, lora_rank=4, quant="w8a16").init(
+            jax.random.key(0), _tok()
+        )
+
+
+# --- end-to-end workflow (slow tier) -----------------------------------------
+
+
+@pytest.fixture(scope="module")
+def base_checkpoint(tmp_path_factory):
+    """One epoch of the debug Llama config = the 'pretrained' base."""
+    from pytorch_distributed_template_tpu.config import (
+        ConfigParser, LOADERS, LOSSES, METRICS, MODELS as _M,
+    )
+    import pytorch_distributed_template_tpu.data  # noqa: F401
+    import pytorch_distributed_template_tpu.engine  # noqa: F401
+    from pytorch_distributed_template_tpu.engine import Trainer
+    from pytorch_distributed_template_tpu.parallel import mesh_from_config
+
+    tmp = tmp_path_factory.mktemp("lora_base")
+    cfg = json.loads((REPO / "configs" / "llama_debug.json").read_text())
+    cfg["trainer"].update(save_dir=str(tmp), epochs=1, tensorboard=False)
+    config = ConfigParser(cfg, run_id="base", training=True)
+    trainer = Trainer(
+        config.init_obj("arch", _M), LOSSES.get(config["loss"]),
+        [METRICS.get(mm) for mm in config["metrics"]], config=config,
+        train_loader=config.init_obj("train_loader", LOADERS),
+        valid_loader=None, mesh=mesh_from_config(config), seed=0,
+    )
+    trainer.train()
+    return config.save_dir / "checkpoint-epoch1", cfg
+
+
+@pytest.mark.slow
+def test_lora_finetune_workflow_end_to_end(base_checkpoint, tmp_path):
+    """Config-driven LoRA fine-tune: warm start from the base checkpoint,
+    train only the adapters, merge via the CLI, sample via the CLI."""
+    from pytorch_distributed_template_tpu.config import (
+        ConfigParser, LOADERS, LOSSES, METRICS, MODELS as _M,
+    )
+    from pytorch_distributed_template_tpu.engine import Trainer
+    from pytorch_distributed_template_tpu.checkpoint import (
+        warm_start_params,
+    )
+    from pytorch_distributed_template_tpu.parallel import mesh_from_config
+
+    ckpt, base_cfg = base_checkpoint
+    cfg = json.loads(json.dumps(base_cfg))  # deep copy
+    cfg["arch"]["args"].update(lora_rank=4)
+    cfg["optimizer"]["args"]["trainable"] = ["lora_"]
+    cfg["trainer"].update(save_dir=str(tmp_path), epochs=1,
+                          init_from=str(ckpt))
+    config = ConfigParser(cfg, run_id="ft", training=True)
+    trainer = Trainer(
+        config.init_obj("arch", _M), LOSSES.get(config["loss"]),
+        [METRICS.get(mm) for mm in config["metrics"]], config=config,
+        train_loader=config.init_obj("train_loader", LOADERS),
+        valid_loader=None, mesh=mesh_from_config(config), seed=1,
+    )
+    # warm start happened: base kernels equal the checkpoint's params
+    warm, restored, skipped = warm_start_params(
+        ckpt, trainer.state.params
+    )
+    frozen_moved, _ = _split_moved(warm, trainer.state.params)
+    assert frozen_moved == 0.0 and len(restored) > 0
+    assert all("lora" in s for s in skipped)
+
+    before = jax.device_get(trainer.state.params)
+    trainer.train()
+    after = jax.device_get(trainer.state.params)
+    frozen_moved, lora_moved = _split_moved(before, after)
+    assert frozen_moved == 0.0, "base weights must stay frozen"
+    assert lora_moved > 0.0, "adapters must train"
+
+    # merge CLI -> params-only artifact -> sampling CLI
+    ft_ckpt = config.save_dir / "checkpoint-epoch1"
+    r = subprocess.run(
+        [sys.executable, str(REPO / "scripts" / "merge_lora.py"),
+         "-r", str(ft_ckpt)],
+        capture_output=True, text=True, timeout=420, cwd=REPO,
+    )
+    assert r.returncode == 0, r.stdout[-2000:] + r.stderr[-2000:]
+    artifact = ft_ckpt.parent / "serving_merged" / "model_merged"
+    served_cfg = json.loads(
+        (artifact.parent / "config.json").read_text()
+    )
+    assert "lora_rank" not in served_cfg["arch"]["args"]
+    r = subprocess.run(
+        [sys.executable, str(REPO / "generate.py"), "-r", str(artifact),
+         "--prompt-ids", "1,2,3", "--max-new-tokens", "4"],
+        capture_output=True, text=True, timeout=420, cwd=REPO,
+    )
+    assert r.returncode == 0, r.stdout[-2000:] + r.stderr[-2000:]
+    ids = [int(x) for x in r.stdout.strip().splitlines()[-1].split(",")]
+    assert len(ids) == 4
