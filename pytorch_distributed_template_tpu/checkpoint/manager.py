@@ -487,14 +487,13 @@ def warm_start_params(resume_path, current_params):
             f"no readable params tree in checkpoint {resume_path}"
         )
 
+    from ..parallel.sharding import path_str
+
     def leaf_paths(tree):
         flat = jax.tree_util.tree_flatten_with_path(
             tree, is_leaf=lambda x: hasattr(x, "shape")
         )[0]
-        return {
-            "/".join(str(getattr(k, "key", k)) for k in path): leaf
-            for path, leaf in flat
-        }
+        return {path_str(path): leaf for path, leaf in flat}
 
     disk_flat = leaf_paths(disk["params"])
     cur_flat = leaf_paths(current_params)
@@ -537,7 +536,7 @@ def warm_start_params(resume_path, current_params):
     restored_flat = leaf_paths(restored)
 
     def graft(path, cur_leaf):
-        name = "/".join(str(getattr(k, "key", k)) for k in path)
+        name = path_str(path)
         return restored_flat[name] if name in matched else cur_leaf
 
     out = jax.tree_util.tree_map_with_path(graft, current_params)

@@ -53,6 +53,28 @@ def sample_logits(key, logits, temperature: float = 1.0, top_k: int = 0,
     return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
 
 
+def fresh_cache(model, params, batch: int, length: int):
+    """Zeroed decode cache for a ``[batch, length]`` budget.
+
+    ``eval_shape`` traces the allocation call without running FLOPs; all
+    cache variables zero-initialize, so a zeros pytree of the resulting
+    shapes/dtypes IS a fresh cache (including int8 rows + scales under
+    ``kv_quant`` — empty slots decode to zeros). The one allocation
+    idiom shared by ``generate``, ``generate_speculative``, and the
+    bench/serving callers.
+    """
+    shapes = jax.eval_shape(
+        lambda p: model.apply(
+            {"params": p}, jnp.zeros((batch, length), jnp.int32),
+            train=False, decode=True, mutable=["cache"],
+        ),
+        params,
+    )
+    return jax.tree.map(
+        lambda s: jnp.zeros(s.shape, s.dtype), shapes[1]["cache"]
+    )
+
+
 def generate(model, params, prompt: jnp.ndarray, max_new_tokens: int,
              temperature: float = 1.0, top_k: int = 0, top_p: float = 0.0,
              rng: Optional[jax.Array] = None) -> jnp.ndarray:
@@ -79,20 +101,7 @@ def generate(model, params, prompt: jnp.ndarray, max_new_tokens: int,
         )
     rng = rng if rng is not None else jax.random.key(0)
 
-    # 1) allocate the [B, total] KV caches from SHAPES only (eval_shape:
-    # no FLOPs run); all cache variables initialize to zeros, so a zeros
-    # pytree of the right shapes/dtypes is exactly the fresh cache
-    shapes = jax.eval_shape(
-        lambda p: model.apply(
-            {"params": p}, jnp.zeros((b, total), jnp.int32),
-            train=False, decode=True, mutable=["cache"],
-        ),
-        params,
-    )
-    cache = jax.tree.map(
-        lambda s: jnp.zeros(s.shape, s.dtype), shapes[1]["cache"]
-    )
-
+    cache = fresh_cache(model, params, b, total)
     prefill, step = _decode_fns(model, float(temperature), int(top_k),
                                 float(top_p))
     last_logits, cache = prefill(params, cache, prompt)
@@ -105,6 +114,183 @@ def generate(model, params, prompt: jnp.ndarray, max_new_tokens: int,
         token, cache = step(params, cache, token, keys[i])
         out.append(token[:, None])
     return jnp.concatenate(out, axis=1)
+
+
+def generate_speculative(model, params, prompt: jnp.ndarray,
+                         max_new_tokens: int, draft_len: int = 4,
+                         ngram: int = 2, return_stats: bool = False):
+    """GREEDY generation via self-speculative (prompt-lookup) decoding.
+
+    Emits BIT-IDENTICAL tokens to ``generate(..., temperature=0)`` —
+    speculation changes the schedule, never the output — but each model
+    call verifies ``draft_len`` guessed tokens at once, so on
+    repetitive continuations (code, structured text) one forward pass
+    commits several tokens. Decode is HBM-bound (a 1-token step and a
+    5-token step stream the same weight bytes), which is exactly why
+    accepted drafts are almost-free throughput.
+
+    The drafter is n-gram prompt lookup (no second model): find the
+    most recent earlier occurrence of the trailing ``ngram`` tokens in
+    the sequence so far and propose the ``draft_len`` tokens that
+    followed it. Each loop iteration feeds ``[last_token, d_1..d_D]``,
+    takes the target model's greedy predictions ``p_1..p_{D+1}``, and
+    commits ``p_1..p_{na+1}`` where ``na`` is the longest matching
+    draft prefix — at least one real token per iteration, like vanilla
+    decode, plus up to ``draft_len`` free ones.
+
+    Speculation REWINDS the KV cache after rejection by resetting the
+    model-level ``pos_index`` counter: rejected rows stay in the cache
+    but are invisible (the visibility mask hides positions beyond the
+    counter) and are overwritten by the next chunk's DUS write at the
+    same positions. This is only sound for the NON-ROLLING cache — a
+    rolling window (Mistral-style ring buffer) evicts on write, which
+    cannot be undone — so models must satisfy ``window == 0`` or
+    ``window > prompt + budget``.
+
+    Restrictions (asserted): batch 1 (the cache keeps ONE position
+    counter; divergent per-row acceptance would need per-row
+    counters), greedy only (sampled speculative decoding needs
+    rejection resampling — not implemented), ``prompt >= ngram``.
+    """
+    prompt = jnp.asarray(prompt, jnp.int32)
+    b, t0 = prompt.shape
+    if b != 1:
+        raise ValueError("speculative decoding supports batch size 1 "
+                         f"(got {b}) — the KV cache keeps one position "
+                         "counter")
+    if t0 < ngram:
+        raise ValueError(f"prompt length {t0} < ngram {ngram}")
+    max_new_tokens = int(max_new_tokens)
+    D, g = int(draft_len), int(ngram)
+    if D < 1:
+        raise ValueError("draft_len must be >= 1")
+    if max_new_tokens <= 0:
+        return (prompt, {}) if return_stats else prompt
+    # verify calls per device dispatch, shrunk to fit the model: the
+    # buffer needs slack for a full final chunk running past the target
+    # (the scan body is unconditional — see _spec_chunk on why), each
+    # iteration writing up to D+1 predictions
+    room = int(model.max_len) - (t0 + max_new_tokens + 2)
+    K = min(32, max_new_tokens, room // (D + 1))
+    if K < 1:
+        raise ValueError(
+            f"prompt + max_new_tokens + draft slack = "
+            f"{t0 + max_new_tokens + 2 + D + 1} exceeds model.max_len "
+            f"= {model.max_len}"
+        )
+    L = t0 + max_new_tokens + K * (D + 1) + 2
+    window = int(getattr(model, "window", 0) or 0)
+    if 0 < window <= L:
+        raise ValueError(
+            f"speculative decoding needs a non-rolling cache: window "
+            f"{window} <= prompt + budget + slack {L} would evict rows "
+            "that rejection must rewind"
+        )
+
+    cache = fresh_cache(model, params, 1, L)
+    prefill, _ = _decode_fns(model, 0.0, 0, 0.0)
+    last_logits, cache = prefill(params, cache, prompt)
+    token0 = jnp.argmax(last_logits, axis=-1).astype(jnp.int32)   # [1]
+
+    toks = jnp.zeros((L,), jnp.int32)
+    toks = jax.lax.dynamic_update_slice(toks, prompt[0], (0,))
+    toks = jax.lax.dynamic_update_slice(toks, token0, (t0,))
+    # n = committed tokens in the buffer; the token at n-1 is committed
+    # but not yet in the KV cache (invariant: cache pos_index == n - 1)
+    n = jnp.int32(t0 + 1)
+    iters = jnp.int32(0)
+
+    run_chunk = _spec_chunk(model, L, D, g, K)
+    # host loop over device chunks: one scalar readback of the commit
+    # count per K verify calls decides whether another chunk is needed
+    while int(n) - t0 - 1 < max_new_tokens:
+        toks, n, iters, cache = run_chunk(params, cache, toks, n, iters)
+
+    out = toks[None, : t0 + max_new_tokens]
+    if return_stats:
+        stats = {
+            "model_calls": int(iters),
+            "tokens_emitted": max_new_tokens,
+            "tokens_per_call": round(
+                float(int(n) - t0 - 1) / max(int(iters), 1), 3
+            ),
+        }
+        return out, stats
+    return out
+
+
+@functools.lru_cache(maxsize=32)
+def _spec_chunk(model, L: int, D: int, g: int, K: int):
+    """Compiled K-iteration speculative chunk: each ``lax.scan``
+    iteration drafts by n-gram lookup, verifies with one ``D+1``-token
+    model call, commits the accepted prefix, and rewinds ``pos_index``.
+
+    A plain unconditional scan — NOT ``lax.while_loop`` and NOT a
+    ``lax.cond``-guarded body — because on the current TPU toolchain
+    both alternatives flip this program onto a ~16x-slower XLA schedule
+    (measured: the identical verify-call body runs 1.3 ms/call as a
+    bare scan vs 21-30 ms under while/cond — the same cliff family
+    BASELINE.md documents for prefill). The caller loops over chunks on
+    the host instead, so iterations past the token budget are wasted
+    work (bounded by one chunk), not wrong results.
+
+    Known residual anomaly (same family, measured round 3): adding the
+    token-buffer ``dynamic_update_slice`` to the scan body — a 2.6 KB
+    int32 write — re-flips the schedule to ~11 ms/call on this tunnel
+    even though the verify call alone runs 1.3 ms. A chunk-frozen
+    buffer variant avoids the write but loses the within-chunk history
+    the drafter needs (acceptance fell 2.8 -> 1.2 tokens/call), so the
+    fresh-draft form is kept and the platform gap is reported honestly
+    in the bench rung."""
+    from jax import lax
+
+    @jax.jit
+    def run_chunk(params, cache, toks, n, iters):
+        starts = jnp.arange(L - g + 1)
+
+        def body(carry, _):
+            toks, n, iters, cur_cache = carry
+            # --- draft: latest earlier occurrence of the trailing g-gram
+            # (g static shift-compares, not a [L, g] gather — the gather
+            # form measured ~35% slower on the current toolchain)
+            key = lax.dynamic_slice(toks, (n - g,), (g,))
+            match = jnp.ones((L - g + 1,), bool)
+            for j in range(g):
+                match = match & (toks[j: L - g + 1 + j] == key[j])
+            # continuation must lie in committed history, and the match
+            # at i = n-g is the key itself — exclude it
+            valid = (starts + g) < n
+            cand = jnp.where(match & valid, starts, -1)
+            i = jnp.max(cand)
+            cont = jnp.where(i >= 0, i + g, n - 1)
+            draft = lax.dynamic_slice(toks, (cont,), (D,))
+
+            # --- verify: one chunked decode call on [last, d_1..d_D]
+            chunk = lax.dynamic_slice(toks, (n - 1,), (1,))
+            chunk = jnp.concatenate([chunk, draft])[None, :]  # [1, D+1]
+            logits, vs = model.apply(
+                {"params": params, "cache": cur_cache}, chunk,
+                train=False, decode=True, mutable=["cache"],
+            )
+            preds = jnp.argmax(logits[0], axis=-1).astype(jnp.int32)
+            na = jnp.sum(jnp.cumprod(
+                (draft == preds[:D]).astype(jnp.int32)
+            ))
+            # committed tokens this round: preds[0..na] (the accepted
+            # draft prefix equals the predictions, plus one fresh token);
+            # stale buffer/cache rows beyond the commit point are
+            # invisible (pos_index rewind) and overwritten next round
+            toks = lax.dynamic_update_slice(toks, preds, (n,))
+            new_cache = dict(vs["cache"])
+            new_cache["pos_index"] = n + na
+            return (toks, n + na + 1, iters + 1, new_cache), None
+
+        (toks, n, iters, cache), _ = lax.scan(
+            body, (toks, n, iters, cache), None, length=K
+        )
+        return toks, n, iters, cache
+
+    return run_chunk
 
 
 @functools.lru_cache(maxsize=32)

@@ -53,12 +53,13 @@ def _decay_mask(exclude):
     """
     if not exclude:
         return None
+    from ..parallel.sharding import path_str
+
     pats = [re.compile(p) for p in exclude]
 
     def mask(params):
         def decide(path, _):
-            name = "/".join(str(getattr(k, "key", k)) for k in path)
-            return not any(p.search(name) for p in pats)
+            return not any(p.search(path_str(path)) for p in pats)
 
         return jax.tree_util.tree_map_with_path(decide, params)
 
@@ -79,12 +80,13 @@ def _trainable_only(tx, patterns):
     ``stop_gradient`` (which prunes the frozen dW matmuls from the
     backward); this switch alone also freezes non-LoRA leaves like
     embeddings and norms."""
+    from ..parallel.sharding import path_str
+
     pats = [re.compile(p) for p in patterns]
 
     def labels(params):
         def decide(path, _):
-            name = "/".join(str(getattr(k, "key", k)) for k in path)
-            return "train" if any(p.search(name) for p in pats) \
+            return "train" if any(p.search(path_str(path)) for p in pats) \
                 else "freeze"
 
         return jax.tree_util.tree_map_with_path(decide, params)

@@ -238,11 +238,12 @@ def make_train_step(model, tx, criterion: Callable,
             # trace time), so the zeroed branches fold away.
             import re as _re
 
+            from ..parallel.sharding import path_str
+
             pats = [_re.compile(p) for p in trainable_patterns]
 
             def _freeze(path, g):
-                name = "/".join(str(getattr(kk, "key", kk)) for kk in path)
-                if any(p.search(name) for p in pats):
+                if any(p.search(path_str(path)) for p in pats):
                     return g
                 return jnp.zeros_like(g)
 
