@@ -457,6 +457,67 @@ def byte_lm_loader(data_dir: str = "data/", batch_size: int = 8,
                               seed=seed)
 
 
+@LOADERS.register("BpeLMLoader")
+def bpe_lm_loader(data_dir: str = "data/", batch_size: int = 8,
+                  shuffle: bool = True, num_workers: int = 0,
+                  training: bool = True, file: str = "input.txt",
+                  seq_len: int = 256, vocab_size: int = 1024,
+                  val_fraction: float = 0.1, seed: int = 0):
+    """Subword LM over any local text file: a byte-level BPE tokenizer
+    (data/tokenizer.py) is trained ONCE per (corpus, vocab_size) and
+    cached next to the file, along with the tokenized id stream, so
+    repeat runs skip straight to chunking. The real-vocab counterpart
+    of ``ByteLMLoader`` — same tail train/val split, same synthetic
+    fallback when the corpus is absent. ``generate.py`` recovers the
+    cached tokenizer through the run config to round-trip ``--prompt``
+    text (data/tokenizer.tokenizer_from_config).
+    """
+    del num_workers
+    from .tokenizer import BpeTokenizer, bpe_cache_path
+
+    path = Path(data_dir) / file
+    if not path.exists():
+        logger.warning(
+            "BpeLMLoader: %s not found; using synthetic LM data.", path
+        )
+        data = synthetic_lm(n=2048, seq_len=seq_len,
+                            vocab_size=vocab_size, seed=seed,
+                            training=training)
+        return _make_image_loader(data, batch_size, shuffle, seed=seed)
+    tok_path = bpe_cache_path(data_dir, file, vocab_size)
+    ids_path = Path(data_dir) / f"{file}.bpe{vocab_size}.npy"
+    src_mtime = path.stat().st_mtime
+    if tok_path.exists() and tok_path.stat().st_mtime >= src_mtime:
+        tok = BpeTokenizer.load(tok_path)
+    else:
+        logger.info("BpeLMLoader: training %d-vocab BPE on %s ...",
+                    vocab_size, path)
+        tok = BpeTokenizer.train_from_file(path, vocab_size)
+        tok.save(tok_path)
+    if not (ids_path.exists()
+            and ids_path.stat().st_mtime >= tok_path.stat().st_mtime):
+        logger.info("BpeLMLoader: tokenizing %s ...", path)
+        # memmapped chunked encode: bounded memory on multi-GB corpora
+        # (same beyond-RAM contract as ByteLMLoader's uint8 memmap)
+        ids = tok.encode_file(path)
+        dtype = np.uint16 if tok.vocab_size <= 65536 else np.int32
+        np.save(ids_path, ids.astype(dtype))
+    ids = np.load(ids_path, mmap_mode="r")
+    split = int(len(ids) * (1.0 - val_fraction))
+    part = ids[:split] if training else ids[split:]
+    n_chunks = len(part) // seq_len
+    if n_chunks == 0:
+        raise ValueError(
+            f"BpeLMLoader: {path} too small for one {seq_len}-token "
+            f"{'train' if training else 'val'} sequence"
+        )
+    tokens = np.asarray(part[: n_chunks * seq_len]).reshape(
+        n_chunks, seq_len
+    )
+    return _make_image_loader({"tokens": tokens}, batch_size, shuffle,
+                              seed=seed)
+
+
 @LOADERS.register("SyntheticLMLoader")
 def lm_loader(data_dir: str = "data/", batch_size: int = 8,
               shuffle: bool = True, num_workers: int = 0,

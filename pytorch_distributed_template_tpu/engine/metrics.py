@@ -74,3 +74,19 @@ def lm_bits_per_byte(output, target):
     if isinstance(output, tuple):
         return fused_lm_cross_entropy(chunk=256)(output, target) / ln2
     return lm_cross_entropy(output, target) / ln2
+
+
+@METRICS.register("lm_nll")
+def lm_nll(output, target):
+    """Per-example next-token negative log likelihood in NATS/token —
+    the subword-vocab counterpart of ``lm_bits_per_byte`` (whose
+    per-BYTE interpretation only holds at vocab 256). Reported as NLL
+    rather than perplexity because mean-of-per-example-perplexities is
+    not corpus perplexity; ``ppl = exp(lm_nll)`` is the right reading
+    of the aggregated value. Same plain/[B,T,V]-or-fused dispatch as
+    the other LM metrics, delegated to the loss implementations."""
+    from .losses import fused_lm_cross_entropy, lm_cross_entropy
+
+    if isinstance(output, tuple):
+        return fused_lm_cross_entropy(chunk=256)(output, target)
+    return lm_cross_entropy(output, target)

@@ -1,0 +1,54 @@
+"""Train a byte-level BPE tokenizer from a local text file.
+
+Standalone front-end for data/tokenizer.py (``BpeLMLoader`` does this
+implicitly and caches the result; use this to inspect or pre-build):
+
+    python scripts/train_tokenizer.py corpus.txt --vocab-size 2048 \
+        -o corpus.bpe2048.json
+    python scripts/train_tokenizer.py corpus.txt --encode "some text"
+"""
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from pytorch_distributed_template_tpu.data.tokenizer import (  # noqa: E402
+    BpeTokenizer,
+)
+
+
+def main() -> None:
+    p = argparse.ArgumentParser(description="Train a byte-level BPE "
+                                            "tokenizer")
+    p.add_argument("corpus", type=Path, help="Text file to train on.")
+    p.add_argument("--vocab-size", type=int, default=1024)
+    p.add_argument("-o", "--output", type=Path, default=None,
+                   help="Tokenizer JSON (default: "
+                        "<corpus>.bpe<vocab>.json).")
+    p.add_argument("--encode", type=str, default=None,
+                   help="After training, print this text's ids and their "
+                        "round-trip.")
+    args = p.parse_args()
+
+    data = args.corpus.read_bytes()
+    tok = BpeTokenizer.train(data, args.vocab_size)
+    out = args.output or args.corpus.with_name(
+        f"{args.corpus.name}.bpe{args.vocab_size}.json"
+    )
+    tok.save(out)
+    sample = tok.encode(data[:65536])
+    print(f"{out}: {tok.vocab_size} tokens "
+          f"({len(tok.merges)} merges), "
+          f"{len(data[:65536]) / max(len(sample), 1):.2f} bytes/token on "
+          "the corpus head")
+    if args.encode is not None:
+        ids = tok.encode(args.encode)
+        print("ids  :", ",".join(str(int(i)) for i in ids))
+        print("text :", tok.decode(ids))
+
+
+if __name__ == "__main__":
+    main()
