@@ -22,6 +22,8 @@ memory-resident and prefetch is async DMA).
 from __future__ import annotations
 
 import logging
+import os
+import time
 from pathlib import Path
 from typing import Optional
 
@@ -487,21 +489,40 @@ def bpe_lm_loader(data_dir: str = "data/", batch_size: int = 8,
     tok_path = bpe_cache_path(data_dir, file, vocab_size)
     ids_path = Path(data_dir) / f"{file}.bpe{vocab_size}.npy"
     src_mtime = path.stat().st_mtime
-    if tok_path.exists() and tok_path.stat().st_mtime >= src_mtime:
-        tok = BpeTokenizer.load(tok_path)
-    else:
-        logger.info("BpeLMLoader: training %d-vocab BPE on %s ...",
-                    vocab_size, path)
-        tok = BpeTokenizer.train_from_file(path, vocab_size)
-        tok.save(tok_path)
-    if not (ids_path.exists()
-            and ids_path.stat().st_mtime >= tok_path.stat().st_mtime):
-        logger.info("BpeLMLoader: tokenizing %s ...", path)
-        # memmapped chunked encode: bounded memory on multi-GB corpora
-        # (same beyond-RAM contract as ByteLMLoader's uint8 memmap)
-        ids = tok.encode_file(path)
-        dtype = np.uint16 if tok.vocab_size <= 65536 else np.int32
-        np.save(ids_path, ids.astype(dtype))
+
+    def caches_fresh():
+        return (tok_path.exists() and ids_path.exists()
+                and tok_path.stat().st_mtime >= src_mtime
+                and ids_path.stat().st_mtime >= tok_path.stat().st_mtime)
+
+    if not caches_fresh():
+        if dist.is_main_process():
+            # one builder; writes are atomic (tmp + os.replace), so the
+            # waiters below never read a partial file
+            logger.info("BpeLMLoader: training %d-vocab BPE on %s ...",
+                        vocab_size, path)
+            tok = BpeTokenizer.train_from_file(path, vocab_size)
+            tok.save(tok_path)
+            logger.info("BpeLMLoader: tokenizing %s ...", path)
+            # memmapped chunked encode: bounded memory on multi-GB
+            # corpora (ByteLMLoader's beyond-RAM contract)
+            ids = tok.encode_file(path)
+            dtype = np.uint16 if tok.vocab_size <= 65536 else np.int32
+            tmp = ids_path.with_name(ids_path.name + f".tmp{os.getpid()}")
+            with open(tmp, "wb") as f:  # file handle: no .npy suffixing
+                np.save(f, ids.astype(dtype))
+            os.replace(tmp, ids_path)
+        else:
+            # non-zero hosts wait for host 0's atomic writes to land
+            deadline = time.time() + 1800
+            while not caches_fresh():
+                if time.time() > deadline:
+                    raise TimeoutError(
+                        f"BpeLMLoader: timed out waiting for host 0 to "
+                        f"build {tok_path} / {ids_path}"
+                    )
+                time.sleep(2.0)
+    tok = BpeTokenizer.load(tok_path)
     ids = np.load(ids_path, mmap_mode="r")
     split = int(len(ids) * (1.0 - val_fraction))
     part = ids[:split] if training else ids[split:]

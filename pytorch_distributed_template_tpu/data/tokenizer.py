@@ -35,6 +35,20 @@ import numpy as np
 logger = logging.getLogger(__name__)
 
 
+def _sample_bytes(source, max_train_bytes: int) -> bytes:
+    """<= ``max_train_bytes`` of evenly-spaced slices from a sliceable
+    byte source (bytes or a uint8 memmap) — the whole file's
+    distribution, not just its head, without materializing it."""
+    if len(source) <= max_train_bytes:
+        return bytes(source[:])
+    k = 16
+    step = len(source) // k
+    take = max_train_bytes // k
+    return b"".join(
+        bytes(source[i * step: i * step + take]) for i in range(k)
+    )
+
+
 def _pair_counts(ids: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
     """Packed (a, b) adjacent-pair keys and their counts."""
     key = ids[:-1].astype(np.int64) << 21 | ids[1:].astype(np.int64)
@@ -107,13 +121,7 @@ class BpeTokenizer:
         if vocab_size < 256:
             raise ValueError(f"vocab_size {vocab_size} < 256 (the byte "
                              "alphabet is the floor)")
-        if len(data) > max_train_bytes:
-            k = 16
-            step = len(data) // k
-            take = max_train_bytes // k
-            data = b"".join(
-                data[i * step: i * step + take] for i in range(k)
-            )
+        data = _sample_bytes(data, max_train_bytes)
         ids = np.frombuffer(data, dtype=np.uint8).astype(np.int32)
         lens = [1] * 256                   # id -> token byte length
         merges: list[tuple[int, int]] = []
@@ -179,16 +187,7 @@ class BpeTokenizer:
         memmap slices, so a multi-GB corpus touches only the sampled
         pages (same beyond-RAM contract as ByteLMLoader)."""
         raw = np.memmap(Path(path), dtype=np.uint8, mode="r")
-        if len(raw) <= max_train_bytes:
-            sample = raw[:].tobytes()
-        else:
-            k = 16
-            step = len(raw) // k
-            take = max_train_bytes // k
-            sample = b"".join(
-                raw[i * step: i * step + take].tobytes() for i in range(k)
-            )
-        return cls.train(sample, vocab_size,
+        return cls.train(_sample_bytes(raw, max_train_bytes), vocab_size,
                          max_train_bytes=max_train_bytes,
                          max_token_bytes=max_token_bytes)
 
@@ -208,10 +207,17 @@ class BpeTokenizer:
     # -- persistence ---------------------------------------------------------
 
     def save(self, path) -> None:
-        Path(path).write_text(json.dumps({
+        # atomic (tmp + rename): concurrent readers — other hosts of a
+        # multi-process run — never see a partial file
+        import os
+
+        path = Path(path)
+        tmp = path.with_name(path.name + f".tmp{os.getpid()}")
+        tmp.write_text(json.dumps({
             "format": "bpe-bytelevel-v1",
             "merges": [list(m) for m in self.merges],
         }))
+        os.replace(tmp, path)
 
     @classmethod
     def load(cls, path) -> "BpeTokenizer":

@@ -33,16 +33,21 @@ def main() -> None:
                         "round-trip.")
     args = p.parse_args()
 
-    data = args.corpus.read_bytes()
-    tok = BpeTokenizer.train(data, args.vocab_size)
+    import numpy as np
+
+    # memmapped train: a multi-GB corpus touches only the sampled pages
+    tok = BpeTokenizer.train_from_file(args.corpus, args.vocab_size)
     out = args.output or args.corpus.with_name(
         f"{args.corpus.name}.bpe{args.vocab_size}.json"
     )
     tok.save(out)
-    sample = tok.encode(data[:65536])
+    head = bytes(
+        np.memmap(args.corpus, dtype=np.uint8, mode="r")[:65536]
+    )
+    sample = tok.encode(head)
     print(f"{out}: {tok.vocab_size} tokens "
           f"({len(tok.merges)} merges), "
-          f"{len(data[:65536]) / max(len(sample), 1):.2f} bytes/token on "
+          f"{len(head) / max(len(sample), 1):.2f} bytes/token on "
           "the corpus head")
     if args.encode is not None:
         ids = tok.encode(args.encode)
