@@ -1,0 +1,190 @@
+"""Shared serving-side loading: checkpoint/artifact -> (model, params).
+
+The one place that knows how to turn ``config.resume`` into something
+``generate()`` can run, used by both front-ends (the one-shot
+``generate.py`` CLI and the ``serve.py`` HTTP server):
+
+- a TRAINING checkpoint restores through the full TrainState template
+  (optimizer slots and all — engine/evaluator.restore_template_state),
+  honoring ``use_ema``;
+- a params-only SERVING artifact (scripts/quantize_checkpoint.py,
+  scripts/merge_lora.py) restores just the param tree, sharded over the
+  mesh per the model's partition rules (multi-host-legal);
+- the run's BPE tokenizer, when the experiment trained through
+  ``BpeLMLoader``, rides along for text round-tripping.
+"""
+from __future__ import annotations
+
+import logging
+
+import jax
+
+from ..checkpoint import load_serving_meta, restore_serving_params
+from ..config.registry import MODELS
+from ..data.tokenizer import tokenizer_from_config
+from ..models.base import inject_mesh
+from ..parallel import apply_rules, dist, mesh_from_config
+from .evaluator import restore_template_state
+
+logger = logging.getLogger(__name__)
+
+
+class GenerationService:
+    """The request-level generation entry shared by BOTH front-ends
+    (generate.py one-shot CLI, serve.py HTTP server): prompt encoding +
+    validation, speculative-vs-sampled dispatch, and text/ids decoding
+    live HERE once — a fix in one front-end cannot miss the other.
+
+    ``generate`` is serialized with a lock: one chip, one compiled
+    decode path (harmless for the one-shot CLI, load-bearing for the
+    threaded HTTP server).
+    """
+
+    def __init__(self, config, use_ema: bool = False):
+        import threading
+
+        self.model, self.params, self.tokenizer = load_generation_stack(
+            config, use_ema=use_ema
+        )
+        self.vocab = int(getattr(self.model, "vocab_size", 0))
+        self.arch = type(self.model).__name__
+        self._lock = threading.Lock()
+
+    def encode_prompt(self, prompt=None, prompt_ids=None) -> list:
+        """Text or explicit ids -> validated id list (raises ValueError
+        with a caller-presentable message on every bad input)."""
+        if prompt_ids is not None:
+            ids = [int(i) for i in prompt_ids]
+            if self.vocab and any(i >= self.vocab or i < 0 for i in ids):
+                raise ValueError(
+                    f"prompt id outside [0, {self.vocab}) — nn.Embed "
+                    "would silently clamp/wrap it"
+                )
+        elif prompt is None:
+            raise ValueError("pass a prompt or prompt ids")
+        elif self.vocab <= 256:
+            ids = list(str(prompt).encode("utf-8"))
+            if any(i >= self.vocab for i in ids):
+                raise ValueError(f"prompt byte >= vocab_size {self.vocab}")
+        else:
+            if self.tokenizer is None:
+                raise ValueError(
+                    f"vocab_size {self.vocab} > 256 and no BpeLMLoader "
+                    "tokenizer found in the run config: pass prompt ids, "
+                    "or train through BpeLMLoader for text round-tripping"
+                )
+            ids = [int(i) for i in self.tokenizer.encode(str(prompt))]
+            if any(i >= self.vocab for i in ids):
+                raise ValueError(
+                    f"tokenizer id >= model vocab_size {self.vocab} — "
+                    "the checkpoint and tokenizer disagree"
+                )
+        if not ids:
+            raise ValueError("empty prompt (need at least one token)")
+        return ids
+
+    def decode_text(self, ids):
+        """Generated ids -> text, when the model has a text form
+        (byte vocab or a recovered tokenizer); else None."""
+        import numpy as np
+
+        ids = np.asarray(ids).reshape(-1)
+        if self.vocab and self.vocab <= 256:
+            return bytes(int(t) for t in ids).decode(
+                "utf-8", errors="replace"
+            )
+        if self.tokenizer is not None:
+            # replace (not raise) on ids past the learned vocab: BPE
+            # training can stop short of the configured head size, and
+            # an undertrained model may emit those ids
+            return self.tokenizer.decode(ids, errors="replace")
+        return None
+
+    def generate(self, prompt=None, prompt_ids=None,
+                 max_new_tokens: int = 64, temperature: float = 0.0,
+                 top_k: int = 0, top_p: float = 0.0, seed: int = 0,
+                 speculative: int = 0) -> dict:
+        """One validated generation request ->
+        ``{"ids", "text"?, "speculative"?}``."""
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        from .generate import generate, generate_speculative
+
+        if speculative > 0 and temperature > 0:
+            raise ValueError(
+                "speculative generation is greedy-exact; drop "
+                "temperature (sampled speculative decoding is not "
+                "implemented)"
+            )
+        ids = self.encode_prompt(prompt, prompt_ids)
+        arr = jnp.asarray(np.asarray(ids, np.int32)[None, :])
+        with self._lock:
+            stats = None
+            if speculative > 0:
+                out, stats = generate_speculative(
+                    self.model, self.params, arr,
+                    max_new_tokens=int(max_new_tokens),
+                    draft_len=int(speculative), return_stats=True,
+                )
+            else:
+                out = generate(
+                    self.model, self.params, arr,
+                    max_new_tokens=int(max_new_tokens),
+                    temperature=float(temperature), top_k=int(top_k),
+                    top_p=float(top_p), rng=jax.random.key(int(seed)),
+                )
+        new = np.asarray(out[0, arr.shape[1]:])
+        resp: dict = {"ids": [int(t) for t in new]}
+        text = self.decode_text(new)
+        if text is not None:
+            resp["text"] = text
+        if stats is not None:
+            resp["speculative"] = stats
+        return resp
+
+
+def load_generation_stack(config, use_ema: bool = False):
+    """``(model, params, tokenizer | None)`` for ``config.resume``."""
+    assert config.resume is not None, "generation requires a checkpoint (-r)"
+    dist.initialize()  # multi-host rendezvous parity with train.py/test.py
+    mesh = mesh_from_config(config)
+    model = inject_mesh(config.init_obj("arch", MODELS), mesh)
+    if not hasattr(model, "max_len"):
+        raise SystemExit(
+            f"arch {type(model).__name__} has no decode support"
+        )
+
+    serving_meta = load_serving_meta(config.resume)
+    if serving_meta is not None:
+        # Params-only serving artifact: the artifact's config.json
+        # already carries the serving arch args, so the model above IS
+        # the serving model — restore its param tree directly; there is
+        # no TrainState (and --ema is moot: the weight choice was baked
+        # in at artifact-production time).
+        if use_ema:
+            logger.warning(
+                "--ema ignored: %s is a params-only serving artifact "
+                "(quantized/merged from %s)", config.resume,
+                serving_meta.get("source_params", "params"),
+            )
+        template = jax.eval_shape(
+            lambda: model.init(jax.random.key(0), model.batch_template(1))
+        )["params"]
+        # Restore sharded over the mesh per the model's partition rules
+        # (the quant tree's kernel_q leaves match the same `/kernel`
+        # rule patterns; scale vectors replicate). A host-local restore
+        # + device_put would break on multi-host meshes.
+        rules = (model.partition_rules()
+                 if hasattr(model, "partition_rules") else [])
+        params = restore_serving_params(
+            config.resume, template, apply_rules(template, mesh, rules)
+        )
+    else:
+        state, _ = restore_template_state(config, model, mesh)
+        params = (
+            state.ema_params
+            if use_ema and state.ema_params is not None else state.params
+        )
+    return model, params, tokenizer_from_config(config)

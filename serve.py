@@ -1,0 +1,131 @@
+"""Minimal HTTP serving front-end: load once, generate per request.
+
+Completes the serving story at the network boundary (the reference has
+no inference path at all, /root/reference/test.py is batch eval): the
+same checkpoint-or-artifact loading as ``generate.py``
+(engine/serving.load_generation_stack — training checkpoints, w8a16 /
+merged-LoRA params-only artifacts, recovered BPE tokenizer), wrapped
+in a stdlib ``ThreadingHTTPServer``. No web framework, no deps.
+
+    python serve.py -r saved/<lm>/train/<run>/model_best --port 8000
+
+    GET  /healthz             -> {"status": "ok", "arch": ..., ...}
+    POST /generate            body: {"prompt": "text"} or
+                              {"prompt_ids": [1, 2, 3]}, optional
+                              max_new_tokens / temperature / top_k /
+                              top_p / seed / speculative
+                              -> {"text": ...} and/or {"ids": [...]}
+
+Generation is serialized with a lock (one chip, one compiled decode
+path); concurrent requests queue. The first request per
+(sampling-config, prompt-length bucket) pays the XLA compile; later
+ones reuse the cached executables (engine/generate._decode_fns).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+if os.environ.get("JAX_PLATFORMS"):
+    # Same platform-override dance as train.py/generate.py.
+    import jax
+
+    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+from pytorch_distributed_template_tpu.config import ConfigParser  # noqa: E402
+import pytorch_distributed_template_tpu.data  # noqa: F401,E402
+import pytorch_distributed_template_tpu.engine  # noqa: F401,E402
+import pytorch_distributed_template_tpu.models  # noqa: F401,E402
+from pytorch_distributed_template_tpu.engine.serving import (  # noqa: E402
+    GenerationService,
+)
+
+
+def _run_request(service: GenerationService, req: dict) -> dict:
+    """JSON request body -> GenerationService.generate kwargs. All
+    encoding/validation/dispatch logic lives in the service (shared
+    with generate.py); this only maps the wire format."""
+    return service.generate(
+        prompt=req.get("prompt"),
+        prompt_ids=req.get("prompt_ids"),
+        max_new_tokens=int(req.get("max_new_tokens", 64)),
+        temperature=float(req.get("temperature", 0.0)),
+        top_k=int(req.get("top_k", 0)),
+        top_p=float(req.get("top_p", 0.0)),
+        seed=int(req.get("seed", 0)),
+        speculative=int(req.get("speculative", 0)),
+    )
+
+
+def make_handler(service: GenerationService):
+    class Handler(BaseHTTPRequestHandler):
+        def _send(self, code: int, payload: dict) -> None:
+            body = json.dumps(payload).encode("utf-8")
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):  # noqa: N802 (http.server API)
+            if self.path != "/healthz":
+                return self._send(404, {"error": "unknown path"})
+            self._send(200, {
+                "status": "ok",
+                "arch": service.arch,
+                "vocab_size": service.vocab,
+                "tokenizer": service.tokenizer is not None,
+            })
+
+        def do_POST(self):  # noqa: N802
+            if self.path != "/generate":
+                return self._send(404, {"error": "unknown path"})
+            try:
+                n = int(self.headers.get("Content-Length", 0))
+                req = json.loads(self.rfile.read(n) or b"{}")
+                self._send(200, _run_request(service, req))
+            except ValueError as e:
+                self._send(400, {"error": str(e)})
+            except Exception as e:  # surface, don't kill the server
+                self._send(500, {"error": f"{type(e).__name__}: {e}"})
+
+        def log_message(self, fmt, *fmt_args):
+            pass  # suppress http.server's noisy per-request stderr lines
+
+    return Handler
+
+
+def main(args, config):
+    logger = config.get_logger("serve")
+    service = GenerationService(config, use_ema=args.ema)
+    server = ThreadingHTTPServer(
+        (args.host, args.port), make_handler(service)
+    )
+    logger.info(
+        "serving %s (vocab %d%s) on http://%s:%d — POST /generate, "
+        "GET /healthz", service.arch, service.vocab,
+        ", tokenizer" if service.tokenizer else "",
+        args.host, server.server_address[1],
+    )
+    print(f"READY http://{args.host}:{server.server_address[1]}",
+          flush=True)
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        pass
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser(description="LM HTTP serving CLI")
+    parser.add_argument("-c", "--config", default=None, type=str)
+    parser.add_argument("-r", "--resume", required=True, type=str,
+                        help="Checkpoint or serving artifact to serve.")
+    parser.add_argument("-s", "--save_dir", default=None, type=str)
+    parser.add_argument("--host", default="127.0.0.1", type=str)
+    parser.add_argument("--port", default=8000, type=int,
+                        help="0 picks a free port (printed on READY).")
+    parser.add_argument("--ema", action="store_true")
+    args, config = ConfigParser.from_args(parser, (), training=False)
+    main(args, config)

@@ -46,6 +46,7 @@ SLOW_FILES = {
     "test_moe.py",
     "test_multihost.py",
     "test_pipeline.py",
+    "test_serve.py",
     "test_transformer.py",
 }
 

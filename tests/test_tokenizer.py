@@ -129,3 +129,21 @@ def test_bpe_loader_synthetic_fallback(tmp_path):
     batch = next(iter(loader))
     assert batch["tokens"].shape == (4, 16)
     assert int(batch["tokens"].max()) < 300
+
+
+def test_roundtrip_property_fuzz():
+    """Property: decode(encode(x)) == x for ARBITRARY byte strings — the
+    no-<unk> guarantee under fuzzing (hypothesis)."""
+    from hypothesis import given, settings
+    from hypothesis import strategies as st
+
+    tok = BpeTokenizer.train(CORPUS, 384)
+
+    @settings(max_examples=200, deadline=None)
+    @given(st.binary(min_size=0, max_size=256))
+    def roundtrip(data):
+        ids = tok.encode(data)
+        out = b"".join(tok.vocab[int(i)] for i in ids)
+        assert out == data
+
+    roundtrip()
