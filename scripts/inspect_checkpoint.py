@@ -1,0 +1,136 @@
+"""Inspect a checkpoint or serving artifact without loading any arrays.
+
+Answers the "what is this directory?" questions from orbax tree
+METADATA plus the `.meta.json` sidecar — no device, no array reads, so
+it works on multi-GB checkpoints instantly:
+
+    python scripts/inspect_checkpoint.py saved/<run>/model_best
+    python scripts/inspect_checkpoint.py <...>/serving_w8a16/model_w8a16
+
+Reports: kind (training checkpoint vs params-only serving artifact),
+arch/epoch/monitor from the sidecar, per-collection parameter counts
+and bytes by dtype, detected modes (w8a16 kernels, LoRA adapters, EMA
+shadow, int8 KV quant is serving-time so not stored), and the largest
+tensors.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import numpy as np  # noqa: E402
+
+from pytorch_distributed_template_tpu.checkpoint.manager import (  # noqa: E402
+    CheckpointManager,
+)
+from pytorch_distributed_template_tpu.parallel.sharding import (  # noqa: E402
+    path_str,
+)
+
+
+def _leaves(tree):
+    import jax
+
+    flat = jax.tree_util.tree_flatten_with_path(
+        tree, is_leaf=lambda x: hasattr(x, "shape")
+    )[0]
+    return [(path_str(p), m) for p, m in flat if hasattr(m, "shape")]
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(
+        description="Inspect a checkpoint/serving artifact (metadata only)"
+    )
+    ap.add_argument("path", type=Path)
+    ap.add_argument("--top", type=int, default=8,
+                    help="How many largest tensors to list.")
+    args = ap.parse_args()
+    path = args.path.resolve()
+    if not path.is_dir():
+        print(f"error: {path} is not a checkpoint directory",
+              file=sys.stderr)
+        return 2
+
+    meta = CheckpointManager.load_meta(path) or {}
+    tree = CheckpointManager(path.parent)._ckpt_tree(path)
+    if tree is None:
+        print(f"error: {path} has no readable orbax metadata",
+              file=sys.stderr)
+        return 2
+
+    if meta.get("params_only"):
+        params_only = True
+    else:
+        # sidecar may be lost (directory copied alone — the restore path
+        # supports this too): infer the kind from the tree itself. A
+        # TrainState checkpoint always carries step/params/opt_state at
+        # the top level; a params-only artifact is the bare param tree.
+        try:
+            keys = set(tree)
+        except TypeError:
+            keys = set()
+        params_only = not {"step", "params", "opt_state"} <= keys
+        if not meta:
+            print("note: no .meta.json sidecar — kind inferred from the "
+                  "tree structure")
+    kind = ("params-only serving artifact" if params_only
+            else "training checkpoint")
+    print(f"{path.name}: {kind}")
+    for k in ("arch", "epoch", "step", "monitor_best", "quant",
+              "lora_merged", "source", "source_params"):
+        if k in meta and meta[k] is not None:
+            print(f"  {k:13s} {meta[k]}")
+
+    collections = {"": tree} if params_only else dict(tree)
+    all_param_leaves = []
+    print("  collections:")
+    for name, sub in sorted(collections.items()):
+        leaves = _leaves(sub)
+        if not leaves:
+            continue
+        n = sum(int(np.prod(m.shape)) for _, m in leaves)
+        by_dtype: dict = {}
+        for _, m in leaves:
+            d = str(np.dtype(m.dtype))
+            by_dtype[d] = by_dtype.get(d, 0) + int(np.prod(m.shape))
+        dt = ", ".join(f"{v:,} {k}" for k, v in sorted(by_dtype.items()))
+        print(f"    {name or 'params':11s} {len(leaves):4d} tensors  "
+              f"{n:>13,} elements  ({dt})")
+        if name in ("", "params", "ema_params"):
+            # collection-prefixed paths so an EMA shadow copy is
+            # distinguishable from its base tensor in the listings
+            prefix = f"{name}/" if name else ""
+            all_param_leaves += [(prefix + p, m) for p, m in leaves]
+
+    modes = []
+    names = [p for p, _ in all_param_leaves]
+    if any(p.endswith("kernel_q") for p in names):
+        modes.append("w8a16 int8 kernels")
+    if any("lora_a" in p for p in names):
+        modes.append("LoRA adapters (unmerged)")
+    if not params_only and "ema_params" in collections:
+        modes.append("EMA shadow weights")
+    if modes:
+        print("  modes:        " + "; ".join(modes))
+
+    biggest = sorted(
+        all_param_leaves, key=lambda kv: -int(np.prod(kv[1].shape))
+    )[: args.top]
+    print(f"  largest {min(args.top, len(biggest))} tensors:")
+    for p, m in biggest:
+        print(f"    {int(np.prod(m.shape)):>13,}  "
+              f"{str(np.dtype(m.dtype)):9s} {tuple(m.shape)}  {p}")
+    cfg = meta.get("config")
+    if cfg:
+        arch = cfg.get("arch", {})
+        print(f"  config arch:  {arch.get('type')} "
+              f"{json.dumps(arch.get('args', {}))[:120]}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -106,3 +106,23 @@ def test_generate_cli_serves_quantized_artifact(quantized_artifact):
     assert r.returncode == 0, r.stdout[-2000:] + r.stderr[-2000:]
     ids = [int(x) for x in r.stdout.strip().splitlines()[-1].split(",")]
     assert len(ids) == 6
+
+
+def test_inspect_checkpoint_cli(lm_checkpoint, quantized_artifact):
+    """scripts/inspect_checkpoint.py reads metadata only (no arrays):
+    kind detection, collections, dtype counts, and quant-mode flag."""
+    def inspect(path):
+        r = subprocess.run(
+            [sys.executable, str(REPO / "scripts" / "inspect_checkpoint.py"),
+             str(path)],
+            capture_output=True, text=True, timeout=240, cwd=REPO,
+        )
+        assert r.returncode == 0, r.stdout[-2000:] + r.stderr[-2000:]
+        return r.stdout
+
+    out = inspect(lm_checkpoint)
+    assert "training checkpoint" in out
+    assert "opt_state" in out and "params" in out
+    out = inspect(quantized_artifact)
+    assert "params-only serving artifact" in out
+    assert "w8a16 int8 kernels" in out and "int8" in out
