@@ -501,6 +501,16 @@ def warm_start_params(resume_path, current_params):
         p for p, leaf in cur_flat.items()
         if p in disk_flat and tuple(disk_flat[p].shape) == tuple(leaf.shape)
     }
+    if not matched:
+        # nothing to graft (e.g. a wrong checkpoint for this arch):
+        # surface it as a warning + empty report, not an orbax crash on
+        # an empty restore item
+        logger.warning(
+            "Warning: warm start from %s matched NO param leaves "
+            "(checkpoint arch likely differs); all %d leaves keep "
+            "their fresh init.", resume_path, len(cur_flat),
+        )
+        return current_params, [], sorted(cur_flat)
 
     # Abstract restore tree holding ONLY the matched leaves, each with
     # the current tree's dtype+sharding (orbax casts/shards on read).

@@ -42,6 +42,20 @@ def lm_cross_entropy(output, target):
     return tok.mean(axis=-1)
 
 
+@LOSSES.register("mlm_cross_entropy")
+def mlm_cross_entropy(output, target):
+    """Masked-LM loss for the BERT family (models/bert.py): ``output``
+    is the model's ``(logits [B,T,V], mask [B,T])`` pair — the mask
+    marks the positions the model corrupted in-graph — and ``target``
+    is the ORIGINAL token stream. Per-example mean cross entropy over
+    the masked positions only (unmasked positions would let the model
+    score by copying its input)."""
+    logits, sel = output
+    tok = optax.softmax_cross_entropy_with_integer_labels(logits, target)
+    denom = jnp.maximum(sel.sum(axis=-1), 1.0)
+    return (tok * sel).sum(axis=-1) / denom
+
+
 @LOSSES.register("mse_loss")
 def mse_loss(output, target):
     return jnp.mean((output - target) ** 2, axis=tuple(range(1, output.ndim)))

@@ -90,3 +90,14 @@ def lm_nll(output, target):
     if isinstance(output, tuple):
         return fused_lm_cross_entropy(chunk=256)(output, target)
     return lm_cross_entropy(output, target)
+
+
+@METRICS.register("mlm_accuracy")
+def mlm_accuracy(output, target):
+    """Per-example accuracy at the MASKED positions of the BERT MLM
+    pair ``(logits, mask)`` (models/bert.py) against the original
+    tokens — the quality number for masked-LM pretraining."""
+    logits, sel = output
+    hit = (jnp.argmax(logits, axis=-1) == target).astype(jnp.float32)
+    denom = jnp.maximum(sel.sum(axis=-1), 1.0)
+    return (hit * sel).sum(axis=-1) / denom

@@ -1,4 +1,5 @@
 from .base import describe, param_count
+from .bert import BertClassifier, BertEncoder, BertMLM
 from .lenet import LeNet
 from .resnet import ResNet, ResNet18, ResNet34, ResNet50, ResNet101, ResNet152
 from .moe import MoeMlp, moe_lm, tiny_moe_lm

@@ -1,0 +1,193 @@
+"""BERT-family bidirectional encoder: MLM pretraining + classification.
+
+The reference's zoo is one CNN classifier (/root/reference/model/
+model.py); the LM families here are decoder-only. This adds the third
+architecture family — a bidirectional encoder — reusing the GPT-2
+trunk's blocks with ``causal=False`` (models/transformer.Block; the
+attention ladder's xla/flash paths take non-causal directly).
+
+Two registry entries share the encoder scope so the fine-tune workflow
+is the framework's standard one:
+
+- ``BertMLM``: masked-language-model pretraining. Masking runs
+  IN-GRAPH at train time (BERT's 80/10/10 recipe, drawn from the step's
+  dropout rng) so any token loader works unchanged — the model corrupts
+  its own inputs and returns ``(logits, mask)``; the paired
+  ``mlm_cross_entropy`` loss / ``mlm_accuracy`` metric score only the
+  masked positions. Eval uses a deterministic position mask (no rng in
+  eval mode, reproducible numbers).
+- ``BertClassifier``: mean-pooled classification head over the same
+  ``encoder/...`` param scope — ``trainer.init_from`` a BertMLM
+  checkpoint grafts the pretrained encoder and leaves the fresh head
+  in place (checkpoint/manager.warm_start_params' swapped-head case).
+
+The last vocab id is reserved as the [MASK] token by default — byte
+corpora (vocab 256) sacrifice byte 255, subword configs should size
+the vocab one over the tokenizer's.
+"""
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from ..config.registry import MODELS
+from .transformer import Block, _dense_init
+
+
+class BertEncoder(nn.Module):
+    """Token + position embedding -> N bidirectional blocks -> LN."""
+
+    vocab_size: int
+    n_layer: int
+    n_head: int
+    d_model: int
+    d_ff: int = 0                   # 0 -> 4*d_model
+    max_len: int = 512
+    dropout: float = 0.1
+    dtype: Any = jnp.float32
+    attn_impl: str = "xla"          # xla | flash (SP impls untested here)
+    mesh: Optional[Any] = None
+
+    @nn.compact
+    def __call__(self, tokens, train: bool):
+        b, t = tokens.shape
+        if t > self.max_len:
+            raise ValueError(f"sequence {t} exceeds max_len {self.max_len}")
+        embed = nn.Embed(self.vocab_size, self.d_model,
+                         embedding_init=_dense_init(0.02), name="wte",
+                         dtype=self.dtype)
+        wpe = self.param("wpe", _dense_init(0.01),
+                         (self.max_len, self.d_model), jnp.float32)
+        x = embed(tokens) + wpe[None, :t].astype(self.dtype)
+        x = nn.Dropout(self.dropout, deterministic=not train)(x)
+        for i in range(self.n_layer):
+            x = Block(
+                d_model=self.d_model, n_head=self.n_head,
+                d_ff=self.d_ff or 4 * self.d_model, dropout=self.dropout,
+                n_layer=self.n_layer, dtype=self.dtype,
+                attn_impl=self.attn_impl, mesh=self.mesh,
+                causal=False, name=f"h_{i}",
+            )(x, train)
+        x = nn.LayerNorm(epsilon=1e-5, dtype=jnp.float32, name="ln_f")(x)
+        return x, embed
+
+
+class BertMLM(nn.Module):
+    """Masked-LM pretraining head over ``BertEncoder`` (tied to wte)."""
+
+    vocab_size: int = 256
+    n_layer: int = 4
+    n_head: int = 4
+    d_model: int = 256
+    d_ff: int = 0
+    max_len: int = 512
+    dropout: float = 0.1
+    dtype: Any = jnp.float32
+    attn_impl: str = "xla"
+    mesh: Optional[Any] = None
+    mask_rate: float = 0.15
+    mask_id: int = -1               # -1 -> vocab_size - 1 (reserved)
+
+    @nn.compact
+    def __call__(self, tokens, train: bool = False):
+        mask_id = self.mask_id if self.mask_id >= 0 else self.vocab_size - 1
+        if train:
+            # BERT's 80/10/10: of the selected positions, 80% become
+            # [MASK], 10% a random token, 10% stay (the model cannot
+            # trust ANY input token). Keys derive from the step's
+            # dropout rng, so masking differs per step like dropout.
+            key = self.make_rng("dropout")
+            k_sel, k_mix, k_rand = jax.random.split(
+                jax.random.fold_in(key, 0x4d4c4d), 3
+            )
+            sel = jax.random.bernoulli(k_sel, self.mask_rate, tokens.shape)
+            mix = jax.random.uniform(k_mix, tokens.shape)
+            rand_tok = jax.random.randint(
+                k_rand, tokens.shape, 0, self.vocab_size
+            )
+            corrupted = jnp.where(
+                sel & (mix < 0.8), mask_id,
+                jnp.where(sel & (mix >= 0.9), rand_tok, tokens),
+            )
+        else:
+            # deterministic eval mask (no rng outside training): every
+            # 7th position, fully [MASK]ed — reproducible val numbers
+            sel = (jnp.arange(tokens.shape[1]) % 7 == 3)[None, :]
+            sel = jnp.broadcast_to(sel, tokens.shape)
+            corrupted = jnp.where(sel, mask_id, tokens)
+        h, embed = BertEncoder(
+            self.vocab_size, self.n_layer, self.n_head, self.d_model,
+            self.d_ff, self.max_len, self.dropout, self.dtype,
+            self.attn_impl, self.mesh, name="encoder",
+        )(corrupted, train)
+        logits = embed.attend(h.astype(self.dtype))
+        return logits.astype(jnp.float32), sel.astype(jnp.float32)
+
+    def batch_template(self, batch_size: int = 1):
+        return jnp.zeros((batch_size, min(self.max_len, 16)), jnp.int32)
+
+
+class BertClassifier(nn.Module):
+    """Mean-pooled classification over the shared ``encoder`` scope."""
+
+    num_classes: int
+    vocab_size: int = 256
+    n_layer: int = 4
+    n_head: int = 4
+    d_model: int = 256
+    d_ff: int = 0
+    max_len: int = 512
+    dropout: float = 0.1
+    dtype: Any = jnp.float32
+    attn_impl: str = "xla"
+    mesh: Optional[Any] = None
+
+    @nn.compact
+    def __call__(self, tokens, train: bool = False):
+        h, _ = BertEncoder(
+            self.vocab_size, self.n_layer, self.n_head, self.d_model,
+            self.d_ff, self.max_len, self.dropout, self.dtype,
+            self.attn_impl, self.mesh, name="encoder",
+        )(tokens, train)
+        pooled = h.mean(axis=1)
+        logits = nn.Dense(
+            self.num_classes, dtype=self.dtype,
+            kernel_init=_dense_init(0.02), name="classifier_head",
+        )(pooled)
+        return logits.astype(jnp.float32)
+
+    def batch_template(self, batch_size: int = 1):
+        return jnp.zeros((batch_size, min(self.max_len, 16)), jnp.int32)
+
+
+@MODELS.register("BertMLM")
+def bert_mlm(vocab_size: int = 256, n_layer: int = 4, n_head: int = 4,
+             d_model: int = 256, d_ff: int = 0, max_len: int = 512,
+             dropout: float = 0.1, bfloat16: bool = False,
+             attn_impl: str = "xla", mesh=None, mask_rate: float = 0.15,
+             mask_id: int = -1):
+    return BertMLM(
+        vocab_size=vocab_size, n_layer=n_layer, n_head=n_head,
+        d_model=d_model, d_ff=d_ff, max_len=max_len, dropout=dropout,
+        dtype=jnp.bfloat16 if bfloat16 else jnp.float32,
+        attn_impl=attn_impl, mesh=mesh, mask_rate=mask_rate,
+        mask_id=mask_id,
+    )
+
+
+@MODELS.register("BertClassifier")
+def bert_classifier(num_classes: int, vocab_size: int = 256,
+                    n_layer: int = 4, n_head: int = 4, d_model: int = 256,
+                    d_ff: int = 0, max_len: int = 512,
+                    dropout: float = 0.1, bfloat16: bool = False,
+                    attn_impl: str = "xla", mesh=None):
+    return BertClassifier(
+        num_classes=num_classes, vocab_size=vocab_size, n_layer=n_layer,
+        n_head=n_head, d_model=d_model, d_ff=d_ff, max_len=max_len,
+        dropout=dropout,
+        dtype=jnp.bfloat16 if bfloat16 else jnp.float32,
+        attn_impl=attn_impl, mesh=mesh,
+    )

@@ -84,11 +84,15 @@ class SelfAttention(nn.Module):
     kv_quant: str = ""              # "" | "int8" (decode cache; quant.py)
     lora_rank: int = 0              # >0: LoRA fine-tuning (models/lora.py)
     lora_alpha: float = 16.0
+    causal: bool = True             # False: bidirectional (BERT family)
 
     @nn.compact
     def __call__(self, x, train: bool, decode: bool = False,
                  decode_index=None, prefill: bool = False):
         b, t, _ = x.shape
+        if decode and not self.causal:
+            raise ValueError("decode is autoregressive by construction; "
+                             "bidirectional attention has no decode mode")
         head_dim = self.d_model // self.n_head
         dense = _dense_or_quant_biased(self.dtype, self.quant,
                                        self.lora_rank, self.lora_alpha)
@@ -101,7 +105,7 @@ class SelfAttention(nn.Module):
             if self.mesh is None:
                 raise ValueError(f"attn_impl={self.attn_impl!r} requires a mesh")
             ctx = ring_attention(
-                q, k, v, self.mesh, causal=True,
+                q, k, v, self.mesh, causal=self.causal,
                 layout=(
                     "zigzag" if self.seq_layout == "zigzag" else "contig"
                 ),
@@ -113,16 +117,16 @@ class SelfAttention(nn.Module):
             if self.mesh is None:
                 raise ValueError(f"attn_impl={self.attn_impl!r} requires a mesh")
             ctx = ulysses_attention(
-                q, k, v, self.mesh, causal=True,
+                q, k, v, self.mesh, causal=self.causal,
                 inner=(
                     "flash" if self.attn_impl == "ulysses_flash" else "xla"
                 ),
             )
         elif self.attn_impl == "flash":
             from ..ops.flash import flash_attention
-            ctx = flash_attention(q, k, v, causal=True)
+            ctx = flash_attention(q, k, v, causal=self.causal)
         else:
-            ctx = multihead_attention(q, k, v, causal=True)
+            ctx = multihead_attention(q, k, v, causal=self.causal)
         ctx = ctx.reshape(b, t, self.d_model)
         out = dense(self.d_model,
                     _dense_init(0.02 / (2 * self.n_layer) ** 0.5),
@@ -230,6 +234,7 @@ class Block(nn.Module):
     kv_quant: str = ""              # "" | "int8" (decode cache; quant.py)
     lora_rank: int = 0              # >0: LoRA fine-tuning (models/lora.py)
     lora_alpha: float = 16.0
+    causal: bool = True             # False: bidirectional (BERT family)
 
     @nn.compact
     def __call__(self, x, train: bool, example_mask=None,
@@ -242,7 +247,7 @@ class Block(nn.Module):
             self.dtype, self.attn_impl, self.mesh,
             seq_layout=self.seq_layout, quant=self.quant,
             kv_quant=self.kv_quant, lora_rank=self.lora_rank,
-            lora_alpha=self.lora_alpha, name="attn",
+            lora_alpha=self.lora_alpha, causal=self.causal, name="attn",
         )(h, train, decode, decode_index, prefill)
         h = nn.LayerNorm(epsilon=self.ln_eps, dtype=jnp.float32,
                          name="ln_2")(x)
