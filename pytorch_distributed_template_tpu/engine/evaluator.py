@@ -95,9 +95,16 @@ def _make_output_step(model, input_key: str, use_ema: bool, mesh):
             {"example_mask": batch["mask"]} if pass_example_mask else {}
         )
         out = model.apply(variables, batch[input_key], train=False, **extra)
-        if isinstance(out, tuple):  # fused_head: (hidden [B,T,D], w [D,V])
-            hidden, w = out
-            out = hidden @ w
+        if isinstance(out, tuple):
+            first, second = out
+            if second.shape == first.shape[: second.ndim]:
+                # (logits, per-position mask) — the BERT MLM pair
+                # (models/bert.py): dump the logits (the mask is
+                # deterministic in eval mode and reconstructible)
+                out = first
+            else:
+                # fused_head: (hidden [B,T,D], w [D,V]) — materialize
+                out = first @ second
         return jax.lax.with_sharding_constraint(
             out.astype(jnp.float32), out_sharding
         )

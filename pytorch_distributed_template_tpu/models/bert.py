@@ -105,9 +105,13 @@ class BertMLM(nn.Module):
             )
             sel = jax.random.bernoulli(k_sel, self.mask_rate, tokens.shape)
             mix = jax.random.uniform(k_mix, tokens.shape)
+            # uniform over vocab MINUS the reserved mask id (draw from a
+            # range one smaller and skip over mask_id) — the "random
+            # token" corruption must never inject [MASK] itself
             rand_tok = jax.random.randint(
-                k_rand, tokens.shape, 0, self.vocab_size
+                k_rand, tokens.shape, 0, self.vocab_size - 1
             )
+            rand_tok = rand_tok + (rand_tok >= mask_id).astype(jnp.int32)
             corrupted = jnp.where(
                 sel & (mix < 0.8), mask_id,
                 jnp.where(sel & (mix >= 0.9), rand_tok, tokens),
