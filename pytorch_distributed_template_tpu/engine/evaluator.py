@@ -95,16 +95,25 @@ def _make_output_step(model, input_key: str, use_ema: bool, mesh):
             {"example_mask": batch["mask"]} if pass_example_mask else {}
         )
         out = model.apply(variables, batch[input_key], train=False, **extra)
+        if getattr(model, "mlm_output", False):
+            # (logits, per-position eval mask) — the BERT MLM pair
+            # (models/bert.py, dispatched by the class attribute, NOT
+            # by shape sniffing): keep both; the dump writes the mask
+            # next to the logits so saved outputs never depend on the
+            # model's private mask rule
+            logits, sel = out
+            return (
+                jax.lax.with_sharding_constraint(
+                    logits.astype(jnp.float32), out_sharding
+                ),
+                jax.lax.with_sharding_constraint(
+                    sel.astype(jnp.float32), out_sharding
+                ),
+            )
         if isinstance(out, tuple):
-            first, second = out
-            if second.shape == first.shape[: second.ndim]:
-                # (logits, per-position mask) — the BERT MLM pair
-                # (models/bert.py): dump the logits (the mask is
-                # deterministic in eval mode and reconstructible)
-                out = first
-            else:
-                # fused_head: (hidden [B,T,D], w [D,V]) — materialize
-                out = first @ second
+            # fused_head: (hidden [B,T,D], w [D,V]) — materialize logits
+            hidden, w = out
+            out = hidden @ w
         return jax.lax.with_sharding_constraint(
             out.astype(jnp.float32), out_sharding
         )
@@ -191,7 +200,7 @@ def evaluate(config, mesh=None, save_outputs=None) -> dict:
                 mesh=mesh,
             )
         )
-        dumped_out, dumped_tgt = [], []
+        dumped_out, dumped_tgt, dumped_msk = [], [], []
 
     from ..utils.util import maybe_tqdm
 
@@ -206,9 +215,15 @@ def evaluate(config, mesh=None, save_outputs=None) -> dict:
         m = eval_step(state, batch)
         accum = m if accum is None else jax.tree.map(jnp.add, accum, m)
         if output_step is not None:
-            out = _host_local_rows(output_step(state, batch))
+            res = output_step(state, batch)
+            if isinstance(res, tuple):          # MLM: (logits, eval mask)
+                res, msk = res
+                keep = _host_local_rows(batch["mask"]).astype(bool)
+                dumped_msk.append(_host_local_rows(msk)[keep])
+            else:
+                keep = _host_local_rows(batch["mask"]).astype(bool)
+            out = _host_local_rows(res)
             tgt = _host_local_rows(batch[target_key])
-            keep = _host_local_rows(batch["mask"]).astype(bool)
             dumped_out.append(out[keep])
             dumped_tgt.append(tgt[keep])
 
@@ -221,6 +236,11 @@ def evaluate(config, mesh=None, save_outputs=None) -> dict:
         if dumped_out:
             np.save(out_dir / f"outputs_p{p}.npy", np.concatenate(dumped_out))
             np.save(out_dir / f"targets_p{p}.npy", np.concatenate(dumped_tgt))
+            if dumped_msk:
+                # the MLM eval mask rides along so post-hoc scoring never
+                # re-derives the model's private masking rule
+                np.save(out_dir / f"masks_p{p}.npy",
+                        np.concatenate(dumped_msk))
             logger.info("saved per-example outputs to %s", out_dir)
         else:
             # No local batches at all: writing a shape/dtype-less

@@ -78,6 +78,11 @@ class BertEncoder(nn.Module):
 class BertMLM(nn.Module):
     """Masked-LM pretraining head over ``BertEncoder`` (tied to wte)."""
 
+    # output convention marker, NOT a flax field: __call__ returns the
+    # (logits, mask) pair — the evaluator's --save-outputs path
+    # dispatches on this instead of shape-sniffing tuples
+    mlm_output = True
+
     vocab_size: int = 256
     n_layer: int = 4
     n_head: int = 4

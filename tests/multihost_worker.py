@@ -127,6 +127,35 @@ def main():
     np.testing.assert_allclose(np.asarray(local), refz[:, t_lo:t_hi],
                                atol=1e-4, rtol=1e-4, err_msg="zigzag")
 
+    # --- BPE cache gating across hosts (data/datasets.BpeLMLoader):
+    # host 0 trains+writes the tokenizer/id caches atomically while the
+    # other host enters the loader FIRST and polls for them — then both
+    # must hold identical merges.
+    if len(sys.argv) > 1:
+        from pathlib import Path
+
+        import pytorch_distributed_template_tpu.data  # noqa: F401
+        from pytorch_distributed_template_tpu.config.registry import LOADERS
+        from pytorch_distributed_template_tpu.data.tokenizer import (
+            BpeTokenizer, bpe_cache_path,
+        )
+
+        base = Path(sys.argv[1])
+        if dist.is_main_process():
+            (base / "c.txt").write_bytes(
+                b"def handler(event):\n    return event\n" * 400
+            )
+        dist.synchronize("bpe-corpus-ready")
+        loader = LOADERS.get("BpeLMLoader")(
+            data_dir=str(base), file="c.txt", vocab_size=300,
+            batch_size=4, seq_len=16, training=True, shuffle=False,
+        )
+        batch = next(iter(loader))
+        assert batch["tokens"].shape == (4, 16)
+        tok = BpeTokenizer.load(bpe_cache_path(base, "c.txt", 300))
+        digests = dist.all_gather_object(tuple(map(tuple, tok.merges)))
+        assert len(set(digests)) == 1, "hosts loaded different tokenizers"
+
     dist.synchronize("test-end")
     print(f"MULTIHOST_OK rank={rank}", flush=True)
 

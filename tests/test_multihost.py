@@ -70,8 +70,11 @@ def _spawn_pair(script, extra_args=(), timeout=330):
     return procs, outs
 
 
-def test_two_process_rendezvous_and_collectives():
-    procs, outs = _spawn_pair(WORKER)
+def test_two_process_rendezvous_and_collectives(tmp_path):
+    # tmp_path arms the worker's BPE cache-gating leg too: host 0 builds
+    # the tokenizer caches (atomic writes), host 1 polls for them, both
+    # must end with identical merges (data/datasets.BpeLMLoader)
+    procs, outs = _spawn_pair(WORKER, extra_args=(str(tmp_path),))
     for rank, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"rank {rank} failed:\n{out[-3000:]}"
         assert f"MULTIHOST_OK rank={rank}" in out, out[-3000:]
