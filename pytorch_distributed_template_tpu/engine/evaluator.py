@@ -216,12 +216,14 @@ def evaluate(config, mesh=None, save_outputs=None) -> dict:
         accum = m if accum is None else jax.tree.map(jnp.add, accum, m)
         if output_step is not None:
             res = output_step(state, batch)
+            keep = _host_local_rows(batch["mask"]).astype(bool)
             if isinstance(res, tuple):          # MLM: (logits, eval mask)
                 res, msk = res
-                keep = _host_local_rows(batch["mask"]).astype(bool)
-                dumped_msk.append(_host_local_rows(msk)[keep])
-            else:
-                keep = _host_local_rows(batch["mask"]).astype(bool)
+                # bool on host: the dump exists for large eval sets, and
+                # a f32 position mask would 4x the file + transfer
+                dumped_msk.append(
+                    _host_local_rows(msk)[keep].astype(bool)
+                )
             out = _host_local_rows(res)
             tgt = _host_local_rows(batch[target_key])
             dumped_out.append(out[keep])
