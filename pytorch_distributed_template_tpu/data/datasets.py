@@ -473,6 +473,17 @@ def bpe_lm_loader(data_dir: str = "data/", batch_size: int = 8,
     fallback when the corpus is absent. ``generate.py`` recovers the
     cached tokenizer through the run config to round-trip ``--prompt``
     text (data/tokenizer.tokenizer_from_config).
+
+    The tokenizer fits on the TRAIN fraction of the file only (bytes
+    before the ``1 - val_fraction`` cut), so held-out nats/token is
+    never computed with merges fitted on eval text. The cache is keyed
+    by (file, vocab_size, train fraction) and invalidated by source
+    mtime — changing ``val_fraction`` refits rather than silently
+    reusing merges fitted at the old cut.
+
+    Multi-host: ``data_dir`` must be a filesystem shared with host 0 —
+    host 0 builds the tokenizer/id caches and every other host polls
+    for the files to appear (below).
     """
     del num_workers
     from .tokenizer import BpeTokenizer, bpe_cache_path
@@ -486,8 +497,10 @@ def bpe_lm_loader(data_dir: str = "data/", batch_size: int = 8,
                             vocab_size=vocab_size, seed=seed,
                             training=training)
         return _make_image_loader(data, batch_size, shuffle, seed=seed)
-    tok_path = bpe_cache_path(data_dir, file, vocab_size)
-    ids_path = Path(data_dir) / f"{file}.bpe{vocab_size}.npy"
+    tok_path = bpe_cache_path(data_dir, file, vocab_size,
+                              val_fraction=val_fraction)
+    # id stream is tokenizer-dependent, so it shares the keyed stem
+    ids_path = tok_path.with_suffix(".npy")
     src_mtime = path.stat().st_mtime
 
     def caches_fresh():
@@ -501,7 +514,9 @@ def bpe_lm_loader(data_dir: str = "data/", batch_size: int = 8,
             # waiters below never read a partial file
             logger.info("BpeLMLoader: training %d-vocab BPE on %s ...",
                         vocab_size, path)
-            tok = BpeTokenizer.train_from_file(path, vocab_size)
+            tok = BpeTokenizer.train_from_file(
+                path, vocab_size, sample_until=1.0 - val_fraction
+            )
             tok.save(tok_path)
             logger.info("BpeLMLoader: tokenizing %s ...", path)
             # memmapped chunked encode: bounded memory on multi-GB
@@ -519,7 +534,11 @@ def bpe_lm_loader(data_dir: str = "data/", batch_size: int = 8,
                 if time.time() > deadline:
                     raise TimeoutError(
                         f"BpeLMLoader: timed out waiting for host 0 to "
-                        f"build {tok_path} / {ids_path}"
+                        f"build {tok_path} / {ids_path} — multi-host "
+                        "runs require data_dir on a filesystem shared "
+                        "with host 0 (each host polls for host 0's "
+                        "atomic cache writes; there is no network "
+                        "broadcast of the tokenizer)"
                     )
                 time.sleep(2.0)
     tok = BpeTokenizer.load(tok_path)
@@ -535,8 +554,12 @@ def bpe_lm_loader(data_dir: str = "data/", batch_size: int = 8,
     tokens = np.asarray(part[: n_chunks * seq_len]).reshape(
         n_chunks, seq_len
     )
-    return _make_image_loader({"tokens": tokens}, batch_size, shuffle,
-                              seed=seed)
+    loader = _make_image_loader({"tokens": tokens}, batch_size, shuffle,
+                                seed=seed)
+    # advertised so the trainer can pin a copy of the tokenizer in the
+    # run dir (the corpus-side cache can be rewritten by later runs)
+    loader.tokenizer_path = tok_path
+    return loader
 
 
 @LOADERS.register("SyntheticLMLoader")

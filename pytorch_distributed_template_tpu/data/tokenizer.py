@@ -181,13 +181,24 @@ class BpeTokenizer:
     @classmethod
     def train_from_file(cls, path, vocab_size: int,
                         max_train_bytes: int = 4 << 20,
-                        max_token_bytes: int = 16) -> "BpeTokenizer":
+                        max_token_bytes: int = 16,
+                        sample_until: float = 1.0) -> "BpeTokenizer":
         """``train`` over a file WITHOUT loading it whole: the <=
         ``max_train_bytes`` evenly-spaced sample is assembled from
         memmap slices, so a multi-GB corpus touches only the sampled
-        pages (same beyond-RAM contract as ByteLMLoader)."""
+        pages (same beyond-RAM contract as ByteLMLoader).
+
+        ``sample_until`` restricts sampling to the first fraction of the
+        file: loaders that split a held-out tail off the SAME file pass
+        their train fraction here so the tokenizer never fits on eval
+        text (fitting on the full file leaks the val tail into the
+        merges, mildly flattering held-out nats/token)."""
+        if not 0.0 < sample_until <= 1.0:
+            raise ValueError(f"sample_until {sample_until} not in (0, 1]")
         raw = np.memmap(Path(path), dtype=np.uint8, mode="r")
-        return cls.train(_sample_bytes(raw, max_train_bytes), vocab_size,
+        end = max(int(len(raw) * sample_until), 1)
+        return cls.train(_sample_bytes(raw[:end], max_train_bytes),
+                         vocab_size,
                          max_train_bytes=max_train_bytes,
                          max_token_bytes=max_token_bytes)
 
@@ -232,22 +243,57 @@ def tokenizer_from_config(config) -> "BpeTokenizer | None":
     trained through ``BpeLMLoader`` (the loader caches the tokenizer
     next to the corpus — same derivation as the loader's own path).
     Used by generate.py to round-trip ``--prompt`` text for subword
-    models."""
+    models.
+
+    Resolution order: (1) the run-pinned ``tokenizer.json`` next
+    to/above the checkpoint — authoritative, because the corpus-side
+    cache is shared mutable state a later run can rewrite with
+    different merges; (2) the corpus-side keyed cache; (3) the legacy
+    (pre-train-fraction-key) cache name."""
+    resume = getattr(config, "resume", None)
+    if resume is not None:
+        d = Path(resume)
+        for _ in range(3):   # ckpt dir -> run dir -> artifact nesting
+            pinned = d / "tokenizer.json"
+            if pinned.exists():
+                return BpeTokenizer.load(pinned)
+            d = d.parent
     for block in ("train_loader", "valid_loader", "test_loader"):
         spec = config.get(block, None)
         if spec and spec.get("type") == "BpeLMLoader":
             args = spec.get("args", {})
-            path = bpe_cache_path(
+            keyed = bpe_cache_path(
                 args.get("data_dir", "data/"),
                 args.get("file", "input.txt"),
                 int(args.get("vocab_size", 1024)),
+                val_fraction=float(args.get("val_fraction", 0.1)),
             )
-            if path.exists():
-                return BpeTokenizer.load(path)
-            logger.warning("BpeLMLoader tokenizer %s not found", path)
+            # legacy fallback: caches written before the train-fraction
+            # key (fitted on the full file) keep round-tripping old runs
+            legacy = (
+                Path(args.get("data_dir", "data/"))
+                / f"{args.get('file', 'input.txt')}"
+                  f".bpe{int(args.get('vocab_size', 1024))}.json"
+            )
+            for path in (keyed, legacy):
+                if path.exists():
+                    return BpeTokenizer.load(path)
+            logger.warning("BpeLMLoader tokenizer %s not found", keyed)
     return None
 
 
-def bpe_cache_path(data_dir, file: str, vocab_size: int) -> Path:
-    """Where ``BpeLMLoader`` persists the tokenizer for a corpus."""
-    return Path(data_dir) / f"{file}.bpe{vocab_size}.json"
+def bpe_cache_path(data_dir, file: str, vocab_size: int,
+                   val_fraction: float = 0.1) -> Path:
+    """Where ``BpeLMLoader`` persists the tokenizer for a corpus.
+
+    The name carries the TRAIN fraction (in percent) the merges were
+    fitted on (``t90`` for the default 10% held-out tail): a
+    ``val_fraction`` change must refit, not silently reuse merges
+    fitted at the old cut — reusing them can leak eval text into the
+    tokenizer."""
+    # "p" stands in for the decimal point (t90, t90p5): the name must
+    # encode val_fraction exactly (rounding would let two different
+    # cuts collide on one cache) yet stay a single path suffix so
+    # ``with_suffix`` derives the sibling id-stream cache
+    pct = f"{(1.0 - float(val_fraction)) * 100:g}".replace(".", "p")
+    return Path(data_dir) / f"{file}.bpe{vocab_size}.t{pct}.json"

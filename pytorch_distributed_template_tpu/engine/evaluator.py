@@ -67,7 +67,8 @@ def restore_template_state(config, model, mesh, template=None):
     return state, ema_decay
 
 
-def _make_output_step(model, input_key: str, use_ema: bool, mesh):
+def _make_output_step(model, input_key: str, use_ema: bool, mesh,
+                      eval_rng: bool = False):
     """Jitted raw-output forward for ``--save-outputs``: returns the
     model's per-example outputs (logits), materializing them even for
     ``fused_head`` models. This is a second forward pass on top of
@@ -82,7 +83,7 @@ def _make_output_step(model, input_key: str, use_ema: bool, mesh):
     pass_example_mask = _accepts_example_mask(model)
     out_sharding = batch_sharding(mesh)
 
-    def output_step(state, batch):
+    def output_step(state, batch, rng=None):
         params = (
             state.ema_params
             if use_ema and state.ema_params is not None
@@ -94,6 +95,10 @@ def _make_output_step(model, input_key: str, use_ema: bool, mesh):
         extra = (
             {"example_mask": batch["mask"]} if pass_example_mask else {}
         )
+        if eval_rng:
+            # SAME per-batch key as eval_step: the dumped logits/mask
+            # must describe the batch the metrics actually scored
+            extra["rngs"] = {"eval": rng}
         out = model.apply(variables, batch[input_key], train=False, **extra)
         if getattr(model, "mlm_output", False):
             # (logits, per-position eval mask) — the BERT MLM pair
@@ -148,7 +153,7 @@ def _host_local_rows(arr) -> np.ndarray:
     )
 
 
-def evaluate(config, mesh=None, save_outputs=None) -> dict:
+def evaluate(config, mesh=None, save_outputs=None, seed=None) -> dict:
     """Evaluate ``config.resume`` on the config's ``test_loader``.
 
     ``save_outputs``: optional directory; when set, every host writes its
@@ -156,6 +161,12 @@ def evaluate(config, mesh=None, save_outputs=None) -> dict:
     ``outputs_p{K}.npy`` / ``targets_p{K}.npy`` for post-hoc analysis —
     the capability the reference exposes by gathering raw predictions
     (reference test.py:87-95, base_trainer.py:176-181).
+
+    ``seed``: optional int; seeds eval-time model randomness (the
+    ``"eval"`` rng stream, folded per batch — e.g. BertMLM's seeded
+    random eval mask). ``None`` keeps the fully deterministic eval path.
+    The reference's ``--seed`` crashes outright (reference test.py:125,
+    numpy unimported); here it is wired end to end.
     """
     logger = config.get_logger("test")
     assert config.resume is not None, "evaluation requires a checkpoint (-r)"
@@ -181,23 +192,26 @@ def evaluate(config, mesh=None, save_outputs=None) -> dict:
         config, model, mesh, template=template
     )
 
+    use_ema = ema_decay > 0 and bool(
+        config["trainer"].get("eval_with_ema", True)
+    )
     eval_step = jax.jit(
         make_eval_step(
             model, criterion, metric_fns,
             input_key=input_key, target_key=target_key,
-            use_ema=ema_decay > 0
-            and bool(config["trainer"].get("eval_with_ema", True)),
+            use_ema=use_ema, eval_rng=seed is not None,
         )
+    )
+    base_key = (
+        jax.random.key(int(seed)) if seed is not None else None
     )
 
     output_step = None
     if save_outputs is not None:
         output_step = jax.jit(
             _make_output_step(
-                model, input_key,
-                use_ema=ema_decay > 0
-                and bool(config["trainer"].get("eval_with_ema", True)),
-                mesh=mesh,
+                model, input_key, use_ema=use_ema, mesh=mesh,
+                eval_rng=seed is not None,
             )
         )
         dumped_out, dumped_tgt, dumped_msk = [], [], []
@@ -211,11 +225,17 @@ def evaluate(config, mesh=None, save_outputs=None) -> dict:
         batches = maybe_tqdm(batches, total=len(test_loader), desc="eval",
                              enable=config["trainer"].get("progress"))
     accum = None
-    for batch in batches:
-        m = eval_step(state, batch)
+    for i, batch in enumerate(batches):
+        # per-batch key: every host folds the same global batch index,
+        # so the mask agrees across hosts of a sharded batch
+        rng_args = (
+            (jax.random.fold_in(base_key, i),)
+            if base_key is not None else ()
+        )
+        m = eval_step(state, batch, *rng_args)
         accum = m if accum is None else jax.tree.map(jnp.add, accum, m)
         if output_step is not None:
-            res = output_step(state, batch)
+            res = output_step(state, batch, *rng_args)
             keep = _host_local_rows(batch["mask"]).astype(bool)
             if isinstance(res, tuple):          # MLM: (logits, eval mask)
                 res, msk = res

@@ -211,8 +211,12 @@ def generate_speculative(model, params, prompt: jnp.ndarray,
         stats = {
             "model_calls": int(iters),
             "tokens_emitted": max_new_tokens,
+            # numerator clamped to tokens actually RETURNED: the final
+            # chunk may commit past max_new_tokens, and counting that
+            # overshoot would inflate the reported acceptance rate
             "tokens_per_call": round(
-                float(int(n) - t0 - 1) / max(int(iters), 1), 3
+                float(min(int(n) - t0 - 1, max_new_tokens))
+                / max(int(iters), 1), 3
             ),
         }
         return out, stats

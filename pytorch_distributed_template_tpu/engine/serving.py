@@ -54,7 +54,26 @@ class GenerationService:
         """Text or explicit ids -> validated id list (raises ValueError
         with a caller-presentable message on every bad input)."""
         if prompt_ids is not None:
-            ids = [int(i) for i in prompt_ids]
+            try:
+                # TypeError (non-iterable payload, nested lists) is as
+                # much a client input error as a bad value — normalize
+                # to ValueError so serve.py maps it to HTTP 400, not 500.
+                # Strings ("123" iterates to [1,2,3]) and non-integral
+                # floats (1.9 truncates) would silently generate from
+                # ids the client never sent — reject, don't coerce.
+                if isinstance(prompt_ids, (str, bytes)):
+                    raise ValueError("got a string, not a list")
+                ids = []
+                for i in prompt_ids:
+                    if int(i) != i:
+                        raise ValueError(f"non-integer id {i!r}")
+                    ids.append(int(i))
+            except (TypeError, ValueError, OverflowError) as e:
+                # OverflowError: json.loads accepts Infinity, and
+                # int(inf) overflows — still a client input error
+                raise ValueError(
+                    f"prompt_ids must be a flat list of ints: {e}"
+                ) from e
             if self.vocab and any(i >= self.vocab or i < 0 for i in ids):
                 raise ValueError(
                     f"prompt id outside [0, {self.vocab}) — nn.Embed "

@@ -322,7 +322,7 @@ def make_train_step(model, tx, criterion: Callable,
 def make_eval_step(model, criterion: Callable,
                    metric_fns: Sequence[Callable] = (),
                    input_key: str = "image", target_key: str = "label",
-                   use_ema: bool = False):
+                   use_ema: bool = False, eval_rng: bool = False):
     """Build ``eval_step(state, batch) -> metrics`` (sufficient statistics).
 
     Equivalent to the reference's no-grad validation forward
@@ -330,11 +330,17 @@ def make_eval_step(model, criterion: Callable,
     (trainer/trainer.py:75-88), but reduced in-graph: no pickle gathers, no
     full prediction set on one host. ``use_ema`` evaluates the shadow EMA
     weights instead of the live params.
+
+    ``eval_rng=True`` changes the signature to ``eval_step(state, batch,
+    rng)`` and exposes the key as the ``"eval"`` rng stream — the
+    ``test.py --seed`` path; models that consume eval-time randomness
+    (BertMLM's seeded eval mask) pick it up via ``self.has_rng("eval")``
+    and everything else ignores it.
     """
 
     pass_example_mask = _accepts_example_mask(model)
 
-    def eval_step(state, batch):
+    def eval_step(state, batch, rng=None):
         params = (
             state.ema_params
             if use_ema and state.ema_params is not None
@@ -346,6 +352,8 @@ def make_eval_step(model, criterion: Callable,
         extra = (
             {"example_mask": batch["mask"]} if pass_example_mask else {}
         )
+        if eval_rng:
+            extra["rngs"] = {"eval": rng}
         output = model.apply(variables, batch[input_key], train=False,
                              **extra)
         per_ex = criterion(output, batch[target_key])

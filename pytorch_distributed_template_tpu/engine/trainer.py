@@ -251,6 +251,20 @@ class Trainer(BaseTrainer):
         self.metric_ftns = list(metric_ftns)
 
         self.train_loader = train_loader
+        tok_path = getattr(train_loader, "tokenizer_path", None)
+        if tok_path is not None and dist.is_main_process():
+            # pin the run's tokenizer IN the run dir: the corpus-side
+            # cache is keyed by (file, vocab, train fraction) and a
+            # later run can rewrite it, but generate.py must round-trip
+            # prompts through the merges THIS run's embeddings saw
+            # (data/tokenizer.tokenizer_from_config prefers this copy)
+            import shutil
+
+            try:
+                shutil.copyfile(tok_path,
+                                self.checkpoint_dir / "tokenizer.json")
+            except OSError as e:  # non-fatal: corpus cache still works
+                self.logger.warning("could not pin tokenizer: %s", e)
         if len_epoch is None:
             # config-level opt-in to iteration-based training (the
             # reference enables it by passing len_epoch to its Trainer;

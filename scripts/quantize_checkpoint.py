@@ -74,7 +74,19 @@ def main(args, config):
     # training-loss mode and is stripped from the serving config below).
     validate_quant_config("w8a16", False, getattr(model, "moe_experts", 0))
 
-    if load_serving_meta(config.resume) is not None:
+    meta = load_serving_meta(config.resume)
+    if meta is not None and meta.get("quant") == "w8a16":
+        # quantize_params_w8 leaves kernel_q trees untouched, so this
+        # would silently write a duplicate artifact whose meta CLAIMS a
+        # fresh quantization — refuse instead
+        raise SystemExit(
+            f"{config.resume} is already a w8a16 serving artifact "
+            f"(quantized from {meta.get('source', 'unknown')}); "
+            "re-quantizing is a no-op that would write a duplicate "
+            "artifact — point -r at the original training checkpoint "
+            "or merged-LoRA artifact instead"
+        )
+    if meta is not None:
         # already a params-only artifact (e.g. scripts/merge_lora.py
         # output) — quantize it directly
         if args.ema:
@@ -94,6 +106,18 @@ def main(args, config):
         src = "ema_params" if args.ema and state.ema_params is not None \
             else "params"
         params = getattr(state, src)
+    def _has_quant_leaves(tree):
+        if isinstance(tree, dict):
+            return any(k == "kernel_q" or _has_quant_leaves(v)
+                       for k, v in tree.items())
+        return False
+
+    if _has_quant_leaves(params):
+        # meta-less belt-and-suspenders for the same refusal above
+        raise SystemExit(
+            f"{config.resume} already holds int8 kernel_q leaves; "
+            "re-quantizing is a no-op — use the original checkpoint"
+        )
     qparams = quantize_params_w8(jax.device_get(params))
 
     out_dir = (

@@ -24,7 +24,7 @@ from pytorch_distributed_template_tpu.parallel import dist
 
 def main(args, config):
     dist.initialize()
-    evaluate(config, save_outputs=args.save_outputs)
+    evaluate(config, save_outputs=args.save_outputs, seed=args.seed)
 
 
 if __name__ == "__main__":
@@ -36,7 +36,10 @@ if __name__ == "__main__":
     parser.add_argument("-l", "--local_rank", default=0, type=int,
                         help="accepted for launcher compatibility; unused")
     parser.add_argument("-s", "--save_dir", default=None, type=str)
-    parser.add_argument("--seed", type=int, default=None)
+    parser.add_argument("--seed", type=int, default=None,
+                        help="seed eval-time model randomness (the "
+                             "'eval' rng stream, e.g. BertMLM's random "
+                             "eval mask); default: deterministic eval")
     parser.add_argument("--save-outputs", default=None, type=str,
                         metavar="DIR",
                         help="dump per-example outputs/targets (npy) here "

@@ -95,6 +95,19 @@ def test_quantize_checkpoint_writes_serving_artifact(quantized_artifact):
     assert meta["params_only"] is True and meta["quant"] == "w8a16"
 
 
+def test_quantize_refuses_already_quantized(quantized_artifact):
+    """Re-quantizing a w8a16 artifact is a silent no-op that would write
+    a duplicate artifact claiming fresh quantization — the CLI refuses
+    with an explanation instead (ADVICE r3)."""
+    r = subprocess.run(
+        [sys.executable, str(REPO / "scripts" / "quantize_checkpoint.py"),
+         "-r", str(quantized_artifact)],
+        capture_output=True, text=True, timeout=420, cwd=REPO, env=None,
+    )
+    assert r.returncode != 0
+    assert "already a w8a16 serving artifact" in (r.stdout + r.stderr)
+
+
 def test_generate_cli_serves_quantized_artifact(quantized_artifact):
     """The full serving workflow: generate.py on the artifact picks up
     the quant config via resume rediscovery, restores the params-only

@@ -6,6 +6,7 @@ compression on repetitive text, save/load stability, loader train/
 cache/split behavior, and the generate.py config-recovery hook.
 """
 import json
+from pathlib import Path
 
 import numpy as np
 import pytest
@@ -105,7 +106,10 @@ def test_bpe_loader_trains_caches_and_splits(tmp_path):
     assert int(batch["tokens"].max()) < 384
     # tokenizer + id cache persisted next to the corpus
     assert bpe_cache_path(tmp_path, "corpus.txt", 384).exists()
-    assert (tmp_path / "corpus.txt.bpe384.npy").exists()
+    # cache names carry the train fraction (t90 = default 10% tail):
+    # changing val_fraction refits instead of reusing stale merges
+    assert (tmp_path / "corpus.txt.bpe384.t90.json").exists()
+    assert (tmp_path / "corpus.txt.bpe384.t90.npy").exists()
     # val split is held-out tail, disjoint chunk count
     val = LOADERS.get("BpeLMLoader")(**kw, training=False, shuffle=False)
     assert len(val) >= 1
@@ -116,6 +120,49 @@ def test_bpe_loader_trains_caches_and_splits(tmp_path):
     tok = tokenizer_from_config(cfg)
     assert tok is not None and tok.vocab_size <= 384
     assert tok.decode(tok.encode("quick brown")) == "quick brown"
+
+    # the loader advertises its tokenizer so the trainer can pin a
+    # copy in the run dir (shared corpus caches are mutable state)
+    assert Path(train.tokenizer_path).exists()
+
+    # legacy (pre-train-fraction-key) cache names still round-trip
+    keyed = bpe_cache_path(tmp_path, "corpus.txt", 384)
+    legacy = tmp_path / "corpus.txt.bpe384.json"
+    keyed.rename(legacy)
+    tok = tokenizer_from_config(cfg)
+    assert tok is not None and tok.vocab_size <= 384
+    legacy.rename(keyed)
+
+    # a run-pinned tokenizer.json next to the checkpoint wins over the
+    # corpus cache — even when the corpus cache has DIFFERENT merges
+    class Cfg(dict):
+        resume = None
+
+    run = tmp_path / "run" / "checkpoint-epoch1"
+    run.mkdir(parents=True)
+    BpeTokenizer([(116, 104)]).save(run.parent / "tokenizer.json")
+    c2 = Cfg(cfg)
+    c2.resume = run
+    tok = tokenizer_from_config(c2)
+    assert tok is not None and tok.vocab_size == 257
+
+
+def test_train_from_file_sample_until_excludes_tail(tmp_path):
+    """The tokenizer must not fit on the held-out tail: a corpus whose
+    tail is wall-to-wall 'Z' pairs yields no Z-containing merges when
+    sampling stops at the train fraction (ADVICE r3: fitting on the
+    full file leaked val text into the merges)."""
+    f = tmp_path / "c.txt"
+    f.write_bytes(b"the cat sat on the mat. " * 400 + b"Z" * 4096)
+    tok = BpeTokenizer.train_from_file(f, 320, sample_until=0.5)
+    assert all(b"Z" not in t for t in tok.vocab[256:])
+    # full-file sampling DOES learn the tail's pair — the guard is live
+    tok_full = BpeTokenizer.train_from_file(f, 320)
+    assert any(b"Z" in t for t in tok_full.vocab[256:])
+    import pytest
+
+    with pytest.raises(ValueError):
+        BpeTokenizer.train_from_file(f, 320, sample_until=0.0)
 
 
 def test_bpe_loader_synthetic_fallback(tmp_path):
