@@ -77,6 +77,9 @@ def _start_watchdog():
 
 
 REPEATS = 3
+# The decode rung's dispatches are short (~0.2-0.4 s), so it can afford
+# more repeats to ride out tunnel tail hiccups (BASELINE.md).
+DECODE_REPEATS = 5
 
 
 def _dispersion(times_per_rep: list) -> dict:
@@ -343,14 +346,14 @@ def bench_decode(batch: int = 8, prompt_len: int = 1024,
     step's sampled token and cache feed the next step — the platform's
     required in-jit chaining); prefill repeats chain through a
     carry-perturbed prompt so no two calls see identical inputs (the
-    tunnel dedups identical dispatches). Known platform anomaly, round 3:
-    prefill at THIS config (12 layers x 32k vocab x rolling window)
-    compiles to a ~10x-slower-than-expected program (~290 ms vs the
-    ~30 ms the same model costs with 6 layers, a 256 vocab, or no
-    window — each alone is fast; ablation in BASELINE.md). The cost is
-    NOT attention (static flash path), the ring-buffer write (roll/DUS,
-    no scatter), or the head (last-position only): it is an XLA
-    scheduling cliff on this tunnel, reported as measured.
+    tunnel dedups identical dispatches). Every timed executable gets
+    TWO warm dispatches before timing: the first post-compile dispatch
+    can pay a ~1.4 s lazy-warmup on this tunnel, and timing it was the
+    r1-r3 "prefill cliff" (and the r3 quant-rung dispersion) in its
+    entirety — root-caused in scripts/debug_prefill_cliff.py and
+    BASELINE.md. Steady-state dense prefill at this config is ~37 ms
+    per 8x1024 prompt including the ~105 ms-amortized tunnel round
+    trip, ~16 ms device-only (scan-length slope).
 
     Decode is HBM-bound (every step
     re-reads all weights), so ``model_bw_frac`` reports achieved bytes/s
@@ -423,10 +426,10 @@ def bench_decode(batch: int = 8, prompt_len: int = 1024,
         return logits[:, -1], vs["cache"]
 
     # --- prefill timing: chained INSIDE one jit (each iteration's prompt
-    # depends on the previous logits) — eager per-call dispatch through
-    # the tunnel costs 100+ ms with the cache pytree as an argument and
-    # would swamp the ~75 ms device time (round-3 finding)
-    n_pf = 5
+    # depends on the previous logits) — the tunnel round trip is ~105 ms
+    # per fenced dispatch regardless of program, so the chain amortizes
+    # it to ~10 ms/prefill and occasional tail hiccups average out
+    n_pf = 20
 
     @jax.jit
     def prefill_many(params, cache, tokens):
@@ -448,11 +451,22 @@ def bench_decode(batch: int = 8, prompt_len: int = 1024,
 
     logits, cache = prefill(params, fresh_cache, prompt)  # compile + warm
     float(logits[0, 0])
-    acc = prefill_many(params, fresh_cache, prompt)  # compile + warm
+    acc = prefill_many(params, fresh_cache, prompt)  # compile
     float(acc)
-    t0 = time.perf_counter()
-    float(prefill_many(params, fresh_cache, (prompt + 1) % 32000))
-    prefill_s = (time.perf_counter() - t0) / n_pf
+    # SECOND warm dispatch: on this tunnel the first post-compile
+    # dispatch of an executable can pay a ~1.4 s lazy-warmup that the
+    # compile call does not absorb (scripts/debug_prefill_cliff.py;
+    # BASELINE.md "prefill anomaly, resolved"). Rounds 1-3 timed
+    # exactly that dispatch — the whole "prefill cliff" and the
+    # dense-vs-quant contrast were this artifact.
+    float(prefill_many(params, fresh_cache, (prompt + 7) % 32000))
+    pf_rates = []
+    for i in range(DECODE_REPEATS):
+        t0 = time.perf_counter()
+        float(prefill_many(params, fresh_cache, (prompt + 1 + i) % 32000))
+        pf_rates.append(n_pf / (time.perf_counter() - t0))
+    pf_disp = _dispersion(pf_rates)
+    prefill_s = 1.0 / pf_disp["steps_per_sec_median"]
     prefill_tps = batch * prompt_len / prefill_s
 
     # --- steady-state decode: new_tokens steps chained in one jit
@@ -473,11 +487,13 @@ def bench_decode(batch: int = 8, prompt_len: int = 1024,
         return last, toks
 
     token0 = jnp.argmax(logits, -1).astype(jnp.int32)
-    last, _ = decode_many(params, cache, token0)  # compile + warm
+    last, _ = decode_many(params, cache, token0)  # compile
     float(last[0])
+    last, _ = decode_many(params, cache, last)    # second warm dispatch
+    float(last[0])                                # (see prefill note)
     reps = []
     tok_in = last
-    for _ in range(REPEATS):
+    for _ in range(DECODE_REPEATS):
         t0 = time.perf_counter()
         # feed last output in as the next seed token: data dependency
         # between repeats, never an identical dispatch
@@ -493,6 +509,7 @@ def bench_decode(batch: int = 8, prompt_len: int = 1024,
     total_bw = (n_bytes + kv_bytes) * disp["steps_per_sec_median"]
     return {
         "prefill_tokens_per_sec": round(prefill_tps, 0),
+        "prefill_spread_pct": pf_disp["spread_pct"],
         "decode_tokens_per_sec": round(decode_tps, 0),
         "decode_step_ms": round(step_ms, 2),
         "spread_pct": disp["spread_pct"],

@@ -541,9 +541,18 @@ def bpe_lm_loader(data_dir: str = "data/", batch_size: int = 8,
                         "broadcast of the tokenizer)"
                     )
                 time.sleep(2.0)
+    from .tokenizer import token_index_at_byte
+
     tok = BpeTokenizer.load(tok_path)
     ids = np.load(ids_path, mmap_mode="r")
-    split = int(len(ids) * (1.0 - val_fraction))
+    # split at the token covering the SAME byte position the tokenizer
+    # fit stopped at — a plain id-stream fraction only approximates the
+    # byte cut (bytes/token differs head vs tail), and when the tail
+    # compresses better the fractional split would hand val some
+    # tokenizer-seen bytes
+    split = token_index_at_byte(
+        tok, ids, int(path.stat().st_size * (1.0 - val_fraction))
+    )
     part = ids[:split] if training else ids[split:]
     n_chunks = len(part) // seq_len
     if n_chunks == 0:

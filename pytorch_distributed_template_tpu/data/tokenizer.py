@@ -238,6 +238,32 @@ class BpeTokenizer:
         return cls([tuple(m) for m in spec["merges"]])
 
 
+def token_index_at_byte(tok: BpeTokenizer, ids, byte_cut: int) -> int:
+    """Index of the first token whose bytes start at or after
+    ``byte_cut`` in the original file.
+
+    Token byte lengths are exact (chunked encoding never merges across
+    chunk bounds, so summed token lengths reproduce file offsets).
+    Lets a loader place its train/val split at the SAME byte position
+    the tokenizer's fit stopped at — a fraction of the id stream only
+    approximates it, because bytes-per-token differs between head and
+    tail (ADVICE r3 leakage fix, exact-boundary form)."""
+    lens = np.array([len(v) for v in tok.vocab], np.int64)
+    total, chunk = 0, 1 << 22
+    for i in range(0, len(ids), chunk):
+        seg = lens[np.asarray(ids[i: i + chunk])]
+        s = int(seg.sum())
+        if total + s < byte_cut:
+            total += s
+            continue
+        # boundary in this chunk: the straddling token goes to TRAIN
+        # (its bytes begin before the cut), so the split is after the
+        # first token whose cumulative coverage reaches the cut
+        cum = total + np.cumsum(seg)
+        return i + int(np.searchsorted(cum, byte_cut, side="left")) + 1
+    return len(ids)
+
+
 def tokenizer_from_config(config) -> "BpeTokenizer | None":
     """Recover the run's tokenizer from its config, if the experiment
     trained through ``BpeLMLoader`` (the loader caches the tokenizer
@@ -291,9 +317,10 @@ def bpe_cache_path(data_dir, file: str, vocab_size: int,
     ``val_fraction`` change must refit, not silently reuse merges
     fitted at the old cut — reusing them can leak eval text into the
     tokenizer."""
-    # "p" stands in for the decimal point (t90, t90p5): the name must
-    # encode val_fraction exactly (rounding would let two different
-    # cuts collide on one cache) yet stay a single path suffix so
-    # ``with_suffix`` derives the sibling id-stream cache
+    # "p" stands in for the decimal point (t90, t90p5) so the keyed
+    # stem stays a single path suffix and ``with_suffix`` derives the
+    # sibling id-stream cache. %g keeps 6 significant digits: cuts
+    # that differ only beyond that collide on one cache — accepted,
+    # val fractions are human-chosen round numbers
     pct = f"{(1.0 - float(val_fraction)) * 100:g}".replace(".", "p")
     return Path(data_dir) / f"{file}.bpe{vocab_size}.t{pct}.json"

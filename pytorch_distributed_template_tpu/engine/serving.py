@@ -65,7 +65,9 @@ class GenerationService:
                     raise ValueError("got a string, not a list")
                 ids = []
                 for i in prompt_ids:
-                    if int(i) != i:
+                    # bool is an int subclass: true/false would coerce
+                    # to ids 1/0 — same reject-don't-coerce class
+                    if isinstance(i, bool) or int(i) != i:
                         raise ValueError(f"non-integer id {i!r}")
                     ids.append(int(i))
             except (TypeError, ValueError, OverflowError) as e:

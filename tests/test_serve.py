@@ -117,7 +117,7 @@ def test_error_paths(server):
     # client-shape errors are 400s, not 500s: non-iterable payloads,
     # nested lists, stringified ids, and non-integral floats must all
     # reject rather than silently generating from coerced ids
-    for bad in (7, [[1, 2], [3]], "123", [1.9, 2.7]):
+    for bad in (7, [[1, 2], [3]], "123", [1.9, 2.7], [True, False]):
         with pytest.raises(urllib.error.HTTPError) as e:
             _post(server, {"prompt_ids": bad, "max_new_tokens": 2})
         assert e.value.code == 400, bad

@@ -165,6 +165,27 @@ def test_train_from_file_sample_until_excludes_tail(tmp_path):
         BpeTokenizer.train_from_file(f, 320, sample_until=0.0)
 
 
+def test_token_index_at_byte_exact_boundary():
+    """The split index reproduces exact byte offsets: tokens before the
+    index cover >= the cut, tokens from the index on start at or after
+    it (the straddling token goes to train)."""
+    from pytorch_distributed_template_tpu.data.tokenizer import (
+        token_index_at_byte,
+    )
+
+    data = b"aa bb aa bb aa bb cc dd " * 40
+    tok = BpeTokenizer.train(data, 300)
+    ids = tok.encode(data)
+    lens = np.array([len(v) for v in tok.vocab])
+    cum = np.cumsum(lens[ids])
+    for cut in (1, 17, len(data) // 2, len(data) - 3, len(data)):
+        s = token_index_at_byte(tok, ids, cut)
+        assert cum[s - 1] >= cut            # train covers the cut...
+        if s > 1:
+            assert cum[s - 2] < cut         # ...and is minimal
+    assert token_index_at_byte(tok, ids, len(data) + 99) == len(ids)
+
+
 def test_bpe_loader_synthetic_fallback(tmp_path):
     import pytorch_distributed_template_tpu.data  # noqa: F401
     from pytorch_distributed_template_tpu.config.registry import LOADERS
