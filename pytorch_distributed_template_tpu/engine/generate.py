@@ -141,11 +141,24 @@ def generate_speculative(model, params, prompt: jnp.ndarray,
     Speculation REWINDS the KV cache after rejection by resetting the
     model-level ``pos_index`` counter: rejected rows stay in the cache
     but are invisible (the visibility mask hides positions beyond the
-    counter) and are overwritten by the next chunk's DUS write at the
-    same positions. This is only sound for the NON-ROLLING cache — a
-    rolling window (Mistral-style ring buffer) evicts on write, which
+    counter) and are overwritten by the next iteration's DUS write at
+    the same positions. This is only sound for the NON-ROLLING cache —
+    a rolling window (Mistral-style ring buffer) evicts on write, which
     cannot be undone — so models must satisfy ``window == 0`` or
     ``window > prompt + budget``.
+
+    The whole generation runs as ONE ``lax.while_loop`` dispatch
+    (after the prefill): the loop stops exactly when the budget is
+    met, so the token buffer needs only final-iteration slack, not
+    per-chunk slack, and there are no mid-generation host round trips
+    (~105 ms each through this platform's tunnel — BASELINE.md).
+    Round 3 shipped a host-chunked ``lax.scan`` form instead, because
+    ``lax.while_loop`` measured ~16x slower — that measurement timed
+    the first post-compile dispatch (the tunnel's lazy-warmup,
+    BASELINE.md "prefill anomaly, resolved"); properly warmed, the
+    while_loop form measures ~2.8 ms per verify call vs ~1.9 ms per
+    vanilla 1-token step, and speculation wins wall-clock whenever
+    acceptance beats ~1.5 tokens/call.
 
     Restrictions (asserted): batch 1 (the cache keeps ONE position
     counter; divergent per-row acceptance would need per-row
@@ -166,19 +179,15 @@ def generate_speculative(model, params, prompt: jnp.ndarray,
         raise ValueError("draft_len must be >= 1")
     if max_new_tokens <= 0:
         return (prompt, {}) if return_stats else prompt
-    # verify calls per device dispatch, shrunk to fit the model: the
-    # buffer needs slack for a full final chunk running past the target
-    # (the scan body is unconditional — see _spec_chunk on why), each
-    # iteration writing up to D+1 predictions
-    room = int(model.max_len) - (t0 + max_new_tokens + 2)
-    K = min(32, max_new_tokens, room // (D + 1))
-    if K < 1:
+    # the loop stops exactly at the budget, so the buffer needs slack
+    # only for the FINAL iteration: <= D committed tokens of overshoot
+    # plus its D+1 written predictions
+    L = t0 + max_new_tokens + 2 * (D + 1)
+    if L > int(model.max_len):
         raise ValueError(
-            f"prompt + max_new_tokens + draft slack = "
-            f"{t0 + max_new_tokens + 2 + D + 1} exceeds model.max_len "
-            f"= {model.max_len}"
+            f"prompt + max_new_tokens + draft slack = {L} exceeds "
+            f"model.max_len = {model.max_len}"
         )
-    L = t0 + max_new_tokens + K * (D + 1) + 2
     window = int(getattr(model, "window", 0) or 0)
     if 0 < window <= L:
         raise ValueError(
@@ -187,24 +196,8 @@ def generate_speculative(model, params, prompt: jnp.ndarray,
             "that rejection must rewind"
         )
 
-    cache = fresh_cache(model, params, 1, L)
-    prefill, _ = _decode_fns(model, 0.0, 0, 0.0)
-    last_logits, cache = prefill(params, cache, prompt)
-    token0 = jnp.argmax(last_logits, axis=-1).astype(jnp.int32)   # [1]
-
-    toks = jnp.zeros((L,), jnp.int32)
-    toks = jax.lax.dynamic_update_slice(toks, prompt[0], (0,))
-    toks = jax.lax.dynamic_update_slice(toks, token0, (t0,))
-    # n = committed tokens in the buffer; the token at n-1 is committed
-    # but not yet in the KV cache (invariant: cache pos_index == n - 1)
-    n = jnp.int32(t0 + 1)
-    iters = jnp.int32(0)
-
-    run_chunk = _spec_chunk(model, L, D, g, K)
-    # host loop over device chunks: one scalar readback of the commit
-    # count per K verify calls decides whether another chunk is needed
-    while int(n) - t0 - 1 < max_new_tokens:
-        toks, n, iters, cache = run_chunk(params, cache, toks, n, iters)
+    run = _spec_loop(model, L, D, g, t0, max_new_tokens)
+    toks, n, iters = run(params, prompt)
 
     out = toks[None, : t0 + max_new_tokens]
     if return_stats:
@@ -224,36 +217,62 @@ def generate_speculative(model, params, prompt: jnp.ndarray,
 
 
 @functools.lru_cache(maxsize=32)
-def _spec_chunk(model, L: int, D: int, g: int, K: int):
-    """Compiled K-iteration speculative chunk: each ``lax.scan``
-    iteration drafts by n-gram lookup, verifies with one ``D+1``-token
-    model call, commits the accepted prefix, and rewinds ``pos_index``.
+def _spec_loop(model, L: int, D: int, g: int, t0: int, max_new: int):
+    """Compiled speculative generation: ONE dispatch per request —
+    zero cache build, prompt prefill, token-buffer setup, and a
+    ``lax.while_loop`` that drafts by n-gram lookup, verifies with one
+    ``D+1``-token model call per iteration, commits the accepted
+    prefix, rewinds ``pos_index``, and exits exactly when ``max_new``
+    tokens are committed.
 
-    A plain unconditional scan — NOT ``lax.while_loop`` and NOT a
-    ``lax.cond``-guarded body — because on the current TPU toolchain
-    both alternatives flip this program onto a ~16x-slower XLA schedule
-    (measured: the identical verify-call body runs 1.3 ms/call as a
-    bare scan vs 21-30 ms under while/cond — the same cliff family
-    BASELINE.md documents for prefill). The caller loops over chunks on
-    the host instead, so iterations past the token budget are wasted
-    work (bounded by one chunk), not wrong results.
+    Everything lives in one executable because on tunneled devices the
+    per-FENCED-dispatch round trip is ~105 ms and an eagerly-built
+    cache pytree costs ~0.5 s of small allocation dispatches (measured,
+    BASELINE.md) — per-request costs that swamp the ~0.5-3 ms verify
+    calls. Round 3 shipped host-chunked ``lax.scan`` calls instead,
+    citing measured ~16x cliffs for ``lax.while_loop`` and the
+    token-buffer DUS; those measurements timed the tunnel's
+    first-dispatch lazy-warmup (BASELINE.md "prefill anomaly,
+    resolved"), not the program.
 
-    Known residual anomaly (same family, measured round 3): adding the
-    token-buffer ``dynamic_update_slice`` to the scan body — a 2.6 KB
-    int32 write — re-flips the schedule to ~11 ms/call on this tunnel
-    even though the verify call alone runs 1.3 ms. A chunk-frozen
-    buffer variant avoids the write but loses the within-chunk history
-    the drafter needs (acceptance fell 2.8 -> 1.2 tokens/call), so the
-    fresh-draft form is kept and the platform gap is reported honestly
-    in the bench rung."""
+    The ``iters < max_new`` cap is belt-and-suspenders (each iteration
+    commits >= 1 token, so the commit condition terminates first)."""
     from jax import lax
 
     @jax.jit
-    def run_chunk(params, cache, toks, n, iters):
+    def run(params, prompt):
+        # zero KV cache, built in-graph (shapes via eval_shape at trace
+        # time — no device work on the host path)
+        shapes = jax.eval_shape(
+            lambda p: model.apply(
+                {"params": p}, jnp.zeros((1, L), jnp.int32),
+                train=False, decode=True, mutable=["cache"],
+            ),
+            params,
+        )[1]["cache"]
+        cache = jax.tree.map(
+            lambda s: jnp.zeros(s.shape, s.dtype), shapes
+        )
+        logits, vs = model.apply(
+            {"params": params, "cache": cache}, prompt,
+            train=False, decode=True, prefill=True, mutable=["cache"],
+        )
+        cache = vs["cache"]
+        token0 = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+        toks = jnp.zeros((L,), jnp.int32)
+        toks = lax.dynamic_update_slice(toks, prompt[0], (0,))
+        toks = lax.dynamic_update_slice(toks, token0, (t0,))
+        # n = committed tokens; the token at n-1 is committed but not
+        # yet in the KV cache (invariant: cache pos_index == n - 1)
+        n = jnp.int32(t0 + 1)
         starts = jnp.arange(L - g + 1)
 
-        def body(carry, _):
-            toks, n, iters, cur_cache = carry
+        def cond(state):
+            toks, n, iters, cur_cache = state
+            return (n - t0 - 1 < max_new) & (iters < max_new)
+
+        def body(state):
+            toks, n, iters, cur_cache = state
             # --- draft: latest earlier occurrence of the trailing g-gram
             # (g static shift-compares, not a [L, g] gather — the gather
             # form measured ~35% slower on the current toolchain)
@@ -287,14 +306,14 @@ def _spec_chunk(model, L: int, D: int, g: int, K: int):
             toks = lax.dynamic_update_slice(toks, preds, (n,))
             new_cache = dict(vs["cache"])
             new_cache["pos_index"] = n + na
-            return (toks, n + na + 1, iters + 1, new_cache), None
+            return (toks, n + na + 1, iters + 1, new_cache)
 
-        (toks, n, iters, cache), _ = lax.scan(
-            body, (toks, n, iters, cache), None, length=K
+        toks, n, iters, cache = lax.while_loop(
+            cond, body, (toks, n, jnp.int32(0), cache)
         )
-        return toks, n, iters, cache
+        return toks, n, iters
 
-    return run_chunk
+    return run
 
 
 @functools.lru_cache(maxsize=32)
