@@ -571,6 +571,158 @@ def bpe_lm_loader(data_dir: str = "data/", batch_size: int = 8,
     return loader
 
 
+@LOADERS.register("PyModuleClsLoader")
+def py_module_cls_loader(data_dir: str = "data/", batch_size: int = 64,
+                         shuffle: bool = True, num_workers: int = 0,
+                         training: bool = True,
+                         modules: tuple = ("asyncio", "email", "unittest",
+                                           "xml", "multiprocessing",
+                                           "importlib", "encodings",
+                                           "http"),
+                         seq_len: int = 128, vocab_size: int = 1024,
+                         corpus_file: str = "pystdlib.txt",
+                         val_fraction: float = 0.2,
+                         max_chunks_per_module: int = 1000,
+                         seed: int = 0):
+    """Real downstream classification: which stdlib package does a
+    token window come from?
+
+    The labeled companion to the unlabeled ``pystdlib.txt`` pretraining
+    corpus (scripts/make_text_corpus.py): windows of ``seq_len`` BPE
+    tokens drawn from the named top-level stdlib packages in THIS
+    image, labeled by package. Tokenized with the SAME cached BPE
+    tokenizer the ``BpeLMLoader`` pretraining run fits (so a
+    pretrained encoder's embeddings line up with the fine-tune ids).
+    The val split holds out whole FILES (deterministic md5 of the
+    file's package-relative name), so val windows come from source
+    files the classifier never saw — a generalization split, not a
+    shuffled-window split. Honest caveat for transfer experiments: the
+    *unlabeled* text of val files does appear in the pretraining
+    corpus (the standard SSL setup); the labels do not.
+
+    The reference's data layer is MNIST-only (reference
+    data_loader/data_loaders.py); this loader is the text-domain
+    real-data analogue, with the same synthetic fallback contract.
+    """
+    del num_workers
+    import hashlib
+    import sysconfig
+
+    from .tokenizer import BpeTokenizer, bpe_cache_path
+
+    modules = tuple(modules)
+    stdlib = Path(sysconfig.get_paths()["stdlib"])
+    tok_path = bpe_cache_path(data_dir, corpus_file, vocab_size)
+    legacy_tok = Path(data_dir) / f"{corpus_file}.bpe{vocab_size}.json"
+    corpus = Path(data_dir) / corpus_file
+
+    if tok_path.exists():
+        tok = BpeTokenizer.load(tok_path)
+    elif legacy_tok.exists():
+        tok = BpeTokenizer.load(legacy_tok)
+    elif corpus.exists():
+        # no pretraining run cached a tokenizer yet: fit one exactly
+        # like BpeLMLoader would (train split only) and cache it there
+        tok = BpeTokenizer.train_from_file(corpus, vocab_size,
+                                           sample_until=0.9)
+        tok.save(tok_path)
+    else:
+        tok = None
+
+    if tok is None or not stdlib.exists():
+        logger.warning(
+            "PyModuleClsLoader: %s missing; using synthetic labeled "
+            "data.", tok_path if tok is None else stdlib,
+        )
+        rng = np.random.default_rng(seed + (0 if training else 1))
+        n = 512 if training else 128
+        labels = rng.integers(0, len(modules), n)
+        # class-dependent token distributions so learning is possible
+        tokens = (rng.integers(0, vocab_size // 2, (n, seq_len))
+                  + labels[:, None] * (vocab_size // (2 * len(modules))))
+        return _make_image_loader(
+            {"tokens": tokens.astype(np.int32),
+             "label": labels.astype(np.int32)},
+            batch_size, shuffle, seed=seed)
+
+    # window cache: encoding ~10 MB of source is seconds of numpy work
+    # per process; four loader builds per experiment ask for a cache
+    key = hashlib.md5(
+        ("|".join(modules) + f"|{seq_len}|{vocab_size}|{val_fraction}|v2"
+         ).encode()).hexdigest()[:10]
+    cache = Path(data_dir) / f"pycls_{key}.npz"
+    if not cache.exists():
+        tok_rows, lab_rows, split_rows = [], [], []
+        for li, mod in enumerate(modules):
+            root = stdlib / mod
+            files = (sorted(root.rglob("*.py")) if root.is_dir()
+                     else [stdlib / f"{mod}.py"])
+            files = [f for f in files if f.exists()
+                     and "__pycache__" not in f.parts]
+            encoded = []
+            for f in files:
+                rel = f.relative_to(stdlib).as_posix()
+                ids = tok.encode(f.read_bytes()[: 256 << 10])
+                k = len(ids) // seq_len
+                if k == 0:
+                    continue
+                h = int(hashlib.md5(rel.encode()).hexdigest(), 16)
+                encoded.append((h, ids[: k * seq_len].reshape(k, seq_len)))
+            # stratified file holdout: walk files in deterministic hash
+            # order, sending whole files to val until this MODULE's val
+            # share is met — a plain per-file hash threshold can leave a
+            # single-big-file class with zero val rows
+            encoded.sort(key=lambda e: e[0])
+            total_mod = sum(len(c) for _, c in encoded)
+            chunks_per_file, val_seen = [], 0
+            for _, c in encoded:
+                is_val = val_seen < val_fraction * total_mod
+                val_seen += len(c) if is_val else 0
+                chunks_per_file.append((c, is_val))
+            total = sum(len(c) for c, _ in chunks_per_file)
+            keep = min(total, max_chunks_per_module)
+            # proportional thinning keeps every file represented
+            frac = keep / max(total, 1)
+            for c, is_val in chunks_per_file:
+                take = max(1, int(round(len(c) * frac)))
+                c = c[:take]
+                tok_rows.append(c)
+                lab_rows.append(np.full(len(c), li, np.int32))
+                split_rows.append(np.full(len(c), is_val, bool))
+        tokens = np.concatenate(tok_rows).astype(np.int32)
+        labels = np.concatenate(lab_rows)
+        is_val = np.concatenate(split_rows)
+        # cross-file duplicate text (encodings/* boilerplate, vendored
+        # copies) can reproduce a train window bit-for-bit inside a
+        # held-out file — drop those val windows so val measures
+        # generalization, never recall
+        train_keys = {r.tobytes() for r in tokens[~is_val]}
+        dup = np.array([is_val[i] and tokens[i].tobytes() in train_keys
+                        for i in range(len(tokens))])
+        if dup.any():
+            logger.info(
+                "PyModuleClsLoader: dropping %d val windows duplicated "
+                "in train files", int(dup.sum()),
+            )
+            tokens, labels, is_val = (
+                tokens[~dup], labels[~dup], is_val[~dup]
+            )
+        tmp = cache.with_name(cache.name + f".tmp{os.getpid()}")
+        with open(tmp, "wb") as fh:
+            np.savez(fh, tokens=tokens, labels=labels, is_val=is_val)
+        os.replace(tmp, cache)
+        logger.info(
+            "PyModuleClsLoader: cached %d windows (%d val) over %d "
+            "classes to %s", len(labels), int(is_val.sum()),
+            len(modules), cache,
+        )
+    data = np.load(cache)
+    sel = ~data["is_val"] if training else data["is_val"]
+    return _make_image_loader(
+        {"tokens": data["tokens"][sel], "label": data["labels"][sel]},
+        batch_size, shuffle, seed=seed)
+
+
 @LOADERS.register("SyntheticLMLoader")
 def lm_loader(data_dir: str = "data/", batch_size: int = 8,
               shuffle: bool = True, num_workers: int = 0,

@@ -59,6 +59,48 @@ def test_digits_loader_is_real_and_disjoint():
     assert all(r.tobytes() not in tr_keys for r in va)
 
 
+def test_py_module_cls_loader_real_split():
+    """The downstream classification loader (BERT transfer evidence,
+    VERDICT r3 #4): real stdlib source, whole-FILE holdout, every class
+    represented in both splits, ids within the BPE vocab."""
+    kw = dict(data_dir="data/", batch_size=32, seq_len=128,
+              vocab_size=1024, num_workers=0)
+    tr = LOADERS.get("PyModuleClsLoader")(**kw, training=True)
+    va = LOADERS.get("PyModuleClsLoader")(**kw, training=False,
+                                          shuffle=False)
+    n_cls = int(tr.arrays["label"].max()) + 1
+    assert n_cls == 8
+    tr_counts = np.bincount(tr.arrays["label"], minlength=n_cls)
+    va_counts = np.bincount(va.arrays["label"], minlength=n_cls)
+    assert (tr_counts > 0).all() and (va_counts > 0).all(), (
+        tr_counts, va_counts
+    )
+    assert int(tr.arrays["tokens"].max()) < 1024
+    # file-level holdout: no token window appears in both splits
+    tr_keys = {r.tobytes() for r in tr.arrays["tokens"]}
+    overlap = sum(r.tobytes() in tr_keys for r in va.arrays["tokens"])
+    assert overlap == 0, f"{overlap} val windows overlap train"
+
+
+def test_bert_transfer_artifact_ordering():
+    """Committed evidence that MLM pretraining transfers: the r4
+    artifact's matched-budget fine-tunes must show the warm-started
+    encoder beating fresh init on held-out-file val accuracy
+    (VERDICT r3 #4 'done' bar)."""
+    art = Path(__file__).parent.parent / "artifacts" / "bert_r4"
+    verdict = json.loads((art / "verdict.json").read_text())
+    assert verdict["pretraining_helps"] is True
+    assert (verdict["warm_best_val_accuracy"]
+            > verdict["fresh_best_val_accuracy"])
+    curves = json.loads((art / "curves.json").read_text())
+    # matched budget: same number of fine-tune epochs in both arms
+    assert (len(curves["finetune_warm"])
+            == len(curves["finetune_fresh"]) > 0)
+    # and the pretrain run really learned something (val loss fell)
+    pre = curves["pretrain"]
+    assert pre[-1]["val_loss"] < pre[0]["val_loss"]
+
+
 def test_corpus_builder_deterministic_and_skips_oversize(tmp_path):
     """make_text_corpus: byte-identical across runs (the held-out tail
     split depends on it) and a file that would blow the budget is
