@@ -77,7 +77,8 @@ def fresh_cache(model, params, batch: int, length: int):
 
 def generate(model, params, prompt: jnp.ndarray, max_new_tokens: int,
              temperature: float = 1.0, top_k: int = 0, top_p: float = 0.0,
-             rng: Optional[jax.Array] = None) -> jnp.ndarray:
+             rng: Optional[jax.Array] = None,
+             row_rngs: Optional[jax.Array] = None) -> jnp.ndarray:
     """Generate ``max_new_tokens`` continuations for each prompt row.
 
     :param model: a TransformerLM-family module (``decode=True`` support).
@@ -85,7 +86,11 @@ def generate(model, params, prompt: jnp.ndarray, max_new_tokens: int,
         ``state.ema_params``).
     :param prompt: ``[B, T0]`` int32 token ids (T0 >= 1).
     :param rng: PRNG key for sampling (defaults to key(0); unused when
-        greedy).
+        greedy). Split into one independent stream PER ROW.
+    :param row_rngs: optional ``[B]`` keys, one per row, overriding the
+        ``rng`` split — the micro-batched server passes each request's
+        own seed here, so a request's sampled tokens do not depend on
+        which other requests shared its batch.
     :returns: ``[B, T0 + max_new_tokens]`` tokens (prompt included).
     """
     prompt = jnp.asarray(prompt, jnp.int32)
@@ -99,21 +104,57 @@ def generate(model, params, prompt: jnp.ndarray, max_new_tokens: int,
             f"prompt + max_new_tokens = {total} exceeds model.max_len "
             f"= {model.max_len}"
         )
-    rng = rng if rng is not None else jax.random.key(0)
+    if row_rngs is None:
+        rng = rng if rng is not None else jax.random.key(0)
+        row_rngs = jax.random.split(rng, b)
+    elif len(row_rngs) != b:
+        raise ValueError(f"row_rngs has {len(row_rngs)} keys for {b} rows")
 
     cache = fresh_cache(model, params, b, total)
     prefill, step = _decode_fns(model, float(temperature), int(top_k),
                                 float(top_p))
     last_logits, cache = prefill(params, cache, prompt)
-    keys = jax.random.split(rng, max_new_tokens)
-    token = sample_logits(keys[0], last_logits, temperature, top_k, top_p)
+    if temperature <= 0:
+        # greedy ignores keys; reuse the (unfolded) row keys as the
+        # step's dummy key argument instead of folding per step
+        keys_at = lambda i: row_rngs                       # noqa: E731
+    else:
+        # ONE dispatch precomputes every step's per-row key ([T, B]);
+        # the loop then just indexes — same per-step cost as the old
+        # single-stream split
+        all_keys = _fold_all_rows(row_rngs, max_new_tokens)
+        keys_at = lambda i: all_keys[i]                    # noqa: E731
+    token = _sample_rows(keys_at(0), last_logits,
+                         temperature, top_k, top_p)
     # tokens stay on device through the loop (no per-step host sync);
     # async dispatch pipelines the steps
     out = [prompt, token[:, None]]
     for i in range(1, max_new_tokens):
-        token, cache = step(params, cache, token, keys[i])
+        token, cache = step(params, cache, token, keys_at(i))
         out.append(token[:, None])
     return jnp.concatenate(out, axis=1)
+
+
+@functools.partial(jax.jit, static_argnums=1)
+def _fold_all_rows(row_rngs, n: int):
+    """``[n, B]`` per-(step, row) keys — row streams are independent,
+    so a row's samples are a function of (its key, the step index)
+    only, never of batch composition."""
+    return jax.vmap(
+        lambda i: jax.vmap(lambda k: jax.random.fold_in(k, i))(row_rngs)
+    )(jnp.arange(n))
+
+
+def _sample_rows(keys, logits, temperature: float, top_k: int,
+                 top_p: float):
+    """``sample_logits`` with one key per row ([B] keys, [B, V]
+    logits)."""
+    if temperature <= 0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    return jax.vmap(
+        lambda k, lg: sample_logits(k, lg[None, :], temperature, top_k,
+                                    top_p)[0]
+    )(keys, logits)
 
 
 def generate_speculative(model, params, prompt: jnp.ndarray,
@@ -338,12 +379,14 @@ def _decode_fns(model, temperature: float, top_k: int, top_p: float = 0.0):
         return logits[:, -1], vs["cache"]
 
     @jax.jit
-    def step(params, cache, token, key):
+    def step(params, cache, token, keys):
+        # keys: [B] per-row streams (generate._fold_rows) — sampling is
+        # row-independent, so batching requests never changes a row
         logits, vs = model.apply(
             {"params": params, "cache": cache}, token[:, None],
             train=False, decode=True, mutable=["cache"],
         )
-        nxt = sample_logits(key, logits[:, -1], temperature, top_k, top_p)
+        nxt = _sample_rows(keys, logits[:, -1], temperature, top_k, top_p)
         return nxt, vs["cache"]
 
     return prefill, step

@@ -113,6 +113,11 @@ class MoeMlp(nn.Module):
         # would pin it to 1.0 and cut the router off from the task gradient.
         gate_vals = gate_vals * tok[:, None]
 
+        if self.dispatch_impl not in ("auto", "gather", "einsum"):
+            raise ValueError(
+                f"dispatch_impl={self.dispatch_impl!r}; expected "
+                "'auto'/'gather'/'einsum'"
+            )
         use_gather = self.dispatch_impl == "gather" or (
             self.dispatch_impl == "auto"
             and not (self.mesh is not None

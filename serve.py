@@ -16,10 +16,17 @@ in a stdlib ``ThreadingHTTPServer``. No web framework, no deps.
                               top_p / seed / speculative
                               -> {"text": ...} and/or {"ids": [...]}
 
-Generation is serialized with a lock (one chip, one compiled decode
-path); concurrent requests queue. The first request per
-(sampling-config, prompt-length bucket) pays the XLA compile; later
-ones reuse the cached executables (engine/generate._decode_fns).
+Concurrent requests MICRO-BATCH (engine/serving.BatchedGenerationService):
+a worker groups compatible requests — same prompt length,
+max_new_tokens, and sampling config — that arrive within
+``--batch-window-ms`` (default 25 ms) into one batched prefill +
+shared decode loop, up to ``--max-batch`` rows. Each request keeps its
+own sampling stream, so responses don't depend on batch composition;
+mixed-shape traffic degrades to per-shape batches, and speculative
+requests run batch-1. ``GET /healthz`` reports batching stats
+(requests/batches/max_batch_size). The first request per
+(sampling-config, shape) pays the XLA compile; later ones reuse the
+cached executables (engine/generate._decode_fns).
 """
 from __future__ import annotations
 
@@ -39,7 +46,7 @@ import pytorch_distributed_template_tpu.data  # noqa: F401,E402
 import pytorch_distributed_template_tpu.engine  # noqa: F401,E402
 import pytorch_distributed_template_tpu.models  # noqa: F401,E402
 from pytorch_distributed_template_tpu.engine.serving import (  # noqa: E402
-    GenerationService,
+    BatchedGenerationService, GenerationService,
 )
 
 
@@ -77,6 +84,7 @@ def make_handler(service: GenerationService):
                 "arch": service.arch,
                 "vocab_size": service.vocab,
                 "tokenizer": service.tokenizer is not None,
+                "batching": getattr(service, "stats", None),
             })
 
         def do_POST(self):  # noqa: N802
@@ -99,7 +107,13 @@ def make_handler(service: GenerationService):
 
 def main(args, config):
     logger = config.get_logger("serve")
-    service = GenerationService(config, use_ema=args.ema)
+    if args.max_batch > 1:
+        service = BatchedGenerationService(
+            config, use_ema=args.ema, max_batch=args.max_batch,
+            window_ms=args.batch_window_ms,
+        )
+    else:  # --max-batch 1: the plain serialized service
+        service = GenerationService(config, use_ema=args.ema)
     server = ThreadingHTTPServer(
         (args.host, args.port), make_handler(service)
     )
@@ -127,5 +141,11 @@ if __name__ == "__main__":
     parser.add_argument("--port", default=8000, type=int,
                         help="0 picks a free port (printed on READY).")
     parser.add_argument("--ema", action="store_true")
+    parser.add_argument("--max-batch", default=8, type=int,
+                        help="micro-batch scheduler width; 1 disables "
+                             "batching")
+    parser.add_argument("--batch-window-ms", default=25.0, type=float,
+                        help="how long the scheduler waits to group "
+                             "concurrent compatible requests")
     args, config = ConfigParser.from_args(parser, (), training=False)
     main(args, config)
