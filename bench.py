@@ -59,7 +59,7 @@ STEPS = 20
 # cause instead of a timeout with nothing. Deliberately standalone from
 # utils/watchdog.StepWatchdog: the bench guard must arm before, and
 # survive, a package/jax import that itself hangs on the wedged device.
-WATCHDOG_SECS = 2100
+WATCHDOG_SECS = 2900   # raised r4: +2 rungs (llama_train, moe)
 _done = threading.Event()
 
 
