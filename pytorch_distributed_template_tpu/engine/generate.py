@@ -110,10 +110,13 @@ def generate(model, params, prompt: jnp.ndarray, max_new_tokens: int,
     elif len(row_rngs) != b:
         raise ValueError(f"row_rngs has {len(row_rngs)} keys for {b} rows")
 
-    cache = fresh_cache(model, params, b, total)
-    prefill, step = _decode_fns(model, float(temperature), int(top_k),
-                                float(top_p))
-    last_logits, cache = prefill(params, cache, prompt)
+    # zero cache + prefill in ONE dispatch: an eagerly-built cache
+    # pytree is ~50 small allocation dispatches (~0.5 s per request
+    # through a tunneled device — the cost the speculative path's
+    # single-dispatch form eliminated; BASELINE.md)
+    _, step = _decode_fns(model, float(temperature), int(top_k),
+                          float(top_p))
+    last_logits, cache = _prefill_fresh(model, total)(params, prompt)
     if temperature <= 0:
         # greedy ignores keys; reuse the (unfolded) row keys as the
         # step's dummy key argument instead of folding per step
@@ -355,6 +358,35 @@ def _spec_loop(model, L: int, D: int, g: int, t0: int, max_new: int):
         return toks, n, iters
 
     return run
+
+
+@functools.lru_cache(maxsize=32)
+def _prefill_fresh(model, total: int):
+    """Compiled (zero cache build + prompt prefill) pair per (model,
+    cache length): one dispatch where ``fresh_cache`` + ``prefill``
+    was ~50 (the per-request serving hot path). Batch size
+    specializes by trace like any other jit dimension."""
+
+    @jax.jit
+    def go(params, prompt):
+        b = prompt.shape[0]
+        shapes = jax.eval_shape(
+            lambda p: model.apply(
+                {"params": p}, jnp.zeros((b, total), jnp.int32),
+                train=False, decode=True, mutable=["cache"],
+            ),
+            params,
+        )[1]["cache"]
+        cache = jax.tree.map(
+            lambda s: jnp.zeros(s.shape, s.dtype), shapes
+        )
+        logits, vs = model.apply(
+            {"params": params, "cache": cache}, prompt,
+            train=False, decode=True, prefill=True, mutable=["cache"],
+        )
+        return logits[:, -1], vs["cache"]
+
+    return go
 
 
 @functools.lru_cache(maxsize=32)
