@@ -59,7 +59,7 @@ STEPS = 20
 # cause instead of a timeout with nothing. Deliberately standalone from
 # utils/watchdog.StepWatchdog: the bench guard must arm before, and
 # survive, a package/jax import that itself hangs on the wedged device.
-WATCHDOG_SECS = 2900   # raised r4: +2 rungs (llama_train, moe)
+WATCHDOG_SECS = 3300   # raised r4: +3 rungs (llama_train, moe, serve_batch)
 _done = threading.Event()
 
 
@@ -716,6 +716,79 @@ def bench_moe(batch: int = 8, seq: int = 1024) -> dict:
     }
 
 
+def bench_serve_batch(n_requests: int = 8, prompt_len: int = 512,
+                      new_tokens: int = 64) -> dict:
+    """Serving micro-batch rung (VERDICT r3 #6's on-chip evidence):
+    aggregate throughput of N concurrent same-shape greedy requests
+    when the server batches them into ONE shared prefill + decode loop
+    (engine/serving.BatchedGenerationService's execution shape) vs the
+    r3 behavior of serializing them one at a time. Uses ``generate()``
+    directly — the same call the service's worker makes — so the
+    number isolates the batching win from HTTP overhead.
+
+    Measured r4: batching 8 requests is ~5-7x aggregate tok/s. The
+    batched arm's dispatch is short (~0.3 s), so the tunnel's tail
+    hiccups (BASELINE.md) dominate its spread_pct; the speedup is a
+    ratio of medians, robust to those tails."""
+    import jax
+    import jax.numpy as jnp
+
+    import pytorch_distributed_template_tpu.models  # noqa: F401
+    from pytorch_distributed_template_tpu.config.registry import MODELS
+    from pytorch_distributed_template_tpu.engine.generate import generate
+
+    model = MODELS.get("Llama")(
+        vocab_size=32000, n_layer=12, n_head=12, n_kv_head=4,
+        d_model=768, max_len=prompt_len + new_tokens, bfloat16=True,
+    )
+    params = model.init(
+        jax.random.key(0), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+    rng = np.random.default_rng(0)
+    prompts = jnp.asarray(
+        rng.integers(0, 32000, (n_requests, prompt_len)), jnp.int32
+    )
+
+    def batched(p):
+        return generate(model, params, p, new_tokens, temperature=0.0)
+
+    def serial(p):
+        outs = [
+            generate(model, params, p[i:i + 1], new_tokens,
+                     temperature=0.0)
+            for i in range(n_requests)
+        ]
+        return outs[-1]
+
+    def timed(fn, tag):
+        out = fn(prompts)                     # compile
+        int(out[0, -1])
+        out = fn((prompts + 1) % 32000)       # second warm dispatch
+        int(out[0, -1])
+        reps = []
+        for i in range(DECODE_REPEATS):
+            t0 = time.perf_counter()
+            out = fn((prompts + 2 + i) % 32000)
+            int(out[0, -1])
+            reps.append(
+                n_requests * new_tokens / (time.perf_counter() - t0)
+            )
+        return _dispersion(reps)
+
+    b = timed(batched, "batched")
+    s = timed(serial, "serial")
+    return {
+        "batched_agg_tokens_per_sec": round(b["steps_per_sec_median"], 0),
+        "serial_agg_tokens_per_sec": round(s["steps_per_sec_median"], 0),
+        "batching_speedup": round(
+            b["steps_per_sec_median"] / s["steps_per_sec_median"], 2),
+        "spread_pct": b["spread_pct"],
+        "n_requests": n_requests,
+        "prompt_len": prompt_len,
+        "new_tokens": new_tokens,
+    }
+
+
 def bench_decode_spec(prompt_len: int = 512, new_tokens: int = 256,
                       draft_len: int = 4) -> dict:
     """Speculative-decoding rung: greedy tokens/sec through
@@ -1075,6 +1148,11 @@ def main():
     rungs["moe"] = _try_ladder("moe", [
         (bench_moe, {"batch": 8, "seq": 1024}),
         (bench_moe, {"batch": 4, "seq": 1024}),
+    ])
+    # serving micro-batch: N shared-batch requests vs N serialized
+    rungs["serve_batch"] = _try_ladder("serve_batch", [
+        (bench_serve_batch, {"n_requests": 8}),
+        (bench_serve_batch, {"n_requests": 4}),
     ])
     # speculative decoding (prompt-lookup drafting): latency-oriented
     # batch-1 serving — speedup is workload-dependent, so the rung
