@@ -21,18 +21,12 @@ import jax
 import jax.numpy as jnp
 
 
-def sample_logits(key, logits, temperature: float = 1.0, top_k: int = 0,
+def filter_logits(logits, temperature: float, top_k: int,
                   top_p: float = 0.0):
-    """Sample token ids from ``[B, V]`` logits (in-graph).
-
-    ``temperature <= 0`` means greedy argmax. ``top_k > 0`` restricts
-    sampling to the k highest-probability tokens. ``top_p`` in (0, 1)
-    applies nucleus sampling: the smallest set of tokens whose cumulative
-    probability reaches ``top_p`` (the top token always survives).
-    ``top_k`` and ``top_p`` compose (k-filter first, as in HF).
-    """
-    if temperature <= 0:
-        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    """Temperature/top-k/top-p filtering of ``[B, V]`` logits — the
+    sampling DISTRIBUTION without the sample, shared by
+    ``sample_logits`` and the speculative verifier (which needs the
+    filtered probabilities for rejection sampling)."""
     logits = logits / temperature
     if top_k > 0:
         kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
@@ -50,6 +44,22 @@ def sample_logits(key, logits, temperature: float = 1.0, top_k: int = 0,
             jnp.where(keep, sorted_logits, jnp.inf), axis=-1, keepdims=True
         )
         logits = jnp.where(logits < thresh, -jnp.inf, logits)
+    return logits
+
+
+def sample_logits(key, logits, temperature: float = 1.0, top_k: int = 0,
+                  top_p: float = 0.0):
+    """Sample token ids from ``[B, V]`` logits (in-graph).
+
+    ``temperature <= 0`` means greedy argmax. ``top_k > 0`` restricts
+    sampling to the k highest-probability tokens. ``top_p`` in (0, 1)
+    applies nucleus sampling: the smallest set of tokens whose cumulative
+    probability reaches ``top_p`` (the top token always survives).
+    ``top_k`` and ``top_p`` compose (k-filter first, as in HF).
+    """
+    if temperature <= 0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    logits = filter_logits(logits, temperature, top_k, top_p)
     return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
 
 
@@ -162,16 +172,30 @@ def _sample_rows(keys, logits, temperature: float, top_k: int,
 
 def generate_speculative(model, params, prompt: jnp.ndarray,
                          max_new_tokens: int, draft_len: int = 4,
-                         ngram: int = 2, return_stats: bool = False):
-    """GREEDY generation via self-speculative (prompt-lookup) decoding.
+                         ngram: int = 2, return_stats: bool = False,
+                         temperature: float = 0.0, top_k: int = 0,
+                         top_p: float = 0.0,
+                         rng: Optional[jax.Array] = None):
+    """Generation via self-speculative (prompt-lookup) decoding.
 
-    Emits BIT-IDENTICAL tokens to ``generate(..., temperature=0)`` —
-    speculation changes the schedule, never the output — but each model
-    call verifies ``draft_len`` guessed tokens at once, so on
-    repetitive continuations (code, structured text) one forward pass
-    commits several tokens. Decode is HBM-bound (a 1-token step and a
-    5-token step stream the same weight bytes), which is exactly why
-    accepted drafts are almost-free throughput.
+    GREEDY (``temperature <= 0``, the default) emits BIT-IDENTICAL
+    tokens to ``generate(..., temperature=0)`` — speculation changes
+    the schedule, never the output. SAMPLED (``temperature > 0``) is
+    DISTRIBUTION-exact rejection sampling: the n-gram drafter proposes
+    deterministically, so draft token ``d`` at a position with target
+    distribution ``p`` (after temperature/top-k/top-p filtering) is
+    accepted with probability ``p(d)``; on rejection the position
+    resamples from the residual ``p`` with ``d`` zeroed, renormalized
+    — which makes the emitted token exactly ``p``-distributed
+    (``P(t) = p(d)·1[t=d] + (1-p(d))·p(t)·1[t≠d]/(1-p(d)) = p(t)``).
+    The token stream differs from ``generate()``'s (different rng
+    path), but its law is the same.
+
+    Each model call verifies ``draft_len`` guessed tokens at once, so
+    on repetitive continuations (code, structured text) one forward
+    pass commits several tokens. Decode is HBM-bound (a 1-token step
+    and a 5-token step stream the same weight bytes), which is exactly
+    why accepted drafts are almost-free throughput.
 
     The drafter is n-gram prompt lookup (no second model): find the
     most recent earlier occurrence of the trailing ``ngram`` tokens in
@@ -206,8 +230,7 @@ def generate_speculative(model, params, prompt: jnp.ndarray,
 
     Restrictions (asserted): batch 1 (the cache keeps ONE position
     counter; divergent per-row acceptance would need per-row
-    counters), greedy only (sampled speculative decoding needs
-    rejection resampling — not implemented), ``prompt >= ngram``.
+    counters), ``prompt >= ngram``.
     """
     prompt = jnp.asarray(prompt, jnp.int32)
     b, t0 = prompt.shape
@@ -240,8 +263,10 @@ def generate_speculative(model, params, prompt: jnp.ndarray,
             "that rejection must rewind"
         )
 
-    run = _spec_loop(model, L, D, g, t0, max_new_tokens)
-    toks, n, iters = run(params, prompt)
+    run = _spec_loop(model, L, D, g, t0, max_new_tokens,
+                     float(temperature), int(top_k), float(top_p))
+    rng = rng if rng is not None else jax.random.key(0)
+    toks, n, iters = run(params, prompt, rng)
 
     out = toks[None, : t0 + max_new_tokens]
     if return_stats:
@@ -261,13 +286,20 @@ def generate_speculative(model, params, prompt: jnp.ndarray,
 
 
 @functools.lru_cache(maxsize=32)
-def _spec_loop(model, L: int, D: int, g: int, t0: int, max_new: int):
+def _spec_loop(model, L: int, D: int, g: int, t0: int, max_new: int,
+               temperature: float = 0.0, top_k: int = 0,
+               top_p: float = 0.0):
     """Compiled speculative generation: ONE dispatch per request —
     zero cache build, prompt prefill, token-buffer setup, and a
     ``lax.while_loop`` that drafts by n-gram lookup, verifies with one
     ``D+1``-token model call per iteration, commits the accepted
     prefix, rewinds ``pos_index``, and exits exactly when ``max_new``
     tokens are committed.
+
+    ``temperature > 0`` switches verification from greedy
+    prefix-match to rejection sampling against the filtered target
+    distribution (see ``generate_speculative`` for the exactness
+    argument); the greedy path is bit-identical to before.
 
     Everything lives in one executable because on tunneled devices the
     per-FENCED-dispatch round trip is ~105 ms and an eagerly-built
@@ -283,8 +315,10 @@ def _spec_loop(model, L: int, D: int, g: int, t0: int, max_new: int):
     commits >= 1 token, so the commit condition terminates first)."""
     from jax import lax
 
+    greedy = temperature <= 0
+
     @jax.jit
-    def run(params, prompt):
+    def run(params, prompt, rng):
         # zero KV cache, built in-graph (shapes via eval_shape at trace
         # time — no device work on the host path)
         shapes = jax.eval_shape(
@@ -302,7 +336,17 @@ def _spec_loop(model, L: int, D: int, g: int, t0: int, max_new: int):
             train=False, decode=True, prefill=True, mutable=["cache"],
         )
         cache = vs["cache"]
-        token0 = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+        # two disjoint streams: the prefill token's and the loop's
+        # (folding iters directly off ``rng`` could collide with the
+        # prefill key at iteration counts past the constant)
+        rng0, rng_loop = jax.random.split(rng)
+        if greedy:
+            token0 = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+        else:
+            token0 = sample_logits(
+                rng0, logits[:, -1].astype(jnp.float32),
+                temperature, top_k, top_p,
+            )
         toks = jnp.zeros((L,), jnp.int32)
         toks = lax.dynamic_update_slice(toks, prompt[0], (0,))
         toks = lax.dynamic_update_slice(toks, token0, (t0,))
@@ -339,15 +383,60 @@ def _spec_loop(model, L: int, D: int, g: int, t0: int, max_new: int):
                 {"params": params, "cache": cur_cache}, chunk,
                 train=False, decode=True, mutable=["cache"],
             )
-            preds = jnp.argmax(logits[0], axis=-1).astype(jnp.int32)
-            na = jnp.sum(jnp.cumprod(
-                (draft == preds[:D]).astype(jnp.int32)
-            ))
-            # committed tokens this round: preds[0..na] (the accepted
-            # draft prefix equals the predictions, plus one fresh token);
-            # stale buffer/cache rows beyond the commit point are
-            # invisible (pos_index rewind) and overwritten next round
-            toks = lax.dynamic_update_slice(toks, preds, (n,))
+            if greedy:
+                preds = jnp.argmax(logits[0], axis=-1).astype(jnp.int32)
+                na = jnp.sum(jnp.cumprod(
+                    (draft == preds[:D]).astype(jnp.int32)
+                ))
+                # committed this round: preds[0..na] (the accepted
+                # draft prefix equals the predictions, plus one fresh
+                # token); stale buffer/cache rows beyond the commit
+                # point are invisible (pos_index rewind) and
+                # overwritten next round
+                write = preds
+            else:
+                # rejection sampling against the filtered target
+                # distribution p_j at each draft position: the n-gram
+                # drafter is deterministic, so accept d_j w.p.
+                # p_j(d_j); the first rejected position resamples from
+                # p with d_j zeroed, renormalized; if ALL D accept,
+                # the bonus position D samples from p_D untouched.
+                # Each emitted token is exactly p-distributed.
+                flogits = filter_logits(
+                    logits[0].astype(jnp.float32), temperature,
+                    top_k, top_p,
+                )                                       # [D+1, V]
+                probs = jax.nn.softmax(flogits, axis=-1)
+                it_key = jax.random.fold_in(rng_loop, iters)
+                k_acc, k_res = jax.random.split(it_key)
+                p_draft = jnp.take_along_axis(
+                    probs[:D], draft[:, None], axis=1
+                )[:, 0]                                  # [D]
+                u = jax.random.uniform(k_acc, (D,))
+                na = jnp.sum(jnp.cumprod(
+                    (u < p_draft).astype(jnp.int32)
+                ))
+                # residual/bonus distribution at the commit position
+                res_logits = flogits[na]
+                res_logits = jnp.where(
+                    (na < D)
+                    & (jnp.arange(res_logits.shape[0])
+                       == draft[jnp.minimum(na, D - 1)]),
+                    -jnp.inf, res_logits,
+                )
+                fresh = jax.random.categorical(
+                    k_res, res_logits
+                ).astype(jnp.int32)
+                # write vector: accepted draft prefix, then the fresh
+                # token at position na; beyond is junk (invisible via
+                # the pos_index rewind, overwritten next round)
+                pos = jnp.arange(D + 1)
+                write = jnp.where(
+                    pos < na,
+                    jnp.concatenate([draft, draft[-1:]]),
+                    fresh,
+                )
+            toks = lax.dynamic_update_slice(toks, write, (n,))
             new_cache = dict(vs["cache"])
             new_cache["pos_index"] = n + na
             return (toks, n + na + 1, iters + 1, new_cache)

@@ -133,21 +133,21 @@ class GenerationService:
 
         from .generate import generate, generate_speculative
 
-        if speculative > 0 and temperature > 0:
-            raise ValueError(
-                "speculative generation is greedy-exact; drop "
-                "temperature (sampled speculative decoding is not "
-                "implemented)"
-            )
         ids = self.encode_prompt(prompt, prompt_ids)
         arr = jnp.asarray(np.asarray(ids, np.int32)[None, :])
         with self._lock:
             stats = None
             if speculative > 0:
+                # temperature > 0 runs distribution-exact rejection
+                # sampling against the filtered target (greedy stays
+                # bit-exact) — engine/generate.generate_speculative
                 out, stats = generate_speculative(
                     self.model, self.params, arr,
                     max_new_tokens=int(max_new_tokens),
                     draft_len=int(speculative), return_stats=True,
+                    temperature=float(temperature), top_k=int(top_k),
+                    top_p=float(top_p),
+                    rng=jax.random.key(int(seed)),
                 )
             else:
                 # row_rngs (not rng): the row stream is key(seed)

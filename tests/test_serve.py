@@ -102,6 +102,14 @@ def test_generate_ids_mode_and_sampling(server):
                        "temperature": 0.8, "top_k": 10, "seed": 3})
     assert len(r["ids"]) == 6
     assert all(0 <= t < 64 for t in r["ids"])
+    # sampled SPECULATIVE requests are served too (r4: rejection
+    # sampling — r3 rejected temperature+speculative outright)
+    r = _post(server, {"prompt": "12:31", "max_new_tokens": 6,
+                       "speculative": 2, "temperature": 0.8, "seed": 5})
+    assert len(r["ids"]) == 6 and "speculative" in r
+    r2 = _post(server, {"prompt": "12:31", "max_new_tokens": 6,
+                        "speculative": 2, "temperature": 0.8, "seed": 5})
+    assert r["ids"] == r2["ids"]          # seeded -> reproducible
 
 
 def test_concurrent_requests_micro_batch(server):
