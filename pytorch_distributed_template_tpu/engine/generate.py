@@ -88,7 +88,8 @@ def fresh_cache(model, params, batch: int, length: int):
 def generate(model, params, prompt: jnp.ndarray, max_new_tokens: int,
              temperature: float = 1.0, top_k: int = 0, top_p: float = 0.0,
              rng: Optional[jax.Array] = None,
-             row_rngs: Optional[jax.Array] = None) -> jnp.ndarray:
+             row_rngs: Optional[jax.Array] = None,
+             pad_lens=None) -> jnp.ndarray:
     """Generate ``max_new_tokens`` continuations for each prompt row.
 
     :param model: a TransformerLM-family module (``decode=True`` support).
@@ -101,7 +102,13 @@ def generate(model, params, prompt: jnp.ndarray, max_new_tokens: int,
         ``rng`` split — the micro-batched server passes each request's
         own seed here, so a request's sampled tokens do not depend on
         which other requests shared its batch.
-    :returns: ``[B, T0 + max_new_tokens]`` tokens (prompt included).
+    :param pad_lens: optional ``[B]`` int32 — per-row LEFT-pad length
+        for mixed-prompt-length batching (RoPE families only; the
+        model masks pad slots per row and slot-index RoPE is exact
+        under the per-row constant shift — models/llama.py). Rows'
+        prompts occupy ``prompt[b, pad_lens[b]:]``.
+    :returns: ``[B, T0 + max_new_tokens]`` tokens (prompt included,
+        left-pad included for padded rows).
     """
     prompt = jnp.asarray(prompt, jnp.int32)
     b, t0 = prompt.shape
@@ -119,6 +126,18 @@ def generate(model, params, prompt: jnp.ndarray, max_new_tokens: int,
         row_rngs = jax.random.split(rng, b)
     elif len(row_rngs) != b:
         raise ValueError(f"row_rngs has {len(row_rngs)} keys for {b} rows")
+    if pad_lens is not None:
+        import inspect
+
+        if "pad_lens" not in inspect.signature(
+            type(model).__call__
+        ).parameters:
+            raise ValueError(
+                f"{type(model).__name__} does not support pad_lens "
+                "(mixed-length batching needs per-row pad masking + "
+                "shift-invariant positions — the RoPE families)"
+            )
+        pad_lens = jnp.asarray(pad_lens, jnp.int32)
 
     # zero cache + prefill in ONE dispatch: an eagerly-built cache
     # pytree is ~50 small allocation dispatches (~0.5 s per request
@@ -126,7 +145,8 @@ def generate(model, params, prompt: jnp.ndarray, max_new_tokens: int,
     # single-dispatch form eliminated; BASELINE.md)
     _, step = _decode_fns(model, float(temperature), int(top_k),
                           float(top_p))
-    last_logits, cache = _prefill_fresh(model, total)(params, prompt)
+    last_logits, cache = _prefill_fresh(model, total)(params, prompt,
+                                                      pad_lens)
     if temperature <= 0:
         # greedy ignores keys; reuse the (unfolded) row keys as the
         # step's dummy key argument instead of folding per step
@@ -143,7 +163,7 @@ def generate(model, params, prompt: jnp.ndarray, max_new_tokens: int,
     # async dispatch pipelines the steps
     out = [prompt, token[:, None]]
     for i in range(1, max_new_tokens):
-        token, cache = step(params, cache, token, keys_at(i))
+        token, cache = step(params, cache, token, keys_at(i), pad_lens)
         out.append(token[:, None])
     return jnp.concatenate(out, axis=1)
 
@@ -457,7 +477,7 @@ def _prefill_fresh(model, total: int):
     specializes by trace like any other jit dimension."""
 
     @jax.jit
-    def go(params, prompt):
+    def go(params, prompt, pad_lens=None):
         b = prompt.shape[0]
         shapes = jax.eval_shape(
             lambda p: model.apply(
@@ -469,9 +489,11 @@ def _prefill_fresh(model, total: int):
         cache = jax.tree.map(
             lambda s: jnp.zeros(s.shape, s.dtype), shapes
         )
+        extra = {} if pad_lens is None else {"pad_lens": pad_lens}
         logits, vs = model.apply(
             {"params": params, "cache": cache}, prompt,
             train=False, decode=True, prefill=True, mutable=["cache"],
+            **extra,
         )
         return logits[:, -1], vs["cache"]
 
@@ -500,12 +522,13 @@ def _decode_fns(model, temperature: float, top_k: int, top_p: float = 0.0):
         return logits[:, -1], vs["cache"]
 
     @jax.jit
-    def step(params, cache, token, keys):
-        # keys: [B] per-row streams (generate._fold_rows) — sampling is
-        # row-independent, so batching requests never changes a row
+    def step(params, cache, token, keys, pad_lens=None):
+        # keys: [B] per-row streams (generate._fold_all_rows) — sampling
+        # is row-independent, so batching requests never changes a row
+        extra = {} if pad_lens is None else {"pad_lens": pad_lens}
         logits, vs = model.apply(
             {"params": params, "cache": cache}, token[:, None],
-            train=False, decode=True, mutable=["cache"],
+            train=False, decode=True, mutable=["cache"], **extra,
         )
         nxt = _sample_rows(keys, logits[:, -1], temperature, top_k, top_p)
         return nxt, vs["cache"]

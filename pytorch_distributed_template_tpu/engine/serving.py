@@ -192,21 +192,33 @@ class BatchedGenerationService(GenerationService):
     own sampling stream (``generate(row_rngs=...)``), so a request's
     output never depends on which requests shared its batch.
 
-    Scope honestly stated: grouping requires EXACT prompt-length
-    match (the decode cache keeps one position counter per batch, so
-    right-padded rows at different positions are not representable);
-    mixed-length traffic falls back to per-length batches.
+    For RoPE families (the Llama/Mistral family: shift-invariant
+    positions + per-row pad masking, ``models/llama.py pad_lens``),
+    requests of DIFFERENT prompt lengths batch together within a
+    128-token length bucket: shorter rows are LEFT-padded and their
+    pad slots masked, which is token-exact vs solo execution
+    (tests/test_generate.py). Absolute-position families (GPT-2) and
+    rolling-window models group by exact prompt length instead (one
+    batch-wide position counter; ring eviction differs per row).
     Speculative requests stay batch-1 by construction and bypass the
     scheduler. ``stats`` (surfaced via /healthz) records how much
     sharing actually happened.
     """
 
+    PAD_BUCKET = 128
+
     def __init__(self, config, use_ema: bool = False,
                  max_batch: int = 8, window_ms: float = 25.0):
+        import inspect
         import queue
         import threading
 
         super().__init__(config, use_ema)
+        self._pad_ok = (
+            "pad_lens" in inspect.signature(
+                type(self.model).__call__).parameters
+            and int(getattr(self.model, "window", 0) or 0) == 0
+        )
         self._max_batch = int(max_batch)
         self._window_s = float(window_ms) / 1e3
         self._queue: "queue.Queue" = queue.Queue()
@@ -235,6 +247,17 @@ class BatchedGenerationService(GenerationService):
         # validate in the CALLER's thread: bad input must raise here
         # (HTTP 400), not poison the worker
         ids = self.encode_prompt(prompt, prompt_ids)
+        max_len = int(getattr(self.model, "max_len", 0) or 0)
+        if max_len and len(ids) + int(max_new_tokens) > max_len:
+            # per-request budget check at ENQUEUE: group keys pin
+            # max_new_tokens, so if every member individually fits,
+            # padding to the longest member's length fits too — one
+            # oversized request can never fail its batchmates
+            raise ValueError(
+                f"prompt ({len(ids)} tokens) + max_new_tokens "
+                f"({int(max_new_tokens)}) exceeds model.max_len "
+                f"{max_len}"
+            )
         req = {
             "ids": ids,
             "max_new_tokens": int(max_new_tokens),
@@ -249,9 +272,12 @@ class BatchedGenerationService(GenerationService):
             raise req["error"]
         return req["result"]
 
-    @staticmethod
-    def _group_key(req):
-        return (len(req["ids"]), req["max_new_tokens"],
+    def _group_key(self, req):
+        n = len(req["ids"])
+        length_key = (
+            -(-n // self.PAD_BUCKET) if self._pad_ok else n
+        )
+        return (length_key, req["max_new_tokens"],
                 req["temperature"], req["top_k"], req["top_p"])
 
     def _worker(self):
@@ -298,9 +324,30 @@ class BatchedGenerationService(GenerationService):
 
         from .generate import generate
 
-        t0 = len(batch[0]["ids"])
-        arr = jnp.asarray(
-            np.stack([r["ids"] for r in batch]).astype(np.int32)
+        t0 = max(len(r["ids"]) for r in batch)
+        if self._pad_ok:
+            # round the padded length up to a small shape menu (powers
+            # of two within the bucket): one XLA compile per (shape,
+            # budget, sampling) instead of one per distinct batch-max
+            # length, at <=2x extra pad slots. Never past what the
+            # model's max_len leaves room for (every member fits by
+            # the enqueue check, so t0 itself always does).
+            shape = 16
+            while shape < t0:
+                shape *= 2
+            max_len = int(getattr(self.model, "max_len", 0) or 0)
+            if max_len:
+                shape = min(shape,
+                            max_len - batch[0]["max_new_tokens"])
+            t0 = max(t0, shape)
+        # left-pad; pad slots are masked per row
+        # (generate(pad_lens=...)) for pad-capable models, and batches
+        # are exact-length by group key otherwise (pad_lens all zero)
+        arr = jnp.asarray(np.stack([
+            [0] * (t0 - len(r["ids"])) + list(r["ids"]) for r in batch
+        ]).astype(np.int32))
+        pad_lens = np.asarray(
+            [t0 - len(r["ids"]) for r in batch], np.int32
         )
         row_rngs = jnp.stack(
             [jax.random.key(r["seed"]) for r in batch]
@@ -312,6 +359,8 @@ class BatchedGenerationService(GenerationService):
                 temperature=batch[0]["temperature"],
                 top_k=batch[0]["top_k"], top_p=batch[0]["top_p"],
                 row_rngs=row_rngs,
+                pad_lens=(jnp.asarray(pad_lens)
+                          if pad_lens.any() else None),
             )
         new = np.asarray(out[:, t0:])
         self.stats["requests"] += len(batch)

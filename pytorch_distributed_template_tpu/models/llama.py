@@ -106,7 +106,8 @@ class LlamaAttention(nn.Module):
 
     @nn.compact
     def __call__(self, x, positions, train: bool, decode: bool = False,
-                 decode_index=None, prefill: bool = False):
+                 decode_index=None, prefill: bool = False,
+                 pad_lens=None):
         b, t, _ = x.shape
         hd = self.d_model // self.n_head
         groups = self.n_head // self.n_kv_head
@@ -120,7 +121,7 @@ class LlamaAttention(nn.Module):
 
         if decode:
             ctx = self._cached_attention(q, k, v, decode_index, groups,
-                                         prefill)
+                                         prefill, pad_lens)
         else:
             cos, sin = rope_tables(positions, hd, self.rope_base)
             q = apply_rope(q, cos, sin)
@@ -171,11 +172,28 @@ class LlamaAttention(nn.Module):
         return dense(self.d_model, "o_proj")(ctx)
 
     def _cached_attention(self, q, k, v, cur, groups: int,
-                          prefill: bool = False):
+                          prefill: bool = False, pad_lens=None):
         """Incremental decode against a K/V cache stored at the KV-head
         count (GQA memory win; same single-position-counter contract as
         models/transformer.SelfAttention._cached_attention). RoPE rotates
         the new rows by their absolute positions before insertion.
+
+        ``pad_lens`` ([B] int32, optional) marks each row's LEFT-pad
+        length for mixed-prompt-length batching: cache slots
+        ``< pad_lens[b]`` are hidden from row ``b``'s attention. Exact
+        for RoPE (positions here are cache-slot indices, a per-row
+        constant shift of the true positions — RoPE scores depend only
+        on q-k OFFSETS, which the shift preserves; pad slots' K/V are
+        masked so their values never matter). "Exact" is mathematical:
+        the padded run rotates at shifted angles and batched prefill
+        uses the masked einsum path where solo uses the flash kernel,
+        so logits agree to float tolerance, not bitwise — a greedy
+        token can differ where the top-2 logits are ULP-tied. Left-padding aligns all
+        rows' LAST token at the same slot, so the single position
+        counter and last-slot logit sampling stay valid. Incompatible
+        with the rolling window (eviction order differs per row) and
+        routes batched prefill through the masked einsum path instead
+        of the causal flash kernel.
 
         With ``window > 0`` the cache is a ROLLING ring buffer of
         ``window`` slots (Mistral-style): slot ``p % window`` holds
@@ -239,6 +257,12 @@ class LlamaAttention(nn.Module):
             )
         cache_len = cached_k.value.shape[1]
         rolling = self.window > 0 and cache_len == self.window
+        if pad_lens is not None and rolling:
+            raise ValueError(
+                "pad_lens (mixed-length batching) is incompatible with "
+                "a rolling-window cache: ring eviction order would "
+                "differ per row"
+            )
         slot_pos = None
         if self.window > 0:
             # Which absolute position each slot holds, stored as pos + 1 so
@@ -350,6 +374,11 @@ class LlamaAttention(nn.Module):
             visible = k_pos <= pos[:, None]
             if self.window > 0:
                 visible = visible & (pos[:, None] - k_pos < self.window)
+            if pad_lens is not None:
+                # [B, t, L]: row b additionally hides its left-pad slots
+                visible = visible[None] & (
+                    k_pos[None] >= pad_lens[:, None, None]
+                )
             # ... and the WRITE stores the rows in cache form
             qk, sk = to_store(k)
             qv, sv = to_store(v)
@@ -365,10 +394,12 @@ class LlamaAttention(nn.Module):
         if groups > 1:
             k_all = jnp.repeat(k_all, groups, axis=2)
             v_all = jnp.repeat(v_all, groups, axis=2)
-        if t > 1 and prefill:
+        if t > 1 and prefill and pad_lens is None:
             return _fresh_prefill_ctx()
+        mask = (visible[:, None] if visible.ndim == 3    # [B, 1, t, L]
+                else visible[None, None])                # [1, 1, t, L]
         return multihead_attention(
-            q, k_all, v_all, causal=False, mask=visible[None, None]
+            q, k_all, v_all, causal=False, mask=mask
         )
 
 
@@ -411,7 +442,7 @@ class LlamaBlock(nn.Module):
     @nn.compact
     def __call__(self, x, positions, train: bool, example_mask=None,
                  decode: bool = False, decode_index=None,
-                 prefill: bool = False):
+                 prefill: bool = False, pad_lens=None):
         h = RMSNorm(self.rms_eps, name="input_layernorm")(x)
         x = x + LlamaAttention(
             self.d_model, self.n_head, self.n_kv_head, self.dtype,
@@ -419,7 +450,7 @@ class LlamaBlock(nn.Module):
             window=self.window, quant=self.quant, kv_quant=self.kv_quant,
             lora_rank=self.lora_rank, lora_alpha=self.lora_alpha,
             name="self_attn",
-        )(h, positions, train, decode, decode_index, prefill)
+        )(h, positions, train, decode, decode_index, prefill, pad_lens)
         h = RMSNorm(self.rms_eps, name="post_attention_layernorm")(x)
         if self.moe:
             # Mixtral-style sparse FFN: routed SwiGLU experts over the
@@ -494,7 +525,8 @@ class LlamaLM(nn.Module):
 
     @nn.compact
     def __call__(self, tokens, train: bool = False, example_mask=None,
-                 decode: bool = False, prefill: bool = False):
+                 decode: bool = False, prefill: bool = False,
+                 pad_lens=None):
         if self.quant:
             from .quant import validate_quant_config
 
@@ -502,6 +534,11 @@ class LlamaLM(nn.Module):
                                   self.moe_experts)
         if self.kv_quant not in ("", "int8"):
             raise ValueError(f"unknown kv_quant {self.kv_quant!r}")
+        if pad_lens is not None and not decode:
+            raise ValueError(
+                "pad_lens is a decode-time batching feature; training "
+                "uses example_mask"
+            )
         b, t = tokens.shape
         n_kv = self.n_kv_head or self.n_head
         if self.n_head % n_kv != 0:
@@ -570,7 +607,8 @@ class LlamaLM(nn.Module):
                 kv_quant=self.kv_quant, lora_rank=self.lora_rank,
                 lora_alpha=self.lora_alpha,
                 name=f"layers_{i}",
-            )(x, positions, train, example_mask, decode, start, prefill)
+            )(x, positions, train, example_mask, decode, start, prefill,
+              pad_lens)
         x = RMSNorm(self.rms_eps, name="norm")(x)
         if zperm is not None:
             x = x[:, np.argsort(zperm)]

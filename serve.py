@@ -17,16 +17,18 @@ in a stdlib ``ThreadingHTTPServer``. No web framework, no deps.
                               -> {"text": ...} and/or {"ids": [...]}
 
 Concurrent requests MICRO-BATCH (engine/serving.BatchedGenerationService):
-a worker groups compatible requests — same prompt length,
-max_new_tokens, and sampling config — that arrive within
+a worker groups compatible requests — same max_new_tokens and sampling
+config, prompt lengths within a 128-token bucket for RoPE families
+(shorter rows left-pad with per-row masking; absolute-position and
+rolling-window models group by exact length) — that arrive within
 ``--batch-window-ms`` (default 25 ms) into one batched prefill +
 shared decode loop, up to ``--max-batch`` rows. Each request keeps its
-own sampling stream, so responses don't depend on batch composition;
-mixed-shape traffic degrades to per-shape batches, and speculative
-requests run batch-1. ``GET /healthz`` reports batching stats
-(requests/batches/max_batch_size). The first request per
-(sampling-config, shape) pays the XLA compile; later ones reuse the
-cached executables (engine/generate._decode_fns).
+own sampling stream, so responses don't depend on batch composition
+(token-exact up to float-level ties between the batched and solo
+kernels), and speculative requests run batch-1. ``GET /healthz``
+reports batching stats (requests/batches/max_batch_size). The first
+request per (sampling-config, shape) pays the XLA compile; later ones
+reuse the cached executables (engine/generate._decode_fns).
 """
 from __future__ import annotations
 

@@ -222,6 +222,41 @@ def test_generate_top_p_runs():
     assert out.shape == (2, 12)
 
 
+def test_padded_mixed_length_batch_matches_solo():
+    """Mixed-prompt-length batching (left-pad + pad_lens) is EXACT for
+    RoPE models: each padded row's greedy continuation equals its solo
+    run token-for-token (per-row pad masking hides pad slots;
+    slot-index RoPE is shift-invariant). Non-RoPE models refuse."""
+    model = MODELS.get("Llama")(vocab_size=VOCAB, n_layer=2, n_head=4,
+                                n_kv_head=2, d_model=32, max_len=64)
+    rng = np.random.default_rng(3)
+    p_short = jnp.asarray(rng.integers(0, VOCAB, (1, 9)), jnp.int32)
+    p_long = jnp.asarray(rng.integers(0, VOCAB, (1, 13)), jnp.int32)
+    params = model.init(jax.random.key(0), p_long)["params"]
+
+    solo_s = generate(model, params, p_short, 8, temperature=0.0)
+    solo_l = generate(model, params, p_long, 8, temperature=0.0)
+
+    pad = jnp.zeros((1, 4), jnp.int32)
+    batch = jnp.concatenate([
+        jnp.concatenate([pad, p_short], axis=1), p_long
+    ], axis=0)                                       # [2, 13] left-padded
+    out = generate(model, params, batch, 8, temperature=0.0,
+                   pad_lens=jnp.asarray([4, 0], jnp.int32))
+    np.testing.assert_array_equal(np.asarray(out[0, 13:]),
+                                  np.asarray(solo_s[0, 9:]))
+    np.testing.assert_array_equal(np.asarray(out[1, 13:]),
+                                  np.asarray(solo_l[0, 13:]))
+
+    # absolute-position families must refuse, not silently mis-position
+    tl = MODELS.get("TinyLM")(vocab_size=VOCAB, n_layer=1, n_head=2,
+                              d_model=16, max_len=32)
+    tp = tl.init(jax.random.key(0), p_short)["params"]
+    with pytest.raises(ValueError, match="pad_lens"):
+        generate(tl, tp, batch[:, :13], 4, temperature=0.0,
+                 pad_lens=jnp.asarray([4, 0], jnp.int32))
+
+
 # --- speculative decoding (engine/generate.generate_speculative) -------------
 
 

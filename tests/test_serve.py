@@ -73,6 +73,85 @@ def server(tmp_path_factory):
         proc.wait(timeout=30)
 
 
+@pytest.fixture(scope="module")
+def llama_server(tmp_path_factory):
+    """A second server over a RoPE-family checkpoint (TinyLlama):
+    exercises MIXED-prompt-length micro-batching (left-pad +
+    per-row masking), which the absolute-position TinyLM server
+    cannot."""
+    from pytorch_distributed_template_tpu.config import (
+        ConfigParser, LOADERS, LOSSES, METRICS, MODELS,
+    )
+    from pytorch_distributed_template_tpu.engine import Trainer
+    from pytorch_distributed_template_tpu.parallel import mesh_from_config
+
+    tmp = tmp_path_factory.mktemp("serve_llama")
+    cfg = json.loads((REPO / "configs" / "llama_debug.json").read_text())
+    cfg["trainer"].update(save_dir=str(tmp), epochs=1, tensorboard=False)
+    config = ConfigParser(cfg, run_id="serve2", training=True)
+    trainer = Trainer(
+        config.init_obj("arch", MODELS), LOSSES.get(config["loss"]),
+        [METRICS.get(m) for m in config["metrics"]], config=config,
+        train_loader=config.init_obj("train_loader", LOADERS),
+        valid_loader=None, mesh=mesh_from_config(config), seed=0,
+    )
+    trainer.train()
+    ckpt = config.save_dir / "checkpoint-epoch1"
+    log = tmp / "serve.log"
+    proc = subprocess.Popen(
+        [sys.executable, str(REPO / "serve.py"), "-r", str(ckpt),
+         "--port", "0", "--batch-window-ms", "100"],
+        stdout=open(log, "w"), stderr=subprocess.STDOUT, cwd=REPO,
+    )
+    try:
+        url = None
+        deadline = time.time() + 300
+        while time.time() < deadline:
+            text = log.read_text() if log.exists() else ""
+            for line in text.splitlines():
+                if line.startswith("READY "):
+                    url = line.split()[1].strip()
+                    break
+            if url or proc.poll() is not None:
+                break
+            time.sleep(1.0)
+        assert proc.poll() is None, (
+            "server exited early:\n" + log.read_text()[-2000:]
+        )
+        assert url, "server never reported READY:\n" + log.read_text()[-2000:]
+        yield url
+    finally:
+        proc.terminate()
+        proc.wait(timeout=30)
+
+
+def test_mixed_length_requests_batch_exactly(llama_server):
+    """RoPE-family serving: requests with DIFFERENT prompt lengths
+    share a batch (left-pad + per-row masking) and still return
+    exactly their solo greedy tokens. (Equality is float-tolerance
+    exact, not bitwise — batched prefill uses the masked einsum path —
+    so a ULP-tied top-2 could in principle flip a token; fixed seeds
+    and checkpoint keep this deterministic per platform.)"""
+    import concurrent.futures
+
+    payloads = [{"prompt_ids": list(range(1, 1 + n)),
+                 "max_new_tokens": 8} for n in (3, 5, 9, 14)]
+    solo = [_post(llama_server, p) for p in payloads]
+    with concurrent.futures.ThreadPoolExecutor(4) as ex:
+        conc = list(ex.map(lambda p: _post(llama_server, p), payloads))
+    for a, b in zip(solo, conc):
+        assert a["ids"] == b["ids"]
+    with urllib.request.urlopen(llama_server + "/healthz",
+                                timeout=60) as r:
+        stats = json.loads(r.read())["batching"]
+    assert stats["max_batch_size"] >= 2, stats
+    # over-budget requests 400 at enqueue and never fail batchmates
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _post(llama_server, {"prompt_ids": list(range(1, 60)),
+                             "max_new_tokens": 32})
+    assert e.value.code == 400
+
+
 def _post(url, payload, timeout=300):
     req = urllib.request.Request(
         url + "/generate", data=json.dumps(payload).encode(),
