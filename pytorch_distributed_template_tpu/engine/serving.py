@@ -281,38 +281,47 @@ class BatchedGenerationService(GenerationService):
                 req["temperature"], req["top_k"], req["top_p"])
 
     def _worker(self):
+        import logging
         import queue
         import time
 
         stash: list = []
         while True:
-            if stash:
-                first = stash.pop(0)
-            else:
-                first = self._queue.get()
-            batch, key = [first], self._group_key(first)
-            # drain compatible stashed requests first
-            rest = []
-            for r in stash:
-                (batch if self._group_key(r) == key
-                 and len(batch) < self._max_batch else rest).append(r)
-            stash = rest
-            deadline = time.monotonic() + self._window_s
-            while len(batch) < self._max_batch:
-                left = deadline - time.monotonic()
-                if left <= 0:
-                    break
-                try:
-                    nxt = self._queue.get(timeout=left)
-                except queue.Empty:
-                    break
-                if self._group_key(nxt) == key:
-                    batch.append(nxt)
-                else:
-                    stash.append(nxt)
+            # the OUTER try guards everything, including the grouping
+            # logic: an exception that escaped it would kill this
+            # thread silently and hang every future request behind a
+            # queue nobody drains
+            batch = []
             try:
+                if stash:
+                    first = stash.pop(0)
+                else:
+                    first = self._queue.get()
+                batch, key = [first], self._group_key(first)
+                # drain compatible stashed requests first
+                rest = []
+                for r in stash:
+                    (batch if self._group_key(r) == key
+                     and len(batch) < self._max_batch else rest).append(r)
+                stash = rest
+                deadline = time.monotonic() + self._window_s
+                while len(batch) < self._max_batch:
+                    left = deadline - time.monotonic()
+                    if left <= 0:
+                        break
+                    try:
+                        nxt = self._queue.get(timeout=left)
+                    except queue.Empty:
+                        break
+                    if self._group_key(nxt) == key:
+                        batch.append(nxt)
+                    else:
+                        stash.append(nxt)
                 self._run_batch(batch)
             except Exception as e:  # noqa: BLE001 — surfaced per request
+                logging.getLogger(__name__).exception(
+                    "batch worker error (batch of %d)", len(batch)
+                )
                 for r in batch:
                     r["error"] = e
                     r["event"].set()
