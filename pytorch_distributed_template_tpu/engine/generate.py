@@ -195,7 +195,8 @@ def generate_speculative(model, params, prompt: jnp.ndarray,
                          ngram: int = 2, return_stats: bool = False,
                          temperature: float = 0.0, top_k: int = 0,
                          top_p: float = 0.0,
-                         rng: Optional[jax.Array] = None):
+                         rng: Optional[jax.Array] = None,
+                         pad_to: Optional[int] = None):
     """Generation via self-speculative (prompt-lookup) decoding.
 
     GREEDY (``temperature <= 0``, the default) emits BIT-IDENTICAL
@@ -251,6 +252,14 @@ def generate_speculative(model, params, prompt: jnp.ndarray,
     Restrictions (asserted): batch 1 (the cache keeps ONE position
     counter; divergent per-row acceptance would need per-row
     counters), ``prompt >= ngram``.
+
+    ``pad_to`` (RoPE families only): left-pad the prompt to this
+    length before compiling, so serving traffic with many distinct
+    prompt lengths shares one executable per length bucket instead of
+    paying a fresh XLA compile per length. Pad slots are masked from
+    attention AND from the n-gram drafter; greedy output is unchanged
+    (the verifier, not the drafter, decides tokens — tests pin this),
+    and the returned array keeps the caller's unpadded layout.
     """
     prompt = jnp.asarray(prompt, jnp.int32)
     b, t0 = prompt.shape
@@ -259,7 +268,25 @@ def generate_speculative(model, params, prompt: jnp.ndarray,
                          f"(got {b}) — the KV cache keeps one position "
                          "counter")
     if t0 < ngram:
+        # checked on the REAL length: bucket padding must not let an
+        # under-ngram prompt slip through with pad zeros as its gram
         raise ValueError(f"prompt length {t0} < ngram {ngram}")
+    pad = 0
+    if pad_to is not None and int(pad_to) > t0:
+        import inspect
+
+        if "pad_lens" not in inspect.signature(
+            type(model).__call__
+        ).parameters:
+            raise ValueError(
+                f"{type(model).__name__} does not support pad_to "
+                "(needs the pad_lens masking path)"
+            )
+        pad = int(pad_to) - t0
+        prompt = jnp.concatenate(
+            [jnp.zeros((b, pad), jnp.int32), prompt], axis=1
+        )
+        t0 = int(pad_to)
     max_new_tokens = int(max_new_tokens)
     D, g = int(draft_len), int(ngram)
     if D < 1:
@@ -284,11 +311,13 @@ def generate_speculative(model, params, prompt: jnp.ndarray,
         )
 
     run = _spec_loop(model, L, D, g, t0, max_new_tokens,
-                     float(temperature), int(top_k), float(top_p))
+                     float(temperature), int(top_k), float(top_p),
+                     padded=pad > 0)
     rng = rng if rng is not None else jax.random.key(0)
-    toks, n, iters = run(params, prompt, rng)
+    toks, n, iters = run(params, prompt, rng, jnp.int32(pad))
 
-    out = toks[None, : t0 + max_new_tokens]
+    # strip any bucket padding: callers get their own layout back
+    out = toks[None, pad: t0 + max_new_tokens]
     if return_stats:
         stats = {
             "model_calls": int(iters),
@@ -308,7 +337,7 @@ def generate_speculative(model, params, prompt: jnp.ndarray,
 @functools.lru_cache(maxsize=32)
 def _spec_loop(model, L: int, D: int, g: int, t0: int, max_new: int,
                temperature: float = 0.0, top_k: int = 0,
-               top_p: float = 0.0):
+               top_p: float = 0.0, padded: bool = False):
     """Compiled speculative generation: ONE dispatch per request —
     zero cache build, prompt prefill, token-buffer setup, and a
     ``lax.while_loop`` that drafts by n-gram lookup, verifies with one
@@ -338,7 +367,7 @@ def _spec_loop(model, L: int, D: int, g: int, t0: int, max_new: int,
     greedy = temperature <= 0
 
     @jax.jit
-    def run(params, prompt, rng):
+    def run(params, prompt, rng, pad_len):
         # zero KV cache, built in-graph (shapes via eval_shape at trace
         # time — no device work on the host path)
         shapes = jax.eval_shape(
@@ -351,9 +380,12 @@ def _spec_loop(model, L: int, D: int, g: int, t0: int, max_new: int,
         cache = jax.tree.map(
             lambda s: jnp.zeros(s.shape, s.dtype), shapes
         )
+        # bucket padding (pad_to): pad slots masked from attention
+        extra = ({"pad_lens": pad_len[None]} if padded else {})
         logits, vs = model.apply(
             {"params": params, "cache": cache}, prompt,
             train=False, decode=True, prefill=True, mutable=["cache"],
+            **extra,
         )
         cache = vs["cache"]
         # two disjoint streams: the prefill token's and the loop's
@@ -389,8 +421,12 @@ def _spec_loop(model, L: int, D: int, g: int, t0: int, max_new: int,
             for j in range(g):
                 match = match & (toks[j: L - g + 1 + j] == key[j])
             # continuation must lie in committed history, and the match
-            # at i = n-g is the key itself — exclude it
+            # at i = n-g is the key itself — exclude it; bucket-pad
+            # slots are excluded too (drafting from pad zeros would
+            # only waste verify slots, never corrupt output)
             valid = (starts + g) < n
+            if padded:
+                valid = valid & (starts >= pad_len)
             cand = jnp.where(match & valid, starts, -1)
             i = jnp.max(cand)
             cont = jnp.where(i >= 0, i + g, n - 1)
@@ -401,7 +437,7 @@ def _spec_loop(model, L: int, D: int, g: int, t0: int, max_new: int,
             chunk = jnp.concatenate([chunk, draft])[None, :]  # [1, D+1]
             logits, vs = model.apply(
                 {"params": params, "cache": cur_cache}, chunk,
-                train=False, decode=True, mutable=["cache"],
+                train=False, decode=True, mutable=["cache"], **extra,
             )
             if greedy:
                 preds = jnp.argmax(logits[0], axis=-1).astype(jnp.int32)

@@ -41,6 +41,7 @@ class GenerationService:
     """
 
     def __init__(self, config, use_ema: bool = False):
+        import inspect
         import threading
 
         self.model, self.params, self.tokenizer = load_generation_stack(
@@ -48,6 +49,14 @@ class GenerationService:
         )
         self.vocab = int(getattr(self.model, "vocab_size", 0))
         self.arch = type(self.model).__name__
+        # pad-capable = the model supports per-row left-pad masking
+        # (RoPE families, non-rolling cache): enables mixed-length
+        # micro-batching and length-bucketed speculative executables
+        self._pad_ok = (
+            "pad_lens" in inspect.signature(
+                type(self.model).__call__).parameters
+            and int(getattr(self.model, "window", 0) or 0) == 0
+        )
         self._lock = threading.Lock()
 
     def encode_prompt(self, prompt=None, prompt_ids=None) -> list:
@@ -140,14 +149,28 @@ class GenerationService:
             if speculative > 0:
                 # temperature > 0 runs distribution-exact rejection
                 # sampling against the filtered target (greedy stays
-                # bit-exact) — engine/generate.generate_speculative
+                # bit-exact) — engine/generate.generate_speculative.
+                # Length-bucket the compiled loop on pad-capable
+                # models: arbitrary prompt lengths would otherwise pay
+                # a fresh XLA compile each (~10 s on tunneled devices)
+                pad_to = None
+                if self._pad_ok:
+                    bucket = 16
+                    while bucket < arr.shape[1]:
+                        bucket *= 2
+                    limit = (int(self.model.max_len)
+                             - int(max_new_tokens)
+                             - 2 * (int(speculative) + 1))
+                    pad_to = min(bucket, limit)
+                    if pad_to <= arr.shape[1]:
+                        pad_to = None
                 out, stats = generate_speculative(
                     self.model, self.params, arr,
                     max_new_tokens=int(max_new_tokens),
                     draft_len=int(speculative), return_stats=True,
                     temperature=float(temperature), top_k=int(top_k),
                     top_p=float(top_p),
-                    rng=jax.random.key(int(seed)),
+                    rng=jax.random.key(int(seed)), pad_to=pad_to,
                 )
             else:
                 # row_rngs (not rng): the row stream is key(seed)
@@ -209,16 +232,10 @@ class BatchedGenerationService(GenerationService):
 
     def __init__(self, config, use_ema: bool = False,
                  max_batch: int = 8, window_ms: float = 25.0):
-        import inspect
         import queue
         import threading
 
-        super().__init__(config, use_ema)
-        self._pad_ok = (
-            "pad_lens" in inspect.signature(
-                type(self.model).__call__).parameters
-            and int(getattr(self.model, "window", 0) or 0) == 0
-        )
+        super().__init__(config, use_ema)   # sets _pad_ok
         self._max_batch = int(max_batch)
         self._window_s = float(window_ms) / 1e3
         self._queue: "queue.Queue" = queue.Queue()

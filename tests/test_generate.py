@@ -350,6 +350,35 @@ def test_speculative_sampled_distribution_exact():
     assert tv < 0.22, (tv, spec_counts, van_counts)
 
 
+def test_speculative_pad_to_bucket_matches_unpadded():
+    """`pad_to` (length-bucketed speculative executables) must not
+    change output: pad slots are masked from attention and the
+    drafter, and greedy verification decides every token. Non-RoPE
+    models refuse."""
+    from pytorch_distributed_template_tpu.engine.generate import (
+        generate_speculative,
+    )
+
+    model = MODELS.get("Llama")(vocab_size=VOCAB, n_layer=2, n_head=4,
+                                n_kv_head=2, d_model=32, max_len=128)
+    base = np.random.default_rng(5).integers(0, VOCAB, 6).tolist()
+    prompt = jnp.asarray([base * 3], jnp.int32)       # length 18
+    params = model.init(jax.random.key(0), prompt)["params"]
+    ref = generate_speculative(model, params, prompt, 24, draft_len=4)
+    out, stats = generate_speculative(
+        model, params, prompt, 24, draft_len=4, pad_to=32,
+        return_stats=True,
+    )
+    np.testing.assert_array_equal(np.asarray(ref), np.asarray(out))
+    assert stats["tokens_per_call"] > 1.0  # drafter still useful padded
+
+    tl = MODELS.get("TinyLM")(vocab_size=VOCAB, n_layer=1, n_head=2,
+                              d_model=16, max_len=64)
+    tp = tl.init(jax.random.key(0), prompt)["params"]
+    with pytest.raises(ValueError, match="pad_to"):
+        generate_speculative(tl, tp, prompt, 8, pad_to=32)
+
+
 def test_speculative_guards():
     from pytorch_distributed_template_tpu.engine.generate import (
         generate_speculative,
