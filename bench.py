@@ -1,6 +1,10 @@
 """Benchmark ladder on the accelerator: throughput, MFU, and dispersion.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", "rungs"}.
+Prints ONE compact JSON line to stdout — {"metric", "value", "unit",
+"vs_baseline", "summary": {rung -> headline + spread}} — sized so the
+driver's tail capture always contains it whole (VERDICT r4 #1: the r4
+full-ladder line arrived truncated, parsed=null). The full ladder with
+every per-rung field goes to stderr and artifacts/bench_full_latest.json.
 
 - ``resnet50``: bf16 ResNet-50 train step at ImageNet shapes. On this
   slice it is HBM-bandwidth-capped (~260 GB/s measured of the 819 GB/s
@@ -637,10 +641,13 @@ def bench_moe(batch: int = 8, seq: int = 1024) -> dict:
     Both arms are the same 12L/768 GPT-2-style trunk, flash attention +
     fused chunked head; the dense arm's MLP is d_ff 3072, the MoE arm
     replaces every MLP with 8 experts of d_ff 1536 routed top-2
-    (GShard dispatch/combine einsums, models/moe.py) — top_k * d_ff
-    matches the dense arm, so each token does the same matmul work and
-    any throughput gap IS the routing machinery (router matmul,
-    dispatch/combine einsums, capacity dropping, aux loss).
+    (``dispatch_impl`` left at its default "auto", which selects the
+    r4 GATHER dispatch on this rung's unsharded single-chip mesh —
+    models/moe.py; the GShard dispatch/combine einsums are the sharded
+    expert-axis path) — top_k * d_ff matches the dense arm, so each
+    token does the same matmul work and any throughput gap IS the
+    routing machinery (router matmul, token gather/scatter, capacity
+    dropping, aux loss).
     ``routing_overhead_pct`` reports that gap; ``mfu`` for the MoE arm
     counts ACTIVE flops (the standard MoE accounting; router excluded,
     so it slightly understates).
@@ -1071,6 +1078,51 @@ def bench_reference_torch(batch: int = 16, steps: int = 3) -> float:
     return batch * steps / dt
 
 
+# Which fields make a rung's one-line headline (VERDICT r4 #1: the
+# driver keeps only the TAIL of stdout, and round 4's full ladder line
+# overflowed it — BENCH_r04.json arrived truncated with parsed=null, so
+# the round's flagship numbers existed only in builder-authored docs).
+# The LAST stdout line is now a compact summary built from this table
+# (headline value(s) + spread per rung, ~1 KB total) that the capture
+# always contains whole; the full ladder goes to stderr and
+# artifacts/bench_full_latest.json for humans.
+_SUMMARY_KEYS = {
+    "resnet50": ("images_per_sec", "mfu"),
+    "gpt2_small": ("tokens_per_sec", "mfu"),
+    "vit_b16": ("images_per_sec", "mfu"),
+    "llama_train": ("tokens_per_sec", "mfu"),
+    "gpt2_long": ("tokens_per_sec", "mfu"),
+    "decode": ("decode_tokens_per_sec", "total_bw_frac"),
+    "decode_w8": ("decode_tokens_per_sec",),
+    "decode_kv8": ("decode_tokens_per_sec",),
+    "decode_w8kv8": ("decode_tokens_per_sec",),
+    "moe": ("routing_overhead_pct", "moe_active_mfu"),
+    "serve_batch": ("batching_speedup",),
+    "decode_spec": ("speedup", "tokens_per_call"),
+    "flash_attention_8k": ("speedup",),
+}
+
+
+def _compact_summary(rungs: dict) -> dict:
+    """Rung dict -> {rung: {headline fields + spread_pct}} per the
+    table above; failed rungs carry a truncated error string so the
+    round artifact still says WHICH rung died."""
+    out = {}
+    for name, r in rungs.items():
+        if "error" in r:
+            out[name] = {"error": str(r["error"])[:80]}
+            continue
+        keys = _SUMMARY_KEYS.get(name)
+        if keys is None:    # unmapped rung: first two numeric fields
+            keys = [k for k, v in r.items()
+                    if isinstance(v, (int, float))][:2]
+        row = {k: r[k] for k in keys if r.get(k) is not None}
+        if "spread_pct" in r:
+            row["spread_pct"] = r["spread_pct"]
+        out[name] = row
+    return out
+
+
 def _try_ladder(name: str, attempts) -> dict:
     """Run the first config of ``attempts`` that fits (OOM fallback),
     recording which one ran; a rung never kills the whole bench. The
@@ -1179,13 +1231,33 @@ def main():
     for r in rungs.values():
         r.pop("_exc", None)  # exception objects are not JSON
     vs = resnet["images_per_sec"] / ref if ref == ref and ref > 0 else 0.0
-    print(json.dumps({
+    full = {
         "metric": "resnet50_train_images_per_sec",
         "value": resnet["images_per_sec"],
         "unit": "images/sec",
         "vs_baseline": round(vs, 3),
         "rungs": rungs,
-    }))
+    }
+    # full ladder for humans: stderr + a local file (NOT stdout — the
+    # driver's tail capture must contain the one stdout line whole).
+    # Guarded broadly: a stray non-serializable rung field must never
+    # suppress the compact stdout line below, which is the whole point
+    # of this contract.
+    try:
+        print(json.dumps(full, default=repr), file=sys.stderr)
+        os.makedirs("artifacts", exist_ok=True)
+        with open("artifacts/bench_full_latest.json", "w") as f:
+            json.dump(full, f, indent=1, default=repr)
+    except Exception as e:  # noqa: BLE001
+        print(f"full-ladder dump failed: {e!r}", file=sys.stderr)
+    # THE one stdout JSON line: compact, parseable from a tail capture
+    print(json.dumps({
+        "metric": full["metric"],
+        "value": full["value"],
+        "unit": full["unit"],
+        "vs_baseline": full["vs_baseline"],
+        "summary": _compact_summary(rungs),
+    }, separators=(",", ":")))
     _done.set()
 
 

@@ -646,9 +646,18 @@ def py_module_cls_loader(data_dir: str = "data/", batch_size: int = 64,
             batch_size, shuffle, seed=seed)
 
     # window cache: encoding ~10 MB of source is seconds of numpy work
-    # per process; four loader builds per experiment ask for a cache
+    # per process; four loader builds per experiment ask for a cache.
+    # The key folds in EVERYTHING the window content depends on,
+    # including the tokenizer's actual bytes (a refit BPE with different
+    # merges must not reuse windows encoded with the stale merges — the
+    # fine-tune ids would silently misalign with pretrained embeddings)
+    # and max_chunks_per_module (changes which windows survive thinning).
+    tok_file = tok_path if tok_path.exists() else legacy_tok
+    tok_fp = hashlib.md5(tok_file.read_bytes()).hexdigest()[:10]
     key = hashlib.md5(
-        ("|".join(modules) + f"|{seq_len}|{vocab_size}|{val_fraction}|v2"
+        ("|".join(modules)
+         + f"|{seq_len}|{vocab_size}|{val_fraction}"
+         + f"|{max_chunks_per_module}|{tok_fp}|v3"
          ).encode()).hexdigest()[:10]
     cache = Path(data_dir) / f"pycls_{key}.npz"
     if not cache.exists():

@@ -283,6 +283,11 @@ class BatchedGenerationService(GenerationService):
             "seed": int(seed),
             "event": threading.Event(),
         }
+        # group key computed HERE, in the caller's thread: a raising
+        # key function inside the worker can strand a request that is
+        # in neither batch nor stash — its event would never be set and
+        # this wait() would block forever (advisor r4)
+        req["key"] = self._group_key(req)
         self._queue.put(req)
         req["event"].wait()
         if "error" in req:
@@ -314,11 +319,16 @@ class BatchedGenerationService(GenerationService):
                     first = stash.pop(0)
                 else:
                     first = self._queue.get()
-                batch, key = [first], self._group_key(first)
+                # requests carry their precomputed "key" (set in the
+                # caller's thread at enqueue): the worker never runs
+                # key logic, so no exception here can strand a request
+                # outside both batch and stash with its event unset
+                batch.append(first)
+                key = first["key"]
                 # drain compatible stashed requests first
                 rest = []
                 for r in stash:
-                    (batch if self._group_key(r) == key
+                    (batch if r["key"] == key
                      and len(batch) < self._max_batch else rest).append(r)
                 stash = rest
                 deadline = time.monotonic() + self._window_s
@@ -330,7 +340,7 @@ class BatchedGenerationService(GenerationService):
                         nxt = self._queue.get(timeout=left)
                     except queue.Empty:
                         break
-                    if self._group_key(nxt) == key:
+                    if nxt["key"] == key:
                         batch.append(nxt)
                     else:
                         stash.append(nxt)

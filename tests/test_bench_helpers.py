@@ -40,3 +40,32 @@ def test_try_ladder_falls_through_and_keeps_exception():
     # the real exception object survives for the headline re-raise
     assert isinstance(out["_exc"], MemoryError)
     assert "b': 4" in str(out["_exc"])
+
+
+def test_compact_summary_is_small_and_complete():
+    """VERDICT r4 #1: the LAST stdout line must fit whole inside the
+    driver's ~2 KB tail capture — every mapped rung present, headline +
+    spread only, errors truncated."""
+    import json
+
+    rungs = {}
+    for name, keys in bench._SUMMARY_KEYS.items():
+        rungs[name] = {k: 123456.789 for k in keys}
+        rungs[name]["spread_pct"] = 12.34
+        rungs[name]["noise_field"] = "x" * 500    # must NOT survive
+    rungs["decode"]["total_bw_frac"] = None       # None fields dropped
+    rungs["failed_rung"] = {"error": "boom " * 100}
+    rungs["unmapped"] = {"alpha": 1.5, "beta": 2, "gamma": "s"}
+
+    s = bench._compact_summary(rungs)
+    assert set(s) == set(rungs)
+    for name in bench._SUMMARY_KEYS:
+        assert "noise_field" not in s[name]
+        assert s[name]["spread_pct"] == 12.34
+    assert "total_bw_frac" not in s["decode"]
+    assert len(s["failed_rung"]["error"]) <= 80
+    assert s["unmapped"] == {"alpha": 1.5, "beta": 2}
+    line = json.dumps({"metric": "m", "value": 1.0, "unit": "u",
+                       "vs_baseline": 1.0, "summary": s},
+                      separators=(",", ":"))
+    assert len(line) < 1600, f"summary line too big: {len(line)}B"
