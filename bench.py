@@ -63,7 +63,7 @@ STEPS = 20
 # cause instead of a timeout with nothing. Deliberately standalone from
 # utils/watchdog.StepWatchdog: the bench guard must arm before, and
 # survive, a package/jax import that itself hangs on the wedged device.
-WATCHDOG_SECS = 3300   # raised r4: +3 rungs (llama_train, moe, serve_batch)
+WATCHDOG_SECS = 3900   # raised r5: +decode_stop rung (2 compiles + arms)
 _done = threading.Event()
 
 
@@ -796,6 +796,88 @@ def bench_serve_batch(n_requests: int = 8, prompt_len: int = 512,
     }
 
 
+def bench_decode_stop(batch: int = 8, prompt_len: int = 512,
+                      new_tokens: int = 256) -> dict:
+    """Stop-token rung (VERDICT r4 missing #1's measured half): chip
+    time actually saved when requests stop early. Both arms run the
+    stop-capable single-dispatch path (engine/generate._stop_loop) at
+    the same budget — identical programs except the stop-set width in
+    one [B, S] integer compare per step; the early arm's stop set
+    covers 1/8 of the vocab (sampled decode hits one geometrically,
+    mean ~8 tokens/row, loop exits at the max over the batch), the
+    control arm's effectively never fires, so the wall-clock ratio
+    isolates the while_loop's early exit. ``saved_frac`` is the
+    headline: the fraction of the full-budget chip time an
+    early-stopping workload gets back.
+
+    Timing: two warm dispatches per executable then DECODE_REPEATS
+    prompt-varied calls (tunnel dedup/lazy-warmup rules, BASELINE.md).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    import pytorch_distributed_template_tpu.models  # noqa: F401
+    from pytorch_distributed_template_tpu.config.registry import MODELS
+    from pytorch_distributed_template_tpu.engine.generate import generate
+
+    vocab = 32000
+    model = MODELS.get("Llama")(
+        vocab_size=vocab, n_layer=12, n_head=12, n_kv_head=4,
+        d_model=768, max_len=prompt_len + new_tokens, bfloat16=True,
+    )
+    params = model.init(
+        jax.random.key(0), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+    rng = np.random.default_rng(0)
+    prompts = jnp.asarray(
+        rng.integers(0, vocab, (batch, prompt_len)), jnp.int32
+    )
+    early_stops = list(range(0, vocab, 8))       # 1/8 of the vocab
+
+    def run(stops, seed):
+        return generate(
+            model, params, prompts, new_tokens, temperature=1.0,
+            top_k=40, rng=jax.random.key(seed), stop_tokens=stops,
+            return_lengths=True,
+        )
+
+    def timed(stops, tag):
+        out, lengths = run(stops, 1)              # compile
+        int(np.asarray(out)[0, -1])
+        out, lengths = run(stops, 2)              # second warm dispatch
+        int(np.asarray(out)[0, -1])
+        reps, lens = [], []
+        for i in range(DECODE_REPEATS):
+            t0 = time.perf_counter()
+            out, lengths = run(stops, 3 + i)
+            int(np.asarray(out)[0, -1])
+            reps.append(1.0 / (time.perf_counter() - t0))
+            lens.append(np.asarray(lengths))
+        return _dispersion(reps), np.concatenate(lens)
+
+    early, early_lens = timed(early_stops, "early")
+    # control: the same stop path with a width-1 set. A sampled decode
+    # cannot make any in-vocab id strictly unreachable, but the loop
+    # only shortens when EVERY row stops early — P(all 8 rows hit one
+    # specific id inside 256 steps) ~ (0.8%)^8 ≈ 0 — and
+    # ``control_mean_emitted`` reports what actually happened.
+    full, full_lens = timed([vocab - 1], "full")
+    t_early = 1.0 / early["steps_per_sec_median"]
+    t_full = 1.0 / full["steps_per_sec_median"]
+    return {
+        "full_budget_s": round(t_full, 3),
+        "early_stop_s": round(t_early, 3),
+        "saved_frac": round(1.0 - t_early / t_full, 3),
+        "mean_emitted": round(float(early_lens.mean()), 1),
+        "max_emitted": int(early_lens.max()),
+        "control_mean_emitted": round(float(full_lens.mean()), 1),
+        "spread_pct": early["spread_pct"],
+        "batch": batch,
+        "prompt_len": prompt_len,
+        "new_tokens": new_tokens,
+    }
+
+
 def bench_decode_spec(prompt_len: int = 512, new_tokens: int = 256,
                       draft_len: int = 4) -> dict:
     """Speculative-decoding rung: greedy tokens/sec through
@@ -1096,6 +1178,7 @@ _SUMMARY_KEYS = {
     "decode_w8": ("decode_tokens_per_sec",),
     "decode_kv8": ("decode_tokens_per_sec",),
     "decode_w8kv8": ("decode_tokens_per_sec",),
+    "decode_stop": ("saved_frac", "mean_emitted"),
     "moe": ("routing_overhead_pct", "moe_active_mfu"),
     "serve_batch": ("batching_speedup",),
     "decode_spec": ("speedup", "tokens_per_call"),
@@ -1195,6 +1278,11 @@ def main():
         (bench_decode, {"quant": "w8a16", "kv_quant": "int8"}),
         (bench_decode, {"quant": "w8a16", "kv_quant": "int8",
                         "batch": 4, "new_tokens": 128}),
+    ])
+    # stop tokens: chip time returned by the early-exit while_loop
+    rungs["decode_stop"] = _try_ladder("decode_stop", [
+        (bench_decode_stop, {}),
+        (bench_decode_stop, {"batch": 4, "new_tokens": 128}),
     ])
     # EP/MoE: dense vs 8-expert top-2 at matched active FLOPs
     rungs["moe"] = _try_ladder("moe", [

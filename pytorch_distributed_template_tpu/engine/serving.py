@@ -113,6 +113,41 @@ class GenerationService:
             raise ValueError("empty prompt (need at least one token)")
         return ids
 
+    def encode_stop(self, stop) -> list:
+        """Wire-level ``stop`` -> validated stop-token id list.
+
+        Accepts a single id / string or a list of them. Strings encode
+        through the same text path as prompts (bytes for byte-vocab
+        models, the run's BPE tokenizer otherwise) and must encode to
+        EXACTLY one token — the in-graph stop check is per emitted
+        token, and silently matching only a suffix of a multi-token
+        sequence would stop on the wrong text. Returns [] for None.
+        """
+        if stop is None:
+            return []
+        items = stop if isinstance(stop, (list, tuple)) else [stop]
+        ids = []
+        for s in items:
+            if isinstance(s, bool) or isinstance(s, float):
+                raise ValueError(f"stop entries are ids or strings, "
+                                 f"got {s!r}")
+            if isinstance(s, int):
+                ids.append(int(s))
+            elif isinstance(s, str):
+                toks = self.encode_prompt(prompt=s)
+                if len(toks) != 1:
+                    raise ValueError(
+                        f"stop string {s!r} encodes to {len(toks)} "
+                        "tokens; only single-token stops are supported "
+                        "(pass stop ids for multi-token sequences)"
+                    )
+                ids.append(int(toks[0]))
+            else:
+                raise ValueError(f"bad stop entry {s!r}")
+        if self.vocab and any(i >= self.vocab or i < 0 for i in ids):
+            raise ValueError(f"stop id outside [0, {self.vocab})")
+        return ids
+
     def decode_text(self, ids):
         """Generated ids -> text, when the model has a text form
         (byte vocab or a recovered tokenizer); else None."""
@@ -133,9 +168,16 @@ class GenerationService:
     def generate(self, prompt=None, prompt_ids=None,
                  max_new_tokens: int = 64, temperature: float = 0.0,
                  top_k: int = 0, top_p: float = 0.0, seed: int = 0,
-                 speculative: int = 0) -> dict:
+                 speculative: int = 0, stop=None) -> dict:
         """One validated generation request ->
-        ``{"ids", "text"?, "speculative"?}``."""
+        ``{"ids", "text"?, "stop_reason", "speculative"?}``.
+
+        ``stop``: stop-token ids and/or single-token strings; the
+        in-graph loop exits as soon as every row is done, so a stopped
+        request costs chip time proportional to what it EMITS, not its
+        budget. The stop token is excluded from the response (its
+        presence is reported as ``stop_reason: "stop"``).
+        """
         import jax
         import jax.numpy as jnp
         import numpy as np
@@ -143,9 +185,11 @@ class GenerationService:
         from .generate import generate, generate_speculative
 
         ids = self.encode_prompt(prompt, prompt_ids)
+        stops = self.encode_stop(stop)
         arr = jnp.asarray(np.asarray(ids, np.int32)[None, :])
         with self._lock:
             stats = None
+            emitted = None
             if speculative > 0:
                 # temperature > 0 runs distribution-exact rejection
                 # sampling against the filtered target (greedy stays
@@ -171,31 +215,57 @@ class GenerationService:
                     temperature=float(temperature), top_k=int(top_k),
                     top_p=float(top_p),
                     rng=jax.random.key(int(seed)), pad_to=pad_to,
+                    stop_tokens=stops or None,
                 )
+                emitted = stats["tokens_emitted"]
             else:
                 # row_rngs (not rng): the row stream is key(seed)
                 # EXACTLY, matching what the micro-batched service
                 # passes per row — same request + seed samples the
                 # same tokens whether or not it shared a batch
-                out = generate(
-                    self.model, self.params, arr,
-                    max_new_tokens=int(max_new_tokens),
-                    temperature=float(temperature), top_k=int(top_k),
-                    top_p=float(top_p),
-                    row_rngs=jnp.stack(
-                        [jax.random.key(int(seed))]
-                    ),
-                )
-        resp = self._response(np.asarray(out[0, arr.shape[1]:]))
+                row_rngs = jnp.stack([jax.random.key(int(seed))])
+                if stops:
+                    out, lengths = generate(
+                        self.model, self.params, arr,
+                        max_new_tokens=int(max_new_tokens),
+                        temperature=float(temperature),
+                        top_k=int(top_k), top_p=float(top_p),
+                        row_rngs=row_rngs, stop_tokens=stops,
+                        return_lengths=True,
+                    )
+                    emitted = int(lengths[0])
+                else:
+                    out = generate(
+                        self.model, self.params, arr,
+                        max_new_tokens=int(max_new_tokens),
+                        temperature=float(temperature),
+                        top_k=int(top_k), top_p=float(top_p),
+                        row_rngs=row_rngs,
+                    )
+        resp = self._response(np.asarray(out[0, arr.shape[1]:]),
+                              stops=stops, emitted=emitted)
         if stats is not None:
             resp["speculative"] = stats
         return resp
 
-    def _response(self, new_ids) -> dict:
+    def _response(self, new_ids, stops=(), emitted=None) -> dict:
         """Generated row -> wire response (ONE place: the batched and
-        serialized paths must never drift apart)."""
-        resp: dict = {"ids": [int(t) for t in new_ids]}
-        text = self.decode_text(new_ids)
+        serialized paths must never drift apart).
+
+        ``emitted`` = tokens the model actually produced for this row
+        (stop token included, frozen pad tail excluded); the stop
+        token itself is stripped from the wire ids/text and reported
+        as ``stop_reason: "stop"``.
+        """
+        ids = [int(t) for t in new_ids]
+        reason = "length"
+        if emitted is not None:
+            ids = ids[:emitted]
+        if stops and ids and ids[-1] in stops:
+            ids = ids[:-1]
+            reason = "stop"
+        resp: dict = {"ids": ids, "stop_reason": reason}
+        text = self.decode_text(ids)
         if text is not None:
             resp["text"] = text
         return resp
@@ -249,7 +319,7 @@ class BatchedGenerationService(GenerationService):
     def generate(self, prompt=None, prompt_ids=None,
                  max_new_tokens: int = 64, temperature: float = 0.0,
                  top_k: int = 0, top_p: float = 0.0, seed: int = 0,
-                 speculative: int = 0) -> dict:
+                 speculative: int = 0, stop=None) -> dict:
         import threading
 
         if speculative > 0:
@@ -259,11 +329,12 @@ class BatchedGenerationService(GenerationService):
                 prompt=prompt, prompt_ids=prompt_ids,
                 max_new_tokens=max_new_tokens, temperature=temperature,
                 top_k=top_k, top_p=top_p, seed=seed,
-                speculative=speculative,
+                speculative=speculative, stop=stop,
             )
         # validate in the CALLER's thread: bad input must raise here
         # (HTTP 400), not poison the worker
         ids = self.encode_prompt(prompt, prompt_ids)
+        stops = self.encode_stop(stop)
         max_len = int(getattr(self.model, "max_len", 0) or 0)
         if max_len and len(ids) + int(max_new_tokens) > max_len:
             # per-request budget check at ENQUEUE: group keys pin
@@ -281,6 +352,9 @@ class BatchedGenerationService(GenerationService):
             "temperature": float(temperature),
             "top_k": int(top_k), "top_p": float(top_p),
             "seed": int(seed),
+            # per-ROW stop sets in the loop executable, so requests
+            # with different stops still share a batch (not in the key)
+            "stop": stops,
             "event": threading.Event(),
         }
         # group key computed HERE, in the caller's thread: a raising
@@ -388,16 +462,35 @@ class BatchedGenerationService(GenerationService):
         row_rngs = jnp.stack(
             [jax.random.key(r["seed"]) for r in batch]
         )
+        any_stop = any(r["stop"] for r in batch)
+        lengths = None
         with self._lock:
-            out = generate(
-                self.model, self.params, arr,
-                max_new_tokens=batch[0]["max_new_tokens"],
-                temperature=batch[0]["temperature"],
-                top_k=batch[0]["top_k"], top_p=batch[0]["top_p"],
-                row_rngs=row_rngs,
-                pad_lens=(jnp.asarray(pad_lens)
-                          if pad_lens.any() else None),
-            )
+            if any_stop:
+                # the stop-capable while_loop path: per-row stop sets,
+                # so rows with different (or no) stops share the batch;
+                # the loop exits once every row is done
+                out, lengths = generate(
+                    self.model, self.params, arr,
+                    max_new_tokens=batch[0]["max_new_tokens"],
+                    temperature=batch[0]["temperature"],
+                    top_k=batch[0]["top_k"], top_p=batch[0]["top_p"],
+                    row_rngs=row_rngs,
+                    pad_lens=(jnp.asarray(pad_lens)
+                              if pad_lens.any() else None),
+                    stop_tokens=[r["stop"] for r in batch],
+                    return_lengths=True,
+                )
+                lengths = np.asarray(lengths)
+            else:
+                out = generate(
+                    self.model, self.params, arr,
+                    max_new_tokens=batch[0]["max_new_tokens"],
+                    temperature=batch[0]["temperature"],
+                    top_k=batch[0]["top_k"], top_p=batch[0]["top_p"],
+                    row_rngs=row_rngs,
+                    pad_lens=(jnp.asarray(pad_lens)
+                              if pad_lens.any() else None),
+                )
         new = np.asarray(out[:, t0:])
         self.stats["requests"] += len(batch)
         self.stats["batches"] += 1
@@ -407,7 +500,10 @@ class BatchedGenerationService(GenerationService):
             self.stats["max_batch_size"], len(batch)
         )
         for i, r in enumerate(batch):
-            r["result"] = self._response(new[i])
+            r["result"] = self._response(
+                new[i], stops=r["stop"],
+                emitted=None if lengths is None else int(lengths[i]),
+            )
             r["event"].set()
 
 

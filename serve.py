@@ -13,8 +13,18 @@ in a stdlib ``ThreadingHTTPServer``. No web framework, no deps.
     POST /generate            body: {"prompt": "text"} or
                               {"prompt_ids": [1, 2, 3]}, optional
                               max_new_tokens / temperature / top_k /
-                              top_p / seed / speculative
-                              -> {"text": ...} and/or {"ids": [...]}
+                              top_p / seed / speculative / stop
+                              -> {"text": ...} and/or {"ids": [...]},
+                              "stop_reason": "stop" | "length"
+
+``stop``: stop-token ids and/or single-token strings (a list or one
+value). Generation for a row ends as soon as it emits a stop token —
+the in-graph loop exits once EVERY row in the batch is done, so
+early-stopping requests stop burning chip time on the rest of their
+budget. The stop token is stripped from the response; ``stop_reason``
+says whether the row stopped or ran out its budget. Requests with
+different stop sets still share a batch (per-row stop sets in the
+executable).
 
 Concurrent requests MICRO-BATCH (engine/serving.BatchedGenerationService):
 a worker groups compatible requests — same max_new_tokens and sampling
@@ -65,6 +75,7 @@ def _run_request(service: GenerationService, req: dict) -> dict:
         top_p=float(req.get("top_p", 0.0)),
         seed=int(req.get("seed", 0)),
         speculative=int(req.get("speculative", 0)),
+        stop=req.get("stop"),
     )
 
 

@@ -257,6 +257,160 @@ def test_padded_mixed_length_batch_matches_solo():
                  pad_lens=jnp.asarray([4, 0], jnp.int32))
 
 
+# --- stop tokens / per-row budgets / per-row sampling (round 5) --------------
+
+
+def test_stop_tokens_that_never_fire_match_plain_path():
+    """The stop-capable while_loop path must be bit-identical to the
+    plain path when no stop fires — greedy AND sampled (pins the
+    single-dispatch loop's key folding and step order)."""
+    model, params = _model_and_params()
+    prompt = jnp.asarray(
+        np.random.default_rng(2).integers(0, VOCAB, (2, 5)), jnp.int32
+    )
+    for kw in (dict(temperature=0.0),
+               dict(temperature=1.0, top_k=8, rng=jax.random.key(4)),
+               dict(temperature=0.9, top_p=0.8, rng=jax.random.key(5))):
+        plain = np.asarray(generate(model, params, prompt, 10, **kw))
+        gen = set(plain[:, 5:].reshape(-1).tolist())
+        unused = next(i for i in range(VOCAB) if i not in gen)
+        out, lengths = generate(model, params, prompt, 10,
+                                stop_tokens=[unused],
+                                return_lengths=True, **kw)
+        np.testing.assert_array_equal(np.asarray(out), plain)
+        np.testing.assert_array_equal(np.asarray(lengths), [10, 10])
+
+
+def test_stop_token_truncates_row_exactly_and_freezes():
+    """A stopped row's tokens equal the unstopped run truncated at the
+    first stop occurrence (stop token included), with pad_id after;
+    other rows are unaffected. Per-row stop sets."""
+    model, params = _model_and_params()
+    prompt = jnp.asarray(
+        np.random.default_rng(3).integers(0, VOCAB, (2, 5)), jnp.int32
+    )
+    plain = np.asarray(generate(model, params, prompt, 10,
+                                temperature=0.0))
+    row0 = plain[0, 5:]
+    sid = int(row0[3])
+    first = int(np.argmax(row0 == sid))           # first occurrence
+    out, lengths = generate(
+        model, params, prompt, 10, temperature=0.0,
+        stop_tokens=[[sid], []], pad_id=63, return_lengths=True,
+    )
+    out = np.asarray(out)
+    assert int(lengths[0]) == first + 1
+    assert int(lengths[1]) == 10
+    np.testing.assert_array_equal(out[0, 5:5 + first + 1],
+                                  row0[:first + 1])
+    np.testing.assert_array_equal(out[0, 5 + first + 1:], 63)
+    np.testing.assert_array_equal(out[1], plain[1])
+
+    # EVERY row stopping early: the loop exits before touching the
+    # tail positions, which must still read pad_id (not the buffer's
+    # zeros) — the frozen-tail contract
+    row1 = plain[1, 5:]
+    sid1 = int(row1[2])
+    first1 = int(np.argmax(row1 == sid1))
+    out2, lengths2 = generate(
+        model, params, prompt, 10, temperature=0.0,
+        stop_tokens=[[sid], [sid1]], pad_id=63, return_lengths=True,
+    )
+    out2 = np.asarray(out2)
+    assert int(lengths2[0]) == first + 1
+    assert int(lengths2[1]) == first1 + 1
+    np.testing.assert_array_equal(out2[0, 5 + first + 1:], 63)
+    np.testing.assert_array_equal(out2[1, 5 + first1 + 1:], 63)
+    np.testing.assert_array_equal(out2[1, 5:5 + first1 + 1],
+                                  row1[:first1 + 1])
+
+
+def test_row_budgets_freeze_rows_independently():
+    model, params = _model_and_params()
+    prompt = jnp.asarray(
+        np.random.default_rng(4).integers(0, VOCAB, (2, 5)), jnp.int32
+    )
+    plain = np.asarray(generate(model, params, prompt, 10,
+                                temperature=0.0))
+    out, lengths = generate(
+        model, params, prompt, 10, temperature=0.0,
+        row_budgets=[2, 7], pad_id=0, return_lengths=True,
+    )
+    out = np.asarray(out)
+    np.testing.assert_array_equal(np.asarray(lengths), [2, 7])
+    np.testing.assert_array_equal(out[0, 5:7], plain[0, 5:7])
+    np.testing.assert_array_equal(out[0, 7:], 0)
+    np.testing.assert_array_equal(out[1, 5:12], plain[1, 5:12])
+    np.testing.assert_array_equal(out[1, 12:], 0)
+    with pytest.raises(ValueError, match="budget"):
+        generate(model, params, prompt, 10, row_budgets=[2, 11])
+
+
+def test_per_row_sampling_matches_static_path_bitwise():
+    """Traced per-row (temperature, top_k, top_p) must sample the SAME
+    tokens as the static executable — the guarantee that lets the
+    batching scheduler drop sampling params from its group key."""
+    model, params = _model_and_params()
+    prompt = jnp.asarray(
+        np.random.default_rng(5).integers(0, VOCAB, (2, 5)), jnp.int32
+    )
+    row_rngs = jax.random.split(jax.random.key(9), 2)
+    static = np.asarray(generate(model, params, prompt, 8,
+                                 temperature=0.8, top_k=5, top_p=0.9,
+                                 row_rngs=row_rngs))
+    traced = np.asarray(generate(
+        model, params, prompt, 8,
+        row_temperatures=[0.8, 0.8], row_top_ks=[5, 5],
+        row_top_ps=[0.9, 0.9], row_rngs=row_rngs,
+    ))
+    np.testing.assert_array_equal(traced, static)
+
+    # mixed greedy + sampled in ONE batch: each row equals its solo run
+    solo0 = np.asarray(generate(model, params, prompt[:1], 8,
+                                temperature=0.0,
+                                row_rngs=row_rngs[:1]))
+    solo1 = np.asarray(generate(model, params, prompt[1:], 8,
+                                temperature=1.0, top_k=8,
+                                row_rngs=row_rngs[1:]))
+    mixed = np.asarray(generate(
+        model, params, prompt, 8,
+        row_temperatures=[0.0, 1.0], row_top_ks=[0, 8],
+        row_rngs=row_rngs,
+    ))
+    np.testing.assert_array_equal(mixed[0], solo0[0])
+    np.testing.assert_array_equal(mixed[1], solo1[0])
+
+
+def test_stop_with_padded_mixed_length_batch():
+    """stop_tokens composes with left-pad mixed-length batching (the
+    serving configuration): the padded row truncates exactly like its
+    solo run."""
+    model = MODELS.get("Llama")(vocab_size=VOCAB, n_layer=2, n_head=4,
+                                n_kv_head=2, d_model=32, max_len=64)
+    rng = np.random.default_rng(6)
+    p_short = jnp.asarray(rng.integers(0, VOCAB, (1, 9)), jnp.int32)
+    p_long = jnp.asarray(rng.integers(0, VOCAB, (1, 13)), jnp.int32)
+    params = model.init(jax.random.key(0), p_long)["params"]
+    solo = np.asarray(generate(model, params, p_short, 8,
+                               temperature=0.0))[0, 9:]
+    sid = int(solo[2])
+    first = int(np.argmax(solo == sid))
+    pad = jnp.zeros((1, 4), jnp.int32)
+    batch = jnp.concatenate([
+        jnp.concatenate([pad, p_short], axis=1), p_long
+    ], axis=0)
+    out, lengths = generate(
+        model, params, batch, 8, temperature=0.0,
+        pad_lens=jnp.asarray([4, 0], jnp.int32),
+        stop_tokens=[[sid], []], return_lengths=True,
+    )
+    out = np.asarray(out)
+    assert int(lengths[0]) == first + 1
+    np.testing.assert_array_equal(out[0, 13:13 + first + 1],
+                                  solo[:first + 1])
+    np.testing.assert_array_equal(out[0, 13 + first + 1:], 0)
+
+
 # --- speculative decoding (engine/generate.generate_speculative) -------------
 
 
@@ -377,6 +531,44 @@ def test_speculative_pad_to_bucket_matches_unpadded():
     tp = tl.init(jax.random.key(0), prompt)["params"]
     with pytest.raises(ValueError, match="pad_to"):
         generate_speculative(tl, tp, prompt, 8, pad_to=32)
+
+
+def test_speculative_stop_tokens_truncate_like_vanilla():
+    """Spec decode with stop tokens: greedy spec is bit-identical to
+    vanilla greedy, so the stopped output must equal vanilla greedy
+    truncated at the first stop (drafts past a stop are rejected, the
+    loop exits early, junk tail masked to 0)."""
+    from pytorch_distributed_template_tpu.engine.generate import (
+        generate_speculative,
+    )
+
+    model = MODELS.get("Llama")(vocab_size=VOCAB, n_layer=2, n_head=4,
+                                n_kv_head=2, d_model=32, max_len=128)
+    base = np.random.default_rng(5).integers(0, VOCAB, 6).tolist()
+    prompt = jnp.asarray([base * 3], jnp.int32)       # length 18
+    params = model.init(jax.random.key(0), prompt)["params"]
+    ref = np.asarray(generate(model, params, prompt, 40,
+                              temperature=0.0))[0, 18:]
+    sid = int(ref[10])
+    first = int(np.argmax(ref == sid))
+    out, stats = generate_speculative(
+        model, params, prompt, 40, draft_len=4, return_stats=True,
+        stop_tokens=[sid],
+    )
+    out = np.asarray(out)[0, 18:]
+    assert stats["stopped"] and stats["tokens_emitted"] == first + 1
+    np.testing.assert_array_equal(out[:first + 1], ref[:first + 1])
+    np.testing.assert_array_equal(out[first + 1:], 0)
+    # fewer verify calls than the full-budget run: the loop exited
+    assert stats["model_calls"] <= first + 1
+
+    # a stop that never fires changes nothing (bit-compat)
+    gen = set(ref.tolist())
+    unused = next(i for i in range(VOCAB) if i not in gen)
+    plain = generate_speculative(model, params, prompt, 40, draft_len=4)
+    stopped = generate_speculative(model, params, prompt, 40,
+                                   draft_len=4, stop_tokens=[unused])
+    np.testing.assert_array_equal(np.asarray(plain), np.asarray(stopped))
 
 
 def test_speculative_guards():

@@ -64,6 +64,23 @@ def test_generate_cli_byte_mode(lm_checkpoint):
     assert "12:3" in r.stdout
 
 
+def test_generate_cli_stop_token(lm_checkpoint):
+    """--stop truncates ids mode exactly: pick a stop from the plain
+    run's own output, re-run, expect the prefix (stop id stripped)."""
+    r = _run(lm_checkpoint, "--prompt-ids", "1,2,3,4",
+             "--max-new-tokens", "8")
+    assert r.returncode == 0, r.stdout[-2000:] + r.stderr[-2000:]
+    ids = [int(x) for x in r.stdout.strip().splitlines()[-1].split(",")]
+    sid = ids[3]
+    first = ids.index(sid)
+    r = _run(lm_checkpoint, "--prompt-ids", "1,2,3,4",
+             "--max-new-tokens", "8", "--stop-id", str(sid))
+    assert r.returncode == 0, r.stdout[-2000:] + r.stderr[-2000:]
+    line = r.stdout.strip().splitlines()[-1]
+    got = [int(x) for x in line.split(",")] if line else []
+    assert got == ids[:first]
+
+
 def test_generate_cli_rejects_out_of_vocab_prompt(lm_checkpoint):
     r = _run(lm_checkpoint, "--prompt", "ab", "--max-new-tokens", "2")
     assert r.returncode != 0
