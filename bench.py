@@ -887,11 +887,21 @@ def bench_decode_spec(prompt_len: int = 512, new_tokens: int = 256,
     configuration; engine/generate.py documents why rolling windows
     cannot rewind).
 
-    The prompt is a repeated phrase and the acceptance rate is REPORTED
-    (``tokens_per_call``): speculative throughput is workload-dependent
-    — repetitive continuations (code, structured text) accept most
-    drafts, adversarial text accepts none — so the speedup only means
-    anything next to its acceptance number. The vanilla baseline is an
+    TWO workloads through the same executable (r5): a repeated phrase
+    (prompt-lookup's best case) and i.i.d. random ids (its adversarial
+    floor), each with its acceptance REPORTED (``tokens_per_call``):
+    speculative throughput is workload-dependent — repetitive
+    continuations (code, structured text) accept most drafts,
+    adversarial text accepts none — so each speedup only means
+    anything next to its acceptance number. Measured r5: the
+    adversarial arm's acceptance collapses to 1.0 tokens/call but its
+    throughput stays ~par with vanilla (1.10x, within the rung's
+    noise) — batch-1 decode is HBM-bound, so the (D+1)-token verify
+    streams the same weight bytes as a 1-token step and wasted draft
+    slots cost MXU time the step wasn't using anyway. The serving
+    fail-safe (engine/serving SPEC_MIN_TOKENS_PER_CALL) still
+    auto-disables below its projected-win bar; this arm is the
+    measurement that sets it. The vanilla baseline is an
     IN-JIT ``lax.scan`` over one-token steps (same model, same cache
     layout): comparing against the eager ``generate()`` Python loop
     would credit speculation with the tunnel's ~14 ms per-dispatch
@@ -925,8 +935,23 @@ def bench_decode_spec(prompt_len: int = 512, new_tokens: int = 256,
     )
     rng = np.random.default_rng(0)
     phrase = rng.integers(0, 32000, 64)
-    prompt = jnp.asarray(
+    # two workloads: the repetitive one is prompt-lookup's best case;
+    # the "natural" one is i.i.d. random ids decoded at temperature
+    # 1.0 — the adversarial floor where the drafter finds ~no matches
+    # and every verify call mostly wastes its draft slots (VERDICT r4
+    # weak #3: round 4 only measured where speculation can't lose).
+    # Temperature matters: GREEDY continuations from an untrained
+    # model collapse into cycles that the drafter then predicts
+    # (measured: acceptance 2.27 even on a random prompt), so the
+    # adversarial arm must SAMPLE to keep its continuation
+    # non-repetitive. Its baseline is the same greedy vanilla scan —
+    # one categorical over the vocab per step is noise against the
+    # ~250 MB weight stream that dominates an HBM-bound decode step.
+    prompt_rep = jnp.asarray(
         np.tile(phrase, prompt_len // 64 + 1)[None, :prompt_len], jnp.int32
+    )
+    prompt_nat = jnp.asarray(
+        rng.integers(0, 32000, (1, prompt_len)), jnp.int32
     )
     params = model.init(
         jax.random.key(0), jnp.zeros((1, 8), jnp.int32)
@@ -938,29 +963,31 @@ def bench_decode_spec(prompt_len: int = 512, new_tokens: int = 256,
         shift = (jnp.asarray(out)[0, -1] % 7 + 1).astype(jnp.int32)
         return jnp.roll(p, int(shift), axis=1)
 
-    # --- speculative
-    out, stats = generate_speculative(
-        model, params, prompt, new_tokens, draft_len=draft_len,
-        return_stats=True,
-    )  # compile
-    p = vary(prompt, out)
-    out, stats = generate_speculative(   # second warm dispatch
-        model, params, p, new_tokens, draft_len=draft_len,
-        return_stats=True,
-    )
-    p = vary(p, out)
-    reps, tpc = [], []
-    for _ in range(DECODE_REPEATS):
-        t0 = time.perf_counter()
-        out, stats = generate_speculative(
-            model, params, p, new_tokens, draft_len=draft_len,
-            return_stats=True,
-        )
-        int(np.asarray(out)[0, -1])
-        reps.append(new_tokens / (time.perf_counter() - t0))
-        tpc.append(stats["tokens_per_call"])
-        p = vary(p, out)
-    spec = _dispersion(reps)
+    # --- speculative, both workloads (one executable per temperature)
+    def spec_arm(prompt, temp):
+        def call(p, i):
+            return generate_speculative(
+                model, params, p, new_tokens, draft_len=draft_len,
+                return_stats=True, temperature=temp,
+                rng=jax.random.key(i),
+            )
+
+        out, stats = call(prompt, 0)   # compile
+        p = vary(prompt, out)
+        out, stats = call(p, 1)        # second warm dispatch (tunnel
+        p = vary(p, out)               # lazy-warmup rule, BASELINE.md)
+        reps, tpc = [], []
+        for i in range(DECODE_REPEATS):
+            t0 = time.perf_counter()
+            out, stats = call(p, 2 + i)
+            int(np.asarray(out)[0, -1])
+            reps.append(new_tokens / (time.perf_counter() - t0))
+            tpc.append(stats["tokens_per_call"])
+            p = vary(p, out)
+        return _dispersion(reps), float(np.median(tpc))
+
+    spec, tpc_rep = spec_arm(prompt_rep, temp=0.0)
+    spec_nat, tpc_nat = spec_arm(prompt_nat, temp=1.0)
 
     # --- vanilla greedy baseline: in-jit scan of one-token steps on the
     # same (batch-1, full-cache) configuration, timed END-TO-END like
@@ -1000,11 +1027,11 @@ def bench_decode_spec(prompt_len: int = 512, new_tokens: int = 256,
         tok0, warm_cache = prefill(params, cache, p_in)
         return vanilla_scan(params, warm_cache, tok0)
 
-    last = vanilla_e2e(prompt)  # compile
+    last = vanilla_e2e(prompt_rep)  # compile
     int(last[0])
-    last = vanilla_e2e(vary(prompt, last[None, :]))  # second warm
+    last = vanilla_e2e(vary(prompt_rep, last[None, :]))  # second warm
     int(last[0])
-    reps, p = [], vary(prompt, last[None, :])
+    reps, p = [], vary(prompt_rep, last[None, :])
     for _ in range(DECODE_REPEATS):
         t0 = time.perf_counter()
         last = vanilla_e2e(p)
@@ -1013,15 +1040,22 @@ def bench_decode_spec(prompt_len: int = 512, new_tokens: int = 256,
         p = vary(p, last[None, :])
     vanilla = _dispersion(reps)
 
+    v = vanilla["steps_per_sec_median"]
     return {
         "spec_tokens_per_sec": round(spec["steps_per_sec_median"], 1),
-        "vanilla_tokens_per_sec": round(vanilla["steps_per_sec_median"], 1),
-        "speedup": round(
-            spec["steps_per_sec_median"] / vanilla["steps_per_sec_median"],
-            2,
-        ),
-        "tokens_per_call": round(float(np.median(tpc)), 2),
+        "vanilla_tokens_per_sec": round(v, 1),
+        "speedup": round(spec["steps_per_sec_median"] / v, 2),
+        "tokens_per_call": round(tpc_rep, 2),
         "spread_pct": spec["spread_pct"],
+        # the adversarial arm: where speculation LOSES — the serving
+        # fail-safe (engine/serving SPEC_MIN_TOKENS_PER_CALL) exists
+        # because of exactly this number
+        "spec_tokens_per_sec_natural": round(
+            spec_nat["steps_per_sec_median"], 1),
+        "speedup_natural": round(
+            spec_nat["steps_per_sec_median"] / v, 2),
+        "tokens_per_call_natural": round(tpc_nat, 2),
+        "spread_pct_natural": spec_nat["spread_pct"],
         "draft_len": draft_len,
         "prompt_len": prompt_len,
         "new_tokens": new_tokens,
@@ -1181,7 +1215,7 @@ _SUMMARY_KEYS = {
     "decode_stop": ("saved_frac", "mean_emitted"),
     "moe": ("routing_overhead_pct", "moe_active_mfu"),
     "serve_batch": ("batching_speedup",),
-    "decode_spec": ("speedup", "tokens_per_call"),
+    "decode_spec": ("speedup", "speedup_natural", "tokens_per_call"),
     "flash_attention_8k": ("speedup",),
 }
 

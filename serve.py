@@ -35,7 +35,11 @@ rolling-window models group by exact length) — that arrive within
 shared decode loop, up to ``--max-batch`` rows. Each request keeps its
 own sampling stream, so responses don't depend on batch composition
 (token-exact up to float-level ties between the batched and solo
-kernels), and speculative requests run batch-1. ``GET /healthz``
+kernels), and speculative requests run batch-1 with an acceptance
+probe: the first chunk measures tokens/call, and requests whose
+acceptance projects a loss finish with plain decode
+(``speculation_disabled: true`` in the response's ``speculative``
+stats; greedy output is identical either way). ``GET /healthz``
 reports batching stats (requests/batches/max_batch_size). The first
 request per (sampling-config, shape) pays the XLA compile; later ones
 reuse the cached executables (engine/generate._decode_fns).
