@@ -1,0 +1,595 @@
+"""Continuous (slot-based) batching for serving (VERDICT r4 next #3).
+
+The static micro-batch scheduler (serving.BatchedGenerationService)
+forms a batch once and decodes it to the longest budget: rows that
+finish early keep occupying the chip, and new arrivals wait out the
+whole loop. This module replaces that with a persistent decode engine:
+
+- a shared KV cache of ``slots`` rows over the model's full
+  ``max_len``, advanced by a single global position counter;
+- requests ADMIT into free rows mid-flight: a batch-1 prefill against
+  a fresh cache positioned at ``p - bucket`` computes the prompt's
+  K/V with the correct absolute-slot RoPE rotations, and a row-scatter
+  copies it into the shared cache, with the row's ``pad_len = p - L``
+  hiding everything before its prompt (the same per-row-constant-shift
+  argument that makes mixed-length batching exact — models/llama.py
+  ``_cached_attention``);
+- decode runs in CHUNKS of ``chunk`` in-graph steps (``lax.scan``)
+  with per-row budgets, stop sets, sampling params, and rng streams —
+  the round-5 per-row machinery from engine/generate — so rows finish
+  independently and their slots free between chunks;
+- the worker dispatches one chunk AHEAD when no arrivals are waiting,
+  hiding the host round trip (load-bearing on tunneled devices, where
+  each fenced dispatch costs ~105 ms — BASELINE.md);
+- when the global position would not fit another request the engine
+  waits for drain and starts a new ERA (reset the counter; stale K/V
+  needs no zeroing — every row's ``pad_len`` masks it).
+
+Token-exactness: a request's tokens depend only on its own prompt,
+seed, and sampling config — never on admission time or batch
+composition (tests pin this against solo ``generate()`` runs, float-
+tolerance exact like the static scheduler's mixed-length batching).
+
+Restricted to pad-capable models (RoPE positions + non-rolling cache);
+``serve.py`` falls back to the static scheduler otherwise. The
+reference has no serving path at all (/root/reference/test.py is batch
+eval) — this subsystem is beyond-reference capability, measured by the
+``serve_mixed`` bench rung.
+"""
+from __future__ import annotations
+
+import functools
+import logging
+import queue as queue_mod
+import threading
+import time
+
+import numpy as np
+
+from .serving import GenerationService
+
+logger = logging.getLogger(__name__)
+
+
+@functools.lru_cache(maxsize=64)
+def _admit_fn(model, bucket: int, k: int, n_stop: int):
+    """Compiled admission for ``k`` same-bucket prompts: ONE dispatch
+    does the batched prefill (a fresh ``[k]``-row cache positioned so
+    every prompt ends at ``pos0 + bucket``), samples the first tokens
+    (stream index 0 per row — identical to solo ``generate()``'s key
+    folding), scatters the prefilled rows into the shared cache,
+    advances the shared ``pos_index``, and writes the slot-state
+    arrays.
+
+    Everything is fused into one executable with PACKED integer/float
+    side inputs because the tunnel serializes small RPCs: the earlier
+    shape of this path (per-request prefill + separate scatter +
+    per-slot host scalars) measured ~1.4 s per admission wave, and
+    even split-but-batched dispatches left the uniform burst 4x
+    behind the static scheduler. Donates the shared cache and slot
+    arrays.
+
+    ``ints`` columns: [slot, budget, pad_len, stop_0..stop_{W-1},
+    pos0] (pos0 replicated down its column; row 0 is read).
+    ``floats`` columns: [temperature, top_p]; ``topk_k`` rides
+    separately as int.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from .generate import _sample_rows_traced
+
+    total = int(model.max_len)
+
+    @functools.partial(jax.jit, donate_argnums=(1, 2))
+    def admit(params, shared, arrays, prompts, ints, floats,
+              keys_data_k, topk_k):
+        slots = ints[:, 0]
+        budgets_k = ints[:, 1]
+        pad_k = ints[:, 2]
+        stops_k = ints[:, 3:3 + n_stop]
+        pos0 = ints[0, 3 + n_stop]
+        temps_k = floats[:, 0]
+        ps_k = floats[:, 1]
+        keys = jax.random.wrap_key_data(keys_data_k)
+        shapes = jax.eval_shape(
+            lambda p: model.apply(
+                {"params": p}, jnp.zeros((k, total), jnp.int32),
+                train=False, decode=True, mutable=["cache"],
+            ),
+            params,
+        )[1]["cache"]
+        cache = jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype),
+                             shapes)
+        cache = dict(cache)
+        cache["pos_index"] = pos0.astype(jnp.int32)
+        logits, vs = model.apply(
+            {"params": params, "cache": cache}, prompts,
+            train=False, decode=True, prefill=True, mutable=["cache"],
+            pad_lens=pad_k,
+        )
+        tok0 = _sample_rows_traced(
+            jax.vmap(jax.random.fold_in)(keys,
+                                         jnp.zeros((k,), jnp.int32)),
+            logits[:, -1], temps_k, topk_k, ps_k,
+        )
+
+        # scatter the k prefilled rows into the shared cache (every
+        # K/V-shaped leaf; duplicate slots from group padding rewrite
+        # identical content, so order doesn't matter)
+        new = vs["cache"]
+
+        def put(s, n):
+            if (s.ndim >= 1 and n.ndim == s.ndim and n.shape[0] == k
+                    and s.shape[1:] == n.shape[1:]):
+                # one indexed scatter per leaf (duplicate padded slots
+                # write identical rows, so scatter order is moot); the
+                # earlier k-way DUS unroll bloated the executable
+                return s.at[slots].set(n.astype(s.dtype))
+            return s
+
+        shared = dict(jax.tree.map(put, dict(shared), new))
+        # the shared position counter advances to the admission point;
+        # chunks advance it in-graph from here (no per-dispatch host
+        # rewrite)
+        shared["pos_index"] = (pos0 + bucket).astype(jnp.int32)
+
+        (tok, emitted, done, budgets, pad_lens, keys_data, stops,
+         temps, ks, ps) = arrays
+        arrays_out = (
+            tok.at[slots].set(tok0),
+            emitted.at[slots].set(jnp.ones((k,), jnp.int32)),
+            done.at[slots].set(jnp.zeros((k,), bool)),
+            budgets.at[slots].set(budgets_k),
+            pad_lens.at[slots].set(pad_k),
+            keys_data.at[slots].set(keys_data_k),
+            stops.at[slots].set(stops_k),
+            temps.at[slots].set(temps_k),
+            ks.at[slots].set(topk_k),
+            ps.at[slots].set(ps_k),
+        )
+        return shared, arrays_out, tok0
+
+    return admit
+
+
+@functools.lru_cache(maxsize=16)
+def _chunk_fn(model, steps: int, n_stop: int):
+    """``steps`` in-graph decode steps over all slots: per-row rng
+    streams (folded at each row's own emission index, matching solo
+    ``generate()`` exactly), traced per-row sampling, stop sets,
+    budgets; finished rows freeze. Donates the cache."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from .generate import _isin, _sample_rows_traced
+
+    @functools.partial(jax.jit, donate_argnums=1)
+    def chunk(params, cache, tok, emitted, done, budgets, pad_lens,
+              keys_data, stops, temps, ks, ps):
+        keys = jax.random.wrap_key_data(keys_data)
+        # re-derive done for the FED tokens: a freshly admitted row
+        # whose first token already hit a stop (or whose budget is 1)
+        # must freeze from step one — the host defers that check to
+        # here so admission never forces a device sync
+        done = done | _isin(tok, stops) | (emitted >= budgets)
+
+        def body(carry, _):
+            cache, tok, emitted, done = carry
+            logits, vs = model.apply(
+                {"params": params, "cache": cache}, tok[:, None],
+                train=False, decode=True, mutable=["cache"],
+                pad_lens=pad_lens,
+            )
+            lg = logits[:, -1]
+            step_keys = jax.vmap(jax.random.fold_in)(keys, emitted)
+            # all-greedy batches skip the sampling branch AT RUNTIME
+            # (lax.cond executes one side): the traced sampler's
+            # full-vocab sort is pure waste for greedy traffic, and
+            # greedy rows inside a mixed batch still take argmax
+            # per-row inside the sampled branch — outputs identical
+            nxt = lax.cond(
+                jnp.any(temps > 0.0),
+                lambda: _sample_rows_traced(step_keys, lg, temps, ks,
+                                            ps),
+                lambda: jnp.argmax(lg, axis=-1).astype(jnp.int32),
+            )
+            nxt = jnp.where(done, 0, nxt)
+            emitted = emitted + (~done).astype(jnp.int32)
+            done = done | _isin(nxt, stops) | (emitted >= budgets)
+            return (vs["cache"], nxt, emitted, done), nxt
+
+        (cache, tok, emitted, done), toks = lax.scan(
+            body, (cache, tok, emitted, done), None, length=steps)
+        return cache, jnp.swapaxes(toks, 0, 1), tok, emitted, done
+
+    return chunk
+
+
+class ContinuousBatchingService(GenerationService):
+    """``GenerationService`` with the slot scheduler above. The wire
+    API is identical to the static scheduler's (prompt / budget /
+    sampling / seed / stop per request); there are NO group keys —
+    per-row budgets, stops, and sampling live in the executable, so
+    ANY mix of requests shares the engine. ``stats`` adds slot
+    occupancy and end-to-end latency percentiles (surfaced via
+    ``/healthz``)."""
+
+    MAX_STOPS = 8          # static stop-set width in the executable
+
+    def _setup(self, model, params, tokenizer=None, slots: int = 8,
+               chunk: int = 8, window_ms: float = 5.0):
+        super()._setup(model, params, tokenizer)
+        if not self._pad_ok:
+            raise ValueError(
+                f"{type(model).__name__} is not pad-capable (RoPE "
+                "positions + non-rolling cache needed): use the static "
+                "BatchedGenerationService")
+        import jax
+
+        self._slots = int(slots)
+        self._chunk = int(chunk)
+        # host-side key derivation: the default threefry impl's key
+        # data for integer seed s is [s >> 32, s & 0xffffffff]; going
+        # through jax.random.key() per request costs a device round
+        # trip IN THE CALLER'S THREAD, which serialized burst arrivals
+        # through the tunnel and split them into admission waves.
+        # Probe once; non-threefry impls fall back to the device path.
+        probe = np.asarray(jax.random.key_data(
+            jax.random.key(0x123456789A)))
+        want = np.asarray([0x123456789A >> 32,
+                           0x123456789A & 0xFFFFFFFF], np.uint32)
+        self._host_keys = (probe.shape == (2,)
+                           and np.array_equal(probe, want))
+        self._window_s = float(window_ms) / 1e3
+        self._queue: "queue_mod.Queue" = queue_mod.Queue()
+        self._latencies: list = []
+        self.stats = {"requests": 0, "completed": 0, "chunks": 0,
+                      "admissions": 0, "eras": 0, "max_active": 0}
+        self._worker_thread = threading.Thread(
+            target=self._worker, daemon=True, name="gen-continuous")
+        self._worker_thread.start()
+
+    # ---- request entry ---------------------------------------------------
+
+    def generate(self, prompt=None, prompt_ids=None,
+                 max_new_tokens: int = 64, temperature: float = 0.0,
+                 top_k: int = 0, top_p: float = 0.0, seed: int = 0,
+                 speculative: int = 0, stop=None) -> dict:
+        if speculative > 0:
+            # batch-1 by construction; runs under the parent's lock
+            # (the scheduler's own dispatches take the same lock)
+            return super().generate(
+                prompt=prompt, prompt_ids=prompt_ids,
+                max_new_tokens=max_new_tokens, temperature=temperature,
+                top_k=top_k, top_p=top_p, seed=seed,
+                speculative=speculative, stop=stop)
+        ids = self.encode_prompt(prompt, prompt_ids)
+        stops = self.encode_stop(stop)
+        if len(stops) > self.MAX_STOPS:
+            raise ValueError(
+                f"at most {self.MAX_STOPS} stop tokens per request "
+                f"(got {len(stops)})")
+        max_new = int(max_new_tokens)
+        if max_new < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        max_len = int(self.model.max_len)
+        if self._bucket(len(ids)) + max_new > max_len:
+            # checked on the BUCKETED length: admission rounds prompts
+            # up to the executable bucket, so a request that only fits
+            # unbucketed could never be admitted and would hang
+            raise ValueError(
+                f"prompt ({len(ids)} tokens, admission bucket "
+                f"{self._bucket(len(ids))}) + max_new_tokens "
+                f"({max_new}) exceeds model.max_len {max_len}")
+        seed = int(seed)
+        if self._host_keys and seed >= 0:
+            key_data = np.asarray(
+                [seed >> 32, seed & 0xFFFFFFFF], np.uint32)
+        else:
+            import jax
+
+            key_data = np.asarray(
+                jax.random.key_data(jax.random.key(seed)))
+        req = {
+            "ids": ids, "budget": max_new,
+            "temperature": float(temperature), "top_k": int(top_k),
+            "top_p": float(top_p), "seed": seed, "stop": stops,
+            # raw key data, derived WITHOUT device work in the
+            # caller's thread (host path above): per-request device
+            # ops serialized burst arrivals through the tunnel
+            "key_data": key_data,
+            "event": threading.Event(), "t0": time.monotonic(),
+        }
+        self._queue.put(req)
+        req["event"].wait()
+        if "error" in req:
+            raise req["error"]
+        return req["result"]
+
+    # ---- scheduler internals --------------------------------------------
+
+    @staticmethod
+    def _bucket(n: int) -> int:
+        b = 16
+        while b < n:
+            b *= 2
+        return b
+
+    def _admissible(self, req, active: bool) -> bool:
+        """Fits now? With active rows the prompt must land BEFORE the
+        global position (bucket <= p); an idle engine era-starts at
+        any length. Budget must fit the era's remaining room."""
+        bucket = self._bucket(len(req["ids"]))
+        max_len = int(self.model.max_len)
+        if not active:
+            return bucket + req["budget"] <= max_len
+        return (bucket <= self._p
+                and self._p + req["budget"] <= max_len)
+
+    def _admit_group(self, reqs: list, slots: list):
+        """Admit same-bucket requests in ONE prefill dispatch + ONE
+        scatter dispatch, nothing forced (the first tokens stay device
+        futures until the next absorb — admission must never stall the
+        pipeline).
+
+        The group is PADDED to a fixed width ``k = self._slots`` by
+        repeating the last request (its duplicate rows scatter onto
+        the same slot — a same-content rewrite, harmless): admission
+        executables specialize on (bucket, k), and arrival-wave sizes
+        are timing-nondeterministic, so a variable k means fresh XLA
+        compiles landing mid-traffic (measured: the serve_mixed rung
+        collapsed 201 -> 43 tok/s from exactly that)."""
+        import jax.numpy as jnp
+
+        n = len(reqs)
+        k = self._slots
+        W = self.MAX_STOPS
+        pad_reqs = reqs + [reqs[-1]] * (k - n)
+        pad_slots = list(slots) + [slots[-1]] * (k - n)
+        bucket = self._bucket(max(len(r["ids"]) for r in reqs))
+        pos0 = self._p - bucket
+        prompts = np.zeros((k, bucket), np.int32)
+        ints = np.full((k, 4 + W), pos0, np.int32)
+        floats = np.zeros((k, 2), np.float32)
+        topks = np.zeros((k,), np.int32)
+        for j, r in enumerate(pad_reqs):
+            prompts[j, bucket - len(r["ids"]):] = r["ids"]
+            ints[j, 0] = pad_slots[j]
+            ints[j, 1] = r["budget"]
+            ints[j, 2] = self._p - len(r["ids"])
+            ints[j, 3:3 + W] = -1
+            for jj, sid in enumerate(r["stop"]):
+                ints[j, 3 + jj] = sid
+            floats[j] = (r["temperature"], r["top_p"])
+            topks[j] = r["top_k"]
+        keys_data = jnp.asarray(
+            np.stack([r["key_data"] for r in pad_reqs]))
+        self._cache, self._arrays, tok0 = _admit_fn(
+            self.model, bucket, k, W)(
+            self.params, self._cache, self._arrays,
+            jnp.asarray(prompts), jnp.asarray(ints),
+            jnp.asarray(floats), keys_data, jnp.asarray(topks))
+        for j, (r, slot) in enumerate(zip(reqs, slots)):
+            self._meta[slot] = {
+                "req": r, "emitted": 1, "out": [],
+                "tok0_ref": (tok0, j),
+                "pad_len": int(ints[j, 2]), "done": False,
+            }
+        self.stats["admissions"] += n
+
+    def _init_arrays(self):
+        """The persistent device slot state, built ONCE (and after an
+        error reset): every slot done with budget 0, so nothing runs
+        until an admission writes real rows via ``_slot_update_fn``."""
+        import jax
+        import jax.numpy as jnp
+
+        S, W = self._slots, self.MAX_STOPS
+        kd = np.asarray(jax.random.key_data(jax.random.key(0)))
+        self._arrays = (
+            jnp.zeros((S,), jnp.int32),                  # tok
+            jnp.zeros((S,), jnp.int32),                  # emitted
+            jnp.ones((S,), bool),                        # done
+            jnp.zeros((S,), jnp.int32),                  # budgets
+            jnp.zeros((S,), jnp.int32),                  # pad_lens
+            jnp.asarray(np.tile(kd, (S, 1))),            # key data
+            jnp.full((S, W), -1, jnp.int32),             # stops
+            jnp.zeros((S,), jnp.float32),                # temps
+            jnp.zeros((S,), jnp.int32),                  # top_ks
+            jnp.zeros((S,), jnp.float32),                # top_ps
+        )
+
+    def _dispatch_chunk(self, steps: int):
+        """Queue one ``steps``-step chunk on the device (async —
+        nothing is forced here) and advance the host position mirror.
+        The cache's ``pos_index`` lives on device (set by admissions,
+        advanced in-graph by each step) — no per-dispatch transfers.
+        ``steps < self._chunk`` only at era end, where the remaining
+        room is smaller than a full chunk (tail executables are
+        lru-cached like any other)."""
+        tok, emitted, done, budgets, pad_lens, keys, stops, temps, \
+            ks, ps = self._arrays
+        chunk = _chunk_fn(self.model, steps, self.MAX_STOPS)
+        cache, toks, tok, emitted, done = chunk(
+            self.params, self._cache, tok, emitted, done, budgets,
+            pad_lens, keys, stops, temps, ks, ps)
+        self._cache = cache
+        self._arrays = (tok, emitted, done, budgets, pad_lens, keys,
+                        stops, temps, ks, ps)
+        self._p += steps
+        self.stats["chunks"] += 1
+        return toks, emitted, done
+
+    def _absorb(self, toks, emitted, done):
+        """Force a dispatched chunk's outputs and hand tokens to their
+        requests; finished rows complete and free their slots."""
+        toks = np.asarray(toks)
+        emitted = np.asarray(emitted)
+        done = np.asarray(done)
+        tok0_np: dict = {}          # one D2H read per admission group
+        for s in range(self._slots):
+            m = self._meta[s]
+            if m is None or m["done"]:
+                continue
+            if not m["out"]:
+                # first absorb for this row: its admission-time token
+                # future is long since resolved (the chunk that just
+                # forced ran after it). Memoized per group — a
+                # np.asarray per ROW was 8 separate device reads
+                # (~0.1 s of serialized tunnel RPCs per wave).
+                arr, j = m["tok0_ref"]
+                if id(arr) not in tok0_np:
+                    tok0_np[id(arr)] = np.asarray(arr)
+                m["out"].append(int(tok0_np[id(arr)][j]))
+            fresh = int(emitted[s]) - m["emitted"]
+            m["out"].extend(int(t) for t in toks[s, :fresh])
+            m["emitted"] = int(emitted[s])
+            m["done"] = bool(done[s])
+        for s in range(self._slots):
+            m = self._meta[s]
+            if m is not None and m["done"]:
+                self._complete(s)
+
+    def _complete(self, slot: int):
+        m = self._meta[slot]
+        req = m["req"]
+        req["result"] = self._response(
+            m["out"], stops=req["stop"], emitted=m["emitted"])
+        req["event"].set()
+        self._meta[slot] = None
+        self.stats["completed"] += 1
+        lat = time.monotonic() - req["t0"]
+        self._latencies.append(lat)
+        if len(self._latencies) > 1024:
+            del self._latencies[:512]
+
+    def latency_percentiles(self) -> dict:
+        lats = sorted(self._latencies[-1024:])
+        if not lats:
+            return {}
+        pick = lambda q: round(lats[min(len(lats) - 1,          # noqa: E731
+                                        int(q * len(lats)))], 4)
+        return {"p50_s": pick(0.50), "p95_s": pick(0.95),
+                "p99_s": pick(0.99), "n": len(lats)}
+
+    def _worker(self):
+        """The scheduler loop. Single thread owns the device state;
+        the outer try mirrors the static worker's contract: an
+        exception surfaces on every in-flight request rather than
+        silently killing the thread."""
+        self._meta = [None] * self._slots
+        self._cache = None
+        self._arrays = None
+        self._p = 0
+        pending: list = []
+        while True:
+            involved = [m["req"] for m in self._meta if m is not None]
+            try:
+                active = any(m is not None for m in self._meta)
+                if not active and not pending:
+                    pending.append(self._queue.get())   # block when idle
+                    deadline = time.monotonic() + self._window_s
+                    while time.monotonic() < deadline:
+                        try:
+                            pending.append(self._queue.get_nowait())
+                        except queue_mod.Empty:
+                            time.sleep(self._window_s / 10)
+                while True:
+                    try:
+                        pending.append(self._queue.get_nowait())
+                    except queue_mod.Empty:
+                        break
+                involved = ([m["req"] for m in self._meta
+                             if m is not None]
+                            + [r for r in pending])
+                self.stats["requests"] = (self.stats["completed"]
+                                          + len(involved))
+                with self._lock:
+                    self._tick(pending)
+            except Exception as e:  # noqa: BLE001 — surfaced per request
+                logger.exception("continuous scheduler error")
+                for r in involved:
+                    r["error"] = e
+                    r["event"].set()
+                pending.clear()
+                self._meta = [None] * self._slots
+                self._cache = None
+                self._arrays = None
+                self._p = 0
+
+    def _tick(self, pending: list):
+        """One scheduler round under the lock: era management,
+        admissions, one (or two, pipelined) chunk dispatches."""
+        from .generate import fresh_cache
+
+        active = any(m is not None for m in self._meta)
+        if not active:
+            # idle: new era (stale K/V is masked by pad_lens; only the
+            # position counter resets)
+            self._p = 0
+            self.stats["eras"] += 1
+            if self._cache is None:
+                self._cache = fresh_cache(
+                    self.model, self.params, self._slots,
+                    int(self.model.max_len))
+            if self._arrays is None:
+                self._init_arrays()
+        # era start positions the counter at the largest bucket a FIFO
+        # prefix of pending requests tolerates: the OLDEST request is
+        # always admitted (no starvation), and same-wave arrivals of
+        # mixed lengths admit together when their budgets all still
+        # fit the era at the larger start position
+        if not active and pending:
+            max_len = int(self.model.max_len)
+            p_cand, chosen = 0, []
+            # only the first `slots` pending requests can admit this
+            # wave — a longer prefix would inflate the era start (and
+            # burn budget room) for requests that must wait anyway
+            for r in pending[:self._slots]:
+                cand = max(p_cand, self._bucket(len(r["ids"])))
+                if all(cand + q["budget"] <= max_len
+                       for q in chosen + [r]):
+                    p_cand, chosen = cand, chosen + [r]
+                else:
+                    break
+            self._p = p_cand
+        # group admissible arrivals by bucket: each group admits in ONE
+        # prefill + ONE scatter dispatch (a same-wave burst — the
+        # static scheduler's best case — stays one batched prefill)
+        free = [s for s in range(self._slots) if self._meta[s] is None]
+        groups: dict = {}
+        for r in list(pending):
+            if not free:
+                break
+            if self._admissible(r, active=True) and self._p > 0:
+                pending.remove(r)
+                b = self._bucket(len(r["ids"]))
+                groups.setdefault(b, []).append((r, free.pop(0)))
+        for pairs in groups.values():
+            self._admit_group([r for r, _ in pairs],
+                              [s for _, s in pairs])
+        self.stats["max_active"] = max(
+            self.stats["max_active"],
+            sum(m is not None for m in self._meta))
+        live = [m for m in self._meta if m is not None]
+        if not live:
+            return
+        # era-end tail: the admission invariant bounds every live
+        # budget by max_len, so min 1 step always remains
+        steps = min(self._chunk, int(self.model.max_len) - self._p)
+        out1 = self._dispatch_chunk(steps)
+        # dispatch ONE chunk ahead while the first runs, unless queue
+        # traffic wants an admission slot between them or everyone
+        # will finish inside the first chunk anyway
+        min_left = min(m["req"]["budget"] - m["emitted"] for m in live)
+        steps2 = min(self._chunk, int(self.model.max_len) - self._p)
+        if (self._queue.empty() and min_left > steps
+                and not any(m is None for m in self._meta)
+                and steps2 >= 1):
+            out2 = self._dispatch_chunk(steps2)
+            self._absorb(*out1)
+            self._absorb(*out2)
+        else:
+            self._absorb(*out1)

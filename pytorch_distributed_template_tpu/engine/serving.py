@@ -40,13 +40,26 @@ class GenerationService:
     threaded HTTP server).
     """
 
-    def __init__(self, config, use_ema: bool = False):
+    def __init__(self, config, use_ema: bool = False, **kw):
+        model, params, tokenizer = load_generation_stack(
+            config, use_ema=use_ema
+        )
+        self._setup(model, params, tokenizer, **kw)
+
+    @classmethod
+    def from_model(cls, model, params, tokenizer=None, **kw):
+        """Build a service around an already-loaded (model, params) —
+        the bench rungs and scheduler tests construct services this
+        way instead of going through checkpoint restore."""
+        obj = cls.__new__(cls)
+        obj._setup(model, params, tokenizer, **kw)
+        return obj
+
+    def _setup(self, model, params, tokenizer=None):
         import inspect
         import threading
 
-        self.model, self.params, self.tokenizer = load_generation_stack(
-            config, use_ema=use_ema
-        )
+        self.model, self.params, self.tokenizer = model, params, tokenizer
         self.vocab = int(getattr(self.model, "vocab_size", 0))
         self.arch = type(self.model).__name__
         # pad-capable = the model supports per-row left-pad masking
@@ -403,12 +416,12 @@ class BatchedGenerationService(GenerationService):
 
     PAD_BUCKET = 128
 
-    def __init__(self, config, use_ema: bool = False,
-                 max_batch: int = 8, window_ms: float = 25.0):
+    def _setup(self, model, params, tokenizer=None,
+               max_batch: int = 8, window_ms: float = 25.0):
         import queue
         import threading
 
-        super().__init__(config, use_ema)   # sets _pad_ok
+        super()._setup(model, params, tokenizer)   # sets _pad_ok
         self._max_batch = int(max_batch)
         self._window_s = float(window_ms) / 1e3
         self._queue: "queue.Queue" = queue.Queue()

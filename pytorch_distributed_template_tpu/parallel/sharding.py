@@ -67,17 +67,32 @@ def apply_rules(params, mesh: Mesh,
     rule set serves every mesh shape. Unmatched leaves replicate — the DDP
     default (reference train.py:46: every rank holds full params).
 
-    FSDP: when the mesh has an ``fsdp`` axis, unmatched leaves are sharded on
-    their largest divisible dimension instead of replicated.
+    FSDP: when the mesh has an ``fsdp`` axis, leaves that would otherwise
+    REPLICATE — unmatched leaves, and rule-matched leaves whose spec
+    pruned to nothing on this mesh (e.g. a TP rule on an fsdp-only
+    mesh) — are sharded on their largest divisible dimension. Round 5's
+    compiled-HLO audit caught the earlier behavior leaving every
+    rule-matched kernel replicated on fsdp meshes: per-device param
+    bytes were 99% of full, i.e. ZeRO-3 in name only.
     """
     compiled = [(re.compile(pat), spec) for pat, spec in rules]
     fsdp = "fsdp" in mesh.axis_names and mesh.shape["fsdp"] > 1
 
     def place(path, leaf):
         name = path_str(path)
+        matched = deliberate_replicate = None
         for pat, spec in compiled:
             if pat.search(name):
-                return NamedSharding(mesh, _prune_spec(spec, mesh))
+                matched = _prune_spec(spec, mesh)
+                # a rule WRITTEN with no axes at all (P()) pins the
+                # leaf replicated on purpose (e.g. MoE routers); only
+                # rules whose axes were pruned AWAY by this mesh fall
+                # through to the ZeRO-3 default
+                deliberate_replicate = not any(e for e in spec)
+                break
+        if matched is not None and (any(e for e in matched)
+                                    or deliberate_replicate):
+            return NamedSharding(mesh, matched)
         if fsdp and hasattr(leaf, "shape") and leaf.ndim >= 1:
             ax = _largest_divisible_axis(leaf.shape, mesh.shape["fsdp"])
             if ax is not None:

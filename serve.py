@@ -26,8 +26,16 @@ says whether the row stopped or ran out its budget. Requests with
 different stop sets still share a batch (per-row stop sets in the
 executable).
 
-Concurrent requests MICRO-BATCH (engine/serving.BatchedGenerationService):
-a worker groups compatible requests — same max_new_tokens and sampling
+Concurrent requests batch. On RoPE / non-rolling-cache models the
+default is CONTINUOUS batching (engine/continuous.py, ``--scheduler
+auto``): a slot engine over one shared KV cache where requests admit
+mid-flight, decode in chunked in-graph steps with per-row budgets /
+stop sets / sampling params (no group keys — ANY mix of requests
+shares the engine), and free their slot the moment they stop;
+``/healthz`` reports slot stats and end-to-end latency percentiles.
+Absolute-position and rolling-window models fall back to the STATIC
+micro-batch scheduler (engine/serving.BatchedGenerationService): a
+worker groups compatible requests — same max_new_tokens and sampling
 config, prompt lengths within a 128-token bucket for RoPE families
 (shorter rows left-pad with per-row masking; absolute-position and
 rolling-window models group by exact length) — that arrive within
@@ -61,8 +69,11 @@ from pytorch_distributed_template_tpu.config import ConfigParser  # noqa: E402
 import pytorch_distributed_template_tpu.data  # noqa: F401,E402
 import pytorch_distributed_template_tpu.engine  # noqa: F401,E402
 import pytorch_distributed_template_tpu.models  # noqa: F401,E402
+from pytorch_distributed_template_tpu.engine.continuous import (  # noqa: E402
+    ContinuousBatchingService,
+)
 from pytorch_distributed_template_tpu.engine.serving import (  # noqa: E402
-    BatchedGenerationService, GenerationService,
+    BatchedGenerationService, GenerationService, load_generation_stack,
 )
 
 
@@ -96,13 +107,17 @@ def make_handler(service: GenerationService):
         def do_GET(self):  # noqa: N802 (http.server API)
             if self.path != "/healthz":
                 return self._send(404, {"error": "unknown path"})
-            self._send(200, {
+            payload = {
                 "status": "ok",
                 "arch": service.arch,
+                "scheduler": type(service).__name__,
                 "vocab_size": service.vocab,
                 "tokenizer": service.tokenizer is not None,
                 "batching": getattr(service, "stats", None),
-            })
+            }
+            if hasattr(service, "latency_percentiles"):
+                payload["latency"] = service.latency_percentiles()
+            self._send(200, payload)
 
         def do_POST(self):  # noqa: N802
             if self.path != "/generate":
@@ -124,13 +139,27 @@ def make_handler(service: GenerationService):
 
 def main(args, config):
     logger = config.get_logger("serve")
-    if args.max_batch > 1:
-        service = BatchedGenerationService(
-            config, use_ema=args.ema, max_batch=args.max_batch,
+    model, params, tok = load_generation_stack(config, use_ema=args.ema)
+    probe = GenerationService.from_model(model, params, tok)
+    want = args.scheduler
+    if want == "auto":
+        want = ("continuous" if probe._pad_ok and args.max_batch > 1
+                else "static" if args.max_batch > 1 else "none")
+    if want == "continuous":
+        # slot scheduler: rows admit/free mid-flight, no group keys
+        # (engine/continuous.py); RoPE + non-rolling-cache models only
+        service = ContinuousBatchingService.from_model(
+            model, params, tok, slots=args.max_batch,
+            chunk=args.decode_chunk, window_ms=args.batch_window_ms,
+        )
+    elif want == "static":
+        service = BatchedGenerationService.from_model(
+            model, params, tok, max_batch=args.max_batch,
             window_ms=args.batch_window_ms,
         )
-    else:  # --max-batch 1: the plain serialized service
-        service = GenerationService(config, use_ema=args.ema)
+    else:  # plain serialized service
+        service = probe
+    logger.info("scheduler: %s", type(service).__name__)
     server = ThreadingHTTPServer(
         (args.host, args.port), make_handler(service)
     )
@@ -159,10 +188,18 @@ if __name__ == "__main__":
                         help="0 picks a free port (printed on READY).")
     parser.add_argument("--ema", action="store_true")
     parser.add_argument("--max-batch", default=8, type=int,
-                        help="micro-batch scheduler width; 1 disables "
+                        help="scheduler width (slots); 1 disables "
                              "batching")
     parser.add_argument("--batch-window-ms", default=25.0, type=float,
                         help="how long the scheduler waits to group "
                              "concurrent compatible requests")
+    parser.add_argument("--scheduler", default="auto",
+                        choices=("auto", "continuous", "static", "none"),
+                        help="auto = continuous batching (slot-based, "
+                             "no group keys) on RoPE/non-rolling "
+                             "models, static micro-batching otherwise")
+    parser.add_argument("--decode-chunk", default=8, type=int,
+                        help="continuous scheduler: decode steps per "
+                             "dispatch (admission latency bound)")
     args, config = ConfigParser.from_args(parser, (), training=False)
     main(args, config)

@@ -81,7 +81,25 @@ def test_apply_rules_prunes_absent_axes():
     params = {"qkv": {"kernel": jnp.zeros((8, 12))}}
     rules = [(r"qkv/kernel", P(None, "tensor"))]
     shardings = apply_rules(params, mesh, rules)
-    assert shardings["qkv"]["kernel"].spec == P(None, None)
+    # pruned to fully-replicated (the exact spec spelling — P() vs
+    # P(None, None) — is not part of the contract)
+    assert all(e is None for e in shardings["qkv"]["kernel"].spec)
+
+
+def test_fsdp_fallback_covers_pruned_rule_matches():
+    """A TP rule on an fsdp-only mesh prunes to nothing — the leaf
+    must then take the ZeRO-3 fallback, NOT silently replicate
+    (round-5 compiled-HLO audit finding: per-device param bytes were
+    99% of full because every rule-matched kernel replicated)."""
+    mesh = build_mesh({"data": 2, "fsdp": 4})
+    params = {"qkv": {"kernel": jnp.zeros((8, 12))},
+              "norm": {"scale": jnp.zeros((64,))}}
+    rules = [(r"qkv/kernel", P(None, "tensor"))]
+    shardings = apply_rules(params, mesh, rules)
+    assert "fsdp" in jax.tree_util.tree_leaves(
+        tuple(shardings["qkv"]["kernel"].spec))
+    # unmatched leaves keep taking the fallback too
+    assert shardings["norm"]["scale"].spec == P("fsdp")
 
 
 def test_fsdp_default_shards_largest_axis():
