@@ -972,15 +972,28 @@ def bench_serve_mixed(n_mixed: int = 24, slots: int = 8,
             "max_new_tokens": 64, "temperature": 0.0, "seed": seed + i,
         } for i in range(8)]
 
+    # shapes/budgets come from a FIXED stream so the compile pass and
+    # the measured pass realize the SAME (bucket, budget, sampling)
+    # group signatures — otherwise the static arm pays fresh XLA
+    # compiles inside the timed run (confirmed by simulating the
+    # draws: with per-pass shape rngs, 11 of 17 measured-pass group
+    # signatures never occurred in the compile pass). Only token
+    # CONTENT and rng seeds vary between passes (tunnel dedup).
+    shape_rng = np.random.default_rng(7)
+    mixed_shapes = [
+        (int(shape_rng.choice([96, 160, 250, 380])),
+         int(shape_rng.choice([16, 32, 64, 96])))
+        for _ in range(n_mixed)
+    ]
+
     def mixed_reqs(seed):
         rng = np.random.default_rng(seed)
         reqs = []
-        for i in range(n_mixed):
-            ln = int(rng.choice([96, 160, 250, 380]))
+        for i, (ln, budget) in enumerate(mixed_shapes):
             reqs.append({
                 "prompt_ids": [int(x) for x in
                                rng.integers(1, vocab, ln)],
-                "max_new_tokens": int(rng.choice([16, 32, 64, 96])),
+                "max_new_tokens": budget,
                 "temperature": float([0.0, 0.8, 1.0][i % 3]),
                 "top_k": int([0, 40, 0][i % 3]),
                 "seed": seed + i,

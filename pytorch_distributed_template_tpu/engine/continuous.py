@@ -188,9 +188,13 @@ def _chunk_fn(model, steps: int, n_stop: int):
             # (lax.cond executes one side): the traced sampler's
             # full-vocab sort is pure waste for greedy traffic, and
             # greedy rows inside a mixed batch still take argmax
-            # per-row inside the sampled branch — outputs identical
+            # per-row inside the sampled branch — outputs identical.
+            # Gated on LIVE rows only: a completed slot keeps its
+            # temperature until reused, and one stale sampled slot
+            # would otherwise disable the shortcut for all later
+            # greedy traffic
             nxt = lax.cond(
-                jnp.any(temps > 0.0),
+                jnp.any((temps > 0.0) & ~done),
                 lambda: _sample_rows_traced(step_keys, lg, temps, ks,
                                             ps),
                 lambda: jnp.argmax(lg, axis=-1).astype(jnp.int32),
@@ -317,16 +321,14 @@ class ContinuousBatchingService(GenerationService):
             b *= 2
         return b
 
-    def _admissible(self, req, active: bool) -> bool:
-        """Fits now? With active rows the prompt must land BEFORE the
-        global position (bucket <= p); an idle engine era-starts at
-        any length. Budget must fit the era's remaining room."""
+    def _admissible(self, req) -> bool:
+        """Fits at the CURRENT position? The prompt must land before
+        the global counter (bucket <= p) and the budget inside the
+        era's remaining room. (Era-start placement for an idle engine
+        is the FIFO-prefix loop in ``_tick``.)"""
         bucket = self._bucket(len(req["ids"]))
-        max_len = int(self.model.max_len)
-        if not active:
-            return bucket + req["budget"] <= max_len
         return (bucket <= self._p
-                and self._p + req["budget"] <= max_len)
+                and self._p + req["budget"] <= int(self.model.max_len))
 
     def _admit_group(self, reqs: list, slots: list):
         """Admit same-bucket requests in ONE prefill dispatch + ONE
@@ -563,7 +565,7 @@ class ContinuousBatchingService(GenerationService):
         for r in list(pending):
             if not free:
                 break
-            if self._admissible(r, active=True) and self._p > 0:
+            if self._admissible(r) and self._p > 0:
                 pending.remove(r)
                 b = self._bucket(len(r["ids"]))
                 groups.setdefault(b, []).append((r, free.pop(0)))

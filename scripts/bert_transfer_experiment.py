@@ -78,9 +78,12 @@ def parse_curves(run_dir: Path) -> list:
 
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("--out", default="artifacts/bert_r4")
-    ap.add_argument("--work", default="/tmp/bert_r4")
-    ap.add_argument("--seed", type=int, default=1)
+    ap.add_argument("--out", default="artifacts/bert_r5")
+    ap.add_argument("--work", default="/tmp/bert_r5")
+    ap.add_argument("--seeds", default="1,2,3",
+                    help="comma-separated fine-tune seeds (VERDICT r4 "
+                         "#7: >= 3 seeds, per-seed curves for BOTH "
+                         "arms committed)")
     ap.add_argument("--reuse", action="store_true",
                     help="skip training; summarize existing runs "
                          "under --work (e.g. after fixing the parser)")
@@ -88,11 +91,16 @@ def main():
     out = REPO / args.out
     work = Path(args.work)
     work.mkdir(parents=True, exist_ok=True)
+    seeds = tuple(int(s) for s in args.seeds.split(","))
 
     def run_or_reuse(phase, config, seed, *extra):
         """Newest prior run for this phase, else train one (so a
         partial experiment — or a parser fix — never retrains
-        finished phases)."""
+        finished phases). Phase names include the seed, so no two
+        (arm, seed) cells can ever read the same run — the r4
+        artifact's bit-identical fresh arms across seeds were this
+        class of aliasing risk, and the cross-seed collision check
+        below now fails loudly if it ever recurs."""
         runs = sorted((work / phase).glob("*/train/*"),
                       key=lambda p: p.stat().st_mtime)
         if runs:
@@ -104,53 +112,73 @@ def main():
     mlm_cfg = str(REPO / "configs/bert_mlm_stdlib.json")
     cls_cfg = str(REPO / "configs/bert_cls_stdlib.json")
     # 1. subword MLM pretraining (once)
-    pre = run_or_reuse("pretrain", mlm_cfg, args.seed)
+    pre = run_or_reuse("pretrain", mlm_cfg, seeds[0])
     ckpt = pre / "model_best"
-    # 2. matched-budget fine-tunes at TWO seeds per arm (identical
+    # 2. matched-budget fine-tunes at every seed x both arms (identical
     #    config; the ONLY difference within a seed is trainer.init_from)
-    seeds = (args.seed, args.seed + 1)
-    warms, freshes = [], []
-    for i, s in enumerate(seeds):
-        sfx = "" if i == 0 else str(i + 1)
-        warms.append(run_or_reuse(
-            f"warm{sfx}", cls_cfg, s,
-            "--set", "trainer;init_from", str(ckpt)))
-        freshes.append(run_or_reuse(f"fresh{sfx}", cls_cfg, s))
-    warm, fresh = warms[0], freshes[0]
+    warms, freshes = {}, {}
+    for s in seeds:
+        warms[s] = run_or_reuse(
+            f"warm_s{s}", cls_cfg, s,
+            "--set", "trainer;init_from", str(ckpt))
+        freshes[s] = run_or_reuse(f"fresh_s{s}", cls_cfg, s)
 
-    # 3. evidence
+    # 3. evidence: per-seed curves for BOTH arms
     out.mkdir(parents=True, exist_ok=True)
     curves = {
         "pretrain": parse_curves(pre),
-        "finetune_warm": parse_curves(warm),
-        "finetune_fresh": parse_curves(fresh),
+        "finetune_warm": {s: parse_curves(warms[s]) for s in seeds},
+        "finetune_fresh": {s: parse_curves(freshes[s]) for s in seeds},
     }
     (out / "curves.json").write_text(json.dumps(curves, indent=2))
-    for tag, rd in (("pretrain", pre), ("finetune_warm", warm),
-                    ("finetune_fresh", fresh)):
-        shutil.copyfile(rd / "summary.json", out / f"{tag}_summary.json")
-        shutil.copyfile(rd / "config.json", out / f"{tag}_config.json")
-        shutil.copyfile(rd / "info.log", out / f"{tag}.log")
+    shutil.copyfile(pre / "summary.json", out / "pretrain_summary.json")
+    shutil.copyfile(pre / "config.json", out / "pretrain_config.json")
+    shutil.copyfile(pre / "info.log", out / "pretrain.log")
+    for s in seeds:
+        for tag, rd in ((f"warm_s{s}", warms[s]),
+                        (f"fresh_s{s}", freshes[s])):
+            shutil.copyfile(rd / "config.json",
+                            out / f"finetune_{tag}_config.json")
+            shutil.copyfile(rd / "info.log", out / f"finetune_{tag}.log")
 
     def best(run_dir):
         return max((e.get("val_accuracy", 0.0)
                     for e in parse_curves(run_dir)), default=0.0)
 
     per_seed = [
-        {"seed": s, "warm": best(w), "fresh": best(f)}
-        for s, w, f in zip(seeds, warms, freshes)
+        {"seed": s, "warm": best(warms[s]), "fresh": best(freshes[s]),
+         "gap": round(best(warms[s]) - best(freshes[s]), 6)}
+        for s in seeds
     ]
+    gaps = [p["gap"] for p in per_seed]
+    # cross-seed determinism check (VERDICT r4 weak #4): different
+    # seeds must produce DIFFERENT training trajectories in each arm —
+    # a bit-identical pair means the seed never reached data order /
+    # init, or two cells aliased to one run
+    def collision(curve_map):
+        vals = [json.dumps(curve_map[s]) for s in seeds]
+        return len(set(vals)) != len(vals)
+
+    fresh_collision = collision(curves["finetune_fresh"])
+    warm_collision = collision(curves["finetune_warm"])
     verdict = {
-        "warm_best_val_accuracy": per_seed[0]["warm"],
-        "fresh_best_val_accuracy": per_seed[0]["fresh"],
         "per_seed": per_seed,
+        "gap_mean": round(sum(gaps) / len(gaps), 6),
+        "gap_min": min(gaps),
+        "gap_max": max(gaps),
         "pretraining_helps": all(p["warm"] > p["fresh"]
                                  for p in per_seed),
-        "seed": args.seed,
-        "matched_budget_epochs": len(curves["finetune_warm"]),
+        "fresh_seed_collision": fresh_collision,
+        "warm_seed_collision": warm_collision,
+        "seeds": list(seeds),
+        "matched_budget_epochs": len(
+            curves["finetune_warm"][seeds[0]]),
     }
     (out / "verdict.json").write_text(json.dumps(verdict, indent=2))
     print(json.dumps(verdict, indent=2))
+    if fresh_collision or warm_collision:
+        raise SystemExit("seed collision: two seeds produced "
+                         "bit-identical curves — determinism bug")
     if not verdict["pretraining_helps"]:
         raise SystemExit("pretraining did NOT beat fresh init")
 

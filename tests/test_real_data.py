@@ -83,20 +83,26 @@ def test_py_module_cls_loader_real_split():
 
 
 def test_bert_transfer_artifact_ordering():
-    """Committed evidence that MLM pretraining transfers: the r4
-    artifact's matched-budget fine-tunes must show the warm-started
-    encoder beating fresh init on held-out-file val accuracy
-    (VERDICT r3 #4 'done' bar)."""
-    art = Path(__file__).parent.parent / "artifacts" / "bert_r4"
+    """Committed evidence that MLM pretraining transfers: the r5
+    artifact (VERDICT r4 #7: >= 3 seeds, per-seed curves for BOTH
+    arms) must show the warm-started encoder beating fresh init on
+    held-out-file val accuracy — per seed, at EVERY epoch, checked
+    from the committed curves themselves (not just the summary)."""
+    art = Path(__file__).parent.parent / "artifacts" / "bert_r5"
     verdict = json.loads((art / "verdict.json").read_text())
     assert verdict["pretraining_helps"] is True
-    assert (verdict["warm_best_val_accuracy"]
-            > verdict["fresh_best_val_accuracy"])
+    assert len(verdict["seeds"]) >= 3
+    assert verdict["gap_min"] > 0
+    assert not verdict["fresh_seed_collision"]
+    assert not verdict["warm_seed_collision"]
     curves = json.loads((art / "curves.json").read_text())
-    # matched budget: same number of fine-tune epochs in both arms
-    assert (len(curves["finetune_warm"])
-            == len(curves["finetune_fresh"]) > 0)
-    # and the pretrain run really learned something (val loss fell)
+    for s in map(str, verdict["seeds"]):
+        warm = curves["finetune_warm"][s]
+        fresh = curves["finetune_fresh"][s]
+        assert len(warm) == len(fresh) > 0    # matched budget
+        for w, f in zip(warm, fresh):
+            assert w["val_accuracy"] > f["val_accuracy"], (s, w, f)
+    # the pretrain run really learned something (val loss fell)
     pre = curves["pretrain"]
     assert pre[-1]["val_loss"] < pre[0]["val_loss"]
 
