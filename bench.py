@@ -636,7 +636,7 @@ def bench_decode(batch: int = 8, prompt_len: int = 1024,
 def bench_decode_batch_sweep(prompt_len: int = 1024,
                              new_tokens: int = 128,
                              window: int = 1024,
-                             batches=(8, 16, 32)) -> dict:
+                             batches=(8, 16, 32, 64)) -> dict:
     """Decode batch-scaling sweep (VERDICT r4 next #8): the serving
     stack's aggregate-throughput ceiling as a measured CURVE, not the
     single batch-8 point. Decode is HBM-bound — weights stream once

@@ -30,7 +30,8 @@ from jax.sharding import PartitionSpec as P
 
 from ..config.registry import MODELS
 from ..ops.attention import (
-    multihead_attention, ring_attention, ulysses_attention, zigzag_perm,
+    grouped_query_attention, multihead_attention, ring_attention,
+    ulysses_attention, zigzag_perm,
 )
 
 
@@ -353,13 +354,13 @@ class LlamaAttention(nn.Module):
                 for var, new in writes:
                     var.value = write(var.value, new, 1)
                 slot_pos.value = write(slot_pos.value, wpos + 1, 0)
-            if groups > 1:
-                k_all = jnp.repeat(k_all, groups, axis=2)
-                v_all = jnp.repeat(v_all, groups, axis=2)
             if t > 1 and prefill:
                 return _fresh_prefill_ctx()
-            return multihead_attention(
-                q, k_all, v_all, causal=False, mask=visible[None, None]
+            # grouped GQA read: no jnp.repeat — the head expansion
+            # materialized a groups-x cache copy per step at batch >= 32
+            # (the "batch-32 cliff", scripts/debug_batch32_cliff.py)
+            return grouped_query_attention(
+                q, k_all, v_all, mask=visible[None, None]
             )
         else:
             # attention reads the DUS'd full-precision view (history rows
@@ -391,16 +392,11 @@ class LlamaAttention(nn.Module):
                     k_scale.value, sk, (0, cur, 0))
                 v_scale.value = jax.lax.dynamic_update_slice(
                     v_scale.value, sv, (0, cur, 0))
-        if groups > 1:
-            k_all = jnp.repeat(k_all, groups, axis=2)
-            v_all = jnp.repeat(v_all, groups, axis=2)
         if t > 1 and prefill and pad_lens is None:
             return _fresh_prefill_ctx()
         mask = (visible[:, None] if visible.ndim == 3    # [B, 1, t, L]
                 else visible[None, None])                # [1, 1, t, L]
-        return multihead_attention(
-            q, k_all, v_all, causal=False, mask=mask
-        )
+        return grouped_query_attention(q, k_all, v_all, mask=mask)
 
 
 class SwiGLU(nn.Module):

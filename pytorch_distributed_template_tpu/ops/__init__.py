@@ -1,10 +1,12 @@
 """Hot-path ops: attention implementations (XLA, ring/SP, Pallas flash)."""
 from .attention import (
-    multihead_attention, ring_attention, ulysses_attention, zigzag_perm,
+    grouped_query_attention, multihead_attention, ring_attention,
+    ulysses_attention, zigzag_perm,
 )
 from .flash import flash_attention, flash_attention_lse
 
 __all__ = [
-    "multihead_attention", "ring_attention", "ulysses_attention",
-    "zigzag_perm", "flash_attention", "flash_attention_lse",
+    "grouped_query_attention", "multihead_attention", "ring_attention",
+    "ulysses_attention", "zigzag_perm", "flash_attention",
+    "flash_attention_lse",
 ]

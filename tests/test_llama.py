@@ -115,6 +115,36 @@ def test_tp_rules_shard_and_train():
     assert losses[-1] < losses[0] - 0.5, losses[::8]
 
 
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+def test_grouped_query_attention_matches_repeat(dtype):
+    """grouped_query_attention == jnp.repeat + multihead_attention (the
+    decode path it replaced; scripts/debug_batch32_cliff.py is the perf
+    story, this pins the numerics)."""
+    from pytorch_distributed_template_tpu.ops.attention import (
+        grouped_query_attention, multihead_attention,
+    )
+
+    b, t, h, kvh, d, length = 2, 3, 6, 2, 8, 16
+    rng = np.random.default_rng(0)
+    q = jnp.asarray(rng.normal(size=(b, t, h, d)), dtype)
+    k = jnp.asarray(rng.normal(size=(b, length, kvh, d)), dtype)
+    v = jnp.asarray(rng.normal(size=(b, length, kvh, d)), dtype)
+    mask = jnp.asarray(
+        rng.random((b, 1, t, length)) > 0.3
+    ) | (jnp.arange(length)[None, None, None] == 0)  # keep rows non-empty
+    got = grouped_query_attention(q, k, v, mask=mask)
+    want = multihead_attention(
+        q, jnp.repeat(k, h // kvh, axis=2), jnp.repeat(v, h // kvh, axis=2),
+        causal=False, mask=mask,
+    )
+    assert got.dtype == want.dtype
+    tol = 1e-6 if dtype == jnp.float32 else 2e-2
+    np.testing.assert_allclose(
+        np.asarray(got, np.float32), np.asarray(want, np.float32),
+        atol=tol, rtol=tol,
+    )
+
+
 def test_cached_decode_logit_exact():
     """Prefill and single-token cached decode reproduce the full-forward
     logits exactly (tie-proof: compares logits, not argmax chains)."""
