@@ -221,6 +221,7 @@ class ContinuousBatchingService(GenerationService):
     ``/healthz``)."""
 
     MAX_STOPS = 8          # static stop-set width in the executable
+    GROW_MAX = 8           # adaptive chunk growth cap, x base chunk
 
     def _setup(self, model, params, tokenizer=None, slots: int = 8,
                chunk: int = 8, window_ms: float = 5.0):
@@ -251,9 +252,38 @@ class ContinuousBatchingService(GenerationService):
         self._latencies: list = []
         self.stats = {"requests": 0, "completed": 0, "chunks": 0,
                       "admissions": 0, "eras": 0, "max_active": 0}
+        self._warm_chunk_ladder()
         self._worker_thread = threading.Thread(
             target=self._worker, daemon=True, name="gen-continuous")
         self._worker_thread.start()
+
+    def _warm_chunk_ladder(self):
+        """Compile every chunk length the scheduler can pick — base
+        chunk and its power-of-two growth ladder up to GROW_MAX — on
+        throwaway all-done slot state, BEFORE the worker starts.
+
+        Adaptive growth chooses a length from the ladder based on
+        ``min_left``, which depends on which requests share the engine
+        at that instant — timing-nondeterministic, so without this a
+        length can be first seen mid-traffic and every slot stalls
+        behind a fresh XLA compile (~30 s for the 124M serving model
+        through the tunnel; the serve_mixed rung's chunk=8 arm
+        measured ~10x slower from exactly that). One-time startup
+        cost, same contract as the padded admission width in
+        ``_admit_group``."""
+        from .generate import fresh_cache
+
+        total = int(self.model.max_len)
+        cache = fresh_cache(self.model, self.params, self._slots, total)
+        self._init_arrays()
+        arrays = self._arrays
+        steps = self._chunk
+        while steps <= min(self._chunk * self.GROW_MAX, total):
+            fn = _chunk_fn(self.model, steps, self.MAX_STOPS)
+            out = fn(self.params, cache, *arrays)
+            cache = out[0]           # the cache argument is donated
+            steps *= 2
+        self._arrays = None          # the worker builds its own state
 
     # ---- request entry ---------------------------------------------------
 
@@ -578,16 +608,43 @@ class ContinuousBatchingService(GenerationService):
         live = [m for m in self._meta if m is not None]
         if not live:
             return
+        min_left = min(m["req"]["budget"] - m["emitted"] for m in live)
         # era-end tail: the admission invariant bounds every live
         # budget by max_len, so min 1 step always remains
         steps = min(self._chunk, int(self.model.max_len) - self._p)
+        # ADAPTIVE chunk growth: when every slot is occupied, no slot
+        # can free before min_left steps (a row only exits early via a
+        # stop token) — so running one long chunk straight to min_left
+        # recycles slots exactly as fast while paying ONE host round
+        # trip instead of min_left/chunk of them (each ~105 ms through
+        # the tunnel; the uniform-burst case of the serve_mixed rung).
+        # With free slots the base chunk stands, keeping admission
+        # latency for new arrivals at one short chunk; with stop
+        # tokens in play rows can finish mid-chunk, so growth is
+        # capped at 4x to bound both the wasted frozen-row steps and
+        # the slot-recycle delay.
+        if min_left > self._chunk and not any(
+                m is None for m in self._meta):
+            limit = min(min_left, self._chunk * (
+                4 if any(m["req"]["stop"] for m in live)
+                else self.GROW_MAX))
+            grown = self._chunk
+            while grown * 2 <= limit:
+                grown *= 2       # power-of-two LADDER: the executable
+                # set is fixed and precompiled at startup
+                # (_warm_chunk_ladder) — a length first seen mid-
+                # traffic would stall every slot behind a fresh XLA
+                # compile, the same timing-nondeterminism the padded
+                # admission width kills (measured: the chunk=8 rung
+                # collapsed ~10x from exactly that before the warmup)
+            steps = min(grown, int(self.model.max_len) - self._p)
         out1 = self._dispatch_chunk(steps)
         # dispatch ONE chunk ahead while the first runs, unless queue
         # traffic wants an admission slot between them or everyone
         # will finish inside the first chunk anyway
-        min_left = min(m["req"]["budget"] - m["emitted"] for m in live)
+        min_left -= steps        # remaining after chunk 1
         steps2 = min(self._chunk, int(self.model.max_len) - self._p)
-        if (self._queue.empty() and min_left > steps
+        if (self._queue.empty() and min_left > 0
                 and not any(m is None for m in self._meta)
                 and steps2 >= 1):
             out2 = self._dispatch_chunk(steps2)

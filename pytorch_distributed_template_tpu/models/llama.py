@@ -358,7 +358,9 @@ class LlamaAttention(nn.Module):
                 return _fresh_prefill_ctx()
             # grouped GQA read: no jnp.repeat — the head expansion
             # materialized a groups-x cache copy per step at batch >= 32
-            # (the "batch-32 cliff", scripts/debug_batch32_cliff.py)
+            # (the "batch-32 cliff", scripts/debug_batch32_cliff.py).
+            # Also measured FASTER at t > 1 (padded admission prefills:
+            # serve_mixed uniform 906 vs 475 tok/s gated to t == 1)
             return grouped_query_attention(
                 q, k_all, v_all, mask=visible[None, None]
             )

@@ -1,0 +1,201 @@
+"""Is the int8-KV dequantization actually fused? (decode_batch follow-up)
+
+After the batch-32 cliff fix, the decode_batch sweep shows int8-KV
+LOSING to dense at batch >= 32 (b64: 5.96 vs 5.82 ms/step) despite
+streaming half the cache bytes — models/quant.dequantize_kv's claim
+that "the bf16 copy never lands in HBM" evidently stops holding
+somewhere in this regime.
+
+Variants timed here (single layer, rolling cache [B, W, KVH, D],
+t=1 decode, 512 in-jit scanned steps, real chip). Each step WRITES its
+new row into the carried cache — without the write the cache is
+loop-invariant and XLA hoists the QK einsum clean out of the scan
+(first version of this script measured 300+ GB/s "bandwidth", above
+the device roofline — a tell worth remembering).
+
+  dense          bf16 cache, grouped attention (the fixed shipped path)
+  int8-dequant   shipped int8 path: dequantize full cache -> concat own
+                 row -> grouped attention
+  int8-fused     split-block: scores_hist = (qg @ k_int8) * k_scale,
+                 scores_own = qg @ k_own (bf16); one softmax over the
+                 concatenation; out = (probs_hist * v_scale) @ v_int8
+                 + probs_own @ v_own. Exact same math (per-row scales
+                 factor out of the dot products); the int8 cache is the
+                 only big operand that streams.
+"""
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+W = 1024
+KVH, H, D = 4, 12, 64
+GROUPS = H // KVH
+STEPS = 512
+NEG_INF = -1e30
+
+
+def timeit(fn, *args):
+    float(fn(*args))
+    float(fn(*args))
+    reps = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        float(fn(*args))
+        reps.append((time.perf_counter() - t0) / STEPS * 1e3)
+    return float(np.median(reps))
+
+
+def quantize_rows(x):
+    amax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1)
+    scale = jnp.where(amax > 0, amax / 127.0, 1.0)
+    q = jnp.clip(jnp.round(x.astype(jnp.float32) / scale[..., None]),
+                 -127, 127)
+    return q.astype(jnp.int8), scale.astype(jnp.float32)
+
+
+def grouped(q, k, v, visible):
+    b, t = q.shape[0], q.shape[1]
+    qg = q.reshape(b, t, KVH, GROUPS, D).astype(jnp.float32) * (D ** -0.5)
+    scores = jnp.einsum("btkgd,blkd->bkgtl", qg, k,
+                        preferred_element_type=jnp.float32)
+    scores = jnp.where(visible[:, None, None, None], scores, NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bkgtl,blkd->btkgd", probs, v,
+                     preferred_element_type=jnp.float32)
+    return out.reshape(b, t, H, D).astype(q.dtype)
+
+
+def write_row(buf, new, start):
+    return lax.dynamic_update_slice(
+        buf, new, (0, start) + (0,) * (new.ndim - 2))
+
+
+def att_dense(q, k_new, v_new, state, cur):
+    cache_k, cache_v, slot_pos = state
+    pos = jnp.full((1,), cur, jnp.int32)
+    hist_pos = slot_pos - 1
+    k_all = jnp.concatenate([cache_k, k_new], axis=1)
+    v_all = jnp.concatenate([cache_v, v_new], axis=1)
+    k_pos = jnp.concatenate([hist_pos, pos])[None, :]
+    visible = (k_pos >= 0) & (k_pos <= pos[:, None]) & (
+        pos[:, None] - k_pos < W)
+    out = grouped(q, k_all, v_all, visible)
+    start = cur % W
+    state = (write_row(cache_k, k_new, start),
+             write_row(cache_v, v_new, start),
+             lax.dynamic_update_slice(
+                 slot_pos, jnp.full((1,), cur + 1, jnp.int32), (start,)))
+    return out, state
+
+
+def att_int8_dequant(q, k_new, v_new, state, cur):
+    qk, ks, qv, vs, slot_pos = state
+    hist_k = (qk.astype(jnp.float32) * ks[..., None]).astype(jnp.bfloat16)
+    hist_v = (qv.astype(jnp.float32) * vs[..., None]).astype(jnp.bfloat16)
+    pos = jnp.full((1,), cur, jnp.int32)
+    hist_pos = slot_pos - 1
+    k_all = jnp.concatenate([hist_k, k_new], axis=1)
+    v_all = jnp.concatenate([hist_v, v_new], axis=1)
+    k_pos = jnp.concatenate([hist_pos, pos])[None, :]
+    visible = (k_pos >= 0) & (k_pos <= pos[:, None]) & (
+        pos[:, None] - k_pos < W)
+    out = grouped(q, k_all, v_all, visible)
+    start = cur % W
+    qk_new, sk_new = quantize_rows(k_new)
+    qv_new, sv_new = quantize_rows(v_new)
+    state = (write_row(qk, qk_new, start), write_row(ks, sk_new, start),
+             write_row(qv, qv_new, start), write_row(vs, sv_new, start),
+             lax.dynamic_update_slice(
+                 slot_pos, jnp.full((1,), cur + 1, jnp.int32), (start,)))
+    return out, state
+
+
+def att_int8_fused(q, k_new, v_new, state, cur):
+    qk, ks, qv, vs, slot_pos = state
+    b, t = q.shape[0], q.shape[1]
+    pos = jnp.full((1,), cur, jnp.int32)
+    hist_pos = slot_pos - 1
+    k_pos_h = hist_pos[None, :]
+    vis_h = (k_pos_h >= 0) & (k_pos_h <= pos[:, None]) & (
+        pos[:, None] - k_pos_h < W)                       # [t, W]
+    vis_o = jnp.ones((t, t), bool)                        # own row(s)
+    qg = q.reshape(b, t, KVH, GROUPS, D).astype(jnp.float32) * (D ** -0.5)
+    # history block: int8 K streams; scale applied to the SCORES
+    s_hist = jnp.einsum("btkgd,blkd->bkgtl", qg, qk,
+                        preferred_element_type=jnp.float32)
+    s_hist = s_hist * jnp.transpose(ks, (0, 2, 1))[:, :, None, None, :]
+    s_hist = jnp.where(vis_h[:, None, None, None], s_hist, NEG_INF)
+    # own block: full precision (tiny)
+    s_own = jnp.einsum("btkgd,blkd->bkgtl", qg, k_new,
+                       preferred_element_type=jnp.float32)
+    s_own = jnp.where(vis_o[:, None, None, None], s_own, NEG_INF)
+    scores = jnp.concatenate([s_hist, s_own], axis=-1)
+    probs = jax.nn.softmax(scores, axis=-1)
+    p_hist, p_own = probs[..., :W], probs[..., W:]
+    p_hist = p_hist * jnp.transpose(vs, (0, 2, 1))[:, :, None, None, :]
+    out = jnp.einsum("bkgtl,blkd->btkgd", p_hist, qv,
+                     preferred_element_type=jnp.float32)
+    out = out + jnp.einsum("bkgtl,blkd->btkgd", p_own, v_new,
+                           preferred_element_type=jnp.float32)
+    out = out.reshape(b, t, H, D).astype(q.dtype)
+    start = cur % W
+    qk_new, sk_new = quantize_rows(k_new)
+    qv_new, sv_new = quantize_rows(v_new)
+    state = (write_row(qk, qk_new, start), write_row(ks, sk_new, start),
+             write_row(qv, qv_new, start), write_row(vs, sv_new, start),
+             lax.dynamic_update_slice(
+                 slot_pos, jnp.full((1,), cur + 1, jnp.int32), (start,)))
+    return out, state
+
+
+def run(name, b, att, int8):
+    key = jax.random.key(b)
+    ks_ = jax.random.split(key, 4)
+    cache_k = jax.random.normal(ks_[0], (b, W, KVH, D), jnp.bfloat16)
+    cache_v = jax.random.normal(ks_[1], (b, W, KVH, D), jnp.bfloat16)
+    q0 = jax.random.normal(ks_[2], (b, 1, H, D), jnp.bfloat16)
+    kv0 = jax.random.normal(ks_[3], (b, 1, KVH, D), jnp.bfloat16)
+    slot_pos = jnp.arange(1, W + 1, dtype=jnp.int32)
+    if int8:
+        qk, sk = quantize_rows(cache_k)
+        qv, sv = quantize_rows(cache_v)
+        state = (qk, sk, qv, sv, slot_pos)
+        cache_bytes = 2 * b * W * KVH * (D + 4)
+    else:
+        state = (cache_k, cache_v, slot_pos)
+        cache_bytes = 2 * b * W * KVH * D * 2
+
+    @jax.jit
+    def many(state, q0, kv0):
+        def body(carry, i):
+            state, acc = carry
+            out, state = att(q0, kv0, kv0, state, W + i)
+            return (state, acc + out.mean()), None
+
+        (_, acc), _ = lax.scan(body, (state, jnp.zeros((), jnp.bfloat16)),
+                               jnp.arange(STEPS, dtype=jnp.int32))
+        return acc.astype(jnp.float32)
+
+    ms = timeit(many, state, q0, kv0)
+    bw = cache_bytes / (ms * 1e-3) / 1e9
+    print(f"  {name:13s} b={b:2d}  {ms:7.3f} ms/step/layer  "
+          f"cache-bytes BW {bw:6.1f} GB/s")
+    return ms
+
+
+def main():
+    print(f"device: {jax.devices()[0].device_kind}; W={W} KVH={KVH} "
+          f"H={H} D={D}; {STEPS} scanned steps, cache written per step, "
+          f"median of 3")
+    for b in (16, 32, 64):
+        run("dense", b, att_dense, False)
+        run("int8-dequant", b, att_int8_dequant, True)
+        run("int8-fused", b, att_int8_fused, True)
+        print()
+
+
+if __name__ == "__main__":
+    main()

@@ -199,7 +199,11 @@ if __name__ == "__main__":
                              "no group keys) on RoPE/non-rolling "
                              "models, static micro-batching otherwise")
     parser.add_argument("--decode-chunk", default=8, type=int,
-                        help="continuous scheduler: decode steps per "
-                             "dispatch (admission latency bound)")
+                        help="continuous scheduler: BASE decode steps "
+                             "per dispatch (admission latency bound); "
+                             "when every slot is busy the engine grows "
+                             "chunks toward the shortest remaining "
+                             "budget, so a small base costs saturated "
+                             "throughput nothing")
     args, config = ConfigParser.from_args(parser, (), training=False)
     main(args, config)
