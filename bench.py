@@ -63,7 +63,9 @@ STEPS = 20
 # cause instead of a timeout with nothing. Deliberately standalone from
 # utils/watchdog.StepWatchdog: the bench guard must arm before, and
 # survive, a package/jax import that itself hangs on the wedged device.
-WATCHDOG_SECS = 5100   # raised r5: +decode_stop/serve_mixed/decode_batch
+WATCHDOG_SECS = 6000   # raised r5: +decode_stop/serve_mixed/decode_batch,
+# then decode_batch's b=64 points and the continuous engine's startup
+# chunk-ladder warmup (4 extra 124M-model compiles inside serve_mixed)
 _done = threading.Event()
 
 

@@ -222,6 +222,12 @@ class ContinuousBatchingService(GenerationService):
 
     MAX_STOPS = 8          # static stop-set width in the executable
     GROW_MAX = 8           # adaptive chunk growth cap, x base chunk
+    # growth cap when live rows carry stop tokens (they can finish
+    # mid-chunk); clamped by GROW_MAX so every pickable length stays
+    # inside the precompiled ladder whatever GROW_MAX is tuned to
+    GROW_MAX_STOPS = 4
+    STREAM_DELTAS = True   # generate(on_tokens=...) emits incremental
+    # per-chunk token deltas (serve.py "stream": true)
 
     def _setup(self, model, params, tokenizer=None, slots: int = 8,
                chunk: int = 8, window_ms: float = 5.0):
@@ -270,7 +276,14 @@ class ContinuousBatchingService(GenerationService):
         through the tunnel; the serve_mixed rung's chunk=8 arm
         measured ~10x slower from exactly that). One-time startup
         cost, same contract as the padded admission width in
-        ``_admit_group``."""
+        ``_admit_group``.
+
+        Deliberately EXECUTES each length instead of AOT
+        ``.lower().compile()``: the AOT path builds a separate
+        executable that is not guaranteed to seed the dispatch-path
+        jit cache the worker actually hits, and a warmup that only
+        probably warms is worse than ~120 frozen-row decode steps
+        (~1 s; all slots are done, rows freeze, nothing is emitted)."""
         from .generate import fresh_cache
 
         total = int(self.model.max_len)
@@ -290,15 +303,25 @@ class ContinuousBatchingService(GenerationService):
     def generate(self, prompt=None, prompt_ids=None,
                  max_new_tokens: int = 64, temperature: float = 0.0,
                  top_k: int = 0, top_p: float = 0.0, seed: int = 0,
-                 speculative: int = 0, stop=None) -> dict:
+                 speculative: int = 0, stop=None,
+                 on_tokens=None) -> dict:
+        """Same contract as the parent plus ``on_tokens``: a callback
+        receiving each batch of freshly decoded token ids for THIS
+        request as its chunks absorb (stop tokens filtered — the
+        concatenated deltas equal the final response's ``ids``). Runs
+        on the scheduler thread: must not block. Powers serve.py's
+        ``"stream": true`` server-sent events."""
         if speculative > 0:
             # batch-1 by construction; runs under the parent's lock
             # (the scheduler's own dispatches take the same lock)
-            return super().generate(
+            result = super().generate(
                 prompt=prompt, prompt_ids=prompt_ids,
                 max_new_tokens=max_new_tokens, temperature=temperature,
                 top_k=top_k, top_p=top_p, seed=seed,
                 speculative=speculative, stop=stop)
+            if on_tokens is not None and result.get("ids"):
+                on_tokens(list(result["ids"]))   # single final delta
+            return result
         ids = self.encode_prompt(prompt, prompt_ids)
         stops = self.encode_stop(stop)
         if len(stops) > self.MAX_STOPS:
@@ -330,6 +353,7 @@ class ContinuousBatchingService(GenerationService):
             "ids": ids, "budget": max_new,
             "temperature": float(temperature), "top_k": int(top_k),
             "top_p": float(top_p), "seed": seed, "stop": stops,
+            "on_tokens": on_tokens,
             # raw key data, derived WITHOUT device work in the
             # caller's thread (host path above): per-request device
             # ops serialized burst arrivals through the tunnel
@@ -465,6 +489,7 @@ class ContinuousBatchingService(GenerationService):
             m = self._meta[s]
             if m is None or m["done"]:
                 continue
+            n_before = len(m["out"])
             if not m["out"]:
                 # first absorb for this row: its admission-time token
                 # future is long since resolved (the chunk that just
@@ -479,6 +504,20 @@ class ContinuousBatchingService(GenerationService):
             m["out"].extend(int(t) for t in toks[s, :fresh])
             m["emitted"] = int(emitted[s])
             m["done"] = bool(done[s])
+            cb = m["req"].get("on_tokens")
+            if cb is not None:
+                # delta = this absorb's emissions, minus stop ids (a
+                # stop can only be the LAST emitted token — the row
+                # freezes after it — so filtering ≡ the final
+                # response's trailing-stop strip)
+                stops = m["req"]["stop"]
+                delta = [t for t in m["out"][n_before:]
+                         if t not in stops]
+                if delta:
+                    try:
+                        cb(delta)
+                    except Exception:   # noqa: BLE001 — a consumer's
+                        pass            # callback must not kill absorb
         for s in range(self._slots):
             m = self._meta[s]
             if m is not None and m["done"]:
@@ -626,7 +665,8 @@ class ContinuousBatchingService(GenerationService):
         if min_left > self._chunk and not any(
                 m is None for m in self._meta):
             limit = min(min_left, self._chunk * (
-                4 if any(m["req"]["stop"] for m in live)
+                min(self.GROW_MAX_STOPS, self.GROW_MAX)
+                if any(m["req"]["stop"] for m in live)
                 else self.GROW_MAX))
             grown = self._chunk
             while grown * 2 <= limit:

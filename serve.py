@@ -13,9 +13,18 @@ in a stdlib ``ThreadingHTTPServer``. No web framework, no deps.
     POST /generate            body: {"prompt": "text"} or
                               {"prompt_ids": [1, 2, 3]}, optional
                               max_new_tokens / temperature / top_k /
-                              top_p / seed / speculative / stop
+                              top_p / seed / speculative / stop /
+                              stream
                               -> {"text": ...} and/or {"ids": [...]},
                               "stop_reason": "stop" | "length"
+
+``stream: true`` switches the response to server-sent events
+(``text/event-stream``): one ``data: {"ids": [...]}`` event per
+decoded chunk as the continuous scheduler absorbs it (the deltas
+concatenate to the final ids), then a final ``data:`` event with the
+complete normal response plus ``"done": true``. Schedulers without
+incremental decode (static groups, speculative requests) send one
+delta covering the whole generation — same wire shape either way.
 
 ``stop``: stop-token ids and/or single-token strings (a list or one
 value). Generation for a row ends as soon as it emits a stop token —
@@ -77,11 +86,12 @@ from pytorch_distributed_template_tpu.engine.serving import (  # noqa: E402
 )
 
 
-def _run_request(service: GenerationService, req: dict) -> dict:
+def _run_request(service: GenerationService, req: dict,
+                 on_tokens=None) -> dict:
     """JSON request body -> GenerationService.generate kwargs. All
     encoding/validation/dispatch logic lives in the service (shared
     with generate.py); this only maps the wire format."""
-    return service.generate(
+    kwargs = dict(
         prompt=req.get("prompt"),
         prompt_ids=req.get("prompt_ids"),
         max_new_tokens=int(req.get("max_new_tokens", 64)),
@@ -92,6 +102,9 @@ def _run_request(service: GenerationService, req: dict) -> dict:
         speculative=int(req.get("speculative", 0)),
         stop=req.get("stop"),
     )
+    if on_tokens is not None:
+        kwargs["on_tokens"] = on_tokens
+    return service.generate(**kwargs)
 
 
 def make_handler(service: GenerationService):
@@ -125,11 +138,78 @@ def make_handler(service: GenerationService):
             try:
                 n = int(self.headers.get("Content-Length", 0))
                 req = json.loads(self.rfile.read(n) or b"{}")
+                if req.get("stream"):
+                    return self._stream(req)
                 self._send(200, _run_request(service, req))
             except ValueError as e:
                 self._send(400, {"error": str(e)})
             except Exception as e:  # surface, don't kill the server
                 self._send(500, {"error": f"{type(e).__name__}: {e}"})
+
+        def _stream(self, req: dict) -> None:
+            """Server-sent events: one ``data:`` line per absorbed
+            token batch (``{"ids": [...]}``' deltas concatenate to the
+            final ids), then a final ``data:`` carrying the complete
+            normal response plus ``"done": true``. Delta events carry
+            ids only (text would need byte/subword boundary tracking);
+            the final event includes ``text`` as usual. On schedulers
+            without incremental decode (static groups, speculative)
+            one delta covers the whole generation. The response has no
+            Content-Length — connection close delimits it (HTTP/1.0
+            framing, curl -N friendly)."""
+            import queue as queue_mod
+            import threading
+
+            q: "queue_mod.Queue" = queue_mod.Queue()
+            out: dict = {}
+
+            incremental = getattr(service, "STREAM_DELTAS", False)
+
+            def run():
+                try:
+                    r = _run_request(
+                        service, req,
+                        on_tokens=(lambda ids: q.put(("tokens", ids)))
+                        if incremental else None)
+                    out["r"] = r
+                    if not incremental and r.get("ids"):
+                        q.put(("tokens", r["ids"]))  # one final delta
+                    q.put(("done", None))
+                except Exception as e:  # noqa: BLE001 — surfaced below
+                    q.put(("error", e))
+
+            threading.Thread(target=run, daemon=True).start()
+            self.send_response(200)
+            self.send_header("Content-Type", "text/event-stream")
+            self.send_header("Cache-Control", "no-cache")
+            self.end_headers()
+
+            def emit(payload: dict) -> None:
+                self.wfile.write(
+                    b"data: " + json.dumps(payload).encode("utf-8")
+                    + b"\n\n")
+                self.wfile.flush()
+
+            # headers are out: from here NOTHING may write a second
+            # HTTP response onto this connection. A client that
+            # disconnects mid-stream raises on emit — swallow it and
+            # let the generation finish in its thread (its slot is
+            # live; the engine completes/frees it regardless).
+            try:
+                while True:
+                    kind, payload = q.get()
+                    if kind == "tokens":
+                        emit({"ids": [int(t) for t in payload]})
+                    elif kind == "error":
+                        e = payload
+                        emit({"error": f"{type(e).__name__}: {e}",
+                              "done": True})
+                        return
+                    else:
+                        emit({**out["r"], "done": True})
+                        return
+            except (BrokenPipeError, ConnectionError, OSError):
+                return
 
         def log_message(self, fmt, *fmt_args):
             pass  # suppress http.server's noisy per-request stderr lines

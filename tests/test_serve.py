@@ -156,6 +156,40 @@ def test_mixed_length_requests_batch_exactly(llama_server):
     assert e.value.code == 400
 
 
+def test_streaming_sse_deltas_match_final(llama_server):
+    """``stream: true`` returns server-sent events whose per-chunk id
+    deltas concatenate to the final response's ids, which in turn
+    match the plain (non-streaming) response for the same request."""
+    import http.client
+    import urllib.parse as up
+
+    u = up.urlparse(llama_server)
+    conn = http.client.HTTPConnection(u.hostname, u.port, timeout=300)
+    payload = {"prompt_ids": [5, 6, 7], "max_new_tokens": 24,
+               "stream": True}
+    conn.request("POST", "/generate", body=json.dumps(payload),
+                 headers={"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    assert resp.status == 200
+    assert resp.getheader("Content-Type") == "text/event-stream"
+    raw = resp.read().decode("utf-8")      # connection close delimits
+    conn.close()
+    events = [json.loads(line[len("data: "):])
+              for line in raw.splitlines() if line.startswith("data: ")]
+    assert events, raw
+    final = events[-1]
+    assert final.get("done") is True and "error" not in final
+    deltas = [t for e in events[:-1] for t in e["ids"]]
+    assert deltas == final["ids"]
+    # the continuous scheduler decodes in chunks (default 8) — a
+    # 24-token greedy budget must arrive incrementally, not in one
+    # terminal flush
+    assert len(events) >= 3, events
+    plain = _post(llama_server, {"prompt_ids": [5, 6, 7],
+                                 "max_new_tokens": 24})
+    assert plain["ids"] == final["ids"]
+
+
 def _post(url, payload, timeout=300):
     req = urllib.request.Request(
         url + "/generate", data=json.dumps(payload).encode(),
