@@ -1,0 +1,102 @@
+"""HBM bandwidth envelope probes for the bench's ``total_bw_frac``.
+
+The bench ladder normalizes byte-accounting against a single "slice
+bandwidth" constant (~260 GB/s, bench.py). This script shows why that
+is an envelope midpoint, not a hard ceiling: achievable HBM throughput
+on this slice depends on the op mix. Measured (v5e slice, 64/32-step
+in-jit chains, forced host readback per rep):
+
+    scale (R+W, 256 MB)        ~220 GB/s
+    add 2-operand (2R+W)       ~265-285 GB/s
+    reduce (pure R, 512 MB)    ~130 GB/s   (reduction-tree bound,
+    matvec (weight stream)     ~125 GB/s    not byte bound)
+
+Consequences: a decode step whose traffic mix is add-shaped
+(multi-operand reads feeding fused elementwise work, the highest
+row above) can legitimately report ``total_bw_frac`` slightly above
+1.0 against the 260 GB/s midpoint (the r5 post-GQA-fix decode rung
+reads ~1.05) — that means "at the roofline", not an accounting
+error. Conversely the reduce/matvec rows (reduction-tree bound)
+explain why reduction-heavy steps sit well under the constant.
+"""
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+
+def _timed_chain(jitted, args, steps, nbytes_per_step, name):
+    float(jitted(*args))
+    float(jitted(*args))
+    reps = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        float(jitted(*args))
+        reps.append(steps * nbytes_per_step
+                    / (time.perf_counter() - t0) / 1e9)
+    print(f"  {name:26s} {np.median(reps):7.1f} GB/s")
+
+
+def main():
+    print(f"device: {jax.devices()[0].device_kind}")
+    n = 256 * 1024 * 1024 // 2          # 256 MB bf16
+    steps = 64
+    x = jax.random.normal(jax.random.key(0), (n,), jnp.bfloat16)
+    y = jax.random.normal(jax.random.key(1), (n,), jnp.bfloat16)
+    two = jnp.bfloat16(2.0)
+
+    def chain(f):
+        @jax.jit
+        def many(a, b):
+            def body(c, _):
+                return f(c, b), None
+            c, _ = lax.scan(body, a, None, length=steps)
+            return c.sum().astype(jnp.float32)
+        return many
+
+    _timed_chain(chain(lambda c, b: c * two), (x, y), steps,
+                 2 * n * 2, "scale (R+W)")
+    _timed_chain(chain(lambda c, b: c + b), (x, y), steps,
+                 3 * n * 2, "add 2-operand (2R+W)")
+
+    n2 = 512 * 1024 * 1024 // 2         # 512 MB bf16
+    steps2 = 32
+    big = jax.random.normal(jax.random.key(2), (n2,), jnp.bfloat16)
+
+    @jax.jit
+    def red(a):
+        def body(c, _):
+            # the carry perturbs the REDUCED OPERAND, so the 512 MB
+            # reduce itself depends on c and cannot be hoisted out of
+            # the scan by loop-invariant code motion
+            s = (a + c.astype(jnp.bfloat16) * jnp.bfloat16(1e-8)).sum()
+            return c + s.astype(jnp.float32), None
+        c, _ = lax.scan(body, jnp.float32(0), None, length=steps2)
+        return c
+
+    _timed_chain(red, (big,), steps2, n2 * 2, "reduce (pure R, 512 MB)")
+
+    m = k = 16384                        # 512 MB bf16 matrix
+    w = jax.random.normal(jax.random.key(3), (m, k), jnp.bfloat16)
+    v = jax.random.normal(jax.random.key(4), (k,), jnp.bfloat16)
+
+    @jax.jit
+    def mv(w, v):
+        def body(c, _):
+            out = jnp.einsum("mk,k->m", w, c,
+                             preferred_element_type=jnp.float32)
+            # renormalize: a 16384-dim random matvec scales entry
+            # magnitude ~sqrt(k)=128x per step; unscaled, the carry
+            # overflows bf16 to inf around step 19 of 32
+            out = out * jnp.float32(1.0 / 128.0)
+            return out.astype(jnp.bfloat16)[:k], None
+        c, _ = lax.scan(body, v, None, length=steps2)
+        return c.sum().astype(jnp.float32)
+
+    _timed_chain(mv, (w, v), steps2, m * k * 2, "matvec (weight stream)")
+
+
+if __name__ == "__main__":
+    main()
