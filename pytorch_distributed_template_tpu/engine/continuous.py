@@ -304,13 +304,24 @@ class ContinuousBatchingService(GenerationService):
                  max_new_tokens: int = 64, temperature: float = 0.0,
                  top_k: int = 0, top_p: float = 0.0, seed: int = 0,
                  speculative: int = 0, stop=None,
-                 on_tokens=None) -> dict:
+                 on_tokens=None, cancel=None) -> dict:
         """Same contract as the parent plus ``on_tokens``: a callback
         receiving each batch of freshly decoded token ids for THIS
         request as its chunks absorb (stop tokens filtered — the
         concatenated deltas equal the final response's ``ids``). Runs
         on the scheduler thread: must not block. Powers serve.py's
-        ``"stream": true`` server-sent events."""
+        ``"stream": true`` server-sent events.
+
+        ``cancel``: an optional ``threading.Event``. Once set, the
+        request is finalized at its NEXT chunk absorb — the row's slot
+        frees immediately for waiting traffic instead of decoding out
+        the rest of its budget (a disconnected streaming client's main
+        cost). The call returns the tokens decoded so far with
+        ``stop_reason: "cancelled"``; a request still in the queue is
+        dropped without ever taking a slot. Speculative requests
+        (``speculative > 0``) bypass the slot engine (batch-1 under
+        the parent's lock) and IGNORE ``cancel`` — they run their
+        whole budget."""
         if speculative > 0:
             # batch-1 by construction; runs under the parent's lock
             # (the scheduler's own dispatches take the same lock)
@@ -353,7 +364,7 @@ class ContinuousBatchingService(GenerationService):
             "ids": ids, "budget": max_new,
             "temperature": float(temperature), "top_k": int(top_k),
             "top_p": float(top_p), "seed": seed, "stop": stops,
-            "on_tokens": on_tokens,
+            "on_tokens": on_tokens, "cancel": cancel,
             # raw key data, derived WITHOUT device work in the
             # caller's thread (host path above): per-request device
             # ops serialized burst arrivals through the tunnel
@@ -504,6 +515,13 @@ class ContinuousBatchingService(GenerationService):
             m["out"].extend(int(t) for t in toks[s, :fresh])
             m["emitted"] = int(emitted[s])
             m["done"] = bool(done[s])
+            ev = m["req"].get("cancel")
+            if ev is not None and not m["done"] and ev.is_set():
+                # cancelled mid-flight: finalize with what's decoded,
+                # free the slot for waiting traffic (the device row
+                # keeps stepping until the slot is reused — bounded
+                # waste; the SLOT availability is the win)
+                m["done"] = True
             cb = m["req"].get("on_tokens")
             if cb is not None:
                 # delta = this absorb's emissions, minus stop ids (a
@@ -526,8 +544,17 @@ class ContinuousBatchingService(GenerationService):
     def _complete(self, slot: int):
         m = self._meta[slot]
         req = m["req"]
-        req["result"] = self._response(
+        resp = self._response(
             m["out"], stops=req["stop"], emitted=m["emitted"])
+        ev = req.get("cancel")
+        if (ev is not None and ev.is_set()
+                and resp["stop_reason"] == "length"
+                and m["emitted"] < req["budget"]):
+            # finalized early by cancellation, not by budget — a row
+            # that genuinely hit its stop token keeps "stop"
+            resp["stop_reason"] = "cancelled"
+            self.stats["cancelled"] = self.stats.get("cancelled", 0) + 1
+        req["result"] = resp
         req["event"].set()
         self._meta[slot] = None
         self.stats["completed"] += 1
@@ -596,6 +623,21 @@ class ContinuousBatchingService(GenerationService):
         from .generate import fresh_cache
 
         active = any(m is not None for m in self._meta)
+        # drop queued requests whose cancel event fired before they
+        # ever took a slot (zero device work spent on them) — BEFORE
+        # era-start positioning, so a cancelled request's bucket or
+        # budget can't inflate/starve the new era's position
+        for r in list(pending):
+            ev = r.get("cancel")
+            if ev is not None and ev.is_set():
+                pending.remove(r)
+                resp = self._response([], stops=r["stop"], emitted=0)
+                resp["stop_reason"] = "cancelled"
+                r["result"] = resp
+                r["event"].set()
+                self.stats["cancelled"] = (
+                    self.stats.get("cancelled", 0) + 1)
+                self.stats["completed"] += 1
         if not active:
             # idle: new era (stale K/V is masked by pad_lens; only the
             # position counter resets)

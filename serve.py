@@ -25,6 +25,10 @@ concatenate to the final ids), then a final ``data:`` event with the
 complete normal response plus ``"done": true``. Schedulers without
 incremental decode (static groups, speculative requests) send one
 delta covering the whole generation — same wire shape either way.
+A mid-stream client disconnect CANCELS the generation on the slot
+engine: the row finalizes at its next chunk absorb and its slot
+frees for waiting traffic instead of decoding out the rest of its
+budget (``cancelled`` count in ``/healthz`` batching stats).
 
 ``stop``: stop-token ids and/or single-token strings (a list or one
 value). Generation for a row ends as soon as it emits a stop token —
@@ -87,7 +91,7 @@ from pytorch_distributed_template_tpu.engine.serving import (  # noqa: E402
 
 
 def _run_request(service: GenerationService, req: dict,
-                 on_tokens=None) -> dict:
+                 on_tokens=None, cancel=None) -> dict:
     """JSON request body -> GenerationService.generate kwargs. All
     encoding/validation/dispatch logic lives in the service (shared
     with generate.py); this only maps the wire format."""
@@ -104,6 +108,8 @@ def _run_request(service: GenerationService, req: dict,
     )
     if on_tokens is not None:
         kwargs["on_tokens"] = on_tokens
+    if cancel is not None:
+        kwargs["cancel"] = cancel
     return service.generate(**kwargs)
 
 
@@ -164,13 +170,19 @@ def make_handler(service: GenerationService):
             out: dict = {}
 
             incremental = getattr(service, "STREAM_DELTAS", False)
+            # speculative requests bypass the slot engine (batch-1
+            # under the lock) and don't honor mid-flight cancel
+            can_cancel = incremental and not int(
+                req.get("speculative", 0))
+            cancel_evt = threading.Event() if can_cancel else None
 
             def run():
                 try:
                     r = _run_request(
                         service, req,
                         on_tokens=(lambda ids: q.put(("tokens", ids)))
-                        if incremental else None)
+                        if incremental else None,
+                        cancel=cancel_evt)
                     out["r"] = r
                     if not incremental and r.get("ids"):
                         q.put(("tokens", r["ids"]))  # one final delta
@@ -192,9 +204,10 @@ def make_handler(service: GenerationService):
 
             # headers are out: from here NOTHING may write a second
             # HTTP response onto this connection. A client that
-            # disconnects mid-stream raises on emit — swallow it and
-            # let the generation finish in its thread (its slot is
-            # live; the engine completes/frees it regardless).
+            # disconnects mid-stream raises on emit — swallow it, and
+            # on the slot engine CANCEL the generation so its slot
+            # frees at the next chunk absorb instead of decoding out
+            # the remaining budget.
             try:
                 while True:
                     kind, payload = q.get()
@@ -209,6 +222,8 @@ def make_handler(service: GenerationService):
                         emit({**out["r"], "done": True})
                         return
             except (BrokenPipeError, ConnectionError, OSError):
+                if cancel_evt is not None:
+                    cancel_evt.set()
                 return
 
         def log_message(self, fmt, *fmt_args):

@@ -156,6 +156,69 @@ def test_mid_flight_admission_exact(stack, service):
         assert out[i] is not None and out[i]["ids"] == ref[i]["ids"], i
 
 
+def test_cancel_frees_slot_and_drops_queued(stack):
+    """A cancel event finalizes a mid-flight request at the next chunk
+    absorb (partial ids = a prefix of the solo run, stop_reason
+    "cancelled", slot freed for the next request); a queued request
+    cancelled before admission returns empty without device work."""
+    model, params, solo = stack
+    service = ContinuousBatchingService.from_model(
+        model, params, slots=1, chunk=1, window_ms=5.0)
+    req = {"prompt_ids": [3, 5, 7], "max_new_tokens": 100,
+           "temperature": 0.0, "seed": 0}
+    ev = threading.Event()
+    out = {}
+
+    def call():
+        out["r"] = service.generate(**req, cancel=ev)
+
+    t = threading.Thread(target=call)
+    t.start()
+    deadline = time.time() + 60
+    while service.stats["chunks"] < 1 and time.time() < deadline:
+        time.sleep(0.001)
+    ev.set()
+    t.join(timeout=120)
+    r = out["r"]
+    assert r["stop_reason"] == "cancelled", r
+    assert 0 < len(r["ids"]) < 100
+    full = solo.generate(**req)
+    assert r["ids"] == full["ids"][:len(r["ids"])]
+    assert service.stats.get("cancelled") == 1
+    # the slot is free again: a follow-up request completes normally
+    r2 = service.generate(prompt_ids=[2, 4], max_new_tokens=5,
+                          temperature=0.0, seed=1)
+    assert len(r2["ids"]) == 5 and r2["stop_reason"] == "length"
+    # queued-cancel: occupy the slot, enqueue a pre-cancelled request
+    ev2, ev3, out2 = threading.Event(), threading.Event(), {}
+    adm0 = service.stats["admissions"]
+    t1 = threading.Thread(target=lambda: service.generate(
+        **req, cancel=ev2))
+    t1.start()
+    # wait until the occupying request is ADMITTED (admissions
+    # counter advances), so the third request genuinely queues
+    # behind a busy slot; deadline so a regression fails, not hangs
+    deadline = time.time() + 60
+    while (service.stats["admissions"] <= adm0
+           and time.time() < deadline):
+        time.sleep(0.001)
+    assert service.stats["admissions"] > adm0, service.stats
+
+    def call3():
+        out2["r"] = service.generate(
+            prompt_ids=[9, 11], max_new_tokens=50, temperature=0.0,
+            seed=2, cancel=ev3)
+
+    ev3.set()                    # cancelled BEFORE it can be admitted
+    t3 = threading.Thread(target=call3)
+    t3.start()
+    t3.join(timeout=120)
+    assert out2["r"]["stop_reason"] == "cancelled"
+    assert out2["r"]["ids"] == []
+    ev2.set()                    # release the occupying request
+    t1.join(timeout=120)
+
+
 def test_stop_tokens_and_eras(stack):
     """Stops free slots early; a drained engine starts a new era and
     later waves still match solo runs (stale cache is masked)."""
