@@ -1,0 +1,137 @@
+"""The MoE floor's LAST lever, probed: Pallas kernels for dispatch.
+
+BASELINE.md's dispatch-floor budget leaves kernel fusion as the only
+untried lever (gather rewrite: shipped; ragged_dot: measured 1.3-1.4x
+slower — scripts/debug_moe_ragged.py). Two kernel shapes were tried:
+
+1. **Fused gather-matmul** (DMA token rows straight from X in HBM
+   into VMEM via a scalar-prefetched index vector, feeding the MXU
+   without materializing expert_in): REJECTED BY MOSAIC on this
+   toolchain — per-row copies fail with "Slice shape along dimension
+   0 must be aligned to tiling (8)", and the routed rows are
+   scattered, so 8-row-aligned DMAs cannot express the gather. The
+   estimated <=2x on the dispatch-movement term stays unrealized on
+   this stack.
+
+2. **Fused expert FFN** (this file): keep the XLA gather, but run
+   ``out = gelu(expert_in @ wi[e]) @ wo[e]`` as ONE kernel — the
+   [E*C, F] hidden activation (63 MB at rung shapes, written + read
+   = 126 MB of fwd HBM traffic) never exists in HBM. Grid
+   (E, C // BC) with the capacity dim innermost so each expert's
+   [D, F] / [F, D] weight blocks stay VMEM-resident across its
+   capacity blocks.
+
+Run on the real chip; parity-checked against the XLA leg first.
+"""
+import functools
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.experimental import pallas as pl
+
+S = 8 * 1024
+E = 8
+K = 2
+D = 768
+F = 1536
+CF = 1.25
+C = max(int(-(-K * S * CF // E)), 1)   # 2560 (ceil, = models/moe.py)
+BC = 512                          # capacity rows per kernel block
+STEPS = 50
+
+
+def _ffn_kernel(xin_ref, wi_ref, wo_ref, out_ref):
+    h = jax.nn.gelu(jnp.dot(xin_ref[0], wi_ref[0],
+                            preferred_element_type=jnp.float32))
+    out_ref[0] = jnp.dot(h.astype(xin_ref.dtype), wo_ref[0],
+                         preferred_element_type=jnp.float32
+                         ).astype(out_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def pallas_expert_ffn(expert_in, wi, wo, interpret=False):
+    """[E, C, D] x [E, D, F] x [E, F, D] -> [E, C, D]; the [C, F]
+    hidden never leaves VMEM."""
+    return pl.pallas_call(
+        _ffn_kernel,
+        grid=(E, C // BC),
+        in_specs=[
+            pl.BlockSpec((1, BC, D), lambda e, ci: (e, ci, 0)),
+            pl.BlockSpec((1, D, F), lambda e, ci: (e, 0, 0)),
+            pl.BlockSpec((1, F, D), lambda e, ci: (e, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, BC, D), lambda e, ci: (e, ci, 0)),
+        out_shape=jax.ShapeDtypeStruct((E, C, D), jnp.bfloat16),
+        interpret=interpret,
+    )(expert_in, wi, wo)
+
+
+def xla_expert_ffn(expert_in, wi, wo):
+    h = jax.nn.gelu(jnp.einsum("ecd,edf->ecf", expert_in, wi,
+                               preferred_element_type=jnp.float32))
+    return jnp.einsum("ecf,efd->ecd", h.astype(expert_in.dtype), wo,
+                      preferred_element_type=jnp.float32
+                      ).astype(jnp.bfloat16)
+
+
+def timeit(fn, *args):
+    float(fn(*args))
+    float(fn(*args))
+    reps = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        float(fn(*args))
+        reps.append((time.perf_counter() - t0) / STEPS * 1e3)
+    return float(np.median(reps))
+
+
+def main():
+    on_tpu = jax.devices()[0].platform == "tpu"
+    print(f"device: {jax.devices()[0].device_kind}; "
+          f"E={E} C={C} D={D} F={F} BC={BC}")
+    ks = jax.random.split(jax.random.key(0), 3)
+    expert_in = jax.random.normal(ks[0], (E, C, D), jnp.bfloat16)
+    wi = jax.random.normal(ks[1], (E, D, F), jnp.bfloat16) * 0.02
+    wo = jax.random.normal(ks[2], (E, F, D), jnp.bfloat16) * 0.02
+
+    ref = xla_expert_ffn(expert_in, wi, wo)
+    got = pallas_expert_ffn(expert_in, wi, wo, interpret=not on_tpu)
+    err = float(jnp.max(jnp.abs(
+        got.astype(jnp.float32) - ref.astype(jnp.float32))))
+    scale = float(jnp.max(jnp.abs(ref.astype(jnp.float32))))
+    print(f"  parity max|pallas - xla| = {err:.2e} "
+          f"(output scale {scale:.2e}, bf16; measured 0.0 on v5e — "
+          f"device-specific exactness, the gate allows bf16-level "
+          f"drift)")
+    assert err <= 0.01 * scale + 1e-4, (err, scale)
+
+    if not on_tpu:
+        print("  (CPU interpret mode: parity only, no timing)")
+        return
+
+    def chain(fn):
+        @jax.jit
+        def many(expert_in, wi, wo):
+            def body(c, _):
+                out = fn(c, wi, wo)
+                # feed the output back so steps can't be hoisted or
+                # overlapped away; one cheap elementwise op
+                return (c + out * jnp.bfloat16(1e-3)), None
+            c, _ = lax.scan(body, expert_in, None, length=STEPS)
+            return c.sum().astype(jnp.float32)
+        return many
+
+    ms_x = timeit(chain(xla_expert_ffn), expert_in, wi, wo)
+    print(f"  XLA einsum-gelu-einsum  {ms_x:7.3f} ms/leg")
+    ms_p = timeit(chain(
+        lambda x, a, b: pallas_expert_ffn(x, a, b, interpret=False)
+    ), expert_in, wi, wo)
+    print(f"  Pallas fused FFN        {ms_p:7.3f} ms/leg")
+    print(f"  pallas/xla = {ms_p / ms_x:.3f}")
+
+
+if __name__ == "__main__":
+    main()

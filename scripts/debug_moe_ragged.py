@@ -34,7 +34,7 @@ K = 2
 D = 768
 F = 1536
 CF = 1.25
-C = int(K * S * CF // E)          # 2560
+C = max(int(-(-K * S * CF // E)), 1)   # 2560 (ceil, = models/moe.py)
 STEPS = 50
 
 
