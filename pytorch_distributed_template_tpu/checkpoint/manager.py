@@ -29,6 +29,7 @@ from typing import Any, Optional, Tuple
 import jax
 import orbax.checkpoint as ocp
 
+from ..observability.trace import span
 from ..parallel import dist
 
 logger = logging.getLogger(__name__)
@@ -78,7 +79,8 @@ class CheckpointManager:
             "monitor_best": _json_safe_best(monitor_best),
             "config": config,
         }
-        self._ckptr.save(path, _saveable(state), force=True)
+        with span("checkpoint/save", epoch=epoch):
+            self._ckptr.save(path, _saveable(state), force=True)
         self._tree_cache.pop(str(path), None)  # overwrite invalidates metadata
         self._inflight.add(path)
         if dist.is_main_process():
@@ -89,10 +91,11 @@ class CheckpointManager:
         if save_best:
             # Wait for the epoch save to snapshot before re-saving the same
             # arrays to model_best.
-            self._ckptr.wait_until_finished()
-            self._inflight.clear()
-            best = self.checkpoint_dir / "model_best"
-            self._ckptr.save(best, _saveable(state), force=True)
+            with span("checkpoint/save_best", epoch=epoch):
+                self._ckptr.wait_until_finished()
+                self._inflight.clear()
+                best = self.checkpoint_dir / "model_best"
+                self._ckptr.save(best, _saveable(state), force=True)
             self._tree_cache.pop(str(best), None)
             if dist.is_main_process():
                 (self.checkpoint_dir / "model_best.meta.json").write_text(
@@ -130,7 +133,8 @@ class CheckpointManager:
             "monitor_best": _json_safe_best(monitor_best),
             "config": config,
         }
-        ck.save(path, _saveable(state), force=True)
+        with span("checkpoint/save_interval", epoch=epoch, step=step):
+            ck.save(path, _saveable(state), force=True)
         self._tree_cache.pop(str(path), None)
         if dist.is_main_process():
             (self.checkpoint_dir / f"{path.name}.meta.json").write_text(
@@ -140,11 +144,12 @@ class CheckpointManager:
         return path
 
     def wait(self) -> None:
-        self._ckptr.wait_until_finished()
-        if self._interval_ckptrs is not None:
-            for ck in self._interval_ckptrs:
-                ck.wait_until_finished()
-        self._inflight.clear()
+        with span("checkpoint/wait"):
+            self._ckptr.wait_until_finished()
+            if self._interval_ckptrs is not None:
+                for ck in self._interval_ckptrs:
+                    ck.wait_until_finished()
+            self._inflight.clear()
 
     def prune(self, keep_last: int) -> None:
         """Delete all but the newest ``keep_last`` periodic checkpoints.
@@ -345,7 +350,8 @@ class CheckpointManager:
                 "Warning: checkpoint has no lr_scale; starting from 1.0 "
                 "(any prior ReduceLROnPlateau reduction is not resumed)."
             )
-        restored = self._ckptr.restore(resume_path, template)
+        with span("checkpoint/restore", path=str(resume_path)):
+            restored = self._ckptr.restore(resume_path, template)
         if seed_ema:
             restored["ema_params"] = jax.tree.map(
                 lambda x: x.copy(), restored["params"]

@@ -27,6 +27,7 @@ import jax
 import numpy as np
 
 from . import native
+from ..observability.trace import span
 from .sampler import ShardedSampler, epoch_permutation
 
 
@@ -206,7 +207,15 @@ def host_prefetch(iterable: Iterable, depth: int = 2) -> Iterator:
 
     def worker():
         try:
-            for item in iterable:
+            it = iter(iterable)
+            while True:
+                # spanned per batch: the host-gather cost is THE number
+                # that says whether prefetch depth is hiding it
+                with span("data/host_gather"):
+                    try:
+                        item = next(it)
+                    except StopIteration:
+                        break
                 while not stop.is_set():
                     try:
                         q.put(item, timeout=0.1)
@@ -263,15 +272,19 @@ def prefetch_to_device(iterator: Iterable[dict], sharding,
     multihost = jax.process_count() > 1
 
     def _put(batch: dict) -> dict:
-        if multihost:
-            # Each host holds its sampler shard; assemble the global array.
-            out = {
-                k: jax.make_array_from_process_local_data(sharding, v)
-                for k, v in batch.items()
-            }
-        else:
-            out = {k: jax.device_put(v, sharding) for k, v in batch.items()}
-        return transform(out) if transform is not None else out
+        with span("data/device_put"):
+            if multihost:
+                # Each host holds its sampler shard; assemble the global
+                # array.
+                out = {
+                    k: jax.make_array_from_process_local_data(sharding, v)
+                    for k, v in batch.items()
+                }
+            else:
+                out = {
+                    k: jax.device_put(v, sharding) for k, v in batch.items()
+                }
+            return transform(out) if transform is not None else out
 
     it = iter(iterator)
     try:

@@ -46,6 +46,7 @@ import time
 
 import numpy as np
 
+from ..observability.trace import span
 from .serving import GenerationService
 
 logger = logging.getLogger(__name__)
@@ -257,7 +258,8 @@ class ContinuousBatchingService(GenerationService):
         self._queue: "queue_mod.Queue" = queue_mod.Queue()
         self._latencies: list = []
         self.stats = {"requests": 0, "completed": 0, "chunks": 0,
-                      "admissions": 0, "eras": 0, "max_active": 0}
+                      "admissions": 0, "eras": 0, "max_active": 0,
+                      "tokens_generated": 0, "cancelled": 0}
         self._warm_chunk_ladder()
         self._worker_thread = threading.Thread(
             target=self._worker, daemon=True, name="gen-continuous")
@@ -479,9 +481,10 @@ class ContinuousBatchingService(GenerationService):
         tok, emitted, done, budgets, pad_lens, keys, stops, temps, \
             ks, ps = self._arrays
         chunk = _chunk_fn(self.model, steps, self.MAX_STOPS)
-        cache, toks, tok, emitted, done = chunk(
-            self.params, self._cache, tok, emitted, done, budgets,
-            pad_lens, keys, stops, temps, ks, ps)
+        with span("serve/chunk_dispatch", steps=steps):
+            cache, toks, tok, emitted, done = chunk(
+                self.params, self._cache, tok, emitted, done, budgets,
+                pad_lens, keys, stops, temps, ks, ps)
         self._cache = cache
         self._arrays = (tok, emitted, done, budgets, pad_lens, keys,
                         stops, temps, ks, ps)
@@ -492,9 +495,10 @@ class ContinuousBatchingService(GenerationService):
     def _absorb(self, toks, emitted, done):
         """Force a dispatched chunk's outputs and hand tokens to their
         requests; finished rows complete and free their slots."""
-        toks = np.asarray(toks)
-        emitted = np.asarray(emitted)
-        done = np.asarray(done)
+        with span("serve/absorb"):
+            toks = np.asarray(toks)
+            emitted = np.asarray(emitted)
+            done = np.asarray(done)
         tok0_np: dict = {}          # one D2H read per admission group
         for s in range(self._slots):
             m = self._meta[s]
@@ -562,6 +566,15 @@ class ContinuousBatchingService(GenerationService):
         self._latencies.append(lat)
         if len(self._latencies) > 1024:
             del self._latencies[:512]
+
+    def queue_depth(self) -> int:
+        """Requests waiting for a slot (not yet admitted)."""
+        return self._queue.qsize()
+
+    def live_slots(self) -> int:
+        """Slots currently decoding a request."""
+        meta = getattr(self, "_meta", None) or []
+        return sum(m is not None for m in meta)
 
     def latency_percentiles(self) -> dict:
         lats = sorted(self._latencies[-1024:])
@@ -681,8 +694,9 @@ class ContinuousBatchingService(GenerationService):
                 b = self._bucket(len(r["ids"]))
                 groups.setdefault(b, []).append((r, free.pop(0)))
         for pairs in groups.values():
-            self._admit_group([r for r, _ in pairs],
-                              [s for _, s in pairs])
+            with span("serve/admit", n=len(pairs)):
+                self._admit_group([r for r, _ in pairs],
+                                  [s for _, s in pairs])
         self.stats["max_active"] = max(
             self.stats["max_active"],
             sum(m is not None for m in self._meta))

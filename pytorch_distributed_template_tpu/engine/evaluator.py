@@ -16,6 +16,7 @@ import numpy as np
 from ..config.registry import LOADERS, METRICS, MODELS
 from ..data.loader import prefetch_to_device
 from ..models.base import inject_mesh
+from ..observability.trace import span
 from ..parallel import batch_sharding, dist, mesh_from_config
 from .losses import resolve_loss
 from .optim import build_optimizer
@@ -232,10 +233,12 @@ def evaluate(config, mesh=None, save_outputs=None, seed=None) -> dict:
             (jax.random.fold_in(base_key, i),)
             if base_key is not None else ()
         )
-        m = eval_step(state, batch, *rng_args)
+        with span("eval/step", batch=i):
+            m = eval_step(state, batch, *rng_args)
         accum = m if accum is None else jax.tree.map(jnp.add, accum, m)
         if output_step is not None:
-            res = output_step(state, batch, *rng_args)
+            with span("eval/save_outputs", batch=i):
+                res = output_step(state, batch, *rng_args)
             keep = _host_local_rows(batch["mask"]).astype(bool)
             if isinstance(res, tuple):          # MLM: (logits, eval mask)
                 res, msk = res

@@ -71,6 +71,10 @@ class GenerationService:
             and int(getattr(self.model, "window", 0) or 0) == 0
         )
         self._lock = threading.Lock()
+        # scheduler subclasses overwrite this with richer dicts in
+        # their own _setup (after this super() call); the plain
+        # serialized service still exposes a token counter for /metrics
+        self.stats = {"tokens_generated": 0}
 
     def encode_prompt(self, prompt=None, prompt_ids=None) -> list:
         """Text or explicit ids -> validated id list (raises ValueError
@@ -384,6 +388,13 @@ class GenerationService:
         text = self.decode_text(ids)
         if text is not None:
             resp["text"] = text
+        # every scheduler's responses funnel through here — the ONE
+        # place a tokens-served counter stays scheduler-agnostic
+        # (surfaced by serve.py's /metrics)
+        stats = getattr(self, "stats", None)
+        if stats is not None:
+            stats["tokens_generated"] = (
+                stats.get("tokens_generated", 0) + len(ids))
         return resp
 
 

@@ -19,6 +19,7 @@ TPU-idiomatic version of the reference's gather-everything-to-rank-0 eval
 """
 from __future__ import annotations
 
+import functools
 import inspect
 from typing import Callable, Dict, Sequence
 
@@ -394,3 +395,41 @@ def finalize_metrics(sums: Dict[str, float]) -> Dict[str, float]:
         else:
             out[k] = float(v)
     return out
+
+
+def instrument_step(jitted_fn, name: str):
+    """Wrap a jitted step callable in telemetry spans that split the
+    one-time compile from steady-state dispatch.
+
+    The first invocation of a jitted function traces + XLA-compiles
+    before executing — on big models that is minutes, and on the host
+    timeline it is indistinguishable from a hang unless labeled. The
+    wrapper records the first call as ``<name>/compile+execute`` and
+    every later one as ``<name>/dispatch`` (dispatch spans measure jit
+    dispatch + donation backpressure, not device runtime — device time
+    belongs to ``jax.profiler``). A shape change mid-run recompiles
+    inside a ``dispatch`` span; the recompilation still surfaces, as a
+    ``compile_events`` entry on the next flight-recorder record
+    (observability/telemetry).
+
+    AOT attributes (``lower``/``eval_shape``) pass through so cost
+    analysis (``profiler.compiled_flops``) keeps working on the wrapped
+    callable.
+    """
+    from ..observability.trace import span
+
+    state = {"first": True}
+
+    @functools.wraps(jitted_fn)
+    def wrapped(*args, **kwargs):
+        if state["first"]:
+            state["first"] = False
+            with span(f"{name}/compile+execute"):
+                return jitted_fn(*args, **kwargs)
+        with span(f"{name}/dispatch"):
+            return jitted_fn(*args, **kwargs)
+
+    for attr in ("lower", "eval_shape", "trace"):
+        if hasattr(jitted_fn, attr):
+            setattr(wrapped, attr, getattr(jitted_fn, attr))
+    return wrapped

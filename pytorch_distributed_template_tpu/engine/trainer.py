@@ -21,6 +21,7 @@ Key structural translation (SURVEY.md §3.1 hot loop -> jit):
 from __future__ import annotations
 
 import math
+import time
 from abc import abstractmethod
 from typing import Optional
 
@@ -31,7 +32,9 @@ import numpy as np
 from ..checkpoint import CheckpointManager
 from ..data.loader import host_prefetch, prefetch_to_device
 from ..models.base import describe, inject_mesh
-from ..observability import MetricTracker, TensorboardWriter
+from ..observability import FlightRecorder, MetricTracker, TensorboardWriter
+from ..observability.trace import get_recorder as get_span_recorder
+from ..observability.trace import span
 from ..ops.augment import build_augment
 from ..observability.profiler import (
     ThroughputMeter, TraceCapture, compiled_flops, mfu,
@@ -43,7 +46,9 @@ from ..utils.util import maybe_tqdm
 from ..utils.watchdog import StepWatchdog
 from .optim import build_optimizer
 from .state import create_sharded_train_state
-from .steps import finalize_metrics, make_eval_step, make_train_step
+from .steps import (
+    finalize_metrics, instrument_step, make_eval_step, make_train_step,
+)
 
 
 def _endless_reshuffling(loader):
@@ -187,6 +192,20 @@ class BaseTrainer:
             trace = getattr(self, "trace", None)
             if trace is not None:
                 trace.close()  # flush a still-open profiler window
+            recorder = getattr(self, "recorder", None)
+            if recorder is not None:
+                recorder.close()
+            if dist.is_main_process():
+                # host-span timeline as a Chrome trace-event file
+                # (chrome://tracing / Perfetto); complements the XLA
+                # profiler's device capture in log_dir/profile
+                try:
+                    get_span_recorder().dump(
+                        self.config.log_dir / "trace.json"
+                    )
+                except Exception:  # teardown diagnostics must not
+                    self.logger.warning("could not write trace.json",
+                                        exc_info=True)  # crash the run
             self._write_summary(log)
         return log
 
@@ -389,11 +408,14 @@ class Trainer(BaseTrainer):
         train_keys = self._metric_keys() + (
             ["skipped_sum"] if self.skip_nonfinite else []
         ) + (["grad_norm_sum"] if self.log_grad_norm else [])
-        self._train_step = jax.jit(
-            train_step,
-            donate_argnums=0,
-            out_shardings=(self.state_sharding,
-                           {k: metric_sharding for k in train_keys}),
+        self._train_step = instrument_step(
+            jax.jit(
+                train_step,
+                donate_argnums=0,
+                out_shardings=(self.state_sharding,
+                               {k: metric_sharding for k in train_keys}),
+            ),
+            "train_step",
         )
         eval_step = make_eval_step(
             model, criterion, self.metric_ftns,
@@ -401,9 +423,14 @@ class Trainer(BaseTrainer):
             use_ema=ema_decay > 0
             and bool(config["trainer"].get("eval_with_ema", True)),
         )
-        self._eval_step = jax.jit(
-            eval_step,
-            out_shardings={k: metric_sharding for k in self._metric_keys()},
+        self._eval_step = instrument_step(
+            jax.jit(
+                eval_step,
+                out_shardings={
+                    k: metric_sharding for k in self._metric_keys()
+                },
+            ),
+            "eval_step",
         )
 
         self.train_metrics = MetricTracker("loss", writer=self.writer)
@@ -426,9 +453,37 @@ class Trainer(BaseTrainer):
         self._flops_per_step = None  # measured lazily on the first batch
         self._flops_measured = False  # latch: the AOT compile runs at most once
 
-        # hung-step detection (utils/watchdog.py); 0 disables
+        # --- flight recorder (observability/telemetry): one structured
+        # JSONL record per step in <run_dir>/telemetry.jsonl on process 0,
+        # ring-buffered in memory everywhere (the watchdog's stall dump
+        # reads the ring) -----------------------------------------------
+        tel_cfg = config["trainer"].get("telemetry", {}) or {}
+        self.recorder = FlightRecorder(
+            run_dir=(self.checkpoint_dir
+                     if dist.is_main_process()
+                     and bool(tel_cfg.get("enabled", True)) else None),
+            capacity=int(tel_cfg.get("capacity", 512)),
+            memory_every=int(tel_cfg.get("memory_every", 16)),
+        )
+        # tokens/step for LM data (integer [B, T, ...] inputs): feeds the
+        # per-record tokens field and the tokens/s aggregate
+        arr = train_loader.arrays.get(self.input_key)
+        dtype = getattr(arr, "dtype", None)
+        shape = getattr(arr, "shape", ())
+        self._tokens_per_example = (
+            int(np.prod(shape[1:]))
+            if dtype is not None and np.issubdtype(dtype, np.integer)
+            and len(shape) >= 2 else None
+        )
+
+        # hung-step detection (utils/watchdog.py); 0 disables. Wired to
+        # the telemetry tier: a stall dumps active spans + the trailing
+        # step records next to the faulthandler stacks.
         self.watchdog = StepWatchdog(
-            timeout_s=float(config["trainer"].get("watchdog_secs", 0))
+            timeout_s=float(config["trainer"].get("watchdog_secs", 0)),
+            recorder=self.recorder,
+            spans=get_span_recorder(),
+            dump_path=config.log_dir / "stall_dump.json",
         )
 
     def _metric_keys(self):
@@ -481,14 +536,42 @@ class Trainer(BaseTrainer):
         # idempotent; trainer.watchdog_secs must exceed the first-step
         # compile time or epoch 1 will false-alarm
         self.watchdog.start()
-        for batch_idx, batch in enumerate(prefetched):
+        batches_it = iter(prefetched)
+        batch_idx = -1
+        t_iter = time.perf_counter()
+        while True:
+            # data-wait = time blocked on the prefetch pipeline; near
+            # zero when prefetch hides the gather, the whole step time
+            # when the loader is the bottleneck — the telemetry field
+            # that answers "is this run input-bound?"
+            t_wait = time.perf_counter()
+            with span("data/next_batch"):
+                try:
+                    batch = next(batches_it)
+                except StopIteration:
+                    break
+            data_wait_ms = (time.perf_counter() - t_wait) * 1e3
+            batch_idx += 1
             step = (epoch - 1) * self.len_epoch + batch_idx
             self.trace.before_step(step)
-            self.state, m = self._train_step(self.state, batch)
+            with span("train/step", step=step):
+                self.state, m = self._train_step(self.state, batch)
             self.trace.after_step(step, sync=m)
             self.watchdog.beat()
             self.throughput.update(self.train_loader.batch_size)
             self.epoch_meter.update(self.train_loader.batch_size)
+            # per-step flight record; wall_ms is the full loop iteration
+            # (dispatch + donation backpressure + data wait), so summed
+            # wall time over a window is the honest steps/s denominator
+            rec = {
+                "wall_ms": round((time.perf_counter() - t_iter) * 1e3, 3),
+                "data_wait_ms": round(data_wait_ms, 3),
+                "examples": self.train_loader.batch_size,
+            }
+            t_iter = time.perf_counter()
+            if self._tokens_per_example:
+                rec["tokens"] = (self._tokens_per_example
+                                 * self.train_loader.batch_size)
 
             if (self.profile_enabled and batch_idx == 0
                     and not self._flops_measured):
@@ -506,27 +589,47 @@ class Trainer(BaseTrainer):
             accum = m if accum is None else jax.tree.map(jnp.add, accum, m)
 
             if main and batch_idx % self.log_step == 0:
-                self.writer.set_step(step)
-                loss_val = float(m["loss_sum"]) / max(float(m["count"]), 1.0)
-                self.train_metrics.update("loss", loss_val)
-                self.writer.add_scalar(
-                    "lr", float(self.lr_fn(step)) * self._lr_scale_host
-                )
-                if self.profile_enabled and step > 0:
-                    # float() above synced the device, so rates are honest.
-                    rate = self.throughput.rate()
-                    self.writer.add_scalar(
-                        "examples_per_sec", rate["examples_per_sec"]
+                with span("train/log", step=step):
+                    self.writer.set_step(step)
+                    loss_val = (float(m["loss_sum"])
+                                / max(float(m["count"]), 1.0))
+                    self.train_metrics.update("loss", loss_val)
+                    lr_val = float(self.lr_fn(step)) * self._lr_scale_host
+                    self.writer.add_scalar("lr", lr_val)
+                    rec["loss"] = round(loss_val, 6)
+                    rec["lr"] = lr_val
+                    if self.log_grad_norm:
+                        rec["grad_norm"] = round(
+                            float(m["grad_norm_sum"])
+                            / max(float(m["count"]), 1.0), 6,
+                        )
+                    if self.profile_enabled and step > 0:
+                        # float() above synced the device, so rates are
+                        # honest.
+                        rate = self.throughput.rate()
+                        self.writer.add_scalar(
+                            "examples_per_sec", rate["examples_per_sec"]
+                        )
+                        rec["steps_per_sec"] = round(
+                            rate["steps_per_sec"], 4)
+                        rec["examples_per_sec"] = round(
+                            rate["examples_per_sec"], 1)
+                        if self._tokens_per_example:
+                            rec["tokens_per_sec"] = round(
+                                rate["examples_per_sec"]
+                                * self._tokens_per_example, 1)
+                        util = mfu(self._flops_per_step,
+                                   rate["steps_per_sec"],
+                                   peak_per_device=self._peak_flops)
+                        if util is not None:
+                            self.writer.add_scalar("mfu", util)
+                            rec["mfu"] = round(util, 4)
+                    self.logger.debug(
+                        "Train Epoch: %d %s Loss: %.6f",
+                        epoch, self._progress(batch_idx + 1), loss_val,
                     )
-                    util = mfu(self._flops_per_step, rate["steps_per_sec"],
-                               peak_per_device=self._peak_flops)
-                    if util is not None:
-                        self.writer.add_scalar("mfu", util)
-                self.logger.debug(
-                    "Train Epoch: %d %s Loss: %.6f",
-                    epoch, self._progress(batch_idx + 1), loss_val,
-                )
-                self._log_input_images(batch)
+                    self._log_input_images(batch)
+            self.recorder.record(step, **rec)
 
             if ((single_host or (batch_idx + 1) % check_every == 0)
                     and preemption.sync_requested()):
@@ -575,7 +678,8 @@ class Trainer(BaseTrainer):
         # exact global epoch averages. A preempted epoch skips validation —
         # the SIGTERM notice window is for checkpointing, not eval.
         if self.do_validation and not preempted:
-            val_log = self._valid_epoch(epoch)
+            with span("train/validate", epoch=epoch):
+                val_log = self._valid_epoch(epoch)
             log.update(**{f"val_{k}": v for k, v in val_log.items()})
         # a preempted epoch skipped validation, so the monitored key is
         # legitimately absent — not a plateau decision and not a misconfig

@@ -1,3 +1,5 @@
 from .logging import setup_logging
 from .tb import TensorboardWriter
+from .telemetry import FlightRecorder, read_jsonl
+from .trace import SpanRecorder, get_recorder, span
 from .tracker import MetricTracker

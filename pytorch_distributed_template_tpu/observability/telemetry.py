@@ -1,0 +1,300 @@
+"""Flight recorder: one structured record per training step.
+
+MegaScale-style per-step telemetry as a first-class subsystem: the
+trainer (and bench.py) feed one record per step into a bounded
+in-memory ring buffer, and process 0 appends each record as a JSON
+line to ``<run_dir>/telemetry.jsonl``. A wedged or crashed run leaves
+its last ``capacity`` steps on disk and in the watchdog's stall dump
+(utils/watchdog.py) instead of evaporating; a healthy run leaves a
+machine-parseable timeline that tooling (bench.py, sweeps, dashboards)
+reads back without scraping logs.
+
+Record schema (all optional except ``v``/``step``/``t``):
+
+    {"v": 1, "step": 0, "t": <unix seconds>,
+     "wall_ms": ..., "data_wait_ms": ...,
+     "loss": ..., "grad_norm": ..., "lr": ...,
+     "examples": ..., "tokens": ...,
+     "steps_per_sec": ..., "examples_per_sec": ..., "tokens_per_sec": ...,
+     "mfu": ...,
+     "compile_events": [{"event": ..., "dur_ms": ...}, ...],
+     "host_rss_mb": ..., "devices": {"0": {"bytes_in_use": ...,
+                                           "peak_bytes_in_use": ...}}}
+
+Memory fields attach every ``memory_every`` records (host RSS is a
+/proc read, device HBM a ``memory_stats()`` call per device — cheap,
+but not per-step cheap on big slices). Compile events come from a
+``jax.monitoring`` duration listener installed once per process: any
+jit/pjit compilation that happened since the previous record rides
+along on the next one, so recompilation storms are visible in the
+timeline instead of silently halving throughput.
+"""
+from __future__ import annotations
+
+import collections
+import json
+import os
+import threading
+import time
+from pathlib import Path
+from typing import Optional
+
+SCHEMA_VERSION = 1
+
+# ---------------------------------------------------------------------------
+# host / device memory probes
+# ---------------------------------------------------------------------------
+
+
+def host_rss_bytes() -> Optional[int]:
+    """Resident set size of this process, or None when unknowable."""
+    try:
+        with open("/proc/self/status") as f:
+            for line in f:
+                if line.startswith("VmRSS:"):
+                    return int(line.split()[1]) * 1024
+    except OSError:
+        pass
+    try:
+        import resource
+
+        # ru_maxrss is KB on linux, bytes on macOS; prefer /proc above,
+        # this is the portable fallback (peak, not current)
+        return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss * 1024
+    except Exception:
+        return None
+
+
+def device_memory_stats() -> dict:
+    """Per-device HBM stats from ``Device.memory_stats()``.
+
+    ``{device_index: {"bytes_in_use": ..., "peak_bytes_in_use": ...}}``;
+    empty on backends that don't report (CPU returns None)."""
+    out: dict = {}
+    try:
+        import jax
+
+        for d in jax.local_devices():
+            stats = None
+            try:
+                stats = d.memory_stats()
+            except Exception:
+                pass
+            if not stats:
+                continue
+            out[str(d.id)] = {
+                k: int(v) for k, v in stats.items()
+                if k in ("bytes_in_use", "peak_bytes_in_use",
+                         "bytes_limit", "num_allocs")
+            }
+    except Exception:
+        pass
+    return out
+
+
+# ---------------------------------------------------------------------------
+# compile-event capture (process-wide, installed once)
+# ---------------------------------------------------------------------------
+
+_compile_lock = threading.Lock()
+_compile_events: "collections.deque" = collections.deque(maxlen=256)
+_compile_listener_installed = False
+
+
+def _install_compile_listener() -> None:
+    """Register a ``jax.monitoring`` duration listener recording every
+    compilation event. Idempotent; silently absent on jax builds
+    without the monitoring API."""
+    global _compile_listener_installed
+    with _compile_lock:
+        if _compile_listener_installed:
+            return
+        _compile_listener_installed = True
+    try:
+        from jax import monitoring
+
+        def _listen(event: str, duration: float, **kw) -> None:
+            # real compilation work only (XLA backend compile + MLIR
+            # lowering); the /jax/core/compile/jaxpr_trace_duration
+            # events fire per traced sub-jaxpr and spam hundreds of
+            # sub-ms entries on the first step
+            if "compil" in event and "trace_duration" not in event:
+                with _compile_lock:
+                    _compile_events.append(
+                        {"event": event,
+                         "dur_ms": round(duration * 1e3, 3)}
+                    )
+
+        monitoring.register_event_duration_secs_listener(_listen)
+    except Exception:
+        pass
+
+
+def drain_compile_events() -> list:
+    """Compilation events since the last drain (process-wide)."""
+    with _compile_lock:
+        out = list(_compile_events)
+        _compile_events.clear()
+    return out
+
+
+# ---------------------------------------------------------------------------
+# the recorder
+# ---------------------------------------------------------------------------
+
+
+class FlightRecorder:
+    """Bounded per-step record ring + JSONL writer.
+
+    :param run_dir: directory for ``telemetry.jsonl``; None disables the
+        file (ring buffer only — e.g. non-main processes, tests).
+    :param capacity: ring size; the watchdog stall dump and
+        ``aggregates()`` see at most this many trailing records.
+    :param memory_every: attach host RSS + device HBM stats to every
+        N-th record (0 disables the memory fields entirely).
+    :param filename: JSONL file name inside ``run_dir``.
+
+    Thread-safe: the serving/bench paths record from worker threads.
+    """
+
+    def __init__(self, run_dir=None, capacity: int = 512,
+                 memory_every: int = 16,
+                 filename: str = "telemetry.jsonl"):
+        self.capacity = int(capacity)
+        self.memory_every = int(memory_every)
+        self.ring: "collections.deque" = collections.deque(
+            maxlen=self.capacity
+        )
+        # _lock guards ONLY the ring + counter (never held across I/O or
+        # device probes): the watchdog's stall dump reads the ring from
+        # its monitor thread, and a wedged file write or memory_stats()
+        # call — exactly the stalls it diagnoses — must not deadlock it.
+        # _io_lock serializes the JSONL file.
+        self._lock = threading.Lock()
+        self._io_lock = threading.Lock()
+        self._n = 0
+        self._file = None
+        self.path = None
+        if run_dir is not None:
+            self.path = Path(run_dir) / filename
+            self.path.parent.mkdir(parents=True, exist_ok=True)
+            self._file = open(self.path, "a", buffering=1)  # line-buffered
+        _install_compile_listener()
+
+    # -- write ---------------------------------------------------------------
+
+    def record(self, step: int, **fields) -> dict:
+        """Append one step record; returns the full record as written.
+
+        Non-finite floats are nulled (strict-JSON consumers choke on
+        NaN/Infinity); None-valued fields are dropped."""
+        rec = {"v": SCHEMA_VERSION, "step": int(step),
+               "t": round(time.time(), 3)}
+        for k, v in fields.items():
+            if v is None:
+                continue
+            if isinstance(v, float) and (v != v or v in (float("inf"),
+                                                         float("-inf"))):
+                v = None
+            rec[k] = v
+        compile_events = drain_compile_events()
+        if compile_events:
+            rec["compile_events"] = compile_events
+        with self._lock:
+            self._n += 1
+            attach_memory = (
+                self.memory_every
+                and (self._n - 1) % self.memory_every == 0
+            )
+        if attach_memory:  # probes run OUTSIDE the ring lock (see init)
+            rss = host_rss_bytes()
+            if rss:
+                rec["host_rss_mb"] = round(rss / 2**20, 1)
+            devices = device_memory_stats()
+            if devices:
+                rec["devices"] = devices
+        with self._lock:
+            self.ring.append(rec)
+        with self._io_lock:
+            if self._file is not None:
+                try:
+                    self._file.write(json.dumps(rec) + "\n")
+                except (OSError, ValueError):
+                    pass  # a full disk must never kill the step loop
+        return rec
+
+    # -- read ----------------------------------------------------------------
+
+    def last(self, n: Optional[int] = None) -> list:
+        """The trailing ``n`` records (all buffered when None)."""
+        with self._lock:
+            records = list(self.ring)
+        return records if n is None else records[-int(n):]
+
+    def aggregates(self) -> dict:
+        """Throughput over the buffered window, computed from the
+        records themselves (the numbers bench.py reports): steps/s from
+        summed ``wall_ms``, tokens/s and examples/s from the summed
+        ``tokens``/``examples`` fields over the same wall time."""
+        records = self.last()
+        timed = [r for r in records if r.get("wall_ms")]
+        if not timed:
+            return {"steps": len(records)}
+        wall_s = sum(r["wall_ms"] for r in timed) / 1e3
+        out = {
+            "steps": len(timed),
+            "wall_s": round(wall_s, 3),
+            "steps_per_sec": round(len(timed) / wall_s, 4),
+        }
+        tokens = sum(r.get("tokens", 0) for r in timed)
+        if tokens:
+            out["tokens_per_sec"] = round(tokens / wall_s, 1)
+        examples = sum(r.get("examples", 0) for r in timed)
+        if examples:
+            out["examples_per_sec"] = round(examples / wall_s, 1)
+        waits = [r["data_wait_ms"] for r in timed
+                 if r.get("data_wait_ms") is not None]
+        if waits:
+            out["data_wait_ms_mean"] = round(sum(waits) / len(waits), 3)
+        losses = [r["loss"] for r in timed if r.get("loss") is not None]
+        if losses:
+            out["last_loss"] = losses[-1]
+        return out
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def flush(self) -> None:
+        with self._io_lock:
+            if self._file is not None:
+                try:
+                    self._file.flush()
+                    os.fsync(self._file.fileno())
+                except (OSError, ValueError):
+                    pass
+
+    def close(self) -> None:
+        with self._io_lock:
+            if self._file is not None:
+                try:
+                    self._file.close()
+                except OSError:
+                    pass
+                self._file = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def read_jsonl(path) -> list:
+    """Load a telemetry JSONL file back into a list of records —
+    the round-trip consumers (tests, dashboards, bench) use."""
+    records = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                records.append(json.loads(line))
+    return records

@@ -48,8 +48,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
-from jax import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
+
+from ..utils.compat import shard_map
 
 NEG_INF = -1e30
 

@@ -1,0 +1,27 @@
+"""JAX version-compat shims shared across the package.
+
+One place for API moves so a jax upgrade/downgrade breaks ONE import
+site instead of scattering 24 collection errors across the test suite
+(the ``shard_map`` move did exactly that: ``jax.experimental.shard_map``
+until 0.4.x, ``jax.shard_map`` from 0.6 — with the replication-check
+kwarg renamed ``check_rep`` -> ``check_vma`` in the same move).
+
+Callers import from here and always use the NEW spelling
+(``check_vma=...``); on old jax the shim translates.
+"""
+from __future__ import annotations
+
+try:  # jax >= 0.6: public top-level API, check_vma kwarg
+    from jax import shard_map as shard_map  # type: ignore[attr-defined]
+except ImportError:  # jax <= 0.4.x/0.5.x: experimental home, check_rep
+    from jax.experimental.shard_map import shard_map as _legacy_shard_map
+
+    def shard_map(f, mesh=None, in_specs=None, out_specs=None,
+                  check_vma: bool = True, **kwargs):
+        """``jax.shard_map`` spelling on top of the experimental API."""
+        return _legacy_shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_rep=check_vma, **kwargs,
+        )
+
+__all__ = ["shard_map"]

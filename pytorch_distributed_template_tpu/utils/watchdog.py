@@ -10,17 +10,24 @@ traceback ever surfacing.
 loop. When no step completes within ``timeout_s`` it logs an error and
 dumps ALL thread stacks (``faulthandler``) to stderr — so a wedged run
 leaves a post-mortem trail showing exactly which call never returned —
-and keeps repeating while the stall lasts. Detection only, by design:
+and keeps repeating while the stall lasts. Wired to the telemetry tier
+(observability/telemetry + trace) it additionally dumps the ACTIVE
+spans ("stuck 214 s inside checkpoint/save") and the last-N step
+records, to stderr and — when ``dump_path`` is set — as a JSON stall
+artifact next to the run's logs, turning a hang into a diagnosable
+record instead of a silent timeout. Detection only, by design:
 killing or restarting is the orchestrator's job (crash -> relaunch ->
 resume is the recovery contract, SURVEY.md §5).
 """
 from __future__ import annotations
 
 import faulthandler
+import json
 import logging
 import sys
 import threading
 import time
+from pathlib import Path
 
 logger = logging.getLogger(__name__)
 
@@ -30,6 +37,12 @@ class StepWatchdog:
 
     :param timeout_s: stall threshold; <= 0 disables entirely (no thread).
     :param dump_stacks: also ``faulthandler.dump_traceback`` on alarm.
+    :param recorder: optional ``FlightRecorder`` — its trailing
+        ``dump_last_n`` step records go into the stall dump.
+    :param spans: optional ``SpanRecorder`` — its currently-open spans
+        go into the stall dump.
+    :param dump_path: optional file path; each alarm (over)writes a JSON
+        stall artifact ``{"stalled_s", "active_spans", "last_records"}``.
 
     Usage::
 
@@ -40,9 +53,15 @@ class StepWatchdog:
         wd.stop()
     """
 
-    def __init__(self, timeout_s: float, dump_stacks: bool = True):
+    def __init__(self, timeout_s: float, dump_stacks: bool = True,
+                 recorder=None, spans=None, dump_path=None,
+                 dump_last_n: int = 16):
         self.timeout_s = float(timeout_s)
         self.dump_stacks = dump_stacks
+        self.recorder = recorder
+        self.spans = spans
+        self.dump_path = Path(dump_path) if dump_path else None
+        self.dump_last_n = int(dump_last_n)
         self._last = time.monotonic()
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
@@ -84,9 +103,54 @@ class StepWatchdog:
                     "Dumping thread stacks to stderr.",
                     stalled, self.timeout_s,
                 )
+                # stacks FIRST: the telemetry dump touches the recorder
+                # and span registries, and the guaranteed faulthandler
+                # dump must never wait behind them
                 if self.dump_stacks:
                     try:
                         faulthandler.dump_traceback(file=sys.stderr)
                     except Exception:  # stderr closed in exotic harnesses
                         pass
+                self._dump_telemetry(stalled)
                 self._last = time.monotonic()  # re-arm, repeat while stalled
+
+    def stall_report(self, stalled_s: float) -> dict:
+        """The stall artifact: active spans (what the process is stuck
+        inside) + the trailing step records (what it was doing before)."""
+        report: dict = {"stalled_s": round(float(stalled_s), 1),
+                        "t": time.time()}
+        if self.spans is not None:
+            try:
+                report["active_spans"] = self.spans.active_spans()
+            except Exception:
+                pass
+        if self.recorder is not None:
+            try:
+                report["last_records"] = self.recorder.last(
+                    self.dump_last_n
+                )
+            except Exception:
+                pass
+        return report
+
+    def _dump_telemetry(self, stalled_s: float) -> None:
+        """Log + (optionally) write the stall artifact. Never raises —
+        diagnostics must not crash the run they diagnose."""
+        if self.recorder is None and self.spans is None:
+            return
+        try:
+            report = self.stall_report(stalled_s)
+            logger.error(
+                "Watchdog stall report: %d active span(s) %s; "
+                "last step record: %s",
+                len(report.get("active_spans", [])),
+                [s["name"] for s in report.get("active_spans", [])],
+                (report.get("last_records") or [None])[-1],
+            )
+            if self.dump_path is not None:
+                self.dump_path.parent.mkdir(parents=True, exist_ok=True)
+                self.dump_path.write_text(json.dumps(report, default=repr))
+                logger.error("Watchdog: stall dump written to %s",
+                             self.dump_path)
+        except Exception:
+            logger.warning("watchdog stall dump failed", exc_info=True)

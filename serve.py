@@ -10,6 +10,10 @@ in a stdlib ``ThreadingHTTPServer``. No web framework, no deps.
     python serve.py -r saved/<lm>/train/<run>/model_best --port 8000
 
     GET  /healthz             -> {"status": "ok", "arch": ..., ...}
+    GET  /metrics             -> Prometheus text exposition (request /
+                              token / cancellation counters, queue
+                              depth, live slots, latency percentiles);
+                              ?format=json for the same as JSON
     POST /generate            body: {"prompt": "text"} or
                               {"prompt_ids": [1, 2, 3]}, optional
                               max_new_tokens / temperature / top_k /
@@ -113,6 +117,71 @@ def _run_request(service: GenerationService, req: dict,
     return service.generate(**kwargs)
 
 
+def service_metrics(service: GenerationService) -> dict:
+    """Scheduler-agnostic metrics snapshot for ``GET /metrics``.
+
+    Counters come from the service's ``stats`` dict (every scheduler
+    maintains one; the continuous engine's is richest), queue depth and
+    live slots from the slot engine's accessors when present (0/absent
+    otherwise — the plain serialized service has no queue)."""
+    stats = dict(getattr(service, "stats", None) or {})
+    out = {
+        "scheduler": type(service).__name__,
+        # the static scheduler increments "requests" only after a batch
+        # finishes generating (engine/serving._run_batch), so falling
+        # back to it for "completed" stays truthful; the continuous
+        # engine tracks both explicitly
+        "requests_total": int(
+            stats.get("requests", stats.get("completed", 0))),
+        "requests_completed": int(
+            stats.get("completed", stats.get("requests", 0))),
+        "tokens_generated_total": int(stats.get("tokens_generated", 0)),
+        "cancelled_total": int(stats.get("cancelled", 0)),
+        "queue_depth": int(
+            service.queue_depth() if hasattr(service, "queue_depth")
+            else getattr(service, "_queue", None).qsize()
+            if getattr(service, "_queue", None) is not None else 0),
+        "live_slots": int(
+            service.live_slots() if hasattr(service, "live_slots") else 0),
+        # named without the _total suffix: it's a capacity gauge, not a
+        # monotonic counter (prometheus_text infers TYPE from the name)
+        "slots": int(getattr(service, "_slots", 0)
+                     or getattr(service, "_max_batch", 0) or 1),
+    }
+    for k in ("batches", "chunks", "admissions", "eras", "max_active",
+              "batched_requests", "max_batch_size"):
+        if k in stats:
+            out[k] = int(stats[k])
+    if hasattr(service, "latency_percentiles"):
+        out["latency"] = service.latency_percentiles()
+    return out
+
+
+def prometheus_text(metrics: dict, prefix: str = "pdt_serve") -> str:
+    """Flat numeric fields -> Prometheus exposition format (0.0.4).
+
+    Counters get a ``_total``-suffix-preserving counter TYPE; everything
+    else is a gauge. Nested dicts (latency percentiles) flatten with an
+    underscore."""
+    lines = []
+
+    def emit(name: str, value) -> None:
+        kind = "counter" if name.endswith("_total") else "gauge"
+        lines.append(f"# TYPE {prefix}_{name} {kind}")
+        lines.append(f"{prefix}_{name} {value}")
+
+    for k, v in metrics.items():
+        if isinstance(v, bool) or k == "scheduler":
+            continue
+        if isinstance(v, (int, float)):
+            emit(k, v)
+        elif isinstance(v, dict):
+            for kk, vv in v.items():
+                if isinstance(vv, (int, float)):
+                    emit(f"{k}_{kk}", vv)
+    return "\n".join(lines) + "\n"
+
+
 def make_handler(service: GenerationService):
     class Handler(BaseHTTPRequestHandler):
         def _send(self, code: int, payload: dict) -> None:
@@ -123,8 +192,24 @@ def make_handler(service: GenerationService):
             self.end_headers()
             self.wfile.write(body)
 
+        def _send_text(self, code: int, text: str,
+                       content_type: str = "text/plain; version=0.0.4"
+                       ) -> None:
+            body = text.encode("utf-8")
+            self.send_response(code)
+            self.send_header("Content-Type", content_type)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
         def do_GET(self):  # noqa: N802 (http.server API)
-            if self.path != "/healthz":
+            path, _, query = self.path.partition("?")
+            if path == "/metrics":
+                metrics = service_metrics(service)
+                if "format=json" in query:
+                    return self._send(200, metrics)
+                return self._send_text(200, prometheus_text(metrics))
+            if path != "/healthz":
                 return self._send(404, {"error": "unknown path"})
             payload = {
                 "status": "ok",

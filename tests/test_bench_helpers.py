@@ -68,4 +68,6 @@ def test_compact_summary_is_small_and_complete():
     line = json.dumps({"metric": "m", "value": 1.0, "unit": "u",
                        "vs_baseline": 1.0, "summary": s},
                       separators=(",", ":"))
-    assert len(line) < 1600, f"summary line too big: {len(line)}B"
+    # budget raised 1600 -> 1700 when the recorder-backed quick rung
+    # joined the table; still comfortably inside the ~2 KB tail capture
+    assert len(line) < 1700, f"summary line too big: {len(line)}B"
