@@ -1473,15 +1473,12 @@ def bench_reference_torch(batch: int = 16, steps: int = 3) -> float:
     return batch * steps / dt
 
 
-def bench_quick(steps: int = 30, batch: int = 8, seq: int = 128) -> dict:
-    """Tiny-LM train step measured THROUGH the flight recorder
-    (observability/telemetry.FlightRecorder): the rung that always
-    completes — seconds even on a CPU host — so the bench's final JSON
-    line carries real steps/s and tokens/s numbers no matter what the
-    heavy ladder does within the ``--budget-s`` budget (the r05 rc=124
-    fix). Doubles as an integration check that the recorder's
-    aggregates round-trip: the reported numbers ARE
-    ``recorder.aggregates()``, not a separate timing path."""
+def _tiny_lm_step(vocab: int = 512, seq: int = 128, batch: int = 8):
+    """Shared TinyLM train-step setup for the recorder-backed quick
+    rung and the ``warm_start`` children: ONE definition, so both rungs
+    measure the same program family (the warm_start cache-hit contract
+    depends on its two child processes building identical executables).
+    Returns ``(state, step_fn, batch_arrays)``."""
     import jax
     import optax
 
@@ -1492,19 +1489,14 @@ def bench_quick(steps: int = 30, batch: int = 8, seq: int = 128) -> dict:
         create_train_state,
     )
     from pytorch_distributed_template_tpu.engine.steps import make_train_step
-    from pytorch_distributed_template_tpu.observability.telemetry import (
-        FlightRecorder,
-    )
 
-    vocab = 512
     model = MODELS.get("TinyLM")(
         vocab_size=vocab, n_layer=2, n_head=4, d_model=128, max_len=seq,
     )
     tx = optax.adamw(3e-4)
-    criterion = resolve_loss("lm_cross_entropy")
     state = create_train_state(model, tx, model.batch_template(1), seed=0)
     step_fn = jax.jit(
-        make_train_step(model, tx, criterion, [],
+        make_train_step(model, tx, resolve_loss("lm_cross_entropy"), [],
                         input_key="tokens", target_key="tokens"),
         donate_argnums=0,
     )
@@ -1513,6 +1505,112 @@ def bench_quick(steps: int = 30, batch: int = 8, seq: int = 128) -> dict:
         "tokens": rng.integers(0, vocab, size=(batch, seq)).astype(np.int32),
         "mask": np.ones(batch, bool),
     }
+    return state, step_fn, batch_arrays
+
+
+def _warm_start_child(cache_dir: str) -> None:
+    """Child half of the ``warm_start`` rung: enable the persistent
+    compilation cache at ``cache_dir``, build + run one TinyLM train
+    step (state init, jit trace, XLA compile, one executed step), and
+    print ONE JSON line: wall seconds from cold interpreter to first
+    completed step plus the process's cache hit/miss counters. The
+    parent runs this twice against the same dir — the second process
+    must report misses == 0 (every executable served from disk)."""
+    from pytorch_distributed_template_tpu.observability.telemetry import (
+        compile_cache_stats,
+    )
+    from pytorch_distributed_template_tpu.utils.compile_cache import (
+        configure_compile_cache,
+    )
+
+    configure_compile_cache(cache_dir=cache_dir)
+    t0 = time.perf_counter()
+    state, step_fn, ba = _tiny_lm_step(seq=64, batch=4)
+    state, m = step_fn(state, ba)
+    float(m["loss_sum"])                   # fence: the step really ran
+    stats = compile_cache_stats()
+    print(json.dumps({
+        "compile_s": round(time.perf_counter() - t0, 3),
+        "hits": stats["hits"],
+        "misses": stats["misses"],
+        "requests": stats["requests"],
+    }), flush=True)
+
+
+def bench_warm_start(platform: str = "") -> dict:
+    """Persistent-compile-cache rung (ISSUE 2 tentpole leg 1): cold vs
+    warm start of an identical training process against one shared
+    cache dir. Two child processes run ``--warm-start-child`` (above)
+    back to back; the first pays every XLA compile and populates the
+    cache, the second must satisfy every compile request from disk —
+    ``warm_new_compiles`` (its cache-miss count) MUST be 0, and the
+    cold/warm wall-second pair is the measured startup win. Child
+    processes because the in-memory jit cache would otherwise hide the
+    persistent layer entirely.
+
+    ``platform``: force the children's ``JAX_PLATFORMS`` — the ladder's
+    fallback arm passes ``"cpu"`` for hosts whose accelerator runtime
+    holds an exclusive per-process lock (the parent already initialized
+    it, so same-device children cannot); the cache mechanics under test
+    are platform-independent even when the compile seconds shrink."""
+    import subprocess
+    import tempfile
+
+    def run_child(d: str) -> dict:
+        # Popen + registry (not subprocess.run): the --budget-s
+        # deadline thread exits via os._exit, which would orphan an
+        # in-flight child to burn CPU for up to its whole timeout —
+        # registered children are killed right before that exit
+        proc = subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__),
+             "--warm-start-child", "--compile-cache-dir", d],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            env=(dict(os.environ, JAX_PLATFORMS=platform)
+                 if platform else None),
+        )
+        _CHILD_PROCS.add(proc)
+        try:
+            out, err = proc.communicate(timeout=900)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.communicate()
+            raise RuntimeError("warm_start child timed out")
+        finally:
+            _CHILD_PROCS.discard(proc)
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"warm_start child rc={proc.returncode}: {err[-800:]}")
+        return json.loads(out.strip().splitlines()[-1])
+
+    with tempfile.TemporaryDirectory(prefix="bench-warmcache-") as d:
+        cold = run_child(d)
+        warm = run_child(d)
+    return {
+        "cold_compile_s": cold["compile_s"],
+        "warm_compile_s": warm["compile_s"],
+        "cold_new_compiles": cold["misses"],
+        "warm_new_compiles": warm["misses"],
+        "warm_cache_hits": warm["hits"],
+        "compile_speedup": round(
+            cold["compile_s"] / max(warm["compile_s"], 1e-9), 2),
+        **({"platform": platform} if platform else {}),
+    }
+
+
+def bench_quick(steps: int = 30, batch: int = 8, seq: int = 128) -> dict:
+    """Tiny-LM train step measured THROUGH the flight recorder
+    (observability/telemetry.FlightRecorder): the rung that always
+    completes — seconds even on a CPU host — so the bench's final JSON
+    line carries real steps/s and tokens/s numbers no matter what the
+    heavy ladder does within the ``--budget-s`` budget (the r05 rc=124
+    fix). Doubles as an integration check that the recorder's
+    aggregates round-trip: the reported numbers ARE
+    ``recorder.aggregates()``, not a separate timing path."""
+    from pytorch_distributed_template_tpu.observability.telemetry import (
+        FlightRecorder,
+    )
+
+    state, step_fn, batch_arrays = _tiny_lm_step(seq=seq, batch=batch)
     state, m = step_fn(state, batch_arrays)   # compile + warm
     float(m["loss_sum"])                      # fence
     recorder = FlightRecorder(run_dir=None, capacity=steps + 8,
@@ -1550,6 +1648,9 @@ def bench_quick(steps: int = 30, batch: int = 8, seq: int = 128) -> dict:
 # artifacts/bench_full_latest.json for humans.
 _SUMMARY_KEYS = {
     "quick": ("steps_per_sec", "tokens_per_sec"),
+    # compile_speedup stays full-ladder-only: derivable from the pair
+    "warm_start": ("cold_compile_s", "warm_compile_s",
+                   "warm_new_compiles"),
     "resnet50": ("images_per_sec", "mfu"),
     "gpt2_small": ("tokens_per_sec", "mfu"),
     "vit_b16": ("images_per_sec", "mfu"),
@@ -1626,6 +1727,9 @@ def _try_ladder(name: str, attempts) -> dict:
 _RESULTS: dict = {"rungs": {}, "ref": float("nan")}
 _print_lock = threading.Lock()
 _printed = threading.Event()
+# live rung child processes (warm_start): killed by the budget deadline
+# thread before its os._exit so no orphan outlives the bench
+_CHILD_PROCS: set = set()
 BUDGET_MARGIN_S = 10.0      # emit this long before the hard budget
 BUDGET_RUNG_MIN_S = 45.0    # don't start a heavy rung with less left
 
@@ -1699,6 +1803,11 @@ def _arm_budget(deadline: float) -> None:
         if not _printed.is_set():
             print("bench budget exhausted: emitting partial results",
                   file=sys.stderr)
+            for p in list(_CHILD_PROCS):   # no orphans past the budget
+                try:
+                    p.kill()
+                except Exception:  # noqa: BLE001
+                    pass
             _emit_final_line()
             sys.stdout.flush()
             sys.stderr.flush()
@@ -1711,6 +1820,15 @@ def _arm_budget(deadline: float) -> None:
 # through its attempts; under --budget-s later rungs skip when the
 # remaining budget cannot plausibly fit one)
 _LADDER = [
+    # persistent-compile-cache cold/warm pair: FIRST among the heavy
+    # rungs (two short child processes) so even small --budget-s runs
+    # carry the warm-start numbers in the final line; the cpu arm is
+    # the fallback for accelerator runtimes whose exclusive device
+    # lock (held by this parent) locks same-device children out
+    ("warm_start", [
+        (bench_warm_start, {}),
+        (bench_warm_start, {"platform": "cpu"}),
+    ]),
     ("resnet50", [
         (bench_resnet50, {"batch": b}) for b in (128, 64, 32)
     ]),
@@ -1854,4 +1972,22 @@ if __name__ == "__main__":
              "is guaranteed on stdout (with partial results) and the "
              "process exits 0 within this budget; 0 = unlimited "
              "(legacy full-ladder behavior)")
-    main(budget_s=parser.parse_args().budget_s)
+    parser.add_argument(
+        "--compile-cache-dir", type=str, default=None,
+        help="persistent XLA compilation cache dir (same knob as the "
+             "entrypoints' compile_cache config section): repeated "
+             "bench runs skip recompiling unchanged rungs")
+    parser.add_argument(
+        "--warm-start-child", action="store_true",
+        help=argparse.SUPPRESS)   # internal: the warm_start rung's child
+    cli = parser.parse_args()
+    if cli.warm_start_child:
+        _warm_start_child(cli.compile_cache_dir)
+        sys.exit(0)
+    if cli.compile_cache_dir:
+        from pytorch_distributed_template_tpu.utils.compile_cache import (
+            configure_compile_cache,
+        )
+
+        configure_compile_cache(cache_dir=cli.compile_cache_dir)
+    main(budget_s=cli.budget_s)

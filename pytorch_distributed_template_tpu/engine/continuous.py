@@ -231,7 +231,8 @@ class ContinuousBatchingService(GenerationService):
     # per-chunk token deltas (serve.py "stream": true)
 
     def _setup(self, model, params, tokenizer=None, slots: int = 8,
-               chunk: int = 8, window_ms: float = 5.0):
+               chunk: int = 8, window_ms: float = 5.0,
+               warm_buckets=None):
         super()._setup(model, params, tokenizer)
         if not self._pad_ok:
             raise ValueError(
@@ -257,6 +258,26 @@ class ContinuousBatchingService(GenerationService):
         self._window_s = float(window_ms) / 1e3
         self._queue: "queue_mod.Queue" = queue_mod.Queue()
         self._latencies: list = []
+        # prompt-length buckets whose (bucket, k) admit executables are
+        # primed at startup alongside the chunk ladder: normalized
+        # through the scheduler's own bucketing, deduped, and dropped
+        # (LOUDLY — an operator asked for them) when even a 1-token
+        # budget cannot fit the era
+        self._warm_buckets = sorted({
+            self._bucket(int(b)) for b in (warm_buckets or ())
+            if int(b) > 0
+            and self._bucket(int(b)) + 1 <= int(model.max_len)
+        })
+        dropped = [int(b) for b in (warm_buckets or ())
+                   if int(b) <= 0
+                   or self._bucket(int(b)) + 1 > int(model.max_len)]
+        if dropped:
+            logger.warning(
+                "warm_buckets %s dropped (not in (0, max_len=%d) after "
+                "bucketing): their admit executables will compile at "
+                "the first matching arrival instead",
+                dropped, int(model.max_len),
+            )
         self.stats = {"requests": 0, "completed": 0, "chunks": 0,
                       "admissions": 0, "eras": 0, "max_active": 0,
                       "tokens_generated": 0, "cancelled": 0}
@@ -285,7 +306,14 @@ class ContinuousBatchingService(GenerationService):
         executable that is not guaranteed to seed the dispatch-path
         jit cache the worker actually hits, and a warmup that only
         probably warms is worse than ~120 frozen-row decode steps
-        (~1 s; all slots are done, rows freeze, nothing is emitted)."""
+        (~1 s; all slots are done, rows freeze, nothing is emitted).
+
+        ``warm_buckets`` (constructor arg) extends the same contract to
+        the ADMIT executables: each configured prompt-length bucket's
+        ``(bucket, k)`` admission compiles here on throwaway slot state
+        — with them covering the deployment's traffic shape, the first
+        arrival wave never stalls behind an XLA compile. Off by default
+        (each bucket costs one batched-prefill compile at startup)."""
         from .generate import fresh_cache
 
         total = int(self.model.max_len)
@@ -298,7 +326,37 @@ class ContinuousBatchingService(GenerationService):
             out = fn(self.params, cache, *arrays)
             cache = out[0]           # the cache argument is donated
             steps *= 2
+        if self._warm_buckets:
+            self._warm_admit_ladder(cache, arrays)
         self._arrays = None          # the worker builds its own state
+
+    def _warm_admit_ladder(self, cache, arrays):
+        """Execute the admit executable for every configured bucket on
+        the throwaway warmup state (cache/arrays donate through the
+        chain and are discarded by the caller). Dummy rows: budget 1,
+        fully-padded prompts at era position ``p = bucket`` — the
+        values are irrelevant, the (bucket, k) specialization is the
+        product."""
+        import jax
+        import jax.numpy as jnp
+
+        k, W = self._slots, self.MAX_STOPS
+        kd = np.asarray(jax.random.key_data(jax.random.key(0)))
+        keys_data = jnp.asarray(np.tile(kd, (k, 1)))
+        for bucket in self._warm_buckets:
+            pos0 = 0                       # admission at p == bucket
+            ints = np.zeros((k, 4 + W), np.int32)
+            ints[:, 0] = np.arange(k)      # one row per slot
+            ints[:, 1] = 1                 # budget 1
+            ints[:, 2] = pos0 + bucket - 1  # pad_len: 1-token prompts
+            ints[:, 3:3 + W] = -1
+            ints[:, 3 + W] = pos0
+            cache, arrays, _ = _admit_fn(self.model, bucket, k, W)(
+                self.params, cache, arrays,
+                jnp.zeros((k, bucket), jnp.int32), jnp.asarray(ints),
+                jnp.zeros((k, 2), jnp.float32), keys_data,
+                jnp.zeros((k,), jnp.int32))
+        jax.block_until_ready(arrays[0])
 
     # ---- request entry ---------------------------------------------------
 

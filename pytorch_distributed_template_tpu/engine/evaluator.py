@@ -219,8 +219,11 @@ def evaluate(config, mesh=None, save_outputs=None, seed=None) -> dict:
 
     from ..utils.util import maybe_tqdm
 
-    batches = prefetch_to_device(test_loader, batch_sharding(mesh),
-                                 transform=device_transform)
+    batches = prefetch_to_device(
+        test_loader, batch_sharding(mesh),
+        size=max(int(config["trainer"].get("prefetch_depth", 2)), 1),
+        transform=device_transform,
+    )
     if dist.is_main_process():
         # reference test.py:71 wraps the eval loop in tqdm (TTY-gated)
         batches = maybe_tqdm(batches, total=len(test_loader), desc="eval",

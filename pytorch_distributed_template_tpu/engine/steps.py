@@ -397,7 +397,7 @@ def finalize_metrics(sums: Dict[str, float]) -> Dict[str, float]:
     return out
 
 
-def instrument_step(jitted_fn, name: str):
+def instrument_step(jitted_fn, name: str, warmup=None):
     """Wrap a jitted step callable in telemetry spans that split the
     one-time compile from steady-state dispatch.
 
@@ -412,22 +412,51 @@ def instrument_step(jitted_fn, name: str):
     ``compile_events`` entry on the next flight-recorder record
     (observability/telemetry).
 
+    ``warmup``: an optional ``engine.warmup.StepWarmup``. At the first
+    call the wrapper collects the background-compiled executable for
+    ``name`` and dispatches THROUGH it from then on — so a warmed
+    step's first invocation records ``<name>/dispatch`` (with
+    ``warm=True``), never ``<name>/compile+execute``. A warmup that
+    failed (or was never registered under ``name``) yields None and
+    the wrapper falls back to the lazy jit path unchanged.
+
     AOT attributes (``lower``/``eval_shape``) pass through so cost
     analysis (``profiler.compiled_flops``) keeps working on the wrapped
     callable.
     """
     from ..observability.trace import span
 
-    state = {"first": True}
+    state = {"first": True, "fn": jitted_fn}
 
     @functools.wraps(jitted_fn)
     def wrapped(*args, **kwargs):
         if state["first"]:
             state["first"] = False
+            compiled = (warmup.result(name)
+                        if warmup is not None else None)
+            if compiled is not None:
+                try:
+                    with span(f"{name}/dispatch", warm=True):
+                        out = compiled(*args, **kwargs)
+                    state["fn"] = compiled
+                    return out
+                except TypeError:
+                    # aval/sharding mismatch between the warmup's
+                    # abstract spec and the real inputs (raised BEFORE
+                    # execution, so nothing was donated): the degrade-
+                    # to-lazy contract must hold here too, not only for
+                    # compile-time failures
+                    import logging
+
+                    logging.getLogger(__name__).warning(
+                        "AOT-warmed %s rejected the real inputs; "
+                        "falling back to lazy compile", name,
+                        exc_info=True,
+                    )
             with span(f"{name}/compile+execute"):
-                return jitted_fn(*args, **kwargs)
+                return state["fn"](*args, **kwargs)
         with span(f"{name}/dispatch"):
-            return jitted_fn(*args, **kwargs)
+            return state["fn"](*args, **kwargs)
 
     for attr in ("lower", "eval_shape", "trace"):
         if hasattr(jitted_fn, attr):

@@ -23,6 +23,7 @@ from __future__ import annotations
 import math
 import time
 from abc import abstractmethod
+from collections import deque
 from typing import Optional
 
 import jax
@@ -33,6 +34,7 @@ from ..checkpoint import CheckpointManager
 from ..data.loader import host_prefetch, prefetch_to_device
 from ..models.base import describe, inject_mesh
 from ..observability import FlightRecorder, MetricTracker, TensorboardWriter
+from ..observability.telemetry import drain_compile_events
 from ..observability.trace import get_recorder as get_span_recorder
 from ..observability.trace import span
 from ..ops.augment import build_augment
@@ -408,14 +410,11 @@ class Trainer(BaseTrainer):
         train_keys = self._metric_keys() + (
             ["skipped_sum"] if self.skip_nonfinite else []
         ) + (["grad_norm_sum"] if self.log_grad_norm else [])
-        self._train_step = instrument_step(
-            jax.jit(
-                train_step,
-                donate_argnums=0,
-                out_shardings=(self.state_sharding,
-                               {k: metric_sharding for k in train_keys}),
-            ),
-            "train_step",
+        train_step_jit = jax.jit(
+            train_step,
+            donate_argnums=0,
+            out_shardings=(self.state_sharding,
+                           {k: metric_sharding for k in train_keys}),
         )
         eval_step = make_eval_step(
             model, criterion, self.metric_ftns,
@@ -423,14 +422,49 @@ class Trainer(BaseTrainer):
             use_ema=ema_decay > 0
             and bool(config["trainer"].get("eval_with_ema", True)),
         )
+        eval_step_jit = jax.jit(
+            eval_step,
+            out_shardings={
+                k: metric_sharding for k in self._metric_keys()
+            },
+        )
+
+        # --- background AOT warmup (engine/warmup.py): compile the steps
+        # from abstract batches on a thread NOW, overlapping the rest of
+        # init + first-epoch data startup, so step 1 dispatches a ready
+        # executable instead of paying trace+compile inline. Any failure
+        # degrades to the lazy jit path (warmup.result -> None). --------
+        self._warmup = None
+        if bool(config["trainer"].get("aot_warmup", True)):
+            from .warmup import StepWarmup, abstract_batch
+
+            try:
+                warmup = StepWarmup()
+                warmup.add(
+                    "train_step", train_step_jit, self.state,
+                    abstract_batch(train_loader, self.batch_sharding,
+                                   transform=self._device_transform),
+                )
+                if valid_loader is not None:
+                    warmup.add(
+                        "eval_step", eval_step_jit, self.state,
+                        abstract_batch(
+                            valid_loader, self.batch_sharding,
+                            transform=getattr(valid_loader,
+                                              "device_transform", None),
+                        ),
+                    )
+                self._warmup = warmup.start()
+            except Exception:  # noqa: BLE001 — warmup is best-effort
+                self.logger.warning(
+                    "could not start AOT warmup; steps compile lazily",
+                    exc_info=True,
+                )
+        self._train_step = instrument_step(
+            train_step_jit, "train_step", warmup=self._warmup
+        )
         self._eval_step = instrument_step(
-            jax.jit(
-                eval_step,
-                out_shardings={
-                    k: metric_sharding for k in self._metric_keys()
-                },
-            ),
-            "eval_step",
+            eval_step_jit, "eval_step", warmup=self._warmup
         )
 
         self.train_metrics = MetricTracker("loss", writer=self.writer)
@@ -451,7 +485,15 @@ class Trainer(BaseTrainer):
         )
         self._peak_flops = prof_cfg.get("peak_flops_per_device")
         self._flops_per_step = None  # measured lazily on the first batch
-        self._flops_measured = False  # latch: the AOT compile runs at most once
+        # latch: the first-step meter reset (+ the profiler's one-time
+        # AOT cost analysis) runs at most once per process
+        self._first_step_timed = False
+        # host->device transfer pipeline depth (data/loader.
+        # prefetch_to_device): 2 double-buffers; deeper hides burstier
+        # host gathers at the cost of depth x batch bytes of HBM
+        self.prefetch_depth = max(
+            int(config["trainer"].get("prefetch_depth", 2)), 1
+        )
 
         # --- flight recorder (observability/telemetry): one structured
         # JSONL record per step in <run_dir>/telemetry.jsonl on process 0,
@@ -465,15 +507,17 @@ class Trainer(BaseTrainer):
             capacity=int(tel_cfg.get("capacity", 512)),
             memory_every=int(tel_cfg.get("memory_every", 16)),
         )
-        # tokens/step for LM data (integer [B, T, ...] inputs): feeds the
-        # per-record tokens field and the tokens/s aggregate
+        # tokens/step for LM data (integer [B, T] inputs): feeds the
+        # per-record tokens field and the tokens/s aggregate. Exactly
+        # rank 2 — integer image arrays (uint8 [B, H, W, C]) are not
+        # token streams and must not emit a fake tokens_per_sec
         arr = train_loader.arrays.get(self.input_key)
         dtype = getattr(arr, "dtype", None)
         shape = getattr(arr, "shape", ())
         self._tokens_per_example = (
-            int(np.prod(shape[1:]))
+            int(shape[1])
             if dtype is not None and np.issubdtype(dtype, np.integer)
-            and len(shape) >= 2 else None
+            and len(shape) == 2 else None
         )
 
         # hung-step detection (utils/watchdog.py); 0 disables. Wired to
@@ -483,7 +527,11 @@ class Trainer(BaseTrainer):
             timeout_s=float(config["trainer"].get("watchdog_secs", 0)),
             recorder=self.recorder,
             spans=get_span_recorder(),
-            dump_path=config.log_dir / "stall_dump.json",
+            # file dump on process 0 only (same gating as the recorder's
+            # JSONL above): hosts sharing a log dir must not race on one
+            # stall_dump.json; every host still dumps stacks to stderr
+            dump_path=(config.log_dir / "stall_dump.json"
+                       if dist.is_main_process() else None),
         )
 
     def _metric_keys(self):
@@ -512,6 +560,7 @@ class Trainer(BaseTrainer):
         if depth > 0:
             batches = host_prefetch(batches, depth)
         prefetched = prefetch_to_device(batches, self.batch_sharding,
+                                        size=self.prefetch_depth,
                                         transform=self._device_transform)
         main = dist.is_main_process()
         if main:
@@ -538,6 +587,13 @@ class Trainer(BaseTrainer):
         self.watchdog.start()
         batches_it = iter(prefetched)
         batch_idx = -1
+        # Sync-free stepping: log-step metric fetches are DEFERRED by one
+        # log window. The entry enqueued at step N is completed at step
+        # N + log_step, when its device buffers have long resolved — so
+        # the host never float()-blocks on the step it just dispatched
+        # (the old per-log-step pipeline bubble). Holds at most one
+        # entry (a handful of scalar metric buffers).
+        pending_log = deque()
         t_iter = time.perf_counter()
         while True:
             # data-wait = time blocked on the prefetch pipeline; near
@@ -573,15 +629,21 @@ class Trainer(BaseTrainer):
                 rec["tokens"] = (self._tokens_per_example
                                  * self.train_loader.batch_size)
 
-            if (self.profile_enabled and batch_idx == 0
-                    and not self._flops_measured):
-                # One AOT cost analysis of the compiled step (startup only;
-                # batch_idx gate so resumed runs measure too; the latch stays
-                # set even when the backend reports no FLOPs).
-                self._flops_measured = True
-                self._flops_per_step = compiled_flops(
-                    self._train_step, self.state, batch
-                )
+            if batch_idx == 0 and not self._first_step_timed:
+                # The run's first step carries the compile (or the AOT
+                # warm-install) cost: exclude it from steady-state
+                # meters UNCONDITIONALLY — this used to happen only
+                # under the profiler, so unprofiled runs reported a
+                # steps_per_sec that silently averaged in the compile
+                # step. (batch_idx gate so resumed runs re-latch too.)
+                self._first_step_timed = True
+                if self.profile_enabled:
+                    # one AOT cost analysis of the compiled step; the
+                    # latch stays set even when the backend reports no
+                    # FLOPs
+                    self._flops_per_step = compiled_flops(
+                        self._train_step, self.state, batch
+                    )
                 jax.block_until_ready(m)
                 self.throughput.reset()  # exclude compilation from rates
                 self.epoch_meter.reset()
@@ -589,47 +651,23 @@ class Trainer(BaseTrainer):
             accum = m if accum is None else jax.tree.map(jnp.add, accum, m)
 
             if main and batch_idx % self.log_step == 0:
-                with span("train/log", step=step):
-                    self.writer.set_step(step)
-                    loss_val = (float(m["loss_sum"])
-                                / max(float(m["count"]), 1.0))
-                    self.train_metrics.update("loss", loss_val)
-                    lr_val = float(self.lr_fn(step)) * self._lr_scale_host
-                    self.writer.add_scalar("lr", lr_val)
-                    rec["loss"] = round(loss_val, 6)
-                    rec["lr"] = lr_val
-                    if self.log_grad_norm:
-                        rec["grad_norm"] = round(
-                            float(m["grad_norm_sum"])
-                            / max(float(m["count"]), 1.0), 6,
-                        )
-                    if self.profile_enabled and step > 0:
-                        # float() above synced the device, so rates are
-                        # honest.
-                        rate = self.throughput.rate()
-                        self.writer.add_scalar(
-                            "examples_per_sec", rate["examples_per_sec"]
-                        )
-                        rec["steps_per_sec"] = round(
-                            rate["steps_per_sec"], 4)
-                        rec["examples_per_sec"] = round(
-                            rate["examples_per_sec"], 1)
-                        if self._tokens_per_example:
-                            rec["tokens_per_sec"] = round(
-                                rate["examples_per_sec"]
-                                * self._tokens_per_example, 1)
-                        util = mfu(self._flops_per_step,
-                                   rate["steps_per_sec"],
-                                   peak_per_device=self._peak_flops)
-                        if util is not None:
-                            self.writer.add_scalar("mfu", util)
-                            rec["mfu"] = round(util, 4)
-                    self.logger.debug(
-                        "Train Epoch: %d %s Loss: %.6f",
-                        epoch, self._progress(batch_idx + 1), loss_val,
-                    )
-                    self._log_input_images(batch)
-            self.recorder.record(step, **rec)
+                # deferred fetch: complete the PREVIOUS log window's
+                # entry (its step finished while this window's steps
+                # dispatched), enqueue this one; only the TB image grid
+                # needs the live batch, so it logs at enqueue time.
+                # Compile events drain NOW so this step's own compile
+                # (the lazy first-step case) rides under its own step
+                # id, not whichever record happens to flush next
+                if pending_log:
+                    self._flush_log_entry(pending_log.popleft())
+                events = drain_compile_events()
+                if events:
+                    rec["compile_events"] = events
+                self.writer.set_step(step)
+                self._log_input_images(batch)
+                pending_log.append((step, epoch, batch_idx, m, rec))
+            else:
+                self.recorder.record(step, **rec)
 
             if ((single_host or (batch_idx + 1) % check_every == 0)
                     and preemption.sync_requested()):
@@ -661,6 +699,11 @@ class Trainer(BaseTrainer):
                         epoch, batch_idx + 1,
                     )
 
+        while pending_log:
+            # drain the deferred log entry (epoch end syncs anyway via
+            # finalize_metrics below, so this fetch costs nothing extra)
+            self._flush_log_entry(pending_log.popleft())
+
         log = (
             finalize_metrics(jax.tree.map(float, accum)) if accum else {}
         )
@@ -686,6 +729,60 @@ class Trainer(BaseTrainer):
         if self.plateau is not None and not preempted:
             self._plateau_step(log)
         return log
+
+    def _flush_log_entry(self, entry) -> None:
+        """Complete one deferred log-step record (sync-free stepping).
+
+        Called one log window after the entry's step was dispatched —
+        by then ``log_step`` further steps have been queued behind it,
+        so ``jax.device_get`` reads already-resolved buffers instead of
+        blocking the dispatch pipeline on the newest step (the old
+        ``float()``-per-log-step host sync). The entry's flight record
+        lands in the JSONL one window late but under its own step id;
+        window throughput is dispatch-rate (bounded-queue steady state
+        tracks completion rate; epoch numbers still come from the
+        synced ``finalize_metrics`` path).
+        """
+        step, epoch, batch_idx, m, rec = entry
+        with span("train/log", step=step):
+            m = jax.device_get(m)
+            self.writer.set_step(step)
+            loss_val = (float(m["loss_sum"])
+                        / max(float(m["count"]), 1.0))
+            self.train_metrics.update("loss", loss_val)
+            lr_val = float(self.lr_fn(step)) * self._lr_scale_host
+            self.writer.add_scalar("lr", lr_val)
+            rec["loss"] = round(loss_val, 6)
+            rec["lr"] = lr_val
+            if self.log_grad_norm:
+                rec["grad_norm"] = round(
+                    float(m["grad_norm_sum"])
+                    / max(float(m["count"]), 1.0), 6,
+                )
+            if self.profile_enabled and step > 0:
+                rate = self.throughput.rate()
+                self.writer.add_scalar(
+                    "examples_per_sec", rate["examples_per_sec"]
+                )
+                rec["steps_per_sec"] = round(
+                    rate["steps_per_sec"], 4)
+                rec["examples_per_sec"] = round(
+                    rate["examples_per_sec"], 1)
+                if self._tokens_per_example:
+                    rec["tokens_per_sec"] = round(
+                        rate["examples_per_sec"]
+                        * self._tokens_per_example, 1)
+                util = mfu(self._flops_per_step,
+                           rate["steps_per_sec"],
+                           peak_per_device=self._peak_flops)
+                if util is not None:
+                    self.writer.add_scalar("mfu", util)
+                    rec["mfu"] = round(util, 4)
+            self.logger.debug(
+                "Train Epoch: %d %s Loss: %.6f",
+                epoch, self._progress(batch_idx + 1), loss_val,
+            )
+        self.recorder.record(step, **rec)
 
     def _plateau_step(self, log: dict) -> None:
         """Per-epoch ReduceLROnPlateau update of ``state.lr_scale``.
@@ -734,6 +831,7 @@ class Trainer(BaseTrainer):
         accum = None
         val_batches = prefetch_to_device(
             self.valid_loader, self.batch_sharding,
+            size=self.prefetch_depth,
             transform=getattr(self.valid_loader, "device_transform", None),
         )
         if dist.is_main_process():

@@ -57,10 +57,12 @@ def host_rss_bytes() -> Optional[int]:
         pass
     try:
         import resource
+        import sys
 
         # ru_maxrss is KB on linux, bytes on macOS; prefer /proc above,
         # this is the portable fallback (peak, not current)
-        return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss * 1024
+        rss = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+        return rss if sys.platform == "darwin" else rss * 1024
     except Exception:
         return None
 
@@ -99,12 +101,18 @@ def device_memory_stats() -> dict:
 _compile_lock = threading.Lock()
 _compile_events: "collections.deque" = collections.deque(maxlen=256)
 _compile_listener_installed = False
+# persistent-compilation-cache counters (utils/compile_cache wires the
+# cache itself; these count process lifetime hits/misses/requests —
+# /metrics and the bench warm_start rung read them). A "miss" IS a real
+# XLA compile; a "hit" is an executable deserialized from the cache dir.
+_cache_counters = {"hits": 0, "misses": 0, "requests": 0}
 
 
 def _install_compile_listener() -> None:
-    """Register a ``jax.monitoring`` duration listener recording every
-    compilation event. Idempotent; silently absent on jax builds
-    without the monitoring API."""
+    """Register ``jax.monitoring`` listeners recording every compilation
+    event (durations) and every persistent-cache hit/miss (plain
+    events). Idempotent; silently absent on jax builds without the
+    monitoring API."""
     global _compile_listener_installed
     with _compile_lock:
         if _compile_listener_installed:
@@ -125,7 +133,28 @@ def _install_compile_listener() -> None:
                          "dur_ms": round(duration * 1e3, 3)}
                     )
 
+        def _listen_plain(event: str, **kw) -> None:
+            # cache hit/miss ride the per-step records too (a miss next
+            # to a backend_compile duration says the compile was real;
+            # a hit says it was a disk read) — note the
+            # backend_compile_duration event fires EITHER WAY in jax
+            # (it wraps compile_or_get_cached), so these events are the
+            # only honest new-compile signal when the cache is on
+            if not event.startswith("/jax/compilation_cache/"):
+                return
+            key = event.rsplit("/", 1)[-1]
+            with _compile_lock:
+                if key == "cache_hits":
+                    _cache_counters["hits"] += 1
+                    _compile_events.append({"event": event})
+                elif key == "cache_misses":
+                    _cache_counters["misses"] += 1
+                    _compile_events.append({"event": event})
+                elif key == "compile_requests_use_cache":
+                    _cache_counters["requests"] += 1
+
         monitoring.register_event_duration_secs_listener(_listen)
+        monitoring.register_event_listener(_listen_plain)
     except Exception:
         pass
 
@@ -136,6 +165,30 @@ def drain_compile_events() -> list:
         out = list(_compile_events)
         _compile_events.clear()
     return out
+
+
+def compile_cache_stats() -> dict:
+    """Process-lifetime persistent-compilation-cache counters.
+
+    ``misses`` counts real XLA compiles (cache enabled but no entry),
+    ``hits`` counts executables loaded from the cache dir instead of
+    compiled. All zero when the cache was never enabled (the listener
+    only sees events jax emits, and jax emits none without a cache
+    dir). Consumers: serve.py ``GET /metrics`` and bench.py's
+    ``warm_start`` rung."""
+    try:
+        import jax
+
+        cache_dir = jax.config.jax_compilation_cache_dir
+    except Exception:
+        cache_dir = None
+    with _compile_lock:
+        counters = dict(_cache_counters)
+    return {
+        "enabled": bool(cache_dir),
+        "dir": cache_dir,
+        **counters,
+    }
 
 
 # ---------------------------------------------------------------------------
@@ -193,13 +246,28 @@ class FlightRecorder:
         for k, v in fields.items():
             if v is None:
                 continue
+            if (not isinstance(v, (bool, int, float, str, bytes))
+                    and hasattr(v, "item")):
+                # numpy/jax scalars: unwrap to builtins so the
+                # non-finite nulling below sees them and json.dumps
+                # never chokes on a caller's un-converted scalar
+                try:
+                    v = v.item()
+                except Exception:
+                    pass
             if isinstance(v, float) and (v != v or v in (float("inf"),
                                                          float("-inf"))):
                 v = None
             rec[k] = v
         compile_events = drain_compile_events()
         if compile_events:
-            rec["compile_events"] = compile_events
+            # EXTEND a caller-provided list rather than replace it: a
+            # deferred record (trainer sync-free logging) drains at
+            # enqueue time so its own compile rides under its own step,
+            # and anything arriving before the flush still lands here
+            rec["compile_events"] = (
+                list(rec.get("compile_events") or []) + compile_events
+            )
         with self._lock:
             self._n += 1
             attach_memory = (
@@ -218,8 +286,10 @@ class FlightRecorder:
         with self._io_lock:
             if self._file is not None:
                 try:
-                    self._file.write(json.dumps(rec) + "\n")
-                except (OSError, ValueError):
+                    # default=repr: one exotic caller field must not
+                    # void the line (same policy as SpanRecorder.dump)
+                    self._file.write(json.dumps(rec, default=repr) + "\n")
+                except (OSError, ValueError, TypeError):
                     pass  # a full disk must never kill the step loop
         return rec
 

@@ -1,0 +1,128 @@
+"""Persistent XLA compilation cache wiring (warm-path leg 1).
+
+In a GSPMD/pjit system the compiled executable IS the program, so every
+process historically paid the full trace+compile on step 1 of every run
+(engine/steps.instrument_step labels it ``<name>/compile``) and the
+serving engine recompiled its ladder on every restart. jax ships a
+content-addressed on-disk executable cache behind
+``jax_compilation_cache_dir``; this module is the one place that turns
+it on from a config section so every entrypoint (train.py, test.py,
+serve.py, generate.py, bench.py) behaves identically:
+
+    "compile_cache": {
+        "dir": "~/.cache/pdt-xla-cache",   // enables the cache
+        "enabled": true,                    // default true when dir set
+        "min_compile_time_secs": 0.0,       // cache everything (jax
+                                            // defaults to 1.0 — small
+                                            // executables skipped)
+        "min_entry_size_bytes": 0,
+        "max_size_bytes": 4294967296        // LRU-evict past 4 GiB
+                                            // (jax defaults to
+                                            // UNBOUNDED growth)
+    }
+
+Counters: a hit/miss listener (observability/telemetry) counts every
+cache event process-wide — surfaced per-step in the flight recorder's
+``compile_events`` and cumulatively via serve.py ``GET /metrics`` and
+the bench ``warm_start`` rung. Note jax's ``backend_compile_duration``
+monitoring event fires on hits AND misses (it wraps
+``compile_or_get_cached``), so the cache events are the only honest
+"was that a real compile?" signal.
+
+The env var ``JAX_COMPILATION_CACHE_DIR`` (jax's own spelling) still
+works and is never clobbered by a config without a ``compile_cache``
+section.
+"""
+from __future__ import annotations
+
+import logging
+import os
+from typing import Optional
+
+logger = logging.getLogger(__name__)
+
+
+DEFAULT_MAX_SIZE_BYTES = 4 << 30    # 4 GiB LRU bound (jax: unbounded)
+
+
+def configure_compile_cache(config=None, cache_dir: Optional[str] = None,
+                            min_compile_time_secs: Optional[float] = None,
+                            min_entry_size_bytes: Optional[int] = None,
+                            max_size_bytes: Optional[int] = None,
+                            ) -> Optional[str]:
+    """Enable the persistent compilation cache from a config section
+    and/or explicit overrides; returns the active cache dir (None when
+    the cache stays off).
+
+    ``config`` is a ConfigParser or plain dict; its ``compile_cache``
+    section is read as documented above. Explicit kwargs win over the
+    section (bench.py passes ``--compile-cache-dir`` directly). With
+    neither, any value jax already holds (e.g. from
+    ``JAX_COMPILATION_CACHE_DIR``) is left untouched and returned.
+
+    Never raises: a bad cache dir degrades to an uncached run with a
+    warning — compile caching is an optimization, not a dependency.
+    """
+    section = {}
+    if config is not None:
+        try:
+            section = dict(config.get("compile_cache", None) or {})
+        except Exception:
+            section = {}
+    if cache_dir is None and section.get("enabled", True):
+        cache_dir = section.get("dir")
+    if min_compile_time_secs is None:
+        min_compile_time_secs = section.get("min_compile_time_secs", 0.0)
+    if min_entry_size_bytes is None:
+        min_entry_size_bytes = section.get("min_entry_size_bytes", 0)
+    if max_size_bytes is None:
+        max_size_bytes = section.get("max_size_bytes",
+                                     DEFAULT_MAX_SIZE_BYTES)
+
+    # counters must exist even when the cache is configured via env var
+    # only — the listener is idempotent and cheap
+    from ..observability.telemetry import _install_compile_listener
+
+    _install_compile_listener()
+
+    try:
+        import jax
+    except Exception:  # pragma: no cover — jax is a hard dep everywhere
+        return None
+
+    if cache_dir is None:
+        # nothing to set; report what jax already has (env var path)
+        return jax.config.jax_compilation_cache_dir
+
+    try:
+        cache_dir = os.path.abspath(os.path.expanduser(str(cache_dir)))
+        os.makedirs(cache_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        # jax's 1.0 s default skips exactly the small-but-numerous
+        # executables (admit/chunk ladders, transforms) whose aggregate
+        # cold cost the cache exists to delete; default to caching all
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          float(min_compile_time_secs))
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes",
+                          int(min_entry_size_bytes))
+        # ...and because min_compile_time 0 writes EVERY executable,
+        # bound the dir: jax LRU-evicts by atime past this size (its
+        # own default is -1 = grow forever)
+        jax.config.update("jax_compilation_cache_max_size",
+                          int(max_size_bytes))
+        try:
+            # jax memoizes the is-cache-used decision at the FIRST
+            # compile of the process; enabling the dir after any
+            # compile has happened (tests, notebooks, late config)
+            # silently does nothing until that memo is cleared
+            from jax._src import compilation_cache
+
+            compilation_cache.reset_cache()
+        except Exception:
+            pass
+        logger.info("persistent compilation cache: %s", cache_dir)
+        return cache_dir
+    except Exception as e:  # noqa: BLE001 — never fail an entrypoint
+        logger.warning("could not enable compilation cache at %r: %s",
+                       cache_dir, e)
+        return None

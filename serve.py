@@ -92,6 +92,12 @@ from pytorch_distributed_template_tpu.engine.continuous import (  # noqa: E402
 from pytorch_distributed_template_tpu.engine.serving import (  # noqa: E402
     BatchedGenerationService, GenerationService, load_generation_stack,
 )
+from pytorch_distributed_template_tpu.observability.telemetry import (  # noqa: E402
+    compile_cache_stats,
+)
+from pytorch_distributed_template_tpu.utils.compile_cache import (  # noqa: E402
+    configure_compile_cache,
+)
 
 
 def _run_request(service: GenerationService, req: dict,
@@ -154,6 +160,12 @@ def service_metrics(service: GenerationService) -> dict:
             out[k] = int(stats[k])
     if hasattr(service, "latency_percentiles"):
         out["latency"] = service.latency_percentiles()
+    # persistent-compile-cache counters (utils/compile_cache): a miss is
+    # a real XLA compile, a hit an executable read back from disk —
+    # restart cost and mid-traffic recompile storms as scrapeable series
+    cache = compile_cache_stats()
+    out["compile_cache_hits_total"] = int(cache["hits"])
+    out["compile_cache_misses_total"] = int(cache["misses"])
     return out
 
 
@@ -319,6 +331,18 @@ def make_handler(service: GenerationService):
 
 def main(args, config):
     logger = config.get_logger("serve")
+    # validate --warm-buckets BEFORE the (expensive) checkpoint restore:
+    # a typo should fail in milliseconds, not after a multi-GB load
+    try:
+        warm_buckets = [int(b) for b in args.warm_buckets.split(",")
+                        if b.strip()]
+    except ValueError:
+        raise SystemExit(
+            f"--warm-buckets must be comma-separated integers, got "
+            f"{args.warm_buckets!r}")
+    # persistent compile cache BEFORE any executable builds: a restarted
+    # server re-reads its warmup ladder from disk instead of recompiling
+    configure_compile_cache(config)
     model, params, tok = load_generation_stack(config, use_ema=args.ema)
     probe = GenerationService.from_model(model, params, tok)
     want = args.scheduler
@@ -331,6 +355,7 @@ def main(args, config):
         service = ContinuousBatchingService.from_model(
             model, params, tok, slots=args.max_batch,
             chunk=args.decode_chunk, window_ms=args.batch_window_ms,
+            warm_buckets=warm_buckets,
         )
     elif want == "static":
         service = BatchedGenerationService.from_model(
@@ -378,6 +403,16 @@ if __name__ == "__main__":
                         help="auto = continuous batching (slot-based, "
                              "no group keys) on RoPE/non-rolling "
                              "models, static micro-batching otherwise")
+    parser.add_argument("--warm-buckets", default="", type=str,
+                        metavar="N,N,...",
+                        help="continuous scheduler: prompt-length "
+                             "buckets whose admission executables "
+                             "compile at STARTUP (with the chunk "
+                             "ladder) instead of at the first arrival "
+                             "wave — e.g. 64,128,256 for chat traffic; "
+                             "empty disables (default). Pairs with "
+                             "compile_cache: a restarted server reads "
+                             "the whole ladder from disk")
     parser.add_argument("--decode-chunk", default=8, type=int,
                         help="continuous scheduler: BASE decode steps "
                              "per dispatch (admission latency bound); "

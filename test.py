@@ -20,9 +20,13 @@ from pytorch_distributed_template_tpu.config import ConfigParser
 from pytorch_distributed_template_tpu import data, models  # noqa: F401  (register)
 from pytorch_distributed_template_tpu.engine.evaluator import evaluate
 from pytorch_distributed_template_tpu.parallel import dist
+from pytorch_distributed_template_tpu.utils.compile_cache import (
+    configure_compile_cache,
+)
 
 
 def main(args, config):
+    configure_compile_cache(config)
     dist.initialize()
     evaluate(config, save_outputs=args.save_outputs, seed=args.seed)
 

@@ -31,10 +31,17 @@ from pytorch_distributed_template_tpu import data, models  # noqa: F401  (regist
 from pytorch_distributed_template_tpu.engine import Trainer
 from pytorch_distributed_template_tpu.engine.losses import resolve_loss
 from pytorch_distributed_template_tpu.parallel import dist, mesh_from_config
+from pytorch_distributed_template_tpu.utils.compile_cache import (
+    configure_compile_cache,
+)
 
 
 def main(args, config):
     logger = config.get_logger("train")
+
+    # persistent XLA compile cache (config["compile_cache"]): before any
+    # jit so re-runs skip step-1 compilation entirely
+    configure_compile_cache(config)
 
     # multi-host init (no-op single host; reference train.py:20-29)
     dist.initialize()
