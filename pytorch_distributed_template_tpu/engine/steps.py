@@ -54,7 +54,8 @@ def make_train_step(model, tx, criterion: Callable,
                     augment=None,
                     mixup_alpha: float = 0.0,
                     log_grad_norm: bool = False,
-                    trainable_patterns=None):
+                    trainable_patterns=None,
+                    health: bool = False):
     """Build ``train_step(state, batch) -> (state, metrics)``.
 
     ``metrics`` holds scalar sums + count; callers divide after accumulating
@@ -85,6 +86,18 @@ def make_train_step(model, tx, criterion: Callable,
 
     ``augment`` (ops/augment.build_augment) is applied to the input batch
     in-graph before the forward pass, keyed per step — train-time only.
+
+    ``health`` adds the numerics-forensics summary
+    (observability/health) as ONE packed f32 vector under
+    ``metrics["health"]``: per-example loss, global grad/update norms,
+    and non-finite element counts for the post-update params and the
+    raw gradients per top-level param group (field order:
+    ``health_layout(params)``). A handful of scalar reductions and a
+    single tiny output, so the summary rides the dispatch pipeline
+    instead of stalling it. Appended AFTER the ``skip_nonfinite``
+    zeroing so a suppressed step still reports the non-finite counts
+    that got it suppressed (that report is the whole point). Callers
+    strip the ``health`` key out of the epoch accumulator.
 
     ``mixup_alpha > 0`` enables mixup (Zhang et al. 2018) in-graph: one
     Beta(alpha, alpha) draw per step mixes the batch with a random
@@ -250,7 +263,18 @@ def make_train_step(model, tx, criterion: Callable,
 
             grads = jax.tree_util.tree_map_with_path(_freeze, grads)
 
-        if log_grad_norm or grad_clip_norm > 0:
+        # hold the PRE-CLIP gradients for the health summary, AFTER the
+        # normalize/freeze transforms: clipping can smear one NaN over
+        # every group (NaN global norm -> NaN scale), destroying the
+        # per-module attribution the dump exists for, while capturing
+        # after the freeze keeps the counted tree identical to the one
+        # gnorm below is computed on — the lax.cond fast path in
+        # pack_health_summary is only sound when they match (a NaN in a
+        # frozen — training-inert — leaf is deliberately out of scope
+        # for both)
+        health_grads = grads if health else None
+
+        if log_grad_norm or grad_clip_norm > 0 or health:
             # pre-clip global norm of the mean gradient
             gnorm = optax.global_norm(grads)
         if log_grad_norm:
@@ -279,6 +303,10 @@ def make_train_step(model, tx, criterion: Callable,
             # update equals scaling the learning rate
             s = state.lr_scale.astype(jnp.float32)
             updates = jax.tree.map(lambda u: (u * s).astype(u.dtype), updates)
+        if health:
+            # post-LR-scale update magnitude: an optimizer blow-up shows
+            # here even when the gradients themselves were finite
+            health_update_norm = optax.global_norm(updates)
         new_params = optax.apply_updates(state.params, updates)
         if skip_nonfinite:
             # branchless select: a suppressed step leaves params/opt_state/
@@ -315,6 +343,22 @@ def make_train_step(model, tx, criterion: Callable,
             opt_state=new_opt_state,
             ema_params=new_ema,
         )
+        if health:
+            # nonfinite_params counts the post-select weights: what the
+            # next step will actually train from (0 when the guard
+            # suppressed the poisoned update, as designed). Packed as
+            # ONE f32 vector, merged after the metrics zeroing above —
+            # a suppressed step's health fields must survive to reach
+            # the detector
+            from ..observability.health import pack_health_summary
+
+            metrics = {**metrics, "health": pack_health_summary(
+                loss=loss_sum.astype(jnp.float32) / denom,
+                grad_norm=gnorm,
+                update_norm=health_update_norm,
+                grads=health_grads,
+                new_params=new_params,
+            )}
         return new_state, metrics
 
     return train_step

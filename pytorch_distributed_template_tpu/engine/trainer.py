@@ -34,6 +34,10 @@ from ..checkpoint import CheckpointManager
 from ..data.loader import host_prefetch, prefetch_to_device
 from ..models.base import describe, inject_mesh
 from ..observability import FlightRecorder, MetricTracker, TensorboardWriter
+from ..observability.crosshost import CrossHostAggregator
+from ..observability.health import (
+    HealthMonitor, health_counters, health_layout, health_metric_keys,
+)
 from ..observability.telemetry import drain_compile_events
 from ..observability.trace import get_recorder as get_span_recorder
 from ..observability.trace import span
@@ -392,6 +396,15 @@ class Trainer(BaseTrainer):
         self.log_grad_norm = bool(
             config["trainer"].get("log_grad_norm", False)
         )
+        # --- health summary (observability/health): a few scalar
+        # reductions compiled INTO the step; fetched one step deferred,
+        # so detection never syncs the dispatch pipeline ---------------
+        health_cfg = config["trainer"].get("health", {}) or {}
+        self._health_enabled = bool(health_cfg.get("enabled", True))
+        self._health_keys = (
+            health_metric_keys(self.state.params)
+            if self._health_enabled else []
+        )
         train_step = make_train_step(
             model, self.tx, criterion, self.metric_ftns,
             input_key=self.input_key, target_key=self.target_key,
@@ -403,13 +416,15 @@ class Trainer(BaseTrainer):
             trainable_patterns=config["optimizer"].get("args", {}).get(
                 "trainable"
             ),
+            health=self._health_enabled,
         )
         metric_sharding = jax.sharding.NamedSharding(
             self.mesh, jax.sharding.PartitionSpec()
         )
         train_keys = self._metric_keys() + (
             ["skipped_sum"] if self.skip_nonfinite else []
-        ) + (["grad_norm_sum"] if self.log_grad_norm else [])
+        ) + (["grad_norm_sum"] if self.log_grad_norm else []
+             ) + self._health_keys
         train_step_jit = jax.jit(
             train_step,
             donate_argnums=0,
@@ -507,6 +522,22 @@ class Trainer(BaseTrainer):
             capacity=int(tel_cfg.get("capacity", 512)),
             memory_every=int(tel_cfg.get("memory_every", 16)),
         )
+        # anomaly detection over the deferred health summaries; dumps
+        # (anomaly_<step>.json) on process 0 only, detection everywhere
+        self.health = HealthMonitor(
+            health_cfg, recorder=self.recorder,
+            spans=get_span_recorder(),
+            log_dir=(config.log_dir if dist.is_main_process() else None),
+            layout=health_layout(self.state.params),
+        )
+        # per-log-window host stats exchange + straggler flag (no-op
+        # collective single-host; auto-enabled on multi-host jobs)
+        self.crosshost = CrossHostAggregator(
+            tel_cfg.get("crosshost"), is_main=dist.is_main_process()
+        )
+        # runtime-triggered profiling (SIGUSR2 in train.py) notes its
+        # captures on the flight-recorder timeline
+        self.trace.attach_recorder(self.recorder)
         # tokens/step for LM data (integer [B, T] inputs): feeds the
         # per-record tokens field and the tokens/s aggregate. Exactly
         # rank 2 — integer image arrays (uint8 [B, H, W, C]) are not
@@ -551,6 +582,7 @@ class Trainer(BaseTrainer):
 
     def _train_epoch(self, epoch: int) -> dict:
         self.train_metrics.reset()
+        self.health.epoch_start()  # promotion pause is epoch-scoped
         self.throughput.reset()  # exclude validation/checkpoint wall time
         self.epoch_meter.reset()  # (epoch 1 includes compile unless the
         # profiler's post-compile reset fires; later epochs are clean)
@@ -614,6 +646,16 @@ class Trainer(BaseTrainer):
                 self.state, m = self._train_step(self.state, batch)
             self.trace.after_step(step, sync=m)
             self.watchdog.beat()
+            if self._health_keys:
+                # strip the health scalars out of the epoch accumulator
+                # (they are per-step signals, not sufficient statistics)
+                # and hand them to the monitor, which fetches them one
+                # step deferred — no sync on the step just dispatched
+                hm = {k: m.pop(k) for k in self._health_keys if k in m}
+                self.health.enqueue(
+                    step, hm,
+                    meta={"epoch": epoch, "batch_idx": batch_idx},
+                )
             self.throughput.update(self.train_loader.batch_size)
             self.epoch_meter.update(self.train_loader.batch_size)
             # per-step flight record; wall_ms is the full loop iteration
@@ -649,6 +691,21 @@ class Trainer(BaseTrainer):
                 self.epoch_meter.reset()
 
             accum = m if accum is None else jax.tree.map(jnp.add, accum, m)
+
+            if self.crosshost.should_exchange(batch_idx, self.log_step):
+                # EVERY host reaches this collective at the same batch
+                # (deterministic condition); only process 0 attaches the
+                # aggregate to its record
+                agg = self.crosshost.exchange(
+                    self.recorder.last(self.log_step)
+                )
+                if agg is not None and main:
+                    rec["hosts"] = agg["hosts"]
+                    if "wall_spread" in agg:
+                        rec["wall_spread"] = agg["wall_spread"]
+                    if agg.get("straggler"):
+                        rec["straggler"] = True
+                        rec["straggler_hosts"] = agg["straggler_hosts"]
 
             if main and batch_idx % self.log_step == 0:
                 # deferred fetch: complete the PREVIOUS log window's
@@ -703,6 +760,7 @@ class Trainer(BaseTrainer):
             # drain the deferred log entry (epoch end syncs anyway via
             # finalize_metrics below, so this fetch costs nothing extra)
             self._flush_log_entry(pending_log.popleft())
+        self.health.drain()  # observe the last step's deferred summary
 
         log = (
             finalize_metrics(jax.tree.map(float, accum)) if accum else {}
@@ -782,6 +840,11 @@ class Trainer(BaseTrainer):
                 "Train Epoch: %d %s Loss: %.6f",
                 epoch, self._progress(batch_idx + 1), loss_val,
             )
+        hc = health_counters()
+        if hc["anomaly_total"]:
+            rec["anomaly_total"] = hc["anomaly_total"]
+        if hc["straggler_windows_total"]:
+            rec["straggler_windows_total"] = hc["straggler_windows_total"]
         self.recorder.record(step, **rec)
 
     def _plateau_step(self, log: dict) -> None:
@@ -854,6 +917,18 @@ class Trainer(BaseTrainer):
     # -- checkpointing ------------------------------------------------------
 
     def _save_checkpoint(self, epoch: int, save_best: bool = False) -> None:
+        if save_best and not self.health.promotion_allowed():
+            # trainer.health.pause_best_promotion: an epoch that fired a
+            # numerics anomaly does not crown model_best — its monitored
+            # metric may be the artifact of the very step that fired
+            save_best = False
+            if dist.is_main_process():
+                self.logger.warning(
+                    "Health: anomaly at step %s this epoch; best-model "
+                    "promotion skipped for epoch %d "
+                    "(health.pause_best_promotion).",
+                    self.health.last_anomaly_step, epoch,
+                )
         self.ckpt_manager.save(
             epoch=epoch,
             state=self.state,

@@ -1,3 +1,5 @@
+from .crosshost import CrossHostAggregator
+from .health import EwmaDetector, HealthMonitor, health_counters
 from .logging import setup_logging
 from .tb import TensorboardWriter
 from .telemetry import FlightRecorder, read_jsonl

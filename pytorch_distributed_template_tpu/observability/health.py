@@ -1,0 +1,471 @@
+"""Numerics forensics: per-step health summary + anomaly detection.
+
+The flight recorder (telemetry.py) writes WHAT happened every step; this
+module decides whether it was HEALTHY and, when it wasn't, freezes the
+evidence before it scrolls out of the ring. Three pieces:
+
+- **In-graph summary** (``health_summary_*`` helpers, called from
+  ``engine/steps.make_train_step(health=True)``): a handful of scalar
+  reductions compiled INTO the train step — per-example loss, global
+  grad/update norms, and non-finite element counts for the gradients
+  (per top-level parameter group, so a dump says *which* module
+  produced the NaN) and the post-update parameters. The host never
+  syncs on them: the trainer defers the fetch by one step (the same
+  sync-free pattern as its log-window metrics), so detection rides the
+  dispatch pipeline instead of stalling it.
+
+- **Anomaly detector** (``EwmaDetector`` + ``HealthMonitor``): hard
+  triggers on any non-finite count or non-finite loss, soft triggers on
+  EWMA z-scores of loss and grad-norm. Soft triggers hold their fire
+  for ``warmup_steps`` observations so the compile step / early
+  optimization transient can't false-alarm. On firing, process 0 writes
+  ``<log_dir>/anomaly_<step>.json``: the offending step's summary, the
+  detector state, the trailing flight-recorder records, the active
+  spans, and (when the trainer passes it) the epoch/batch index — then
+  flushes the recorder so the JSONL tail survives whatever happens
+  next. Firing can also (configurably) pause best-checkpoint promotion
+  for the epoch, so a poisoned metric can't crown ``model_best``.
+
+- **Process-wide counters** (``health_counters()``): ``anomaly_total``,
+  ``straggler_windows_total``, ``profile_captures_total``, and
+  ``last_anomaly_step`` — read by serve.py's ``GET /metrics`` /
+  ``/healthz`` and ridden onto log-step recorder records.
+
+Config (``trainer.health`` in the experiment JSON, all optional)::
+
+    "health": {"enabled": true, "ewma_alpha": 0.05, "z_threshold": 8.0,
+               "warmup_steps": 20, "dump_last_n": 32, "max_dumps": 8,
+               "cooldown_steps": 25, "pause_best_promotion": false}
+"""
+from __future__ import annotations
+
+import collections
+import json
+import math
+import threading
+import time
+from pathlib import Path
+from typing import Dict, Optional
+
+# ---------------------------------------------------------------------------
+# process-wide health counters (serve /metrics + recorder piggyback)
+# ---------------------------------------------------------------------------
+
+_counter_lock = threading.Lock()
+_counters: dict = {
+    "anomaly_total": 0,
+    "straggler_windows_total": 0,
+    "profile_captures_total": 0,
+    "last_anomaly_step": None,
+}
+
+
+def health_counters() -> dict:
+    """Snapshot of the process-lifetime health counters."""
+    with _counter_lock:
+        return dict(_counters)
+
+
+def bump_counter(name: str, n: int = 1) -> None:
+    with _counter_lock:
+        _counters[name] = int(_counters.get(name) or 0) + n
+
+
+def note_anomaly(step: int) -> None:
+    with _counter_lock:
+        _counters["anomaly_total"] += 1
+        _counters["last_anomaly_step"] = int(step)
+
+
+def reset_counters() -> None:
+    """Test hook: counters are process-global."""
+    with _counter_lock:
+        _counters.update(anomaly_total=0, straggler_windows_total=0,
+                         profile_captures_total=0, last_anomaly_step=None)
+
+
+# ---------------------------------------------------------------------------
+# in-graph summary helpers (traced inside the jitted train step)
+# ---------------------------------------------------------------------------
+
+
+def _group_items(tree):
+    """Top-level (group_name, subtree) pairs of a param/grad pytree;
+    the whole tree under ``"all"`` when it isn't a mapping."""
+    if hasattr(tree, "items"):
+        return sorted(tree.items())
+    return [("all", tree)]
+
+
+def nonfinite_total(tree):
+    """Count of non-finite elements across all inexact leaves (traced)."""
+    import jax
+    import jax.numpy as jnp
+
+    total = jnp.zeros((), jnp.float32)
+    for leaf in jax.tree.leaves(tree):
+        if jnp.issubdtype(jnp.asarray(leaf).dtype, jnp.inexact):
+            total = total + jnp.sum(
+                ~jnp.isfinite(leaf)
+            ).astype(jnp.float32)
+    return total
+
+
+def nonfinite_by_group(tree) -> Dict[str, object]:
+    """Non-finite element counts per top-level parameter group (traced).
+
+    Group = a top-level key of the params dict (``Dense_0``,
+    ``TransformerBlock_3``, ...), so an anomaly dump attributes the NaN
+    to a module instead of just saying "somewhere". The total non-finite
+    grad count is the sum of these — computed host-side, not as a
+    second full-tree pass."""
+    return {name: nonfinite_total(sub) for name, sub in _group_items(tree)}
+
+
+def health_layout(params) -> list:
+    """Field order of the packed summary vector — the host-side mirror
+    of ``pack_health_summary``. One [K] vector instead of K scalar
+    outputs: the summary rides the step's output pytree as a single
+    tiny transfer, which is what keeps the per-step overhead inside the
+    dispatch shadow even on hosts where per-buffer costs dominate."""
+    return ["loss", "grad_norm", "update_norm", "nonfinite_params"] + [
+        f"nonfinite/{name}" for name, _ in _group_items(params)
+    ]
+
+
+def pack_health_summary(loss, grad_norm, update_norm, grads,
+                        new_params):
+    """Build the packed summary vector (traced); order matches
+    ``health_layout``.
+
+    The non-finite COUNT passes (full-tree elementwise scans) hide
+    behind a ``lax.cond`` keyed on the scalars already in hand: when
+    loss and both norms are finite, every count is provably zero — a
+    non-finite element anywhere makes the corresponding norm non-finite
+    (NaN propagates through the squared sum; inf squares to inf), and a
+    param can only go non-finite through a non-finite update — so the
+    cheap branch returns the TRUE value. Steady-state per-step cost is
+    therefore three scalar ``isfinite`` checks; the expensive scans run
+    only on the steps that are about to be dumped anyway.
+    ``grads`` must be the PRE-CLIP gradients from the SAME point in
+    the dataflow as ``grad_norm`` (post-normalize, post-freeze): a NaN
+    global norm makes the clip scale NaN and would smear one bad leaf
+    over every group, destroying the per-module attribution the dump
+    exists for — and the fast-path proof above only holds when the
+    counted tree is the one the norm was computed on.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    loss = jnp.asarray(loss).astype(jnp.float32)
+    grad_norm = jnp.asarray(grad_norm).astype(jnp.float32)
+    update_norm = jnp.asarray(update_norm).astype(jnp.float32)
+    names = sorted(name for name, _ in _group_items(grads))
+
+    def count_branch(_):
+        gc = nonfinite_by_group(grads)
+        return jnp.stack([nonfinite_total(new_params)]
+                         + [gc[n] for n in names])
+
+    def zero_branch(_):
+        return jnp.zeros((len(names) + 1,), jnp.float32)
+
+    all_finite = (jnp.isfinite(loss) & jnp.isfinite(grad_norm)
+                  & jnp.isfinite(update_norm))
+    counts = jax.lax.cond(all_finite, zero_branch, count_branch, None)
+    return jnp.concatenate(
+        [jnp.stack([loss, grad_norm, update_norm]), counts]
+    )
+
+
+def health_metric_keys(params) -> list:
+    """The metric key(s) ``make_train_step(health=True)`` adds — for
+    out-sharding declarations and for stripping the health entry out of
+    the epoch accumulator. (One packed vector under ``"health"``.)"""
+    return ["health"]
+
+
+def unpack_health_summary(vec, layout: list) -> dict:
+    """Packed vector -> named summary dict; derives the total
+    ``nonfinite_grads`` from the per-group counts."""
+    import numpy as np
+
+    flat = np.asarray(vec, np.float64).reshape(-1)
+    summary = {name: float(v) for name, v in zip(layout, flat)}
+    summary["nonfinite_grads"] = float(sum(
+        v for k, v in summary.items() if k.startswith("nonfinite/")
+    ))
+    return summary
+
+
+# ---------------------------------------------------------------------------
+# host-side detection
+# ---------------------------------------------------------------------------
+
+
+class EwmaDetector:
+    """EWMA mean/variance z-score detector for one scalar series.
+
+    ``update(x)`` returns the z-score of ``x`` against the series'
+    exponentially-weighted history, or None while warming up (fewer than
+    ``warmup`` finite observations) or when ``x`` is non-finite (the
+    hard-trigger path owns that case). The deviation floor
+    (``1e-8 + floor_frac * |mean|``) keeps a near-constant series (e.g.
+    a converged loss) from turning sub-percent jitter into huge
+    z-scores.
+
+    One-sided by default: the monitored series (loss, grad norm) are
+    "bigger is worse" — a healthy training run's steadily DECREASING
+    loss must never fire, so downward deviations score 0.
+    """
+
+    def __init__(self, alpha: float = 0.05, warmup: int = 20,
+                 floor_frac: float = 0.02, one_sided: bool = True):
+        self.one_sided = bool(one_sided)
+        self.alpha = float(alpha)
+        self.warmup = int(warmup)
+        self.floor_frac = float(floor_frac)
+        self.mean = 0.0
+        self.var = 0.0
+        self.n = 0
+
+    def update(self, x) -> Optional[float]:
+        if x is None or not math.isfinite(float(x)):
+            return None
+        x = float(x)
+        z = None
+        if self.n >= self.warmup:
+            dev = (x - self.mean) if self.one_sided else abs(x - self.mean)
+            std = math.sqrt(max(self.var, 0.0))
+            floor = 1e-8 + self.floor_frac * abs(self.mean)
+            z = max(dev, 0.0) / max(std, floor)
+        if self.n == 0:
+            self.mean, self.var = x, 0.0
+        else:
+            a = self.alpha
+            delta = x - self.mean
+            self.mean += a * delta
+            self.var = (1.0 - a) * (self.var + a * delta * delta)
+        self.n += 1
+        return z
+
+    def state(self) -> dict:
+        return {"mean": self.mean, "std": math.sqrt(max(self.var, 0.0)),
+                "n": self.n}
+
+
+class HealthMonitor:
+    """Consumes per-step health summaries; dumps forensics on anomaly.
+
+    :param cfg: the ``trainer.health`` config dict (see module doc).
+    :param recorder: optional ``FlightRecorder`` — its trailing records
+        go into the anomaly bundle and it is flushed after a dump.
+    :param spans: optional ``SpanRecorder`` — active spans at dump time.
+    :param log_dir: where ``anomaly_<step>.json`` lands (None: no file,
+        e.g. non-main processes — detection/counters still run).
+
+    ``enqueue(step, device_metrics)`` defers the device fetch by one
+    step: the entry observed at step N was dispatched at step N-1, whose
+    buffers resolved while step N dispatched — so consuming the summary
+    never blocks the pipeline on the step just issued. ``drain()`` at
+    epoch end observes the final pending entry.
+    """
+
+    def __init__(self, cfg: Optional[dict] = None, recorder=None,
+                 spans=None, log_dir=None, layout=None):
+        cfg = dict(cfg or {})
+        self.layout = list(layout) if layout is not None else None
+        self.enabled = bool(cfg.get("enabled", True))
+        self.z_threshold = float(cfg.get("z_threshold", 8.0))
+        self.dump_last_n = int(cfg.get("dump_last_n", 32))
+        self.max_dumps = int(cfg.get("max_dumps", 8))
+        self.cooldown_steps = int(cfg.get("cooldown_steps", 25))
+        self.pause_best_promotion = bool(
+            cfg.get("pause_best_promotion", False)
+        )
+        alpha = float(cfg.get("ewma_alpha", 0.05))
+        warmup = int(cfg.get("warmup_steps", 20))
+        self.detectors = {
+            "loss": EwmaDetector(alpha, warmup),
+            # grad norm legitimately swings several-x during normal
+            # training (schedule phases, batch composition — measured
+            # 0.6 -> 4.3 on the bench TinyLM). floor_frac=1.0 makes the
+            # z-score count multiples of the running MEAN, so the soft
+            # trigger needs an ~order-of-magnitude explosion
+            # (> (1 + z_threshold) x EWMA), not a few sigmas of a
+            # quiet stretch
+            "grad_norm": EwmaDetector(alpha, warmup, floor_frac=1.0),
+        }
+        self.recorder = recorder
+        self.spans = spans
+        self.log_dir = Path(log_dir) if log_dir is not None else None
+        self.cfg = cfg
+        self.anomalies = 0          # fires this process
+        self.dumps_written = 0
+        self.last_anomaly_step: Optional[int] = None
+        self._last_dump_step: Optional[int] = None
+        self._last_note_step: Optional[int] = None
+        self._epoch_anomaly = False
+        self._pending: "collections.deque" = collections.deque()
+
+    # -- deferred per-step feed ---------------------------------------------
+
+    def enqueue(self, step: int, device_metrics: dict,
+                meta: Optional[dict] = None) -> None:
+        """Queue this step's (still on-device) health scalars; observe
+        the previously queued step (its buffers have resolved)."""
+        if not self.enabled:
+            return
+        self._pending.append((step, device_metrics, meta))
+        while len(self._pending) > 1:
+            self._observe_device(*self._pending.popleft())
+
+    def drain(self) -> None:
+        """Observe anything still pending (epoch end)."""
+        while self._pending:
+            self._observe_device(*self._pending.popleft())
+
+    def _observe_device(self, step, device_metrics, meta) -> None:
+        try:
+            import jax
+
+            fetched = jax.device_get(device_metrics)
+            if self.layout is not None and "health" in fetched:
+                summary = unpack_health_summary(fetched["health"],
+                                                self.layout)
+            else:  # pre-unpacked scalar dicts (tests, custom feeds)
+                summary = {k.replace("health/", "", 1): float(v)
+                           for k, v in fetched.items()}
+        except Exception:  # noqa: BLE001 — diagnostics must not crash
+            return
+        self.observe(step, summary, meta=meta)
+
+    # -- detection -----------------------------------------------------------
+
+    def observe(self, step: int, summary: dict,
+                meta: Optional[dict] = None) -> Optional[dict]:
+        """Run the detectors over one step's summary; returns the
+        anomaly dict when one fired (also written to disk), else None.
+
+        ``summary`` keys: ``loss``, ``grad_norm``, ``update_norm``,
+        ``nonfinite_grads``, ``nonfinite_params``, and per-group
+        ``nonfinite/<group>`` counts (all plain floats).
+        """
+        if not self.enabled:
+            return None
+        reasons = []
+        loss = summary.get("loss")
+        if loss is not None and not math.isfinite(float(loss)):
+            reasons.append({"kind": "nonfinite_loss", "value": repr(loss)})
+        for key in ("grad_norm", "update_norm"):
+            # hard trigger, not EWMA (the detector skips non-finite
+            # inputs and this path owns them): a norm can overflow f32
+            # to inf from FINITE elements (squares sum past ~3.4e38),
+            # in which case grad clipping silently zeroes every update
+            # — loss stays finite, counts stay 0, and without this
+            # check the run stalls with the health layer all-clear
+            v = summary.get(key)
+            if v is not None and not math.isfinite(float(v)):
+                reasons.append({"kind": f"nonfinite_{key}",
+                                "value": repr(v)})
+        for key in ("nonfinite_grads", "nonfinite_params"):
+            v = summary.get(key)
+            if v is not None and (not math.isfinite(float(v))
+                                  or float(v) > 0):
+                reasons.append({"kind": key, "count": float(v)})
+        zscores = {}
+        for name, det in self.detectors.items():
+            z = det.update(summary.get(name))
+            if z is not None:
+                zscores[name] = round(z, 2)
+                if z > self.z_threshold:
+                    reasons.append({
+                        "kind": f"{name}_zscore", "z": round(z, 2),
+                        "value": summary.get(name),
+                        "ewma": det.state(),
+                    })
+        if not reasons:
+            return None
+        return self._fire(step, summary, reasons, zscores, meta)
+
+    def _fire(self, step, summary, reasons, zscores, meta) -> dict:
+        self.anomalies += 1
+        self.last_anomaly_step = int(step)
+        self._epoch_anomaly = True
+        note_anomaly(step)
+        anomaly = {
+            "v": 1,
+            "step": int(step),
+            "t": round(time.time(), 3),
+            "reasons": reasons,
+            "summary": summary,
+            "zscores": zscores,
+            "detector": {k: d.state() for k, d in self.detectors.items()},
+            "config": self.cfg,
+        }
+        if meta:
+            anomaly.update(meta)
+        if self.spans is not None:
+            try:
+                anomaly["active_spans"] = self.spans.active_spans()
+            except Exception:  # noqa: BLE001
+                pass
+        if self.recorder is not None:
+            try:
+                anomaly["last_records"] = self.recorder.last(
+                    self.dump_last_n
+                )
+            except Exception:  # noqa: BLE001
+                pass
+        self._write_dump(step, anomaly)
+        # timeline note + tail fsync rate-limited by the SAME cooldown
+        # as the dumps: a persistent NaN streak under skip_nonfinite
+        # fires every step for the rest of the run, and an fsync per
+        # hot-loop step (ms-to-tens-of-ms on networked filesystems)
+        # would tax exactly the run the user asked to keep going.
+        # Counters still count every fire.
+        note_ok = (self._last_note_step is None
+                   or step - self._last_note_step >= self.cooldown_steps)
+        if self.recorder is not None and note_ok:
+            self._last_note_step = int(step)
+            try:
+                # the anomaly becomes a timeline event, and the JSONL
+                # tail is forced to disk — a crash right after a NaN
+                # must not lose the records that explain it
+                self.recorder.record(
+                    step, event="anomaly",
+                    reasons=json.dumps([r["kind"] for r in reasons]),
+                )
+                self.recorder.flush()
+            except Exception:  # noqa: BLE001
+                pass
+        return anomaly
+
+    def _write_dump(self, step, anomaly) -> None:
+        if self.log_dir is None:
+            return
+        if self.dumps_written >= self.max_dumps:
+            return
+        if (self._last_dump_step is not None
+                and step - self._last_dump_step < self.cooldown_steps):
+            return  # a NaN streak fires per step; don't flood the dir
+        try:
+            self.log_dir.mkdir(parents=True, exist_ok=True)
+            path = self.log_dir / f"anomaly_{int(step)}.json"
+            path.write_text(json.dumps(anomaly, default=repr))
+            self.dumps_written += 1
+            self._last_dump_step = int(step)
+            anomaly["dump_path"] = str(path)
+        except Exception:  # noqa: BLE001 — a full disk must not kill
+            pass           # the run the dump is diagnosing
+
+    # -- checkpoint-promotion gate -------------------------------------------
+
+    def promotion_allowed(self) -> bool:
+        """False while ``pause_best_promotion`` is set and the current
+        epoch saw an anomaly — a poisoned metric must not crown
+        ``model_best``."""
+        return not (self.pause_best_promotion and self._epoch_anomaly)
+
+    def epoch_start(self) -> None:
+        self._epoch_anomaly = False

@@ -140,11 +140,19 @@ class TraceCapture:
 
     :param log_dir: run log dir; traces land in its ``profile/`` subdir.
     :param start_step: first step included in the capture (global step).
-    :param num_steps: how many steps to capture.
+    :param num_steps: how many steps to capture (0: nothing scheduled —
+        but ``request()`` can still arm a capture at runtime).
 
     Call ``before_step(step)`` / ``after_step(step)`` around each train
-    step; idempotent and a no-op once the window has been captured or when
-    disabled (``num_steps == 0``).
+    step; idempotent and a no-op while no window is armed.
+
+    ``request(n)`` arms an ON-DEMAND n-step capture starting at the next
+    step — signal-handler-safe (it only assigns one attribute), which is
+    how ``train.py`` wires it to SIGUSR2: profile a live run exactly
+    when it misbehaves, no restart, no config edit. Each completed
+    capture bumps the process-wide ``profile_captures_total`` counter
+    (observability/health) and, when a recorder is attached, lands an
+    ``event: "profile_capture"`` record on the telemetry timeline.
     """
 
     def __init__(self, log_dir, start_step: int = 10, num_steps: int = 0):
@@ -153,9 +161,35 @@ class TraceCapture:
         self.num_steps = int(num_steps)
         self._active = False
         self._done = self.num_steps <= 0
+        self._requested: Optional[int] = None
+        self.captures = 0
+        self.recorder = None
+
+    def attach_recorder(self, recorder) -> None:
+        """Optional FlightRecorder that capture completions get noted on."""
+        self.recorder = recorder
+
+    def request(self, num_steps: int = 5) -> None:
+        """Arm an on-demand capture of ``num_steps`` steps starting at
+        the next ``before_step``. Safe from signal handlers / other
+        threads (single attribute write); ignored while a capture is
+        already in flight — a second SIGUSR2 during a slow capture must
+        not latch a surprise extra trace for after it closes."""
+        if self._active:
+            return
+        self._requested = max(int(num_steps), 1)
 
     def before_step(self, step: int) -> None:
-        if not self._done and not self._active and step >= self.start_step:
+        if self._active:
+            return
+        if self._requested is not None:
+            # runtime trigger: re-arm regardless of the config-scheduled
+            # window having been consumed
+            self.num_steps = self._requested
+            self._requested = None
+            self._done = False
+            self.start_step = step
+        if not self._done and step >= self.start_step:
             Path(self.dir).mkdir(parents=True, exist_ok=True)
             jax.profiler.start_trace(self.dir)
             self._active = True
@@ -171,9 +205,117 @@ class TraceCapture:
             jax.profiler.stop_trace()
             self._active = False
             self._done = True
+            self._note_capture(step)
+
+    def _note_capture(self, step: int) -> None:
+        self.captures += 1
+        try:
+            from .health import bump_counter
+
+            bump_counter("profile_captures_total")
+        except Exception:  # noqa: BLE001
+            pass
+        if self.recorder is not None:
+            try:
+                self.recorder.record(
+                    step, event="profile_capture",
+                    profile_dir=self.dir, profile_steps=self.num_steps,
+                )
+            except Exception:  # noqa: BLE001
+                pass
 
     def close(self) -> None:
         if self._active:
             jax.profiler.stop_trace()
             self._active = False
             self._done = True
+            self._note_capture(self.start_step + self.num_steps)
+
+
+def install_sigusr2(trace: TraceCapture, default_steps: int = 5) -> bool:
+    """SIGUSR2 -> ``trace.request(n)``: on-demand profiling of a live
+    training run (``kill -USR2 <pid>``). ``PDT_PROFILE_STEPS`` overrides
+    the window length. Returns False on platforms without SIGUSR2 or
+    when not called from the main thread (signal module restriction)."""
+    import signal
+
+    if not hasattr(signal, "SIGUSR2"):
+        return False
+
+    def _handler(signum, frame):
+        try:
+            n = int(os.environ.get("PDT_PROFILE_STEPS", default_steps))
+        except ValueError:
+            n = default_steps
+        trace.request(n)
+
+    try:
+        signal.signal(signal.SIGUSR2, _handler)
+        return True
+    except ValueError:  # not the main thread
+        return False
+
+
+class OnDemandProfiler:
+    """Progress-windowed on-demand capture for step-less processes
+    (serve.py's ``POST /profile?steps=N``).
+
+    The serving schedulers have no global step counter, but they DO have
+    monotonic progress counters (continuous engine: ``chunks``; static:
+    ``batches``/``requests``). ``capture()`` starts a ``jax.profiler``
+    trace, waits until ``progress_fn`` has advanced by ``steps`` (or
+    ``timeout_s`` passes — an idle server must not pin a request thread
+    forever), stops, and reports what it saw. One capture at a time:
+    concurrent callers get ``busy``.
+    """
+
+    def __init__(self, out_dir):
+        import threading
+
+        self.dir = str(Path(out_dir) / "profile")
+        self._lock = threading.Lock()
+        self.captures = 0
+
+    def capture(self, steps: int = 0, progress_fn=None,
+                timeout_s: float = 30.0, poll_s: float = 0.05) -> dict:
+        if not self._lock.acquire(blocking=False):
+            return {"busy": True,
+                    "error": "a profile capture is already running"}
+        try:
+            Path(self.dir).mkdir(parents=True, exist_ok=True)
+            jax.profiler.start_trace(self.dir)
+            t0 = time.monotonic()
+            base = progress_fn() if (progress_fn and steps > 0) else 0
+            seen, timed_out = 0, False
+            while progress_fn is not None and steps > 0:
+                seen = progress_fn() - base
+                if seen >= steps:
+                    break
+                if time.monotonic() - t0 > timeout_s:
+                    timed_out = True
+                    break
+                time.sleep(poll_s)
+            jax.profiler.stop_trace()
+            self.captures += 1
+            try:
+                from .health import bump_counter
+
+                bump_counter("profile_captures_total")
+            except Exception:  # noqa: BLE001
+                pass
+            return {
+                "profile_dir": self.dir,
+                "steps_requested": int(steps),
+                "steps_observed": int(seen),
+                "duration_s": round(time.monotonic() - t0, 3),
+                "timed_out": timed_out,
+                "captures_total": self.captures,
+            }
+        except Exception as e:  # noqa: BLE001 — surface, don't kill serve
+            try:
+                jax.profiler.stop_trace()
+            except Exception:  # noqa: BLE001
+                pass
+            return {"error": f"{type(e).__name__}: {e}"}
+        finally:
+            self._lock.release()

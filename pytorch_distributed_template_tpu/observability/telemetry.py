@@ -31,15 +31,45 @@ timeline instead of silently halving throughput.
 """
 from __future__ import annotations
 
+import atexit
 import collections
 import json
 import os
 import threading
 import time
+import weakref
 from pathlib import Path
 from typing import Optional
 
 SCHEMA_VERSION = 1
+
+# File-backed recorders register here so ONE atexit hook fsyncs every
+# live JSONL tail on interpreter exit — normal return, sys.exit, and
+# unhandled exceptions all run atexit, so a crashing run keeps its last
+# ring of records on disk without every caller remembering to flush().
+# (os._exit and SIGKILL bypass atexit; the watchdog's stall-path flush
+# covers the wedged-then-killed case.) WeakSet: registration must not
+# keep closed recorders alive.
+_live_recorders: "weakref.WeakSet" = weakref.WeakSet()
+_atexit_lock = threading.Lock()
+_atexit_installed = False
+
+
+def _flush_live_recorders() -> None:
+    for rec in list(_live_recorders):
+        try:
+            rec.flush()
+        except Exception:  # noqa: BLE001 — exit hooks must never raise
+            pass
+
+
+def _register_for_atexit(recorder) -> None:
+    global _atexit_installed
+    _live_recorders.add(recorder)
+    with _atexit_lock:
+        if not _atexit_installed:
+            _atexit_installed = True
+            atexit.register(_flush_live_recorders)
 
 # ---------------------------------------------------------------------------
 # host / device memory probes
@@ -232,6 +262,7 @@ class FlightRecorder:
             self.path = Path(run_dir) / filename
             self.path.parent.mkdir(parents=True, exist_ok=True)
             self._file = open(self.path, "a", buffering=1)  # line-buffered
+            _register_for_atexit(self)
         _install_compile_listener()
 
     # -- write ---------------------------------------------------------------

@@ -116,7 +116,8 @@ class StepWatchdog:
 
     def stall_report(self, stalled_s: float) -> dict:
         """The stall artifact: active spans (what the process is stuck
-        inside) + the trailing step records (what it was doing before)."""
+        inside), the trailing step records (what it was doing before),
+        and the host/device memory picture (was it dying of OOM?)."""
         report: dict = {"stalled_s": round(float(stalled_s), 1),
                         "t": time.time()}
         if self.spans is not None:
@@ -131,6 +132,24 @@ class StepWatchdog:
                 )
             except Exception:
                 pass
+        # memory probes: HBM high-water marks make OOM-adjacent stalls
+        # (allocator thrashing, a leak crossing bytes_limit) diagnosable
+        # post-mortem. Probes run on the monitor thread and never block
+        # on the wedged device path (memory_stats is a local runtime
+        # query); each guarded independently.
+        try:
+            from ..observability.telemetry import (
+                device_memory_stats, host_rss_bytes,
+            )
+
+            rss = host_rss_bytes()
+            if rss:
+                report["host_rss_mb"] = round(rss / 2**20, 1)
+            devices = device_memory_stats()
+            if devices:
+                report["devices"] = devices
+        except Exception:
+            pass
         return report
 
     def _dump_telemetry(self, stalled_s: float) -> None:
@@ -138,6 +157,14 @@ class StepWatchdog:
         diagnostics must not crash the run they diagnose."""
         if self.recorder is None and self.spans is None:
             return
+        if self.recorder is not None:
+            try:
+                # force the JSONL tail to disk FIRST: a stall often ends
+                # in an external SIGKILL, which runs no atexit hooks —
+                # this is the last guaranteed chance to persist the ring
+                self.recorder.flush()
+            except Exception:
+                pass
         try:
             report = self.stall_report(stalled_s)
             logger.error(

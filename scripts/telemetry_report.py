@@ -1,0 +1,439 @@
+#!/usr/bin/env python
+"""Offline telemetry analyzer + CI regression gate.
+
+Turns a run's observability artifacts — ``telemetry.jsonl`` (flight
+recorder), ``trace.json`` (host spans), ``anomaly_*.json`` (numerics
+forensics), and a bench final-line JSON — into one report, and gates CI
+on it:
+
+    # human/markdown report over a run dir
+    python scripts/telemetry_report.py --run-dir saved/<exp>/train/<id>
+
+    # bench-smoke regression gate: nonzero exit on regression
+    python scripts/telemetry_report.py --bench /tmp/bench.out \
+        --compare bench_baseline.json --tolerance 0.1
+
+Report fields (JSON with ``--json``, markdown otherwise):
+
+- steady-state steps/s, tokens/s, examples/s — computed over timed
+  records EXCLUDING the first step and any record carrying
+  ``compile_events`` (compilation is startup cost, not throughput);
+- mean MFU over the records that report it;
+- data-wait fraction (summed ``data_wait_ms`` / summed ``wall_ms``) —
+  the "is this run input-bound?" number;
+- compile-cache hit rate from the per-record cache hit/miss events;
+- anomaly count + straggler windows + per-host wall spread (from the
+  health layer's recorder events and ``hosts{}`` aggregates);
+- top host spans by total time (from ``trace.json``);
+- the bench final line's headline numbers.
+
+``--compare BASELINE`` compares the current bench JSON against a
+committed baseline: for each metric (default ``steps/s,tokens/s``) the
+gate fails (exit 1) when ``current < baseline * (1 - tolerance)``.
+Improvements and same-or-better runs pass; metrics missing from either
+side are reported and skipped. Exit codes: 0 ok, 1 regression, 2 usage
+or unreadable input.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+# metric name in the bench final line -> fallback path in its summary
+_BENCH_METRIC_FALLBACK = {
+    "steps/s": ("summary", "quick", "steps_per_sec"),
+    "tokens/s": ("summary", "quick", "tokens_per_sec"),
+}
+
+
+# ---------------------------------------------------------------------------
+# input loading
+# ---------------------------------------------------------------------------
+
+
+def load_jsonl(path) -> list:
+    records = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                try:
+                    records.append(json.loads(line))
+                except json.JSONDecodeError:
+                    pass  # a torn tail line (crash mid-write) is expected
+    return records
+
+
+def load_bench_json(path) -> dict:
+    """A bench final line from either a plain JSON file (the committed
+    baseline) or a captured stdout stream (``tee /tmp/bench.out``) —
+    whole-file parse first, else the LAST parseable stdout line (the
+    bench contract: the final stdout line is always the JSON)."""
+    text = Path(path).read_text()
+    try:
+        return json.loads(text)
+    except json.JSONDecodeError:
+        pass
+    for line in reversed(text.strip().splitlines()):
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            return json.loads(line)
+        except json.JSONDecodeError:
+            continue
+    raise ValueError(f"no parseable JSON line in {path}")
+
+
+# ---------------------------------------------------------------------------
+# analyzers
+# ---------------------------------------------------------------------------
+
+
+def analyze_telemetry(records: list) -> dict:
+    """Aggregate a flight-recorder timeline (see module doc)."""
+    out: dict = {"records": len(records)}
+    timed = [r for r in records if r.get("wall_ms")]
+    # steady state: drop the first timed record (compile / warm-install)
+    # and anything that carries compile events — those steps measure XLA,
+    # not the model
+    steady = [r for r in timed[1:] if not r.get("compile_events")]
+    out["steady_steps"] = len(steady)
+    if steady:
+        wall_s = sum(r["wall_ms"] for r in steady) / 1e3
+        out["steady_steps_per_sec"] = round(len(steady) / wall_s, 4)
+        tokens = sum(r.get("tokens", 0) for r in steady)
+        if tokens:
+            out["steady_tokens_per_sec"] = round(tokens / wall_s, 1)
+        examples = sum(r.get("examples", 0) for r in steady)
+        if examples:
+            out["steady_examples_per_sec"] = round(examples / wall_s, 1)
+        waits = [r["data_wait_ms"] for r in steady
+                 if r.get("data_wait_ms") is not None]
+        if waits:
+            out["data_wait_frac"] = round(
+                sum(waits) / (wall_s * 1e3), 4
+            )
+    mfus = [r["mfu"] for r in records if r.get("mfu") is not None]
+    if mfus:
+        out["mfu_mean"] = round(sum(mfus) / len(mfus), 4)
+    losses = [r["loss"] for r in records if r.get("loss") is not None]
+    if losses:
+        out["last_loss"] = losses[-1]
+    # compile picture: event counts + persistent-cache hit rate
+    compiles = hits = misses = 0
+    compile_ms = 0.0
+    for r in records:
+        for ev in r.get("compile_events") or []:
+            name = ev.get("event", "")
+            if name.endswith("cache_hits"):
+                hits += 1
+            elif name.endswith("cache_misses"):
+                misses += 1
+            elif "dur_ms" in ev:
+                compiles += 1
+                compile_ms += ev["dur_ms"]
+    out["compile_events"] = compiles
+    if compiles:
+        out["compile_ms_total"] = round(compile_ms, 1)
+    if hits + misses:
+        out["compile_cache_hit_rate"] = round(hits / (hits + misses), 3)
+    # health layer: anomaly / profile events, straggler windows, spread
+    out["anomalies"] = sum(
+        1 for r in records if r.get("event") == "anomaly"
+    )
+    out["profile_captures"] = sum(
+        1 for r in records if r.get("event") == "profile_capture"
+    )
+    straggler_windows = [r for r in records if r.get("straggler")]
+    out["straggler_windows"] = len(straggler_windows)
+    spreads = [r["wall_spread"] for r in records
+               if r.get("wall_spread") is not None]
+    if spreads:
+        out["host_wall_spread_max"] = max(spreads)
+        hosts = next(
+            (r["hosts"] for r in reversed(records) if r.get("hosts")),
+            None,
+        )
+        if hosts:
+            out["hosts"] = len(hosts)
+    rss = [r["host_rss_mb"] for r in records if r.get("host_rss_mb")]
+    if rss:
+        out["host_rss_mb_max"] = max(rss)
+    hbm_peak = 0
+    for r in records:
+        for stats in (r.get("devices") or {}).values():
+            hbm_peak = max(hbm_peak, int(stats.get("peak_bytes_in_use", 0)))
+    if hbm_peak:
+        out["hbm_peak_mb"] = round(hbm_peak / 2**20, 1)
+    return out
+
+
+def analyze_trace(path, top: int = 8) -> dict:
+    """Total host-span time by name from a Chrome trace-event file."""
+    try:
+        events = json.loads(Path(path).read_text()).get("traceEvents", [])
+    except (OSError, json.JSONDecodeError, AttributeError):
+        return {}
+    totals: dict = {}
+    for e in events:
+        if e.get("ph") != "X":
+            continue
+        t = totals.setdefault(e.get("name", "?"), [0.0, 0])
+        t[0] += e.get("dur", 0.0) / 1e3
+        t[1] += 1
+    spans = sorted(totals.items(), key=lambda kv: -kv[1][0])[:top]
+    return {
+        "events": len(events),
+        "top_spans": [
+            {"name": n, "total_ms": round(ms, 1), "count": c}
+            for n, (ms, c) in spans
+        ],
+    }
+
+
+def analyze_anomalies(run_dir) -> dict:
+    """Summarize the ``anomaly_*.json`` forensic bundles in a run dir."""
+    files = sorted(Path(run_dir).glob("anomaly_*.json"))
+    dumps = []
+    for f in files:
+        try:
+            a = json.loads(f.read_text())
+        except (OSError, json.JSONDecodeError):
+            continue
+        dumps.append({
+            "file": f.name,
+            "step": a.get("step"),
+            "reasons": [r.get("kind") for r in a.get("reasons", [])],
+        })
+    return {"dump_count": len(dumps), "dumps": dumps}
+
+
+def bench_headline(bench: dict) -> dict:
+    out = {}
+    for key in ("metric", "value", "unit", "steps/s", "tokens/s"):
+        if bench.get(key) is not None:
+            out[key] = bench[key]
+    return out
+
+
+# ---------------------------------------------------------------------------
+# regression gate
+# ---------------------------------------------------------------------------
+
+
+def _bench_metric(bench: dict, key: str):
+    v = bench.get(key)
+    if isinstance(v, (int, float)):
+        return float(v)
+    node = bench
+    for part in _BENCH_METRIC_FALLBACK.get(key, ()):
+        if not isinstance(node, dict):
+            return None
+        node = node.get(part)
+    return float(node) if isinstance(node, (int, float)) else None
+
+
+def compare(current: dict, baseline: dict, tolerance: float,
+            metrics=("steps/s", "tokens/s")) -> dict:
+    """Throughput gate: fail when current < baseline * (1 - tolerance).
+
+    Returns ``{"compared": [...], "regressions": [...],
+    "skipped": [...]}``; callers exit nonzero on any regression."""
+    compared, regressions, skipped = [], [], []
+    for key in metrics:
+        cur = _bench_metric(current, key)
+        base = _bench_metric(baseline, key)
+        if cur is None or base is None or base <= 0:
+            skipped.append({"metric": key, "current": cur,
+                            "baseline": base})
+            continue
+        floor = base * (1.0 - tolerance)
+        row = {
+            "metric": key,
+            "current": cur,
+            "baseline": base,
+            "floor": round(floor, 4),
+            "ratio": round(cur / base, 4),
+            "ok": cur >= floor,
+        }
+        compared.append(row)
+        if not row["ok"]:
+            regressions.append(row)
+    return {"compared": compared, "regressions": regressions,
+            "skipped": skipped}
+
+
+# ---------------------------------------------------------------------------
+# rendering
+# ---------------------------------------------------------------------------
+
+
+def to_markdown(report: dict) -> str:
+    lines = ["# Telemetry report", ""]
+
+    def table(title, d: dict):
+        if not d:
+            return
+        lines.append(f"## {title}")
+        lines.append("")
+        lines.append("| metric | value |")
+        lines.append("|---|---|")
+        for k, v in d.items():
+            if isinstance(v, (list, dict)):
+                continue
+            lines.append(f"| {k} | {v} |")
+        lines.append("")
+
+    table("Flight recorder", report.get("telemetry", {}))
+    tr = report.get("trace") or {}
+    if tr.get("top_spans"):
+        lines.append("## Host spans (top by total time)")
+        lines.append("")
+        lines.append("| span | total ms | count |")
+        lines.append("|---|---|---|")
+        for s in tr["top_spans"]:
+            lines.append(
+                f"| {s['name']} | {s['total_ms']} | {s['count']} |"
+            )
+        lines.append("")
+    an = report.get("anomalies") or {}
+    if an.get("dump_count"):
+        lines.append("## Anomaly dumps")
+        lines.append("")
+        for d in an["dumps"]:
+            lines.append(
+                f"- `{d['file']}` step {d['step']}: "
+                f"{', '.join(d['reasons'])}"
+            )
+        lines.append("")
+    table("Bench", report.get("bench", {}))
+    cmp_ = report.get("compare") or {}
+    if cmp_.get("compared") or cmp_.get("skipped"):
+        lines.append("## Regression gate")
+        lines.append("")
+        lines.append("| metric | current | baseline | floor | verdict |")
+        lines.append("|---|---|---|---|---|")
+        for row in cmp_.get("compared", []):
+            verdict = "ok" if row["ok"] else "**REGRESSION**"
+            lines.append(
+                f"| {row['metric']} | {row['current']} | "
+                f"{row['baseline']} | {row['floor']} | {verdict} |"
+            )
+        for row in cmp_.get("skipped", []):
+            lines.append(
+                f"| {row['metric']} | {row['current']} | "
+                f"{row['baseline']} | - | skipped |"
+            )
+        lines.append("")
+    return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        description="offline telemetry analyzer + regression gate"
+    )
+    p.add_argument("--run-dir", type=str, default=None,
+                   help="run directory: picks up telemetry.jsonl, "
+                        "trace.json and anomaly_*.json automatically")
+    p.add_argument("--telemetry", type=str, default=None,
+                   help="explicit telemetry.jsonl path")
+    p.add_argument("--trace", type=str, default=None,
+                   help="explicit trace.json path")
+    p.add_argument("--bench", type=str, default=None,
+                   help="bench output: final-line JSON file or a "
+                        "captured stdout stream (tee)")
+    p.add_argument("--compare", type=str, default=None, metavar="BASELINE",
+                   help="baseline bench JSON to gate against "
+                        "(requires --bench)")
+    p.add_argument("--tolerance", type=float, default=0.1,
+                   help="allowed fractional regression vs baseline "
+                        "(0.1 = fail below 90%% of baseline)")
+    p.add_argument("--metrics", type=str, default="steps/s,tokens/s",
+                   help="comma-separated bench metrics to gate on")
+    p.add_argument("--json", action="store_true",
+                   help="emit the report as JSON instead of markdown")
+    p.add_argument("--out", type=str, default=None,
+                   help="also write the report to this path")
+    args = p.parse_args(argv)
+
+    report: dict = {}
+    try:
+        tel_path = args.telemetry
+        run_dir = Path(args.run_dir) if args.run_dir else None
+        if tel_path is None and run_dir is not None:
+            cand = run_dir / "telemetry.jsonl"
+            tel_path = cand if cand.exists() else None
+        if tel_path is not None:
+            report["telemetry"] = analyze_telemetry(load_jsonl(tel_path))
+        trace_path = args.trace
+        if trace_path is None and run_dir is not None:
+            cand = run_dir / "trace.json"
+            trace_path = cand if cand.exists() else None
+        if trace_path is not None:
+            report["trace"] = analyze_trace(trace_path)
+        if run_dir is not None:
+            report["anomalies"] = analyze_anomalies(run_dir)
+        bench = None
+        if args.bench is not None:
+            bench = load_bench_json(args.bench)
+            report["bench"] = bench_headline(bench)
+    except (OSError, ValueError) as e:
+        print(f"telemetry_report: {e}", file=sys.stderr)
+        return 2
+    if not report and args.compare is None:
+        p.print_usage(sys.stderr)
+        print("telemetry_report: nothing to analyze (pass --run-dir, "
+              "--telemetry and/or --bench)", file=sys.stderr)
+        return 2
+
+    rc = 0
+    if args.compare is not None:
+        if bench is None:
+            print("telemetry_report: --compare requires --bench",
+                  file=sys.stderr)
+            return 2
+        try:
+            baseline = load_bench_json(args.compare)
+        except (OSError, ValueError) as e:
+            print(f"telemetry_report: baseline: {e}", file=sys.stderr)
+            return 2
+        metrics = [m.strip() for m in args.metrics.split(",") if m.strip()]
+        result = compare(bench, baseline, args.tolerance, metrics)
+        report["compare"] = result
+        if result["regressions"]:
+            rc = 1
+            for row in result["regressions"]:
+                print(
+                    f"REGRESSION: {row['metric']} = {row['current']} "
+                    f"< floor {row['floor']} "
+                    f"(baseline {row['baseline']}, "
+                    f"tolerance {args.tolerance})",
+                    file=sys.stderr,
+                )
+        elif not result["compared"]:
+            print("telemetry_report: no comparable metrics between "
+                  "current and baseline", file=sys.stderr)
+            return 2
+
+    rendered = (json.dumps(report, indent=2) if args.json
+                else to_markdown(report))
+    print(rendered)
+    if args.out:
+        try:
+            Path(args.out).write_text(rendered + "\n")
+        except OSError as e:
+            print(f"telemetry_report: --out: {e}", file=sys.stderr)
+            return 2
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -9,11 +9,18 @@ in a stdlib ``ThreadingHTTPServer``. No web framework, no deps.
 
     python serve.py -r saved/<lm>/train/<run>/model_best --port 8000
 
-    GET  /healthz             -> {"status": "ok", "arch": ..., ...}
+    GET  /healthz             -> {"status": "ok", "arch": ...,
+                              "last_anomaly_step": null | int, ...}
     GET  /metrics             -> Prometheus text exposition (request /
                               token / cancellation counters, queue
-                              depth, live slots, latency percentiles);
+                              depth, live slots, latency percentiles,
+                              anomaly / straggler-window / profile-
+                              capture totals);
                               ?format=json for the same as JSON
+    POST /profile?steps=N     -> on-demand jax.profiler capture windowed
+                              on the scheduler's progress counters
+                              (&timeout_s=S, default 30); responds when
+                              the capture closes, 409 if one is running
     POST /generate            body: {"prompt": "text"} or
                               {"prompt_ids": [1, 2, 3]}, optional
                               max_new_tokens / temperature / top_k /
@@ -92,6 +99,12 @@ from pytorch_distributed_template_tpu.engine.continuous import (  # noqa: E402
 from pytorch_distributed_template_tpu.engine.serving import (  # noqa: E402
     BatchedGenerationService, GenerationService, load_generation_stack,
 )
+from pytorch_distributed_template_tpu.observability.health import (  # noqa: E402
+    health_counters,
+)
+from pytorch_distributed_template_tpu.observability.profiler import (  # noqa: E402
+    OnDemandProfiler,
+)
 from pytorch_distributed_template_tpu.observability.telemetry import (  # noqa: E402
     compile_cache_stats,
 )
@@ -166,6 +179,12 @@ def service_metrics(service: GenerationService) -> dict:
     cache = compile_cache_stats()
     out["compile_cache_hits_total"] = int(cache["hits"])
     out["compile_cache_misses_total"] = int(cache["misses"])
+    # health-layer counters (observability/health): anomalies fired,
+    # straggler windows flagged, on-demand profiler captures taken
+    hc = health_counters()
+    out["anomaly_total"] = int(hc["anomaly_total"])
+    out["straggler_windows_total"] = int(hc["straggler_windows_total"])
+    out["profile_captures_total"] = int(hc["profile_captures_total"])
     return out
 
 
@@ -194,7 +213,7 @@ def prometheus_text(metrics: dict, prefix: str = "pdt_serve") -> str:
     return "\n".join(lines) + "\n"
 
 
-def make_handler(service: GenerationService):
+def make_handler(service: GenerationService, profiler=None):
     class Handler(BaseHTTPRequestHandler):
         def _send(self, code: int, payload: dict) -> None:
             body = json.dumps(payload).encode("utf-8")
@@ -230,13 +249,19 @@ def make_handler(service: GenerationService):
                 "vocab_size": service.vocab,
                 "tokenizer": service.tokenizer is not None,
                 "batching": getattr(service, "stats", None),
+                # null until a numerics anomaly fires (health layer)
+                "last_anomaly_step": health_counters()[
+                    "last_anomaly_step"],
             }
             if hasattr(service, "latency_percentiles"):
                 payload["latency"] = service.latency_percentiles()
             self._send(200, payload)
 
         def do_POST(self):  # noqa: N802
-            if self.path != "/generate":
+            path, _, query = self.path.partition("?")
+            if path == "/profile":
+                return self._profile(query)
+            if path != "/generate":
                 return self._send(404, {"error": "unknown path"})
             try:
                 n = int(self.headers.get("Content-Length", 0))
@@ -248,6 +273,53 @@ def make_handler(service: GenerationService):
                 self._send(400, {"error": str(e)})
             except Exception as e:  # surface, don't kill the server
                 self._send(500, {"error": f"{type(e).__name__}: {e}"})
+
+        def _profile(self, query: str) -> None:
+            """``POST /profile?steps=N[&timeout_s=S]``: on-demand
+            ``jax.profiler`` capture windowed on the scheduler's own
+            progress counters (continuous engine: chunk dispatches;
+            static: completed batches/requests) — the serving analogue
+            of the trainer's SIGUSR2 step window. Responds after the
+            capture closes (steps observed, or timeout on an idle
+            server); concurrent captures get 409."""
+            if profiler is None:
+                return self._send(
+                    503, {"error": "profiling not configured"})
+            from urllib.parse import parse_qsl
+
+            params = dict(parse_qsl(query))
+            try:
+                steps = int(params.get("steps", 8))
+                timeout_s = float(params.get("timeout_s", 30.0))
+            except ValueError as e:
+                return self._send(400, {"error": str(e)})
+
+            # ONE monotonic counter per scheduler type — summing
+            # overlapping stats (a completed request also advanced
+            # 'chunks' for every chunk it consumed; a static batch
+            # advances 'batches' AND N x 'requests') would close the
+            # window after far fewer scheduler steps than asked. The
+            # plain serialized service only counts tokens, so its
+            # "step" is a generated token.
+            stats = getattr(service, "stats", None) or {}
+            counter = next(
+                (k for k in ("chunks", "batches", "completed",
+                             "requests", "tokens_generated")
+                 if k in stats), None)
+            if steps > 0 and counter is None:
+                return self._send(503, {
+                    "error": "scheduler exposes no progress counter; "
+                             "use steps=0 for an immediate capture"})
+
+            def progress() -> int:
+                s = getattr(service, "stats", None) or {}
+                return int(s.get(counter, 0))
+
+            out = profiler.capture(steps=steps, progress_fn=progress,
+                                   timeout_s=timeout_s)
+            code = (409 if out.get("busy")
+                    else 500 if "error" in out else 200)
+            self._send(code, out)
 
         def _stream(self, req: dict) -> None:
             """Server-sent events: one ``data:`` line per absorbed
@@ -365,8 +437,11 @@ def main(args, config):
     else:  # plain serialized service
         service = probe
     logger.info("scheduler: %s", type(service).__name__)
+    # on-demand profiling (POST /profile): captures land next to the
+    # serving run's logs
+    profiler = OnDemandProfiler(config.save_dir)
     server = ThreadingHTTPServer(
-        (args.host, args.port), make_handler(service)
+        (args.host, args.port), make_handler(service, profiler=profiler)
     )
     logger.info(
         "serving %s (vocab %d%s) on http://%s:%d — POST /generate, "

@@ -1,0 +1,73 @@
+"""Worker for the two-process straggler-detection test
+(test_multihost.py::test_two_process_straggler_detection).
+
+Same rendezvous pattern as multihost_worker.py: two coordinated JAX
+CPU processes. Each rank fabricates a window of flight-recorder records
+with rank-dependent step wall times (rank 1 is the planted straggler at
+2x the rank-0 wall), then both run the CrossHostAggregator exchange —
+the real ``process_allgather`` collective over the gRPC/DCN seam — and
+assert the aggregate is identical on both hosts: two host entries,
+rank 1 flagged, spread ~2x. A second exchange with equal walls must NOT
+flag, and only process 0 bumps the straggler counter.
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+from pytorch_distributed_template_tpu.observability.crosshost import (
+    CrossHostAggregator,
+)
+from pytorch_distributed_template_tpu.observability.health import (
+    health_counters,
+)
+from pytorch_distributed_template_tpu.parallel import dist
+
+
+def fake_records(wall_ms: float, wait_ms: float, n: int = 8) -> list:
+    return [{"step": i, "wall_ms": wall_ms, "data_wait_ms": wait_ms}
+            for i in range(n)]
+
+
+def main():
+    dist.initialize()
+    rank = dist.process_index()
+    nprocs = dist.process_count()
+    assert nprocs == int(os.environ["NUM_PROCESSES"]), nprocs
+
+    agg = CrossHostAggregator({"threshold": 1.25},
+                              is_main=dist.is_main_process())
+    assert agg.enabled  # auto: multi-host => on
+
+    # --- straggler window: rank 1 runs steps at 2x rank 0's wall time
+    wall = 100.0 if rank == 0 else 200.0
+    out = agg.exchange(fake_records(wall, wait_ms=1.0 + rank))
+    assert out is not None
+    assert set(out["hosts"]) == {str(r) for r in range(nprocs)}, out
+    assert out["hosts"]["0"]["wall_ms"] == 100.0, out
+    assert out["hosts"]["1"]["wall_ms"] == 200.0, out
+    assert out.get("straggler") is True, out
+    assert out["straggler_hosts"] == [1], out
+    assert abs(out["wall_spread"] - 200.0 / 150.0) < 1e-6, out
+
+    # --- healthy window: equal walls, nobody flagged
+    out2 = agg.exchange(fake_records(120.0, wait_ms=0.5))
+    assert out2 is not None and "straggler" not in out2, out2
+
+    # counter bumps on process 0 only (it owns the telemetry record)
+    expected = 1 if rank == 0 else 0
+    got = health_counters()["straggler_windows_total"]
+    assert got == expected, (rank, got)
+    assert agg.straggler_windows == 1
+    assert agg.windows == 2
+
+    dist.synchronize("health-test-end")
+    print(f"MULTIHOST_HEALTH_OK rank={rank}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

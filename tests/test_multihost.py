@@ -18,6 +18,7 @@ import pytest
 
 WORKER = Path(__file__).parent / "multihost_worker.py"
 TRAIN_WORKER = Path(__file__).parent / "multihost_train_worker.py"
+HEALTH_WORKER = Path(__file__).parent / "multihost_health_worker.py"
 REPO = Path(__file__).parent.parent
 
 
@@ -78,6 +79,17 @@ def test_two_process_rendezvous_and_collectives(tmp_path):
     for rank, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"rank {rank} failed:\n{out[-3000:]}"
         assert f"MULTIHOST_OK rank={rank}" in out, out[-3000:]
+
+
+def test_two_process_straggler_detection():
+    """CrossHostAggregator over a REAL two-process process_allgather:
+    rank 1 fabricates 2x step walls, both ranks must compute the same
+    aggregate, rank 1 gets flagged, and only rank 0 bumps the
+    straggler counter (multihost_health_worker.py)."""
+    procs, outs = _spawn_pair(HEALTH_WORKER, timeout=240)
+    for rank, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"rank {rank} failed:\n{out[-3000:]}"
+        assert f"MULTIHOST_HEALTH_OK rank={rank}" in out, out[-3000:]
 
 
 def test_two_process_full_training(tmp_path):

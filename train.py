@@ -70,6 +70,21 @@ def main(args, config):
         mesh=mesh,
         seed=args.seed if args.seed is not None else 0,
     )
+
+    # on-demand profiling: `kill -USR2 <pid>` captures the next N steps
+    # (PDT_PROFILE_STEPS, default 5) as a jax.profiler trace into
+    # <log_dir>/profile — no restart, no config edit
+    from pytorch_distributed_template_tpu.observability.profiler import (
+        install_sigusr2,
+    )
+
+    if install_sigusr2(trainer.trace) and dist.is_main_process():
+        logger.info(
+            "SIGUSR2 armed: signal pid %d to capture an on-demand "
+            "profiler trace (PDT_PROFILE_STEPS=%s steps).",
+            os.getpid(), os.environ.get("PDT_PROFILE_STEPS", "5"),
+        )
+
     trainer.train()
 
 
