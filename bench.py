@@ -1602,6 +1602,100 @@ def bench_warm_start(platform: str = "") -> dict:
     }
 
 
+def bench_chaos(kill_step: int = 3, epochs: int = 1, batch: int = 16,
+                synthetic_n: int = 64, platform: str = "cpu") -> dict:
+    """Chaos rung (resilience subsystem): kill-and-recover, measured.
+
+    Drives ``scripts/supervise.py`` over a tiny ``train.py`` run with a
+    deterministic ``kill@step:N`` fault injected (resilience/faults) —
+    the first attempt is SIGKILLed mid-epoch, the supervisor classifies
+    the crash, backs off, relaunches with ``--auto-resume``, and the
+    resumed attempt fast-forwards to the exact next batch via the
+    checkpoint's ``data_state`` sidecar. The rung asserts the recovery
+    CONTRACT (exactly one restart, step-accurate final global step) and
+    reports time-to-recovery as the number. Children run on CPU like
+    the ``warm_start`` fallback arm: the parent may hold the
+    accelerator's exclusive lock, and the recovery mechanics under test
+    are platform-independent."""
+    import subprocess
+    import tempfile
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    len_epoch = synthetic_n // batch
+    target_step = epochs * len_epoch
+    with tempfile.TemporaryDirectory(prefix="bench-chaos-") as d:
+        events = os.path.join(d, "supervisor.jsonl")
+        env = dict(os.environ, PDT_FAULTS=f"kill@step:{kill_step}",
+                   JAX_PLATFORMS=platform)
+        cmd = [
+            sys.executable, os.path.join(repo, "scripts", "supervise.py"),
+            "--max-restarts", "3", "--restart-delay", "0.5",
+            "--jitter", "0", "--events-file", events,
+            "-c", os.path.join(repo, "configs", "mnist_debug.json"),
+            "-s", os.path.join(d, "save"), "--no-validate",
+            "--set", "trainer;epochs", str(epochs),
+            "--set", "trainer;save_period", "1",
+            "--set", "trainer;save_interval_steps", "2",
+            "--set", "train_loader;args;synthetic_n", str(synthetic_n),
+            "--set", "train_loader;args;batch_size", str(batch),
+        ]
+        t0 = time.perf_counter()
+        proc = subprocess.Popen(cmd, stdout=subprocess.PIPE,
+                                stderr=subprocess.PIPE, text=True, env=env)
+        _CHILD_PROCS.add(proc)
+        try:
+            _, err = proc.communicate(timeout=900)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.communicate()
+            raise RuntimeError("chaos supervisor timed out")
+        finally:
+            _CHILD_PROCS.discard(proc)
+        wall_s = time.perf_counter() - t0
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"chaos supervisor rc={proc.returncode}: {err[-800:]}")
+
+        from pytorch_distributed_template_tpu.resilience.supervisor import (
+            read_supervisor_stats,
+        )
+
+        stats = read_supervisor_stats(events)
+        if not stats["clean"] or stats["restarts_total"] != 1:
+            raise RuntimeError(f"chaos recovery contract violated: {stats}")
+        # time-to-recovery: first death -> clean completion (backoff +
+        # relaunch + resume fast-forward + the remaining steps)
+        events_list = [json.loads(ln) for ln in open(events)
+                       if ln.strip()]
+        t_exit = next(e["t"] for e in events_list if e["event"] == "exit")
+        t_clean = next(e["t"] for e in events_list
+                       if e["event"] == "clean")
+        # step-accurate resume: the resumed run's final epoch
+        # checkpoint must land on the uninterrupted target step
+        import glob as _glob
+
+        ds_files = _glob.glob(os.path.join(
+            d, "save", "*", "train", "*",
+            f"checkpoint-epoch{epochs}.data_state.json"))
+        if not ds_files:
+            raise RuntimeError("chaos: no final epoch checkpoint found")
+        with open(max(ds_files, key=os.path.getmtime)) as f:
+            final_step = int(json.load(f).get("global_step", -1))
+        if final_step != target_step:
+            raise RuntimeError(
+                f"chaos: resumed run ended at step {final_step}, "
+                f"uninterrupted target is {target_step}")
+    return {
+        "restarts": stats["restarts_total"],
+        "cause": stats["last_restart_cause"],
+        "final_step": final_step,
+        "target_step": target_step,
+        "time_to_recovery_s": round(t_clean - t_exit, 3),
+        "wall_s": round(wall_s, 3),
+        "platform": platform,
+    }
+
+
 def _recorder_timed_loop(state, step_fn, batch_arrays, recorder, n,
                          batch, seq, monitor=None, health_keys=()):
     """One timed window of ``n`` steps through the flight recorder;
@@ -1773,6 +1867,9 @@ _SUMMARY_KEYS = {
     # compile_speedup stays full-ladder-only: derivable from the pair
     "warm_start": ("cold_compile_s", "warm_compile_s",
                    "warm_new_compiles"),
+    # step-accuracy (final_step == target_step) is asserted inside the
+    # rung, so the summary only needs the recovery headline
+    "chaos": ("restarts", "time_to_recovery_s"),
     "resnet50": ("images_per_sec", "mfu"),
     "gpt2_small": ("tokens_per_sec", "mfu"),
     "vit_b16": ("images_per_sec", "mfu"),
@@ -1958,6 +2055,17 @@ _LADDER = [
     ("warm_start", [
         (bench_warm_start, {}),
         (bench_warm_start, {"platform": "cpu"}),
+    ]),
+    # chaos: kill@step -> supervisor restart -> step-accurate resume,
+    # end to end through scripts/supervise.py + train.py children
+    # (resilience subsystem); reports time-to-recovery. CPU children
+    # like warm_start's fallback arm — the parent may hold the
+    # accelerator lock and the mechanics are platform-independent
+    ("chaos", [
+        (bench_chaos, {}),
+        # fallback arm: 32/16 = 2 steps/epoch, so the kill must land
+        # strictly inside step range 0..1 to ever fire
+        (bench_chaos, {"kill_step": 1, "synthetic_n": 32}),
     ]),
     ("resnet50", [
         (bench_resnet50, {"batch": b}) for b in (128, 64, 32)

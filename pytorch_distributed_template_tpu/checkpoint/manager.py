@@ -31,6 +31,7 @@ import orbax.checkpoint as ocp
 
 from ..observability.trace import span
 from ..parallel import dist
+from ..resilience import faults
 
 logger = logging.getLogger(__name__)
 
@@ -64,14 +65,18 @@ class CheckpointManager:
     # -- save ---------------------------------------------------------------
 
     def save(self, epoch: int, state, arch: str, config: dict,
-             monitor_best: float, save_best: bool = False) -> Path:
+             monitor_best: float, save_best: bool = False,
+             data_state: Optional[dict] = None) -> Path:
         """Save ``checkpoint-epoch{epoch}`` (+ ``model_best`` if improved).
 
         All hosts participate in the array writes (orbax requirement for
         sharded state); host 0 writes the sidecar metadata. The reference's
         per-epoch policy (save_period gating, best tracking) stays in the
-        trainer — this method is the mechanism.
+        trainer — this method is the mechanism. ``data_state`` is the
+        step-accurate-resume sidecar (next batch, sampler cursor, RNG
+        fingerprint — resilience subsystem); None skips it.
         """
+        faults.on_checkpoint_save(epoch)
         path = self.checkpoint_dir / f"checkpoint-epoch{epoch}"
         meta = {
             "arch": arch,
@@ -87,6 +92,7 @@ class CheckpointManager:
             (self.checkpoint_dir / f"checkpoint-epoch{epoch}.meta.json").write_text(
                 json.dumps(meta, indent=2)
             )
+            self._write_data_state(path, data_state)
         logger.info("Saving checkpoint: %s ...", path)
         if save_best:
             # Wait for the epoch save to snapshot before re-saving the same
@@ -105,7 +111,8 @@ class CheckpointManager:
         return path
 
     def save_interval(self, epoch: int, step: int, state, arch: str,
-                      config: dict, monitor_best: float) -> Path:
+                      config: dict, monitor_best: float,
+                      data_state: Optional[dict] = None) -> Path:
         """Mid-epoch async save into alternating ``checkpoint-interval-a`` /
         ``-b`` slots.
 
@@ -118,6 +125,7 @@ class CheckpointManager:
         Two slots also mean a crash mid-write can never destroy the only
         mid-epoch checkpoint — the other slot is always complete.
         """
+        faults.on_checkpoint_save(epoch)
         if self._interval_ckptrs is None:
             self._interval_ckptrs = (ocp.StandardCheckpointer(),
                                      ocp.StandardCheckpointer())
@@ -140,8 +148,74 @@ class CheckpointManager:
             (self.checkpoint_dir / f"{path.name}.meta.json").write_text(
                 json.dumps(meta, indent=2)
             )
+            self._write_data_state(path, data_state)
         logger.info("Interval checkpoint: %s ...", path)
         return path
+
+    def save_emergency(self, epoch: int, state, arch: str, config: dict,
+                       monitor_best: float,
+                       data_state: Optional[dict] = None) -> Path:
+        """Best-effort last-breath save into ``checkpoint-emergency``.
+
+        Called from the trainer's unhandled-exception path (resilience
+        subsystem): a DEDICATED checkpointer (the main one may be
+        wedged mid-async-write — part of why we are dying), and a
+        blocking ``wait_until_finished`` because the process exits
+        right after — an async write would be torn. The ``emergency``
+        flag rides both sidecars so ``--auto-resume`` ranking and
+        ``scripts/inspect_checkpoint.py`` can tell it apart from a
+        planned save.
+        """
+        path = self.checkpoint_dir / "checkpoint-emergency"
+        meta = {
+            "arch": arch,
+            "epoch": epoch,
+            "monitor_best": _json_safe_best(monitor_best),
+            "config": config,
+            "emergency": True,
+        }
+        with span("checkpoint/save_emergency", epoch=epoch):
+            ck = ocp.StandardCheckpointer()
+            ck.save(path, _saveable(state), force=True)
+            ck.wait_until_finished()
+        self._tree_cache.pop(str(path), None)
+        if dist.is_main_process():
+            (self.checkpoint_dir / f"{path.name}.meta.json").write_text(
+                json.dumps(meta, indent=2)
+            )
+            if data_state is not None:
+                data_state = dict(data_state, emergency=True)
+            self._write_data_state(path, data_state)
+        logger.warning("Emergency checkpoint written: %s", path)
+        return path
+
+    def _write_data_state(self, path: Path, data_state: Optional[dict]):
+        """``<name>.data_state.json`` sidecar (main process only; the
+        caller gates). Tiny, so it is always written synchronously even
+        when the array write is async."""
+        if data_state is None:
+            return
+        try:
+            (path.parent / f"{path.name}.data_state.json").write_text(
+                json.dumps(data_state, indent=2)
+            )
+        except OSError:
+            logger.warning("could not write data_state sidecar for %s",
+                           path, exc_info=True)
+
+    @staticmethod
+    def load_data_state(resume_path) -> Optional[dict]:
+        """The step-accurate-resume sidecar next to a checkpoint, or
+        None (pre-resilience checkpoints have none — resume then falls
+        back to the old epoch-granular semantics)."""
+        resume_path = Path(resume_path)
+        cand = resume_path.parent / f"{resume_path.name}.data_state.json"
+        if cand.exists():
+            try:
+                return json.loads(cand.read_text())
+            except (OSError, ValueError):
+                logger.warning("unreadable data_state sidecar %s", cand)
+        return None
 
     def wait(self) -> None:
         with span("checkpoint/wait"):
@@ -188,9 +262,11 @@ class CheckpointManager:
                     "metadata sidecar.", path,
                 )
                 continue
-            meta = path.parent / f"{path.name}.meta.json"
-            if meta.exists():
-                meta.unlink()
+            for sidecar in (f"{path.name}.meta.json",
+                            f"{path.name}.data_state.json"):
+                cand = path.parent / sidecar
+                if cand.exists():
+                    cand.unlink()
             logger.info("Pruned old checkpoint: %s", path)
 
     def _ckpt_tree(self, path):

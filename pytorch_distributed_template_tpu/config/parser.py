@@ -238,8 +238,14 @@ def find_latest_checkpoint(config: dict):
                     cands.append(
                         (int(m.group(1)), 1, ck.stat().st_mtime, ck)
                     )
-            # mid-epoch A/B interval slots: epoch from the sidecar
-            for ck in run.glob("checkpoint-interval-[ab]"):
+            # mid-epoch A/B interval slots + the emergency save from an
+            # unhandled-exception exit: epoch from the sidecar. Both
+            # rank as "incomplete" (an epoch-edge checkpoint of the
+            # same epoch wins); among incompletes of one epoch, mtime
+            # decides — the emergency save at the crash moment is
+            # newest by construction
+            for ck in list(run.glob("checkpoint-interval-[ab]")) + list(
+                    run.glob("checkpoint-emergency")):
                 if not ck.is_dir():
                     continue
                 epoch = 0

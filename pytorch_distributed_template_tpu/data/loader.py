@@ -28,6 +28,7 @@ import numpy as np
 
 from . import native
 from ..observability.trace import span
+from ..resilience import faults
 from .sampler import ShardedSampler, epoch_permutation
 
 
@@ -139,10 +140,24 @@ class ArrayDataLoader:
         return idx, np.ones(len(idx), dtype=bool)
 
     def __iter__(self) -> Iterator[dict]:
+        return self.iter_batches()
+
+    def iter_batches(self, start_batch: int = 0) -> Iterator[dict]:
+        """Iterate the epoch's batches, optionally from batch ordinal
+        ``start_batch`` (step-accurate mid-epoch resume: the trainer
+        fast-forwards to the ``data_state`` sidecar's next batch
+        WITHOUT gathering the skipped batches — the permutation is a
+        pure function of ``(seed, epoch)``, so skipping index ranges
+        is exact). Also hosts the ``loader_raise`` fault hook
+        (resilience/faults), keyed by the epoch-absolute batch
+        ordinal."""
         idx, mask = self._epoch_indices()
         n = len(idx)
         end = (n // self.batch_size) * self.batch_size if self.drop_last else n
-        for start in range(0, end, self.batch_size):
+        for bi, start in enumerate(range(0, end, self.batch_size)):
+            if bi < start_batch:
+                continue  # cheap: no gather for fast-forwarded batches
+            faults.on_loader_batch(bi, loader=self)
             stop = min(start + self.batch_size, end)
             batch_idx = idx[start:stop]
             batch_mask = mask[start:stop]

@@ -83,6 +83,21 @@ class ShardedSampler:
         positions = np.arange(self.shard_index, self.total_size, self.num_shards)
         return positions < self.num_samples
 
+    def state(self) -> dict:
+        """Serializable shard cursor for the checkpoint ``data_state``
+        sidecar (resilience subsystem): everything needed to prove a
+        resumed run reconstructs this shard's exact order — the order
+        itself is a pure function of ``(seed, epoch)``, so no index
+        arrays travel, only the knobs that derive them."""
+        return {
+            "num_samples": self.num_samples,
+            "num_shards": self.num_shards,
+            "shard_index": self.shard_index,
+            "shuffle": self.shuffle,
+            "seed": self.seed,
+            "epoch": self.epoch,
+        }
+
     def __iter__(self):
         return iter(self.indices())
 

@@ -55,7 +55,8 @@ def make_train_step(model, tx, criterion: Callable,
                     mixup_alpha: float = 0.0,
                     log_grad_norm: bool = False,
                     trainable_patterns=None,
-                    health: bool = False):
+                    health: bool = False,
+                    inject_nan_grad_step=None):
     """Build ``train_step(state, batch) -> (state, metrics)``.
 
     ``metrics`` holds scalar sums + count; callers divide after accumulating
@@ -98,6 +99,14 @@ def make_train_step(model, tx, criterion: Callable,
     zeroing so a suppressed step still reports the non-finite counts
     that got it suppressed (that report is the whole point). Callers
     strip the ``health`` key out of the epoch accumulator.
+
+    ``inject_nan_grad_step`` (resilience/faults ``nan_grad@step:N``):
+    when set, every gradient leaf is NaN-poisoned at exactly that
+    global step via a branchless in-graph select on ``state.step`` —
+    the deterministic trigger for the numerics-forensics /
+    ``skip_nonfinite`` recovery paths. Injected BEFORE normalization,
+    clipping, and the health capture, so the poisoned step looks
+    exactly like a real gradient blow-up to every detector downstream.
 
     ``mixup_alpha > 0`` enables mixup (Zhang et al. 2018) in-graph: one
     Beta(alpha, alpha) draw per step mixes the batch with a random
@@ -233,6 +242,15 @@ def make_train_step(model, tx, criterion: Callable,
                 body, (state.batch_stats, zeros_g, zeros_m), micro
             )
             loss_sum, count = metrics["loss_sum"], metrics["count"]
+
+        if inject_nan_grad_step is not None:
+            poison = jnp.where(
+                state.step == jnp.int32(inject_nan_grad_step),
+                jnp.float32(jnp.nan), jnp.float32(0.0),
+            )
+            grads = jax.tree.map(
+                lambda g: g + poison.astype(g.dtype), grads
+            )
 
         # Normalize the summed gradients by the global valid count (matches
         # grad-of-mean on the full batch exactly).

@@ -20,7 +20,9 @@ Key structural translation (SURVEY.md §3.1 hot loop -> jit):
 """
 from __future__ import annotations
 
+import hashlib
 import math
+import os
 import time
 from abc import abstractmethod
 from collections import deque
@@ -46,6 +48,7 @@ from ..observability.profiler import (
     ThroughputMeter, TraceCapture, compiled_flops, mfu,
 )
 from ..parallel import batch_sharding, dist, mesh_from_config
+from ..resilience import faults
 from ..utils import preemption
 from ..utils.debug import configure_debug
 from ..utils.util import maybe_tqdm
@@ -106,6 +109,10 @@ class BaseTrainer:
                 self.early_stop = math.inf
 
         self.start_epoch = 1
+        # (epoch, next_batch) cursor maintained by the batch loop —
+        # what the data_state sidecar and the emergency save record
+        self._cursor = None
+        self._resume_next_batch = 0
         self.checkpoint_dir = config.save_dir
         self.ckpt_manager = CheckpointManager(self.checkpoint_dir)
         self.writer = TensorboardWriter(
@@ -187,6 +194,14 @@ class BaseTrainer:
                             "epochs. Training stops.", self.early_stop,
                         )
                     break
+        except Exception as exc:
+            # unhandled-exception emergency checkpoint (resilience
+            # subsystem): land the live state + data_state before the
+            # process dies, so the supervisor's relaunch resumes at the
+            # exact next batch instead of the last periodic save. The
+            # original exception always propagates.
+            self._emergency_save(exc)
+            raise
         finally:
             # stop the watchdog FIRST: no steps run past this point, and
             # the async checkpoint flush below can legitimately take
@@ -194,7 +209,15 @@ class BaseTrainer:
             watchdog = getattr(self, "watchdog", None)
             if watchdog is not None:
                 watchdog.stop()
-            self.ckpt_manager.wait()
+            if watchdog is not None:
+                # the final flush can legitimately outlast the
+                # supervisor's hang timeout; keep the external
+                # heartbeat alive so a healthy finishing run is not
+                # SIGKILLed mid-checkpoint-write
+                with watchdog.heartbeat_keepalive():
+                    self.ckpt_manager.wait()
+            else:
+                self.ckpt_manager.wait()
             trace = getattr(self, "trace", None)
             if trace is not None:
                 trace.close()  # flush a still-open profiler window
@@ -250,6 +273,93 @@ class BaseTrainer:
     def _save_checkpoint(self, epoch: int, save_best: bool = False) -> None:
         raise NotImplementedError
 
+    # -- resilience: emergency save + data_state sidecar --------------------
+
+    def _data_state_snapshot(self) -> Optional[dict]:
+        """The step-accurate-resume sidecar for the state being saved:
+        where the NEXT batch after this checkpoint lives (epoch +
+        batch ordinal, normalized past epoch edges), plus the sampler
+        cursor and an RNG fingerprint for forensics. None when the
+        trainer has no cursor yet (nothing ran)."""
+        if self._cursor is None:
+            return None
+        epoch, next_batch = self._cursor
+        len_epoch = int(getattr(self, "len_epoch", 0) or 0)
+        if len_epoch and next_batch >= len_epoch:
+            epoch, next_batch = epoch + 1, 0
+        ds = {
+            "epoch": int(epoch),
+            "next_batch": int(next_batch),
+            "len_epoch": len_epoch,
+        }
+        state = getattr(self, "state", None)
+        if state is not None:
+            try:
+                import jax as _jax
+
+                ds["global_step"] = int(_jax.device_get(state.step))
+                key_bytes = np.asarray(
+                    _jax.device_get(_jax.random.key_data(state.rng))
+                ).tobytes()
+                ds["rng_fingerprint"] = hashlib.sha256(
+                    key_bytes).hexdigest()[:12]
+            except Exception:  # sidecar forensics must not block a save
+                pass
+        loader = getattr(self, "train_loader", None)
+        if loader is not None:
+            ds["batch_size"] = int(getattr(loader, "batch_size", 0))
+            sampler = getattr(loader, "sampler", None)
+            if sampler is not None and hasattr(sampler, "state"):
+                ds["sampler"] = sampler.state()
+            else:
+                ds["shuffle"] = bool(getattr(loader, "shuffle", False))
+                ds["data_seed"] = int(getattr(loader, "seed", 0))
+        return ds
+
+    def _emergency_save(self, exc: Exception) -> None:
+        """Best-effort checkpoint on the unhandled-exception path.
+
+        Skipped when (a) disabled (``trainer.emergency_checkpoint:
+        false``), (b) the exception IS a checkpoint-write fault
+        (re-entering the failing checkpointer would double-fault), or
+        (c) there is no state yet. Never raises — the original
+        exception is the story, this is just the save of what survives
+        it."""
+        if not bool(self.config["trainer"].get("emergency_checkpoint",
+                                               True)):
+            return
+        if getattr(exc, "is_checkpoint_fault", False):
+            self.logger.warning(
+                "Emergency checkpoint SKIPPED: the failure is the "
+                "checkpoint path itself (%s).", exc,
+            )
+            return
+        state = getattr(self, "state", None)
+        model = getattr(self, "model", None)
+        if state is None or self._cursor is None:
+            return
+        try:
+            self.ckpt_manager.save_emergency(
+                epoch=self._cursor[0],
+                state=state,
+                arch=type(model).__name__ if model is not None else "?",
+                config=dict(self.config.config),
+                monitor_best=(
+                    self.mnt_best
+                    if isinstance(self.mnt_best, (int, float)) else 0.0
+                ),
+                data_state=self._data_state_snapshot(),
+            )
+            self.logger.warning(
+                "Emergency checkpoint saved after %s: %s",
+                type(exc).__name__, exc,
+            )
+        except Exception:  # noqa: BLE001 — never mask the original error
+            self.logger.warning(
+                "Emergency checkpoint failed (original error propagates)",
+                exc_info=True,
+            )
+
 
 class Trainer(BaseTrainer):
     """Concrete trainer (reference trainer/trainer.py:11-123), jit-compiled.
@@ -269,6 +379,17 @@ class Trainer(BaseTrainer):
                  mesh=None, seed: int = 0):
         super().__init__(config)
         configure_debug(config["trainer"].get("debug"))
+        # deterministic fault plan (resilience/faults): PDT_FAULTS env
+        # wins over the ``trainer.faults`` config string; installed per
+        # trainer build so one-shot faults re-arm for each fresh run
+        faults.install_from_env_or_config(
+            config["trainer"].get("faults")
+        )
+        # loader_raise targets the TRAIN input pipeline specifically —
+        # the validation loader reaching the same batch ordinal first
+        # must not consume the one-shot spec
+        faults.watch_loader(train_loader)
+        self._seed = int(seed)
         self.mesh = mesh if mesh is not None else mesh_from_config(config)
         model = inject_mesh(model, self.mesh)
         self.model = model
@@ -347,6 +468,14 @@ class Trainer(BaseTrainer):
             )
             if restored_best is not None:
                 self.mnt_best = restored_best
+            # step-accurate resume (resilience subsystem): the
+            # data_state sidecar overrides the epoch-granular
+            # ``meta.epoch + 1`` with the exact (epoch, next_batch)
+            # the checkpointed state stopped at
+            if bool(config["trainer"].get("step_accurate_resume", True)):
+                self._apply_data_state(
+                    CheckpointManager.load_data_state(config.resume)
+                )
         elif config["trainer"].get("init_from"):
             # params-only warm start (``trainer.init_from`` in the JSON or
             # --set): graft matching param leaves from a checkpoint into
@@ -417,6 +546,8 @@ class Trainer(BaseTrainer):
                 "trainable"
             ),
             health=self._health_enabled,
+            # in-graph deterministic fault (nan_grad@step:N), or None
+            inject_nan_grad_step=faults.nan_grad_step(),
         )
         metric_sharding = jax.sharding.NamedSharding(
             self.mesh, jax.sharding.PartitionSpec()
@@ -563,6 +694,12 @@ class Trainer(BaseTrainer):
             # stall_dump.json; every host still dumps stacks to stderr
             dump_path=(config.log_dir / "stall_dump.json"
                        if dist.is_main_process() else None),
+            # supervisor liveness: the same beat the stall monitor uses
+            # also touches the heartbeat file the resilience supervisor
+            # watches from outside (PDT_HEARTBEAT_FILE exported by
+            # scripts/supervise.py; trainer.heartbeat_file otherwise)
+            heartbeat_path=(os.environ.get("PDT_HEARTBEAT_FILE")
+                            or config["trainer"].get("heartbeat_file")),
         )
 
     def _metric_keys(self):
@@ -570,15 +707,68 @@ class Trainer(BaseTrainer):
             f"{m.__name__}_sum" for m in self.metric_ftns
         ]
 
+    # -- resilience: step-accurate resume -----------------------------------
+
+    def _apply_data_state(self, ds: Optional[dict]) -> None:
+        """Turn a checkpoint's ``data_state`` sidecar into a mid-epoch
+        resume point: ``start_epoch`` becomes the in-flight epoch and
+        ``_batches`` fast-forwards its first epoch to ``next_batch``.
+        Falls back (with a warning) to the epoch-granular semantics
+        when the sidecar is absent, the run is iteration-based
+        (endless loader: batch ordinals are not stable coordinates),
+        or the data geometry changed under the checkpoint."""
+        if not ds:
+            return
+        if self._train_iter is not None:
+            self.logger.warning(
+                "data_state present but len_epoch (iteration-based) "
+                "training resumes at epoch granularity."
+            )
+            return
+        if (int(ds.get("len_epoch", self.len_epoch)) != self.len_epoch
+                or int(ds.get("batch_size",
+                              self.train_loader.batch_size))
+                != self.train_loader.batch_size):
+            self.logger.warning(
+                "data_state geometry mismatch (checkpoint len_epoch=%s/"
+                "batch_size=%s vs current %s/%s); resuming at epoch "
+                "granularity.", ds.get("len_epoch"), ds.get("batch_size"),
+                self.len_epoch, self.train_loader.batch_size,
+            )
+            return
+        epoch = int(ds.get("epoch", self.start_epoch))
+        next_batch = int(ds.get("next_batch", 0))
+        if next_batch >= self.len_epoch:  # normalized at save, but be safe
+            epoch, next_batch = epoch + 1, 0
+        self.start_epoch = epoch
+        self._resume_next_batch = next_batch
+        if next_batch and dist.is_main_process():
+            self.logger.info(
+                "Step-accurate resume: continuing epoch %d at batch %d "
+                "(global step %s).", epoch, next_batch,
+                ds.get("global_step", "?"),
+            )
+
     # -- epoch loops --------------------------------------------------------
 
     def _batches(self, epoch: int):
+        # mid-epoch fast-forward applies exactly once: to the resumed
+        # epoch itself (the ordinal skip is exact because the epoch
+        # permutation is a pure function of (seed, epoch))
+        skip = (self._resume_next_batch
+                if epoch == self.start_epoch else 0)
         if self._train_iter is not None:
             for i in range(self.len_epoch):
                 yield i, next(self._train_iter)
         else:
             self.train_loader.set_epoch(epoch)
-            yield from enumerate(self.train_loader)
+            if skip and hasattr(self.train_loader, "iter_batches"):
+                it = self.train_loader.iter_batches(start_batch=skip)
+            else:
+                it = iter(self.train_loader)
+                for _ in range(skip):  # generic-iterable fallback
+                    next(it, None)
+            yield from enumerate(it, start=skip)
 
     def _train_epoch(self, epoch: int) -> dict:
         self.train_metrics.reset()
@@ -618,7 +808,12 @@ class Trainer(BaseTrainer):
         # compile time or epoch 1 will false-alarm
         self.watchdog.start()
         batches_it = iter(prefetched)
-        batch_idx = -1
+        # resumed mid-epoch: batch ordinals continue from the resume
+        # point (the generator under `batches` already fast-forwarded)
+        start_batch = (self._resume_next_batch
+                       if epoch == self.start_epoch else 0)
+        self._cursor = (epoch, start_batch)
+        batch_idx = start_batch - 1
         # Sync-free stepping: log-step metric fetches are DEFERRED by one
         # log window. The entry enqueued at step N is completed at step
         # N + log_step, when its device buffers have long resolved — so
@@ -641,9 +836,16 @@ class Trainer(BaseTrainer):
             data_wait_ms = (time.perf_counter() - t_wait) * 1e3
             batch_idx += 1
             step = (epoch - 1) * self.len_epoch + batch_idx
+            # deterministic fault hook (resilience/faults): slow_host /
+            # crash / kill fire HERE, before the step dispatches, so
+            # kill@step:N means exactly N completed steps
+            faults.on_step(step)
             self.trace.before_step(step)
             with span("train/step", step=step):
                 self.state, m = self._train_step(self.state, batch)
+            # the dispatched step completes on-device even if the host
+            # dies after this point: the cursor counts it done
+            self._cursor = (epoch, batch_idx + 1)
             self.trace.after_step(step, sync=m)
             self.watchdog.beat()
             if self._health_keys:
@@ -671,13 +873,15 @@ class Trainer(BaseTrainer):
                 rec["tokens"] = (self._tokens_per_example
                                  * self.train_loader.batch_size)
 
-            if batch_idx == 0 and not self._first_step_timed:
+            if not self._first_step_timed:
                 # The run's first step carries the compile (or the AOT
                 # warm-install) cost: exclude it from steady-state
                 # meters UNCONDITIONALLY — this used to happen only
                 # under the profiler, so unprofiled runs reported a
                 # steps_per_sec that silently averaged in the compile
-                # step. (batch_idx gate so resumed runs re-latch too.)
+                # step. (Keyed on the latch alone, not batch_idx == 0:
+                # a step-accurate resume enters mid-epoch, where the
+                # first — compiling — step has a nonzero ordinal.)
                 self._first_step_timed = True
                 if self.profile_enabled:
                     # one AOT cost analysis of the compiled step; the
@@ -749,6 +953,7 @@ class Trainer(BaseTrainer):
                         self.mnt_best
                         if isinstance(self.mnt_best, (int, float)) else 0.0
                     ),
+                    data_state=self._data_state_snapshot(),
                 )
                 if main:
                     self.logger.info(
@@ -938,6 +1143,9 @@ class Trainer(BaseTrainer):
                 self.mnt_best if isinstance(self.mnt_best, (int, float)) else 0.0
             ),
             save_best=save_best,
+            # completed epoch ⇒ (epoch+1, batch 0); preemption-cut
+            # epoch ⇒ the exact mid-epoch next batch (the cursor knows)
+            data_state=self._data_state_snapshot(),
         )
         keep = int(self.config["trainer"].get("keep_last", 0))
         if keep > 0:

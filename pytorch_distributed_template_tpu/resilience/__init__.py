@@ -1,0 +1,25 @@
+"""Resilience subsystem: supervisor, deterministic fault injection,
+emergency checkpoints, step-accurate resume.
+
+Three cooperating layers (docs/RESILIENCE.md):
+
+- :mod:`.supervisor` — an in-process replacement for the old bash
+  relaunch loop: spawns ``train.py``, classifies exits (clean /
+  preemption / crash / hang), enforces a restart budget with
+  exponential backoff + jitter and a rolling crash-loop window, detects
+  hangs via the trainer's heartbeat file, and logs every lifecycle
+  event as JSONL (``supervisor.jsonl``). Stdlib-only: importing it must
+  never pull in jax (the supervisor process manages jax processes, it
+  is not one).
+- :mod:`.faults` — a config/env-driven deterministic fault plan
+  (``PDT_FAULTS="kill@step:120;nan_grad@step:40;..."``) with hook
+  points in the trainer loop, the compiled train step, the data
+  loader, and the checkpoint manager, so every recovery path is
+  exercisable on demand in tests, the bench ``chaos`` rung, and CI.
+- step-accurate resume — checkpoints gain a ``data_state`` sidecar
+  (next batch, sampler cursor, RNG fingerprint) written on interval,
+  epoch, preemption, and emergency paths; the trainer fast-forwards
+  the loader to the exact next batch on resume
+  (``checkpoint/manager.py`` + ``engine/trainer.py``).
+"""
+from .supervisor import EXIT_PREEMPTED  # noqa: F401

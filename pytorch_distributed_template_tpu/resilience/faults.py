@@ -1,0 +1,364 @@
+"""Deterministic fault injection: a parsed plan + cheap hook points.
+
+Nothing in a training framework's recovery story is real until a test
+can *make* the failure happen: this module turns a one-line plan into
+deterministic faults fired at exact steps/batches/epochs, so the
+supervisor restart path, the emergency checkpoint, the health
+monitor's NaN forensics, the non-finite guard, and the watchdog can
+all be exercised on demand (tests, the bench ``chaos`` rung, the CI
+``chaos-smoke`` job) instead of waiting for production to break.
+
+Plan grammar (``;``-separated specs)::
+
+    PDT_FAULTS="kill@step:120;nan_grad@step:40;slow_host@step:30:2.5s;\
+loader_raise@batch:7;ckpt_write_fail@epoch:2"
+
+    <kind>@<unit>:<at>[:<arg>][@attempt:<n|any>]
+
+Kinds and their designated detectors/recovery (docs/RESILIENCE.md has
+the full failure matrix):
+
+===============  ======  ==========================================
+kind             unit    effect at the hook point
+===============  ======  ==========================================
+``kill``         step    SIGKILL this process *before* dispatching
+                         the step (hard crash / preemption without
+                         notice; nothing flushes, by design)
+``crash``        step    raise :class:`FaultInjected` before the
+                         step (unhandled-exception path → emergency
+                         checkpoint → supervisor restart)
+``nan_grad``     step    poison every gradient leaf with NaN inside
+                         the compiled step (health monitor +
+                         ``skip_nonfinite`` guard path); injected at
+                         trace time via ``state.step == at``
+``slow_host``    step    ``time.sleep(arg)`` before the step (host
+                         straggler / hang; arg like ``2.5s``/``250ms``,
+                         default 1s) — trips the watchdog and, when
+                         long enough, the supervisor's heartbeat
+                         hang detection
+``loader_raise`` batch   raise from the data loader at per-epoch
+                         batch index ``at`` (input-pipeline failure)
+``ckpt_write_fail`` epoch raise from ``CheckpointManager.save``/
+                         ``save_interval`` at epoch ``at``; flagged
+                         ``is_checkpoint_fault`` so the emergency
+                         path knows NOT to re-enter the checkpointer
+===============  ======  ==========================================
+
+Attempt gating: each spec fires only on one supervisor attempt
+(default the first), so a ``kill@step:5`` chaos run dies once and the
+restarted attempt — the supervisor exports ``PDT_ATTEMPT=n`` — sails
+past the same step. ``@attempt:any`` disables the gate. Every spec
+additionally fires at most once per process.
+
+Stdlib-only on purpose: the supervisor and the loader hook import this
+module, and neither should drag jax in. The one in-graph fault
+(``nan_grad``) is compiled by ``engine/steps.py`` from the plain int
+this module hands it.
+"""
+from __future__ import annotations
+
+import logging
+import os
+import re
+import signal
+import sys
+import time
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+logger = logging.getLogger(__name__)
+
+# kind -> the trigger unit its hook point understands
+KINDS = {
+    "kill": "step",
+    "crash": "step",
+    "nan_grad": "step",
+    "slow_host": "step",
+    "loader_raise": "batch",
+    "ckpt_write_fail": "epoch",
+}
+
+ENV_PLAN = "PDT_FAULTS"
+ENV_ATTEMPT = "PDT_ATTEMPT"
+
+
+class FaultInjected(RuntimeError):
+    """An injected fault firing as an exception.
+
+    ``is_checkpoint_fault`` marks faults raised from inside the
+    checkpoint manager — the trainer's emergency-save path skips the
+    save when the checkpointer itself is the thing that failed.
+    """
+
+    def __init__(self, spec: "FaultSpec", message: str):
+        super().__init__(message)
+        self.kind = spec.kind
+        self.spec = spec
+        self.is_checkpoint_fault = spec.kind == "ckpt_write_fail"
+
+
+_DURATION = re.compile(r"^(\d+(?:\.\d+)?)(ms|s)?$")
+
+
+def _parse_duration_s(text: str) -> float:
+    m = _DURATION.match(text)
+    if not m:
+        raise ValueError(f"bad duration {text!r} (want e.g. '2.5s', '250ms')")
+    value = float(m.group(1))
+    return value / 1e3 if m.group(2) == "ms" else value
+
+
+@dataclass
+class FaultSpec:
+    kind: str
+    unit: str
+    at: int
+    arg: Optional[str] = None
+    attempt: Optional[int] = 1     # None = any attempt
+    fired: bool = field(default=False, compare=False)
+
+    @property
+    def duration_s(self) -> float:
+        return _parse_duration_s(self.arg) if self.arg else 1.0
+
+    def describe(self) -> str:
+        out = f"{self.kind}@{self.unit}:{self.at}"
+        if self.arg:
+            out += f":{self.arg}"
+        if self.attempt != 1:
+            out += f"@attempt:{self.attempt if self.attempt else 'any'}"
+        return out
+
+
+@dataclass
+class FaultPlan:
+    specs: List[FaultSpec] = field(default_factory=list)
+
+    @classmethod
+    def parse(cls, text: Optional[str]) -> "FaultPlan":
+        specs: List[FaultSpec] = []
+        for token in (text or "").split(";"):
+            token = token.strip()
+            if not token:
+                continue
+            parts = token.split("@")
+            if len(parts) < 2:
+                raise ValueError(
+                    f"bad fault spec {token!r}: want kind@unit:at[:arg]"
+                )
+            kind = parts[0].strip()
+            if kind not in KINDS:
+                raise ValueError(
+                    f"unknown fault kind {kind!r} (known: {sorted(KINDS)})"
+                )
+            attempt: Optional[int] = 1
+            for extra in parts[2:]:
+                key, _, val = extra.partition(":")
+                if key.strip() != "attempt":
+                    raise ValueError(
+                        f"bad fault qualifier {extra!r} in {token!r} "
+                        "(only @attempt:<n|any> is understood)"
+                    )
+                attempt = None if val.strip() == "any" else int(val)
+            trigger = parts[1].split(":")
+            unit = trigger[0].strip()
+            if unit != KINDS[kind]:
+                raise ValueError(
+                    f"fault {kind!r} triggers on {KINDS[kind]!r}, "
+                    f"not {unit!r}"
+                )
+            if len(trigger) < 2 or len(trigger) > 3:
+                raise ValueError(
+                    f"bad trigger {parts[1]!r} in {token!r}: "
+                    "want unit:at[:arg]"
+                )
+            at = int(trigger[1])
+            arg = trigger[2].strip() if len(trigger) == 3 else None
+            if kind == "slow_host" and arg is not None:
+                _parse_duration_s(arg)  # validate at parse time
+            specs.append(FaultSpec(kind, unit, at, arg, attempt))
+        return cls(specs)
+
+    def active(self, attempt: int) -> List[FaultSpec]:
+        return [s for s in self.specs
+                if s.attempt is None or s.attempt == attempt]
+
+    def __bool__(self) -> bool:
+        return bool(self.specs)
+
+
+# ---------------------------------------------------------------------------
+# process-global plan + hook points
+# ---------------------------------------------------------------------------
+
+_plan: Optional[FaultPlan] = None
+_attempt: int = 1
+_active: List[FaultSpec] = []
+# id() of the loader loader_raise targets; None = any loader. The
+# trainer binds its TRAIN loader so a validation/eval pass sharing the
+# same loader class cannot consume the one-shot spec at ITS batch 7.
+_watched_loader_id: Optional[int] = None
+
+
+def configure(text: Optional[str] = None,
+              attempt: Optional[int] = None) -> FaultPlan:
+    """(Re)install the process fault plan.
+
+    ``PDT_FAULTS`` in the environment wins over ``text`` (the operator/
+    supervisor-level injection path must be able to override a config
+    file); both absent installs an empty plan. ``attempt`` defaults to
+    ``PDT_ATTEMPT`` (exported by the supervisor), else 1.
+    """
+    global _plan, _attempt, _active
+    env = os.environ.get(ENV_PLAN)
+    _plan = FaultPlan.parse(env if env else text)
+    if attempt is None:
+        try:
+            attempt = int(os.environ.get(ENV_ATTEMPT, "1"))
+        except ValueError:
+            attempt = 1
+    _attempt = attempt
+    _active = _plan.active(_attempt)
+    if _active:
+        logger.warning(
+            "FAULT PLAN ACTIVE (attempt %d): %s", _attempt,
+            "; ".join(s.describe() for s in _active),
+        )
+    return _plan
+
+
+def reset() -> None:
+    """Drop the plan entirely (tests)."""
+    global _plan, _attempt, _active, _watched_loader_id
+    _plan, _attempt, _active, _watched_loader_id = None, 1, [], None
+
+
+def watch_loader(loader) -> None:
+    """Bind ``loader_raise`` to one loader instance (the trainer binds
+    its train loader). Unbound (the default, e.g. a bare loader in a
+    test), the hook fires from any loader."""
+    global _watched_loader_id
+    _watched_loader_id = id(loader) if loader is not None else None
+
+
+def _ensure_configured() -> None:
+    if _plan is None:
+        configure()
+
+
+def _take(kind: str, value: int) -> Optional[FaultSpec]:
+    """The not-yet-fired active spec of ``kind`` triggering at
+    ``value``, marked fired; None otherwise. O(active specs) — the
+    plan is empty in production, a handful of entries under chaos."""
+    for s in _active:
+        if s.kind == kind and not s.fired and s.at == int(value):
+            s.fired = True
+            return s
+    return None
+
+
+def on_step(step: int) -> None:
+    """Trainer-loop hook, called once per batch with the global step,
+    BEFORE the step is dispatched (``kill@step:N`` ⇒ exactly N steps
+    completed). Order: slow_host (then continue), crash (raise),
+    kill (never returns)."""
+    if _plan is None:
+        _ensure_configured()
+    if not _active:
+        return
+    s = _take("slow_host", step)
+    if s is not None:
+        logger.warning("fault slow_host: sleeping %.3fs at step %d",
+                       s.duration_s, step)
+        time.sleep(s.duration_s)
+    s = _take("crash", step)
+    if s is not None:
+        raise FaultInjected(
+            s, f"injected crash at step {step} ({s.describe()})"
+        )
+    s = _take("kill", step)
+    if s is not None:
+        # raw write + SIGKILL: simulate a hard host loss — no flushes,
+        # no atexit, no emergency checkpoint. The surviving evidence is
+        # whatever was already durable, exactly like a real preemption
+        # without notice.
+        try:
+            os.write(2, f"fault kill: SIGKILL at step {step}\n".encode())
+        except OSError:
+            pass
+        os.kill(os.getpid(), signal.SIGKILL)
+
+
+def on_loader_batch(batch_index: int, loader=None) -> None:
+    """Data-loader hook (per-epoch batch ordinal, before the gather).
+
+    ``loader``: the iterating loader instance, checked against
+    :func:`watch_loader`'s binding so only the targeted (train) input
+    pipeline can fire the one-shot spec."""
+    if _plan is None:
+        _ensure_configured()
+    if not _active:
+        return
+    if (_watched_loader_id is not None and loader is not None
+            and id(loader) != _watched_loader_id):
+        return
+    s = _take("loader_raise", batch_index)
+    if s is not None:
+        raise FaultInjected(
+            s, f"injected loader failure at batch {batch_index} "
+               f"({s.describe()})"
+        )
+
+
+def on_checkpoint_save(epoch: int) -> None:
+    """Checkpoint-manager hook (save/save_interval entry)."""
+    if _plan is None:
+        _ensure_configured()
+    if not _active:
+        return
+    s = _take("ckpt_write_fail", epoch)
+    if s is not None:
+        raise FaultInjected(
+            s, f"injected checkpoint write failure at epoch {epoch} "
+               f"({s.describe()})"
+        )
+
+
+def nan_grad_step() -> Optional[int]:
+    """The global step whose gradients should be NaN-poisoned, or None.
+
+    Read once at trainer build time and compiled into the train step
+    (``engine/steps.make_train_step(inject_nan_grad_step=...)``) — the
+    injection itself is a branchless in-graph select, so the fault
+    fires at the exact step with zero host involvement.
+    """
+    _ensure_configured()
+    for s in _active:
+        if s.kind == "nan_grad":
+            return s.at
+    return None
+
+
+def install_from_env_or_config(config_text: Optional[str]) -> None:
+    """Trainer-entry helper: (re)configure from PDT_FAULTS / the
+    ``trainer.faults`` config string. Called once per Trainer build so
+    a fresh trainer in the same process gets fresh one-shot flags."""
+    configure(config_text)
+
+
+def main(argv=None) -> int:
+    """``python -m ...resilience.faults 'PLAN'`` — parse + describe a
+    plan (CI/operator sanity check; exit 2 on a malformed plan)."""
+    text = (argv or sys.argv[1:] or [os.environ.get(ENV_PLAN, "")])[0]
+    try:
+        plan = FaultPlan.parse(text)
+    except ValueError as e:
+        print(f"invalid fault plan: {e}", file=sys.stderr)
+        return 2
+    for s in plan.specs:
+        print(s.describe())
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

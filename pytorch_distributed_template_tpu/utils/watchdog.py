@@ -43,6 +43,12 @@ class StepWatchdog:
         go into the stall dump.
     :param dump_path: optional file path; each alarm (over)writes a JSON
         stall artifact ``{"stalled_s", "active_spans", "last_records"}``.
+    :param heartbeat_path: optional file the beat touches (throttled to
+        ``heartbeat_interval_s``) — the liveness signal the resilience
+        supervisor watches from OUTSIDE the process
+        (``PDT_HEARTBEAT_FILE``; resilience/supervisor.py). Works even
+        with ``timeout_s == 0``: external hang detection does not
+        require the in-process monitor thread.
 
     Usage::
 
@@ -55,19 +61,26 @@ class StepWatchdog:
 
     def __init__(self, timeout_s: float, dump_stacks: bool = True,
                  recorder=None, spans=None, dump_path=None,
-                 dump_last_n: int = 16):
+                 dump_last_n: int = 16, heartbeat_path=None,
+                 heartbeat_interval_s: float = 1.0):
         self.timeout_s = float(timeout_s)
         self.dump_stacks = dump_stacks
         self.recorder = recorder
         self.spans = spans
         self.dump_path = Path(dump_path) if dump_path else None
         self.dump_last_n = int(dump_last_n)
+        self.heartbeat_path = (
+            Path(heartbeat_path) if heartbeat_path else None
+        )
+        self.heartbeat_interval_s = float(heartbeat_interval_s)
+        self._hb_last = 0.0
         self._last = time.monotonic()
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         self.alarms = 0  # number of stall alarms fired (observable in tests)
 
     def start(self) -> None:
+        self._touch_heartbeat(force=True)  # alive before the first step
         if self.timeout_s <= 0 or self._thread is not None:
             return
         self._last = time.monotonic()
@@ -79,6 +92,55 @@ class StepWatchdog:
 
     def beat(self) -> None:
         self._last = time.monotonic()
+        self._touch_heartbeat()
+
+    def heartbeat_keepalive(self, interval_s: float = 1.0):
+        """Context manager: touch the heartbeat from a side thread for
+        the duration of a LEGITIMATE long host block — the end-of-run
+        checkpoint flush, where no step will ever beat again but the
+        process is making real progress. Without it, a supervisor
+        ``--hang-timeout`` shorter than the final orbax flush would
+        SIGKILL a healthy, finishing run mid-write. No-op when no
+        heartbeat file is configured."""
+        import contextlib
+
+        @contextlib.contextmanager
+        def _ctx():
+            if self.heartbeat_path is None:
+                yield
+                return
+            stop = threading.Event()
+
+            def pump():
+                while not stop.wait(interval_s):
+                    self._touch_heartbeat(force=True)
+
+            t = threading.Thread(target=pump, name="heartbeat-keepalive",
+                                 daemon=True)
+            t.start()
+            try:
+                yield
+            finally:
+                stop.set()
+                t.join(timeout=2)
+
+        return _ctx()
+
+    def _touch_heartbeat(self, force: bool = False) -> None:
+        """Update the heartbeat file's mtime (the supervisor's liveness
+        signal), at most once per ``heartbeat_interval_s`` — steps can
+        be sub-millisecond and a per-step write would tax the loop."""
+        if self.heartbeat_path is None:
+            return
+        now = time.monotonic()
+        if not force and now - self._hb_last < self.heartbeat_interval_s:
+            return
+        self._hb_last = now
+        try:
+            with open(self.heartbeat_path, "w") as f:
+                f.write(f"{time.time():.3f}\n")
+        except OSError:
+            pass  # liveness reporting must never kill the step loop
 
     def stop(self) -> None:
         if self._thread is None:

@@ -77,13 +77,39 @@ def main() -> int:
         if not meta:
             print("note: no .meta.json sidecar — kind inferred from the "
                   "tree structure")
+    data_state = CheckpointManager.load_data_state(path)
+    emergency = bool(meta.get("emergency")
+                     or (data_state or {}).get("emergency"))
     kind = ("params-only serving artifact" if params_only
+            else "EMERGENCY training checkpoint" if emergency
             else "training checkpoint")
     print(f"{path.name}: {kind}")
+    if emergency:
+        print("  note:         written by the unhandled-exception "
+              "emergency path (resilience subsystem) — state is the "
+              "last completed step before the crash")
     for k in ("arch", "epoch", "step", "monitor_best", "quant",
               "lora_merged", "source", "source_params"):
         if k in meta and meta[k] is not None:
             print(f"  {k:13s} {meta[k]}")
+    if data_state:
+        # step-accurate-resume sidecar: where --auto-resume will pick
+        # this run back up, and the cursor/fingerprint forensics
+        print("  data_state:")
+        for k in ("global_step", "epoch", "next_batch", "len_epoch",
+                  "batch_size", "rng_fingerprint"):
+            if data_state.get(k) is not None:
+                print(f"    {k:16s} {data_state[k]}")
+        sampler = data_state.get("sampler")
+        if sampler:
+            cursor = ", ".join(f"{k}={sampler[k]}" for k in
+                               ("shard_index", "num_shards", "epoch",
+                                "seed", "shuffle") if k in sampler)
+            print(f"    {'shard_cursor':16s} {cursor}")
+        elif "data_seed" in data_state:
+            print(f"    {'shuffle':16s} "
+                  f"shuffle={data_state.get('shuffle')}, "
+                  f"seed={data_state.get('data_seed')}")
 
     collections = {"": tree} if params_only else dict(tree)
     all_param_leaves = []

@@ -1,39 +1,17 @@
 #!/usr/bin/env bash
-# Crash -> relaunch -> resume supervisor for train.py.
+# DEPRECATED thin wrapper — the bash retry loop moved to the Python
+# supervisor (scripts/supervise.py): exit classification (clean /
+# preemption / crash / hang), exponential backoff + jitter, a rolling
+# crash-loop budget, heartbeat hang detection, SIGTERM-drain, and a
+# supervisor.jsonl lifecycle log. See docs/RESILIENCE.md.
 #
-# The framework's failure contract (docs/DESIGN.md §5) is deliberately
-# process-lifetime-simple: preemption/crash recovery = relaunch with
-# --auto-resume, which finds the experiment's newest checkpoint
-# (including mid-epoch interval checkpoints, trainer.save_interval_steps).
-# This script IS that relaunch loop: run train.py until it exits cleanly,
-# restarting on any failure up to MAX_RESTARTS times with a backoff.
+# Kept for the original flags/env contract: MAX_RESTARTS and
+# RESTART_DELAY_S are honored (supervise.py reads them as its flag
+# defaults), and all arguments still pass through to train.py with
+# --auto-resume injected.
 #
 # Usage: scripts/run_resilient.sh -c configs/foo.json [train.py args...]
-#   MAX_RESTARTS (default 10) and RESTART_DELAY_S (default 10) via env.
-#
-# Exit codes: 0 on clean training completion; the last failure code after
-# exhausting restarts.
 set -u
 
-MAX_RESTARTS="${MAX_RESTARTS:-10}"
-RESTART_DELAY_S="${RESTART_DELAY_S:-10}"
 SCRIPT_DIR="$(cd "$(dirname "${BASH_SOURCE[0]}")" && pwd)"
-REPO_DIR="$(dirname "$SCRIPT_DIR")"
-
-attempt=0
-while :; do
-  attempt=$((attempt + 1))
-  echo "[run_resilient] attempt ${attempt}: python train.py --auto-resume $*" >&2
-  python "${REPO_DIR}/train.py" --auto-resume "$@"
-  code=$?
-  if [ "$code" -eq 0 ]; then
-    echo "[run_resilient] training finished cleanly." >&2
-    exit 0
-  fi
-  if [ "$attempt" -gt "$MAX_RESTARTS" ]; then
-    echo "[run_resilient] giving up after ${attempt} attempts (last exit ${code})." >&2
-    exit "$code"
-  fi
-  echo "[run_resilient] exit ${code}; relaunching in ${RESTART_DELAY_S}s (resumes newest checkpoint)." >&2
-  sleep "$RESTART_DELAY_S"
-done
+exec python "${SCRIPT_DIR}/supervise.py" "$@"

@@ -24,6 +24,9 @@ Report fields (JSON with ``--json``, markdown otherwise):
 - compile-cache hit rate from the per-record cache hit/miss events;
 - anomaly count + straggler windows + per-host wall spread (from the
   health layer's recorder events and ``hosts{}`` aggregates);
+- supervisor restart counters (``--supervisor supervisor.jsonl`` or a
+  ``supervisor.jsonl`` inside ``--run-dir``): restarts by cause
+  (crash/hang/preemption), give-up reason, clean completion;
 - top host spans by total time (from ``trace.json``);
 - the bench final line's headline numbers.
 
@@ -40,6 +43,8 @@ import argparse
 import json
 import sys
 from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
 # metric name in the bench final line -> fallback path in its summary
 _BENCH_METRIC_FALLBACK = {
@@ -194,6 +199,31 @@ def analyze_trace(path, top: int = 8) -> dict:
     }
 
 
+def analyze_supervisor(path) -> dict:
+    """Fold a ``supervisor.jsonl`` lifecycle log (resilience
+    subsystem) into restart counters: how many relaunches, why, and
+    whether the supervisor gave up or finished clean. One parser owns
+    the schema — ``resilience.supervisor.read_supervisor_stats`` (also
+    behind serve.py's /metrics and the CI chaos gate) — and this only
+    flattens its result for the markdown table."""
+    from pytorch_distributed_template_tpu.resilience.supervisor import (
+        read_supervisor_stats,
+    )
+
+    stats = read_supervisor_stats(path)
+    out: dict = {
+        "restarts_total": stats["restarts_total"],
+        "attempts": stats["attempts"],
+        "clean": stats["clean"],
+        "gave_up": stats["gave_up"],
+    }
+    if stats["last_restart_cause"] is not None:
+        out["last_restart_cause"] = stats["last_restart_cause"]
+    for cause, n in sorted(stats["causes"].items()):
+        out[f"cause_{cause}"] = n
+    return out
+
+
 def analyze_anomalies(run_dir) -> dict:
     """Summarize the ``anomaly_*.json`` forensic bundles in a run dir."""
     files = sorted(Path(run_dir).glob("anomaly_*.json"))
@@ -288,6 +318,7 @@ def to_markdown(report: dict) -> str:
         lines.append("")
 
     table("Flight recorder", report.get("telemetry", {}))
+    table("Supervisor", report.get("supervisor", {}))
     tr = report.get("trace") or {}
     if tr.get("top_spans"):
         lines.append("## Host spans (top by total time)")
@@ -347,6 +378,10 @@ def main(argv=None) -> int:
                    help="explicit telemetry.jsonl path")
     p.add_argument("--trace", type=str, default=None,
                    help="explicit trace.json path")
+    p.add_argument("--supervisor", type=str, default=None,
+                   help="explicit supervisor.jsonl path (the "
+                        "resilience supervisor's lifecycle log; "
+                        "--run-dir also auto-discovers one)")
     p.add_argument("--bench", type=str, default=None,
                    help="bench output: final-line JSON file or a "
                         "captured stdout stream (tee)")
@@ -379,6 +414,12 @@ def main(argv=None) -> int:
             trace_path = cand if cand.exists() else None
         if trace_path is not None:
             report["trace"] = analyze_trace(trace_path)
+        sup_path = args.supervisor
+        if sup_path is None and run_dir is not None:
+            cand = run_dir / "supervisor.jsonl"
+            sup_path = cand if cand.exists() else None
+        if sup_path is not None:
+            report["supervisor"] = analyze_supervisor(sup_path)
         if run_dir is not None:
             report["anomalies"] = analyze_anomalies(run_dir)
         bench = None

@@ -15,7 +15,8 @@ in a stdlib ``ThreadingHTTPServer``. No web framework, no deps.
                               token / cancellation counters, queue
                               depth, live slots, latency percentiles,
                               anomaly / straggler-window / profile-
-                              capture totals);
+                              capture totals, supervisor restart
+                              counters when supervised);
                               ?format=json for the same as JSON
     POST /profile?steps=N     -> on-demand jax.profiler capture windowed
                               on the scheduler's progress counters
@@ -108,9 +109,32 @@ from pytorch_distributed_template_tpu.observability.profiler import (  # noqa: E
 from pytorch_distributed_template_tpu.observability.telemetry import (  # noqa: E402
     compile_cache_stats,
 )
+from pytorch_distributed_template_tpu.resilience.supervisor import (  # noqa: E402
+    ENV_EVENTS, read_supervisor_stats,
+)
 from pytorch_distributed_template_tpu.utils.compile_cache import (  # noqa: E402
     configure_compile_cache,
 )
+
+
+def supervisor_restart_stats() -> dict:
+    """Restart counters from the resilience supervisor's lifecycle log.
+
+    A supervised process inherits ``PDT_SUPERVISOR_EVENTS`` from
+    ``scripts/supervise.py``; unsupervised servers fall back to a
+    ``supervisor.jsonl`` in the working directory, and {} when neither
+    exists. Re-read per scrape — the file is a handful of lines."""
+    path = os.environ.get(ENV_EVENTS, "supervisor.jsonl")
+    if not os.path.exists(path):
+        return {}
+    try:
+        stats = read_supervisor_stats(path)
+    except OSError:
+        return {}
+    return {
+        "restarts_total": int(stats["restarts_total"]),
+        "last_restart_cause": stats["last_restart_cause"],
+    }
 
 
 def _run_request(service: GenerationService, req: dict,
@@ -185,6 +209,10 @@ def service_metrics(service: GenerationService) -> dict:
     out["anomaly_total"] = int(hc["anomaly_total"])
     out["straggler_windows_total"] = int(hc["straggler_windows_total"])
     out["profile_captures_total"] = int(hc["profile_captures_total"])
+    # resilience-supervisor counters (when supervised / a log exists):
+    # restarts_total scrapes as a counter; the cause string is JSON-only
+    # (prometheus_text emits numeric fields exclusively)
+    out.update(supervisor_restart_stats())
     return out
 
 
@@ -252,6 +280,8 @@ def make_handler(service: GenerationService, profiler=None):
                 # null until a numerics anomaly fires (health layer)
                 "last_anomaly_step": health_counters()[
                     "last_anomaly_step"],
+                # resilience supervisor (absent keys = unsupervised)
+                **supervisor_restart_stats(),
             }
             if hasattr(service, "latency_percentiles"):
                 payload["latency"] = service.latency_percentiles()

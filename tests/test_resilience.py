@@ -292,6 +292,22 @@ def test_save_interval_steps(tmp_path):
     assert meta_a["epoch"] == meta_b["epoch"] == 1
     assert {meta_a["step"], meta_b["step"]} == {6, 8}
 
+    # step-accurate-resume sidecars (resilience subsystem) ride every
+    # interval save: next_batch matches the slot's step, and the final
+    # slot (all 8 batches done) normalizes past the epoch edge
+    from pytorch_distributed_template_tpu.checkpoint.manager import (
+        CheckpointManager,
+    )
+
+    by_step = {}
+    for name in ("checkpoint-interval-a", "checkpoint-interval-b"):
+        ds = CheckpointManager.load_data_state(config.save_dir / name)
+        assert ds is not None and ds["len_epoch"] == 8
+        by_step[ds["global_step"]] = ds
+    assert set(by_step) == {6, 8}
+    assert (by_step[6]["epoch"], by_step[6]["next_batch"]) == (1, 6)
+    assert (by_step[8]["epoch"], by_step[8]["next_batch"]) == (2, 0)
+
     # auto-resume rediscovery picks an interval slot (no epoch checkpoint
     # exists: save_period never fired) and it restores cleanly
     latest = find_latest_checkpoint(dict(config.config))

@@ -87,6 +87,18 @@ def main(args, config):
 
     trainer.train()
 
+    from pytorch_distributed_template_tpu.resilience import EXIT_PREEMPTED
+    from pytorch_distributed_template_tpu.utils import preemption
+
+    if preemption.requested():
+        # checkpointed + drained, but the work is NOT finished: exit
+        # with the distinct preemption code so the supervisor
+        # (scripts/supervise.py) relaunches without burning its crash
+        # budget — a plain shell still sees non-zero
+        logger.warning("exiting with preemption status %d (resume "
+                       "with --auto-resume)", EXIT_PREEMPTED)
+        raise SystemExit(EXIT_PREEMPTED)
+
 
 if __name__ == "__main__":
     parser = argparse.ArgumentParser(description="TPU-native training template")
