@@ -23,7 +23,13 @@ whole loop. This module replaces that with a persistent decode engine:
   each fenced dispatch costs ~105 ms — BASELINE.md);
 - when the global position would not fit another request the engine
   waits for drain and starts a new ERA (reset the counter; stale K/V
-  needs no zeroing — every row's ``pad_len`` masks it).
+  needs no zeroing — every row's ``pad_len`` masks it);
+- with a prefix cache attached (engine/kvcache.py, the
+  ``prefix_cache`` constructor arg), admissions whose prompt prefix is
+  pooled scatter the cached block chain into their cache slots and
+  prefill ONLY the suffix (``_warm_admit_fn``) — pool blocks are
+  era-independent (canonical rotation space), so reuse survives era
+  resets for free.
 
 Token-exactness: a request's tokens depend only on its own prompt,
 seed, and sampling config — never on admission time or batch
@@ -154,6 +160,105 @@ def _admit_fn(model, bucket: int, k: int, n_stop: int):
     return admit
 
 
+@functools.lru_cache(maxsize=64)
+def _warm_admit_fn(model, feed: int, k: int, n_stop: int, nb: int,
+                   block: int, rotary: bool, rope_base: float):
+    """Prefix-cache-aware admission: ``_admit_fn`` with the paged KV
+    pool spliced in (engine/kvcache.py). The fed token window is only
+    ``feed`` wide — the group's largest UNCACHED suffix snapped to the
+    same power-of-two ladder as cold admission buckets, so the
+    compile-cache/warmup story is untouched — and each row's cached
+    prefix blocks are scattered into its cache slots (re-rotated from
+    canonical to absolute-slot RoPE space by the row's constant start
+    angle) before the prefill runs.
+
+    Correctness shape: row ``j``'s prompt occupies slots
+    ``pad_j .. p-1``; its blocks cover ``pad_j .. pad_j + c_j - 1`` and
+    the fed window covers ``[p - feed, p)``. Because
+    ``feed >= suffix_j`` for every row, the two always tile the prompt;
+    where they overlap, the prefill's own DUS write wins over the
+    scattered copy at every layer, so overlapped positions are
+    RECOMPUTED exactly as the cold path computes them. Unused block
+    lanes (-1 ids, group padding) redirect into the fed window, where
+    the same DUS overwrite makes their garbage dead by construction.
+
+    ``ints`` layout is ``_admit_fn``'s with ``pos0 = p - feed``; the
+    pool rides as a ``{path: [P, block, H, D]}`` dict plus ``[k, nb]``
+    block ids. Donates the shared cache and slot arrays; the pool is
+    read-only here (capture owns its donation).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from .generate import _sample_rows_traced
+    from .kvcache import scatter_blocks
+
+    total = int(model.max_len)
+
+    @functools.partial(jax.jit, donate_argnums=(1, 2))
+    def admit(params, shared, arrays, prompts, ints, floats,
+              keys_data_k, topk_k, pool, block_ids):
+        slots = ints[:, 0]
+        budgets_k = ints[:, 1]
+        pad_k = ints[:, 2]
+        stops_k = ints[:, 3:3 + n_stop]
+        pos0 = ints[0, 3 + n_stop]
+        temps_k = floats[:, 0]
+        ps_k = floats[:, 1]
+        keys = jax.random.wrap_key_data(keys_data_k)
+        shapes = jax.eval_shape(
+            lambda p: model.apply(
+                {"params": p}, jnp.zeros((k, total), jnp.int32),
+                train=False, decode=True, mutable=["cache"],
+            ),
+            params,
+        )[1]["cache"]
+        cache = jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype),
+                             shapes)
+        cache = dict(scatter_blocks(
+            dict(cache), pool, block_ids, pad_k, pos0, feed, block,
+            rotary=rotary, rope_base=rope_base))
+        cache["pos_index"] = pos0.astype(jnp.int32)
+        logits, vs = model.apply(
+            {"params": params, "cache": cache}, prompts,
+            train=False, decode=True, prefill=True, mutable=["cache"],
+            pad_lens=pad_k,
+        )
+        tok0 = _sample_rows_traced(
+            jax.vmap(jax.random.fold_in)(keys,
+                                         jnp.zeros((k,), jnp.int32)),
+            logits[:, -1], temps_k, topk_k, ps_k,
+        )
+        new = vs["cache"]
+
+        def put(s, n):
+            if (s.ndim >= 1 and n.ndim == s.ndim and n.shape[0] == k
+                    and s.shape[1:] == n.shape[1:]):
+                return s.at[slots].set(n.astype(s.dtype))
+            return s
+
+        shared = dict(jax.tree.map(put, dict(shared), new))
+        shared["pos_index"] = (pos0 + feed).astype(jnp.int32)
+
+        (tok, emitted, done, budgets, pad_lens, keys_data, stops,
+         temps, ks, ps) = arrays
+        arrays_out = (
+            tok.at[slots].set(tok0),
+            emitted.at[slots].set(jnp.ones((k,), jnp.int32)),
+            done.at[slots].set(jnp.zeros((k,), bool)),
+            budgets.at[slots].set(budgets_k),
+            pad_lens.at[slots].set(pad_k),
+            keys_data.at[slots].set(keys_data_k),
+            stops.at[slots].set(stops_k),
+            temps.at[slots].set(temps_k),
+            ks.at[slots].set(topk_k),
+            ps.at[slots].set(ps_k),
+        )
+        return shared, arrays_out, tok0
+
+    return admit
+
+
 @functools.lru_cache(maxsize=16)
 def _chunk_fn(model, steps: int, n_stop: int):
     """``steps`` in-graph decode steps over all slots: per-row rng
@@ -232,8 +337,10 @@ class ContinuousBatchingService(GenerationService):
 
     def _setup(self, model, params, tokenizer=None, slots: int = 8,
                chunk: int = 8, window_ms: float = 5.0,
-               warm_buckets=None):
-        super()._setup(model, params, tokenizer)
+               warm_buckets=None, prefix_cache=None, recorder=None):
+        super()._setup(model, params, tokenizer,
+                       prefix_cache=prefix_cache)
+        self._recorder = recorder
         if not self._pad_ok:
             raise ValueError(
                 f"{type(model).__name__} is not pad-capable (RoPE "
@@ -343,7 +450,21 @@ class ContinuousBatchingService(GenerationService):
         k, W = self._slots, self.MAX_STOPS
         kd = np.asarray(jax.random.key_data(jax.random.key(0)))
         keys_data = jnp.asarray(np.tile(kd, (k, 1)))
-        for bucket in self._warm_buckets:
+        buckets = self._warm_buckets
+        if self._prefix is not None and buckets:
+            # prefix-cache hits admit with feed = bucket(largest
+            # UNCACHED suffix) — any ladder value up to the configured
+            # prompt bucket, not just the bucket itself. Prime the
+            # whole power-of-two sub-ladder so the first shared-prefix
+            # wave after startup never stalls every slot behind a
+            # fresh XLA compile (the exact class of stall warm_buckets
+            # exists to prevent)
+            b, sub = 16, []
+            while b <= max(buckets):
+                sub.append(b)
+                b *= 2
+            buckets = sorted(set(buckets) | set(sub))
+        for bucket in buckets:
             pos0 = 0                       # admission at p == bucket
             ints = np.zeros((k, 4 + W), np.int32)
             ints[:, 0] = np.arange(k)      # one row per slot
@@ -351,11 +472,26 @@ class ContinuousBatchingService(GenerationService):
             ints[:, 2] = pos0 + bucket - 1  # pad_len: 1-token prompts
             ints[:, 3:3 + W] = -1
             ints[:, 3 + W] = pos0
-            cache, arrays, _ = _admit_fn(self.model, bucket, k, W)(
-                self.params, cache, arrays,
-                jnp.zeros((k, bucket), jnp.int32), jnp.asarray(ints),
-                jnp.zeros((k, 2), jnp.float32), keys_data,
-                jnp.zeros((k,), jnp.int32))
+            if self._prefix is not None:
+                # prefix-cache deployments run every admission through
+                # the warm executable (a full miss feeds block_ids of
+                # all -1) — prime THAT shape, not the legacy one
+                nb = self._prefix.nb_max
+                cache, arrays, _ = _warm_admit_fn(
+                    self.model, bucket, k, W, nb, self._prefix.block,
+                    self._prefix.rotary, self._prefix.rope_base)(
+                    self.params, cache, arrays,
+                    jnp.zeros((k, bucket), jnp.int32),
+                    jnp.asarray(ints), jnp.zeros((k, 2), jnp.float32),
+                    keys_data, jnp.zeros((k,), jnp.int32),
+                    self._prefix.pool,
+                    jnp.full((k, nb), -1, jnp.int32))
+            else:
+                cache, arrays, _ = _admit_fn(self.model, bucket, k, W)(
+                    self.params, cache, arrays,
+                    jnp.zeros((k, bucket), jnp.int32), jnp.asarray(ints),
+                    jnp.zeros((k, 2), jnp.float32), keys_data,
+                    jnp.zeros((k,), jnp.int32))
         jax.block_until_ready(arrays[0])
 
     # ---- request entry ---------------------------------------------------
@@ -476,13 +612,28 @@ class ContinuousBatchingService(GenerationService):
         pad_reqs = reqs + [reqs[-1]] * (k - n)
         pad_slots = list(slots) + [slots[-1]] * (k - n)
         bucket = self._bucket(max(len(r["ids"]) for r in reqs))
-        pos0 = self._p - bucket
-        prompts = np.zeros((k, bucket), np.int32)
+        # ---- prefix-cache lookup: longest fully-blocked cached prefix
+        # per request; the fed window shrinks to the largest UNCACHED
+        # suffix (snapped to the same ladder — always <= bucket, so the
+        # admissibility/era math above stays valid unchanged). Refs are
+        # held until the copy kernels are dispatched, so a same-tick
+        # insert can never evict a block this group is about to read.
+        matches = None
+        if self._prefix is not None:
+            matches = [self._prefix.lookup(r["ids"]) for r in reqs]
+            feed = self._bucket(max(
+                len(r["ids"]) - m[2] for r, m in zip(reqs, matches)))
+        else:
+            feed = bucket
+        pos0 = self._p - feed
+        prompts = np.zeros((k, feed), np.int32)
         ints = np.full((k, 4 + W), pos0, np.int32)
         floats = np.zeros((k, 2), np.float32)
         topks = np.zeros((k,), np.int32)
         for j, r in enumerate(pad_reqs):
-            prompts[j, bucket - len(r["ids"]):] = r["ids"]
+            m = min(len(r["ids"]), feed)   # fed = trailing tokens; any
+            # leading truncation is covered by the row's cached blocks
+            prompts[j, feed - m:] = r["ids"][len(r["ids"]) - m:]
             ints[j, 0] = pad_slots[j]
             ints[j, 1] = r["budget"]
             ints[j, 2] = self._p - len(r["ids"])
@@ -493,11 +644,36 @@ class ContinuousBatchingService(GenerationService):
             topks[j] = r["top_k"]
         keys_data = jnp.asarray(
             np.stack([r["key_data"] for r in pad_reqs]))
-        self._cache, self._arrays, tok0 = _admit_fn(
-            self.model, bucket, k, W)(
-            self.params, self._cache, self._arrays,
-            jnp.asarray(prompts), jnp.asarray(ints),
-            jnp.asarray(floats), keys_data, jnp.asarray(topks))
+        if self._prefix is None:
+            self._cache, self._arrays, tok0 = _admit_fn(
+                self.model, bucket, k, W)(
+                self.params, self._cache, self._arrays,
+                jnp.asarray(prompts), jnp.asarray(ints),
+                jnp.asarray(floats), keys_data, jnp.asarray(topks))
+        else:
+            nb = self._prefix.nb_max
+            block_ids = np.full((k, nb), -1, np.int32)
+            pad_matches = matches + [matches[-1]] * (k - n)
+            for j, (_, blocks, _) in enumerate(pad_matches):
+                block_ids[j, :len(blocks)] = blocks
+            try:
+                self._cache, self._arrays, tok0 = _warm_admit_fn(
+                    self.model, feed, k, W, nb, self._prefix.block,
+                    self._prefix.rotary, self._prefix.rope_base)(
+                    self.params, self._cache, self._arrays,
+                    jnp.asarray(prompts), jnp.asarray(ints),
+                    jnp.asarray(floats), keys_data, jnp.asarray(topks),
+                    self._prefix.pool, jnp.asarray(block_ids))
+            except Exception:
+                # a failed dispatch (e.g. an OOM'd first compile) must
+                # not strand the lookup refs: leaked refs pin blocks
+                # against eviction FOREVER on a server that recovers
+                for nodes, _, _ in matches:
+                    self._prefix.release(nodes)
+                raise
+            # inserts + the ref release ride one helper (its finally
+            # owns the release from here on)
+            self._insert_prefixes(reqs, slots, ints, matches)
         for j, (r, slot) in enumerate(zip(reqs, slots)):
             self._meta[slot] = {
                 "req": r, "emitted": 1, "out": [],
@@ -602,6 +778,66 @@ class ContinuousBatchingService(GenerationService):
             m = self._meta[s]
             if m is not None and m["done"]:
                 self._complete(s)
+        if self._recorder is not None:
+            # per-chunk serving telemetry: cumulative counters, so the
+            # offline analyzer (scripts/telemetry_report.py) reads the
+            # LAST record for totals; prefix-cache fields ride along
+            # when the pool is enabled
+            rec = {
+                "event": "serve_chunk",
+                "live_slots": sum(mm is not None for mm in self._meta),
+                "queue_depth": self._queue.qsize(),
+                "tokens_generated_total":
+                    self.stats.get("tokens_generated", 0),
+                "admissions_total": self.stats.get("admissions", 0),
+            }
+            if self._prefix is not None:
+                snap = self._prefix.stats_snapshot()
+                rec.update(
+                    prefix_hit_tokens_total=snap["prefix_hit_tokens"],
+                    prefix_hit_requests_total=snap[
+                        "prefix_hit_requests"],
+                    prefix_lookups_total=snap["prefix_lookups"],
+                    prefix_evictions_total=snap["prefix_evictions"],
+                    prefix_pool_blocks_used=snap[
+                        "prefix_pool_blocks_used"],
+                    prefix_pool_blocks=snap["prefix_pool_blocks"],
+                )
+            self._recorder.record(self.stats["chunks"], **rec)
+
+    def _insert_prefixes(self, reqs, slots, ints, matches):
+        """Put the admitted prompts' own full blocks back into the pool:
+        plan the index inserts on the host (allocating from the free
+        list, LRU-evicting unreferenced blocks when full), then ONE
+        fixed-shape capture dispatch — padded to ``(slots, nb_max)``
+        like the admit itself, so arrival-wave sizes never mint fresh
+        XLA executables mid-traffic. Lookup refs release only after
+        both copy kernels are enqueued (device program order makes the
+        reads safe against any later overwrite)."""
+        try:
+            nb = self._prefix.nb_max
+            rows, cap_slots, cap_pads = [], [], []
+            any_new = False
+            for j, r in enumerate(reqs):
+                blocks, start = self._prefix.plan_insert(r["ids"])
+                row = [-1] * nb
+                for i, b in enumerate(blocks):
+                    row[start + i] = b
+                if blocks:
+                    any_new = True
+                rows.append(row)
+                cap_slots.append(slots[j])
+                cap_pads.append(int(ints[j, 2]))
+            while len(rows) < self._slots:   # fixed executable shape
+                rows.append([-1] * nb)
+                cap_slots.append(cap_slots[-1])
+                cap_pads.append(cap_pads[-1])
+            if any_new:
+                self._prefix.capture(self._cache, cap_slots, cap_pads,
+                                     rows)
+        finally:
+            for nodes, _, _ in matches:
+                self._prefix.release(nodes)
 
     def _complete(self, slot: int):
         m = self._meta[slot]

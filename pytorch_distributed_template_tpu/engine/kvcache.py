@@ -1,0 +1,536 @@
+"""Paged KV block pool + automatic prefix caching for the serving path.
+
+Production LM traffic is dominated by requests sharing long system /
+few-shot prefixes, and prefill is the compute-bound slice of serving
+(~16 ms device time per 8x1024 prompt — BASELINE.md). vLLM's
+PagedAttention (Kwon et al., SOSP 2023) and SGLang's RadixAttention
+(Zheng et al., 2024) showed that block-granular KV management plus a
+prefix index over token ids turns that shared work into an HBM copy
+instead of a recompute. This module is the TPU-native version of that
+idea for THIS framework's cache layout:
+
+- **Block pool** (``PrefixCache``): one bounded device array per
+  KV-cache leaf, shaped ``[pool_blocks, block_tokens, kv_heads,
+  head_dim]`` — fixed-size token blocks allocated from a free list,
+  ref-counted while an admission is reading them, LRU-evicted when the
+  pool fills. Block id 0 is a reserved scratch block (never allocated)
+  so padded/unused lanes of the fixed-shape kernels always have a legal
+  destination.
+- **Radix index** (``RadixIndex``): a trie over prompt token ids with
+  one edge per FULL block (``block_tokens`` ids) mapping prefixes to
+  block chains. Matching is block-granular — two prompts that diverge
+  mid-block share nothing for that block (the vLLM hash-per-full-block
+  contract); there are no partial-edge splits to manage.
+- **Canonical rotation space**: the Llama-family cache stores K rotated
+  at absolute cache-slot angles (models/llama._cached_attention), and
+  the continuous engine admits a prompt wherever the era's global
+  position counter happens to be — so the same prefix lands at
+  different slots on different admits. Pool blocks therefore store K in
+  CANONICAL space (prefix token ``j`` rotated at angle ``j``); RoPE
+  rotations compose additively (``R(aθ)·R(bθ) = R((a+b)θ)``), so
+  capture de-rotates by the row's start slot and extraction re-rotates
+  by the target start slot — one constant-angle rotation per row,
+  fused into the copy kernel. V (and non-rotary families) copy as-is.
+  The round-trip is exact in real arithmetic and float-tolerance exact
+  in practice — the same contract as the engine's mixed-length
+  batching ("logits agree to float tolerance, not bitwise").
+- **Suffix-only prefill**: an admission with ``c`` cached prefix tokens
+  scatters the block chain into the row's cache slots and feeds only
+  the suffix through the model. The fed window is snapped to the same
+  power-of-two ladder as cold admissions (engine/continuous._bucket),
+  so the compile-cache/warmup story is untouched. Inside the fed
+  window the model RECOMPUTES any overlapped prefix positions exactly
+  as the cold path would (its DUS write wins over the scattered copy),
+  which keeps warm output equal to cold output.
+
+Scope: non-rolling caches only (``window == 0`` — ring eviction order
+is position-dependent) and full-precision KV (``kv_quant == ""`` —
+rotating through an int8 round-trip would add quantization error on
+every reuse). Models declare their layout via ``kv_cache_spec()``
+(models/llama.py, models/transformer.py).
+"""
+from __future__ import annotations
+
+import functools
+import logging
+import threading
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+#: reserved pool block: padded/unused kernel lanes read and write here
+SCRATCH_BLOCK = 0
+
+
+def _path_str(path) -> str:
+    """Flax cache pytree path -> stable string key ("layers_0/self_attn/
+    cached_key") shared by the host pool dict and the traced kernels."""
+    parts = []
+    for p in path:
+        parts.append(str(getattr(p, "key", p)))
+    return "/".join(parts)
+
+
+def _leaf_kind(path_s: str, leaf) -> str | None:
+    """'key' / 'value' for poolable K/V cache leaves, None for
+    everything else (pos_index, slot_pos, int8 scales)."""
+    if getattr(leaf, "ndim", 0) != 4:
+        return None
+    name = path_s.rsplit("/", 1)[-1]
+    if name == "cached_key":
+        return "key"
+    if name == "cached_value":
+        return "value"
+    return None
+
+
+def rotate_rows(x, deltas, rope_base: float):
+    """Rotate ``[B, T, H, D]`` K rows by a per-row CONSTANT RoPE angle
+    ``deltas[b]`` (rotate-half convention, f32 math — the op-for-op
+    broadcast form of models/llama.apply_rope). Because RoPE rotations
+    compose additively, rotating canonical-space K by the row's start
+    slot reproduces the cache's absolute-slot rotation; negative deltas
+    invert (capture path)."""
+    import jax.numpy as jnp
+
+    from ..models.llama import rope_tables
+
+    d = x.shape[-1]
+    cos, sin = rope_tables(jnp.asarray(deltas, jnp.int32), d, rope_base)
+    xf = x.astype(jnp.float32)
+    rot = jnp.concatenate([-xf[..., d // 2:], xf[..., : d // 2]], axis=-1)
+    out = xf * cos[:, None, None, :] + rot * sin[:, None, None, :]
+    return out.astype(x.dtype)
+
+
+def scatter_blocks(cache, pool, block_ids, pads, pos0, feed: int,
+                   block: int, rotary: bool, rope_base: float):
+    """Scatter pool block chains into a (fresh) per-row cache pytree.
+
+    ``cache``: the group cache (leaves ``[k, total, H, D]``).
+    ``pool``: ``{path_str: [P, block, H, D]}``.
+    ``block_ids``: ``[k, nb]`` int32, ``-1`` = unused lane.
+    ``pads``: ``[k]`` row start slots (= rotation delta for K).
+    ``pos0``: scalar — the fed window start; unused lanes are
+    redirected into ``[pos0, pos0 + feed)``, which the suffix prefill's
+    own DUS writes overwrite at every layer before any read, so their
+    garbage is dead by construction. Traced; shapes are static.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    k, nb = block_ids.shape
+    tok = jnp.arange(nb * block)
+    used = jnp.repeat(block_ids >= 0, block, axis=1)        # [k, nb*block]
+    dest = jnp.where(used, pads[:, None] + tok[None, :],
+                     pos0 + (tok % feed)[None, :])
+    safe_ids = jnp.clip(block_ids, 0, None)                  # -1 -> scratch
+
+    def put(path, leaf):
+        ps = _path_str(path)
+        if ps not in pool:
+            return leaf
+        src = pool[ps][safe_ids]                 # [k, nb, block, H, D]
+        src = src.reshape(k, nb * block, *src.shape[3:])
+        if rotary and ps.endswith("cached_key"):
+            src = rotate_rows(src, pads, rope_base)
+        src = src.astype(leaf.dtype)
+        return jax.vmap(lambda row, d, s: row.at[d].set(s))(leaf, dest,
+                                                            src)
+
+    return jax.tree_util.tree_map_with_path(put, cache)
+
+
+@functools.lru_cache(maxsize=32)
+def _capture_fn(model, k: int, nb: int, block: int, rotary: bool,
+                rope_base: float):
+    """Compiled pool capture: gather ``nb`` blocks of each of ``k``
+    cache rows (row ``slots[j]``, prompt starting at slot ``pads[j]``),
+    de-rotate K to canonical space, and write them into the (donated)
+    pool at ``block_ids``. Unused lanes (``-1``) read row 0 and write
+    the scratch block. One async dispatch; never forces a sync."""
+    import jax
+    import jax.numpy as jnp
+
+    @functools.partial(jax.jit, donate_argnums=0)
+    def capture(pool, cache, slots, pads, block_ids):
+        tok = jnp.arange(nb * block)
+        used = jnp.repeat(block_ids >= 0, block, axis=1)
+        src_idx = jnp.where(used, pads[:, None] + tok[None, :], 0)
+        ids = jnp.where(block_ids >= 0, block_ids, SCRATCH_BLOCK)
+        flat = jax.tree_util.tree_flatten_with_path(dict(cache))[0]
+        by_path = {_path_str(p): leaf for p, leaf in flat}
+        out = {}
+        for ps, pool_leaf in pool.items():
+            rows = by_path[ps][slots]                       # [k, T, H, D]
+            content = jax.vmap(lambda r, i: r[i])(rows, src_idx)
+            if rotary and ps.endswith("cached_key"):
+                content = rotate_rows(content, -pads, rope_base)
+            content = content.astype(pool_leaf.dtype).reshape(
+                k, nb, block, *content.shape[2:])
+            out[ps] = pool_leaf.at[ids.reshape(-1)].set(
+                content.reshape(k * nb, block, *content.shape[3:]))
+        return out
+
+    return capture
+
+
+@functools.lru_cache(maxsize=32)
+def _warm_prefill_fn(model, total: int, feed: int, nb: int, block: int,
+                     padded: bool):
+    """Compiled batch-1 warm prefill: build a zero ``[1, total]`` cache
+    in-graph, scatter the cached block chain at canonical slots 0..c-1
+    (delta 0 — at batch 1 the prompt starts at slot 0, so pool space IS
+    cache space and K needs no re-rotation), position the counter at
+    ``pos0 = L - feed``, and run the trailing ``feed`` prompt tokens
+    through the masked continuation path. Pad-capable models
+    (``padded``) pass ``prefill=True`` with an all-zero ``pad_lens`` —
+    that combination keeps the masked einsum path (the fresh-cache
+    flash fast path requires ``pad_lens is None`` and would ignore the
+    scattered history) while still taking the model-level
+    last-position logits trim, so the ``[1, feed, V]`` head never
+    materializes. Returns ``(last_logits, cache)`` — the same contract
+    as engine/generate._prefill_fresh, so the normal decode step loop
+    takes over unchanged. Full misses never come here (the caller
+    routes c == 0 through the genuine flash prefill)."""
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def run(params, suffix, pool, block_ids, pos0):
+        shapes = jax.eval_shape(
+            lambda p: model.apply(
+                {"params": p}, jnp.zeros((1, total), jnp.int32),
+                train=False, decode=True, mutable=["cache"],
+            ),
+            params,
+        )[1]["cache"]
+        cache = jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype),
+                             shapes)
+        cache = scatter_blocks(
+            dict(cache), pool, block_ids, jnp.zeros((1,), jnp.int32),
+            pos0, feed, block, rotary=False, rope_base=0.0)
+        cache["pos_index"] = pos0.astype(jnp.int32)
+        extra = ({"prefill": True,
+                  "pad_lens": jnp.zeros((1,), jnp.int32)}
+                 if padded else {})
+        logits, vs = model.apply(
+            {"params": params, "cache": cache}, suffix,
+            train=False, decode=True, mutable=["cache"], **extra,
+        )
+        return logits[:, -1], vs["cache"]
+
+    return run
+
+
+class RadixIndex:
+    """Block-granular radix/trie over prompt token ids.
+
+    One edge per full ``block_tokens``-id chunk; each node owns exactly
+    one pool block. Matching walks whole blocks (divergence mid-block
+    shares nothing for that block). Nodes carry a refcount — held while
+    an admission's copy kernel may still read the block — and an LRU
+    clock; eviction only ever takes an UNREFERENCED LEAF (children pin
+    their ancestors by construction of the walk)."""
+
+    def __init__(self, block_tokens: int):
+        self.block = int(block_tokens)
+        self.root = {"children": {}, "block": None, "parent": None,
+                     "refs": 0, "last_use": 0}
+        self._clock = 0
+        self.nodes = 0
+
+    def _tick(self) -> int:
+        self._clock += 1
+        return self._clock
+
+    def _chunks(self, ids):
+        ids = list(ids)
+        n = len(ids) // self.block
+        return [tuple(ids[i * self.block:(i + 1) * self.block])
+                for i in range(n)]
+
+    def match(self, ids):
+        """Longest fully-blocked cached prefix of ``ids`` ->
+        ``(nodes, block_ids)`` (refs NOT acquired — see ``acquire``)."""
+        now = self._tick()
+        node, nodes, blocks = self.root, [], []
+        for chunk in self._chunks(ids):
+            nxt = node["children"].get(chunk)
+            if nxt is None:
+                break
+            nxt["last_use"] = now
+            nodes.append(nxt)
+            blocks.append(nxt["block"])
+            node = nxt
+        return nodes, blocks
+
+    def acquire(self, nodes):
+        for n in nodes:
+            n["refs"] += 1
+
+    def release(self, nodes):
+        for n in nodes:
+            n["refs"] -= 1
+            assert n["refs"] >= 0, "radix refcount underflow"
+
+    def insert(self, ids, alloc):
+        """Create nodes for every full block of ``ids`` not yet present.
+        ``alloc()`` returns a free block id or None (pool exhausted —
+        insertion stops there; the present prefix stays useful).
+        Returns ``(new_nodes, new_block_ids, start_block_index)``.
+
+        The walked path (existing AND just-created nodes) is PINNED
+        for the duration: ``alloc`` may LRU-evict, and evicting the
+        very chain being extended would detach the node the next new
+        child links under — an unreachable subtree whose blocks leak
+        forever."""
+        now = self._tick()
+        node = self.root
+        pinned = []
+        new_nodes, new_blocks, start = [], [], None
+        try:
+            for i, chunk in enumerate(self._chunks(ids)):
+                nxt = node["children"].get(chunk)
+                if nxt is None:
+                    bid = alloc()
+                    if bid is None:
+                        break
+                    nxt = {"children": {}, "block": bid, "parent": node,
+                           "chunk": chunk, "refs": 0, "last_use": now}
+                    node["children"][chunk] = nxt
+                    self.nodes += 1
+                    new_nodes.append(nxt)
+                    new_blocks.append(bid)
+                    if start is None:
+                        start = i
+                nxt["refs"] += 1
+                pinned.append(nxt)
+                nxt["last_use"] = now
+                node = nxt
+        finally:
+            for n in pinned:
+                n["refs"] -= 1
+        return new_nodes, new_blocks, (0 if start is None else start)
+
+    def evict_lru(self):
+        """Detach the least-recently-used unreferenced LEAF node and
+        return its block id (None when everything is pinned)."""
+        best, best_key = None, None
+        stack = [self.root]
+        while stack:
+            node = stack.pop()
+            for child in node["children"].values():
+                if not child["children"]:
+                    if child["refs"] == 0 and (
+                            best is None
+                            or child["last_use"] < best_key):
+                        best, best_key = child, child["last_use"]
+                else:
+                    stack.append(child)
+        if best is None:
+            return None
+        del best["parent"]["children"][best["chunk"]]
+        best["parent"] = None
+        self.nodes -= 1
+        return best["block"]
+
+
+class PrefixCache:
+    """The serving-path prefix cache: radix index + bounded device
+    block pool + the compiled capture/extract kernels.
+
+    Thread-safety: host bookkeeping (index/free list/stats) is guarded
+    by a lock; device kernels are dispatched by the caller's scheduler
+    thread, whose program order gives the read-before-overwrite
+    guarantee the immediate ref release relies on.
+    """
+
+    def __init__(self, model, params, block_tokens: int = 32,
+                 pool_blocks: int = 256, eviction: str = "lru"):
+        import jax
+        import jax.numpy as jnp
+
+        spec = getattr(model, "kv_cache_spec", None)
+        if spec is None:
+            raise ValueError(
+                f"{type(model).__name__} declares no kv_cache_spec(): "
+                "prefix caching needs the decode-cache layout contract")
+        spec = spec()
+        if spec.get("window", 0):
+            raise ValueError(
+                "prefix caching needs a non-rolling cache (window == 0):"
+                " ring eviction order is position-dependent")
+        if spec.get("kv_quant"):
+            raise ValueError(
+                "prefix caching supports full-precision KV only "
+                f"(kv_quant={spec['kv_quant']!r} would re-quantize on "
+                "every reuse)")
+        if eviction != "lru":
+            raise ValueError(f"unknown eviction policy {eviction!r} "
+                             "(only 'lru')")
+        if int(block_tokens) < 1 or int(pool_blocks) < 2:
+            raise ValueError("need block_tokens >= 1 and pool_blocks "
+                             ">= 2 (block 0 is reserved scratch)")
+        self.model = model
+        self.block = int(block_tokens)
+        self.pool_blocks = int(pool_blocks)
+        self.rotary = bool(spec.get("rotary"))
+        self.rope_base = float(spec.get("rope_base") or 0.0)
+        # device pool: one [P, block, H, D] leaf per poolable cache leaf,
+        # discovered from a [1, block] eval_shape trace (no device work)
+        shapes = jax.eval_shape(
+            lambda p: model.apply(
+                {"params": p}, jnp.zeros((1, self.block), jnp.int32),
+                train=False, decode=True, mutable=["cache"],
+            ),
+            params,
+        )[1]["cache"]
+        flat = jax.tree_util.tree_flatten_with_path(dict(shapes))[0]
+        self.pool = {}
+        for path, leaf in flat:
+            ps = _path_str(path)
+            if _leaf_kind(ps, leaf) is not None:
+                self.pool[ps] = jnp.zeros(
+                    (self.pool_blocks,) + tuple(leaf.shape[1:]),
+                    leaf.dtype)
+        if not self.pool:
+            raise ValueError(
+                f"{type(model).__name__} exposes no poolable KV leaves")
+        import inspect
+
+        self._padded = "pad_lens" in inspect.signature(
+            type(model).__call__).parameters
+        self.index = RadixIndex(self.block)
+        self._free = list(range(1, self.pool_blocks))  # 0 = scratch
+        self._lock = threading.Lock()
+        self.stats = {
+            "prefix_lookups": 0, "prefix_hit_requests": 0,
+            "prefix_hit_tokens": 0, "prefix_inserted_blocks": 0,
+            "prefix_evictions": 0, "prefix_dropped_inserts": 0,
+        }
+        self.nb_max = -(-int(model.max_len) // self.block)
+
+    # ---- host bookkeeping -------------------------------------------------
+
+    def used_blocks(self) -> int:
+        return self.pool_blocks - 1 - len(self._free)
+
+    def _alloc(self):
+        """One free block id, evicting the LRU unreferenced leaf when
+        the free list is empty; None when everything is pinned."""
+        if self._free:
+            return self._free.pop()
+        bid = self.index.evict_lru()
+        if bid is None:
+            self.stats["prefix_dropped_inserts"] += 1
+            return None
+        self.stats["prefix_evictions"] += 1
+        return bid
+
+    def lookup(self, ids):
+        """Longest cached, fully-blocked, PROPER prefix of ``ids`` ->
+        ``(nodes, block_ids, cached_tokens)``; refs acquired (callers
+        MUST ``release(nodes)`` once the copy kernel is dispatched).
+        Proper: the prompt's final token is never served from cache —
+        its logits must be computed to sample the first output token —
+        so ``cached_tokens <= len(ids) - 1``."""
+        with self._lock:
+            self.stats["prefix_lookups"] += 1
+            nodes, blocks = self.index.match(ids)
+            limit = (len(ids) - 1) // self.block     # proper-prefix cap
+            nodes, blocks = nodes[:limit], blocks[:limit]
+            c = len(nodes) * self.block
+            if c:
+                self.stats["prefix_hit_requests"] += 1
+                self.stats["prefix_hit_tokens"] += c
+                self.index.acquire(nodes)
+            return nodes, blocks, c
+
+    def release(self, nodes):
+        with self._lock:
+            self.index.release(nodes)
+
+    def plan_insert(self, ids):
+        """Allocate blocks + index nodes for the full blocks of ``ids``
+        not yet cached. Returns ``(block_ids, start_block)`` for the
+        capture kernel (empty when nothing is new)."""
+        with self._lock:
+            _, blocks, start = self.index.insert(ids, self._alloc)
+            self.stats["prefix_inserted_blocks"] += len(blocks)
+            return blocks, start
+
+    def stats_snapshot(self) -> dict:
+        with self._lock:
+            out = dict(self.stats)
+        out["prefix_pool_blocks"] = self.pool_blocks - 1
+        out["prefix_pool_blocks_used"] = self.used_blocks()
+        lk = out["prefix_lookups"]
+        out["prefix_hit_rate"] = round(
+            out["prefix_hit_requests"] / lk, 4) if lk else 0.0
+        return out
+
+    # ---- device paths -----------------------------------------------------
+
+    def capture(self, cache, slots, pads, per_row_block_ids):
+        """Fill pool blocks from admitted rows of ``cache`` (one async
+        dispatch; the pool leaves are donated through). ``slots`` /
+        ``pads``: per-row cache row + prompt start slot;
+        ``per_row_block_ids``: ``[k][nb]`` lists, ``-1`` padded."""
+        import jax.numpy as jnp
+
+        k = len(slots)
+        nb = max((len(b) for b in per_row_block_ids), default=0)
+        if nb == 0:
+            return
+        ids = np.full((k, nb), -1, np.int32)
+        for j, row in enumerate(per_row_block_ids):
+            ids[j, :len(row)] = row
+        self.pool = _capture_fn(
+            self.model, k, nb, self.block, self.rotary, self.rope_base,
+        )(self.pool, cache, jnp.asarray(np.asarray(slots, np.int32)),
+          jnp.asarray(np.asarray(pads, np.int32)), jnp.asarray(ids))
+
+    def warm_prefill(self, params, ids, total: int):
+        """Batch-1 prefill through the pool (the generate.py path):
+        scatter the cached chain, feed only the suffix, then insert the
+        prompt's own full blocks back. Returns ``(last_logits, cache,
+        cached_tokens)`` — drop-in for engine/generate._prefill_fresh.
+
+        A full MISS routes through the regular flash prefill
+        (engine/generate._prefill_fresh — the cache K/V writes land
+        before the flash fast-path return, so the result is still
+        capturable): miss-heavy traffic pays the cold path's cost, not
+        the masked-einsum continuation's. The fed width on a hit is
+        the exact suffix length — the plain path compiles per prompt
+        length already, so there is no ladder to protect at batch 1."""
+        import jax.numpy as jnp
+
+        from .generate import _prefill_fresh
+
+        L = len(ids)
+        nodes, blocks, c = self.lookup(ids)
+        try:
+            if c == 0:
+                prompt = jnp.asarray(np.asarray(ids, np.int32)[None, :])
+                last_logits, cache = _prefill_fresh(
+                    self.model, int(total))(params, prompt, None)
+            else:
+                feed = L - c
+                nb = len(blocks)
+                bid = np.asarray(blocks, np.int32)[None, :]
+                suffix = jnp.asarray(
+                    np.asarray(ids[c:], np.int32)[None, :])
+                last_logits, cache = _warm_prefill_fn(
+                    self.model, int(total), feed, nb, self.block,
+                    self._padded,
+                )(params, suffix, self.pool, jnp.asarray(bid),
+                  jnp.int32(c))
+        finally:
+            self.release(nodes)
+        new_blocks, start = self.plan_insert(ids)
+        if new_blocks:
+            row = [-1] * start + list(new_blocks)
+            self.capture(cache, [0], [0], [row])
+        return last_logits, cache, c

@@ -55,7 +55,7 @@ class GenerationService:
         obj._setup(model, params, tokenizer, **kw)
         return obj
 
-    def _setup(self, model, params, tokenizer=None):
+    def _setup(self, model, params, tokenizer=None, prefix_cache=None):
         import inspect
         import threading
 
@@ -71,10 +71,38 @@ class GenerationService:
             and int(getattr(self.model, "window", 0) or 0) == 0
         )
         self._lock = threading.Lock()
+        # paged KV prefix cache (engine/kvcache.py): either a prebuilt
+        # PrefixCache or a ``serving.prefix_cache`` config dict. A
+        # layout that cannot pool (rolling window, int8 KV, no
+        # kv_cache_spec) disables LOUDLY instead of failing the load —
+        # the operator asked for a server, not a cache
+        self._prefix = None
+        if prefix_cache is not None:
+            from .kvcache import PrefixCache
+
+            if isinstance(prefix_cache, PrefixCache):
+                self._prefix = prefix_cache
+            elif dict(prefix_cache).get("enabled"):
+                cfg = dict(prefix_cache)
+                try:
+                    self._prefix = PrefixCache(
+                        model, params,
+                        block_tokens=int(cfg.get("block_tokens", 32)),
+                        pool_blocks=int(cfg.get("pool_blocks", 256)),
+                        eviction=cfg.get("eviction", "lru"),
+                    )
+                except ValueError as e:
+                    logger.warning("prefix cache disabled: %s", e)
         # scheduler subclasses overwrite this with richer dicts in
         # their own _setup (after this super() call); the plain
         # serialized service still exposes a token counter for /metrics
         self.stats = {"tokens_generated": 0}
+
+    def prefix_cache_stats(self):
+        """Prefix-cache counters + pool occupancy for /metrics, or
+        None when no pool is attached."""
+        return (self._prefix.stats_snapshot()
+                if self._prefix is not None else None)
 
     def encode_prompt(self, prompt=None, prompt_ids=None) -> list:
         """Text or explicit ids -> validated id list (raises ValueError
@@ -221,6 +249,21 @@ class GenerationService:
             # passes per row — same request + seed samples the
             # same tokens whether or not it shared a batch
             row_rngs = jnp.stack([jax.random.key(int(seed))])
+            if (self._prefix is not None and not stops
+                    and int(max_new_tokens) >= 1
+                    and len(ids) + int(max_new_tokens)
+                    <= int(self.model.max_len)):
+                # paged prefix cache (engine/kvcache.py): prefill only
+                # the uncached suffix, then the normal step loop. Same
+                # per-(step, row) key layout as generate(), so sampled
+                # output matches the cold path; the stop-token path
+                # stays cold (its fused single-dispatch loop builds its
+                # own cache in-graph). Out-of-budget requests also fall
+                # through, so generate() raises the usual ValueError.
+                new_ids = self._generate_prefix_cached(
+                    ids, int(max_new_tokens), float(temperature),
+                    int(top_k), float(top_p), row_rngs)
+                return self._response(new_ids, stops=stops)
             if stops:
                 out, lengths = generate(
                     self.model, self.params, arr,
@@ -241,6 +284,38 @@ class GenerationService:
                 )
         return self._response(np.asarray(out[0, arr.shape[1]:]),
                               stops=stops, emitted=emitted)
+
+    def _generate_prefix_cached(self, ids, max_new: int,
+                                temperature: float, top_k: int,
+                                top_p: float, row_rngs):
+        """Batch-1 decode through the paged prefix pool: warm prefill
+        (kvcache.PrefixCache.warm_prefill — cached blocks scatter, only
+        the suffix runs through the model, the prompt's own full blocks
+        insert back) followed by the SAME step loop + per-(step, row)
+        key folding as engine/generate's eager path, so output matches
+        the cold path token for token (float-tolerance exact, like
+        every other batched-vs-solo contract in this stack). Caller
+        holds the lock and has validated budget/stops."""
+        import jax.numpy as jnp
+        import numpy as np
+
+        from .generate import _decode_fns, _fold_all_rows, _sample_rows
+
+        last_logits, cache, hit = self._prefix.warm_prefill(
+            self.params, ids, len(ids) + max_new)
+        _, step = _decode_fns(self.model, temperature, top_k, top_p)
+        if temperature <= 0:
+            keys_at = lambda i: row_rngs                   # noqa: E731
+        else:
+            all_keys = _fold_all_rows(row_rngs, max_new)
+            keys_at = lambda i: all_keys[i]                # noqa: E731
+        token = _sample_rows(keys_at(0), last_logits, temperature,
+                             top_k, top_p)
+        out = [token[:, None]]
+        for i in range(1, max_new):
+            token, cache = step(self.params, cache, token, keys_at(i))
+            out.append(token[:, None])
+        return np.asarray(jnp.concatenate(out, axis=1))[0]
 
     # Speculative fail-safe (VERDICT r4 weak #3 / next #5): prompt-
     # lookup acceptance is workload-dependent — repetitive text accepts

@@ -630,6 +630,21 @@ class LlamaLM(nn.Module):
     def batch_template(self, batch_size: int = 1):
         return jnp.zeros((batch_size, min(self.max_len, 16)), jnp.int32)
 
+    def kv_cache_spec(self) -> dict:
+        """Decode-cache layout contract consumed by engine/kvcache.py
+        (the paged prefix-cache pool). ``rotary=True``: cached K rows
+        are RoPE-rotated at absolute cache-slot angles, so block
+        capture/extraction must shift rotations by the row's start slot
+        (rotations compose additively — kvcache.rotate_rows); a rolling
+        window or int8 KV cache disqualifies the layout for pooling
+        (position-dependent eviction / re-quantization per reuse)."""
+        return {
+            "rotary": True,
+            "rope_base": float(self.rope_base),
+            "window": int(self.window),
+            "kv_quant": self.kv_quant,
+        }
+
     def partition_rules(self):
         """Megatron TP over ``tensor``: column-parallel q/k/v/gate/up,
         row-parallel o/down, vocab-sharded embedding + lm_head columns;

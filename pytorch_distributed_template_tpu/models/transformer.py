@@ -437,6 +437,20 @@ class TransformerLM(nn.Module):
     def batch_template(self, batch_size: int = 1):
         return jnp.zeros((batch_size, min(self.max_len, 16)), jnp.int32)
 
+    def kv_cache_spec(self) -> dict:
+        """Decode-cache layout contract for engine/kvcache.py (paged
+        prefix caching). ``rotary=False``: position information lives in
+        the learned embedding, so cached K/V rows carry no per-slot
+        rotation — blocks copy verbatim. Only the batch-1 canonical
+        path applies (this family is not pad-capable, so it never runs
+        the continuous slot engine)."""
+        return {
+            "rotary": False,
+            "rope_base": 0.0,
+            "window": 0,
+            "kv_quant": self.kv_quant,
+        }
+
     def partition_rules(self):
         """Megatron-style TP rules over the ``tensor`` mesh axis.
 

@@ -176,6 +176,34 @@ def analyze_telemetry(records: list) -> dict:
     return out
 
 
+def analyze_prefix(records: list) -> dict:
+    """Serving prefix-cache section from the slot engine's per-chunk
+    ``serve_chunk`` records (engine/continuous._absorb): the counters
+    are cumulative, so totals come from the LAST record; pool pressure
+    is the max occupancy seen. Empty when the run served nothing (or
+    predates the prefix cache)."""
+    serve = [r for r in records if r.get("event") == "serve_chunk"]
+    if not serve:
+        return {}
+    last = serve[-1]
+    out: dict = {"serve_chunks": len(serve)}
+    for k in ("tokens_generated_total", "admissions_total",
+              "prefix_hit_tokens_total", "prefix_hit_requests_total",
+              "prefix_lookups_total", "prefix_evictions_total",
+              "prefix_pool_blocks"):
+        if last.get(k) is not None:
+            out[k] = last[k]
+    lookups = out.get("prefix_lookups_total")
+    if lookups:
+        out["prefix_hit_rate"] = round(
+            out.get("prefix_hit_requests_total", 0) / lookups, 3)
+    used = [r["prefix_pool_blocks_used"] for r in serve
+            if r.get("prefix_pool_blocks_used") is not None]
+    if used:
+        out["prefix_pool_used_max"] = max(used)
+    return out
+
+
 def analyze_trace(path, top: int = 8) -> dict:
     """Total host-span time by name from a Chrome trace-event file."""
     try:
@@ -318,6 +346,7 @@ def to_markdown(report: dict) -> str:
         lines.append("")
 
     table("Flight recorder", report.get("telemetry", {}))
+    table("Prefix cache (serving)", report.get("prefix_cache", {}))
     table("Supervisor", report.get("supervisor", {}))
     tr = report.get("trace") or {}
     if tr.get("top_spans"):
@@ -407,7 +436,11 @@ def main(argv=None) -> int:
             cand = run_dir / "telemetry.jsonl"
             tel_path = cand if cand.exists() else None
         if tel_path is not None:
-            report["telemetry"] = analyze_telemetry(load_jsonl(tel_path))
+            records = load_jsonl(tel_path)
+            report["telemetry"] = analyze_telemetry(records)
+            prefix = analyze_prefix(records)
+            if prefix:
+                report["prefix_cache"] = prefix
         trace_path = args.trace
         if trace_path is None and run_dir is not None:
             cand = run_dir / "trace.json"

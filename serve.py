@@ -51,6 +51,13 @@ says whether the row stopped or ran out its budget. Requests with
 different stop sets still share a batch (per-row stop sets in the
 executable).
 
+A ``serving.prefix_cache`` config block (or ``--prefix-cache on``)
+attaches the paged KV block pool + radix prefix index
+(engine/kvcache.py, docs/SERVING.md): requests sharing a cached prompt
+prefix admit as an HBM block copy plus a suffix-only prefill instead
+of recomputing the whole prompt — hit/eviction/occupancy counters ride
+``GET /metrics`` and the per-chunk telemetry JSONL.
+
 Concurrent requests batch. On RoPE / non-rolling-cache models the
 default is CONTINUOUS batching (engine/continuous.py, ``--scheduler
 auto``): a slot engine over one shared KV cache where requests admit
@@ -197,6 +204,26 @@ def service_metrics(service: GenerationService) -> dict:
             out[k] = int(stats[k])
     if hasattr(service, "latency_percentiles"):
         out["latency"] = service.latency_percentiles()
+    # paged prefix-cache counters (engine/kvcache): hit tokens are
+    # prompt tokens served from the pool instead of recomputed; the
+    # pool gauges expose occupancy so operators can size
+    # serving.prefix_cache.pool_blocks from live traffic
+    prefix = (service.prefix_cache_stats()
+              if hasattr(service, "prefix_cache_stats") else None)
+    if prefix is not None:
+        out["prefix_hit_tokens_total"] = int(prefix["prefix_hit_tokens"])
+        out["prefix_hit_requests_total"] = int(
+            prefix["prefix_hit_requests"])
+        out["prefix_lookups_total"] = int(prefix["prefix_lookups"])
+        out["prefix_inserted_blocks_total"] = int(
+            prefix["prefix_inserted_blocks"])
+        out["prefix_evictions_total"] = int(prefix["prefix_evictions"])
+        out["prefix_dropped_inserts_total"] = int(
+            prefix["prefix_dropped_inserts"])
+        out["prefix_hit_rate"] = float(prefix["prefix_hit_rate"])
+        out["prefix_pool_blocks"] = int(prefix["prefix_pool_blocks"])
+        out["prefix_pool_blocks_used"] = int(
+            prefix["prefix_pool_blocks_used"])
     # persistent-compile-cache counters (utils/compile_cache): a miss is
     # a real XLA compile, a hit an executable read back from disk —
     # restart cost and mid-traffic recompile storms as scrapeable series
@@ -447,25 +474,50 @@ def main(args, config):
     configure_compile_cache(config)
     model, params, tok = load_generation_stack(config, use_ema=args.ema)
     probe = GenerationService.from_model(model, params, tok)
+    # serving.prefix_cache config block (paged KV block pool + radix
+    # prefix index, engine/kvcache.py) with CLI override: --prefix-cache
+    # on forces it even without a config block, off disables one
+    prefix_cfg = dict((config.get("serving") or {}).get(
+        "prefix_cache") or {})
+    if args.prefix_cache == "on":
+        prefix_cfg["enabled"] = True
+    elif args.prefix_cache == "off":
+        prefix_cfg["enabled"] = False
     want = args.scheduler
     if want == "auto":
         want = ("continuous" if probe._pad_ok and args.max_batch > 1
                 else "static" if args.max_batch > 1 else "none")
     if want == "continuous":
         # slot scheduler: rows admit/free mid-flight, no group keys
-        # (engine/continuous.py); RoPE + non-rolling-cache models only
+        # (engine/continuous.py); RoPE + non-rolling-cache models only.
+        # Per-chunk serving telemetry (FlightRecorder JSONL next to the
+        # run's logs — scripts/telemetry_report.py renders the prefix-
+        # cache section from it): built HERE, not unconditionally — the
+        # other schedulers never record, and an unused recorder would
+        # leave an open JSONL handle + atexit registration behind
+        from pytorch_distributed_template_tpu.observability.telemetry \
+            import FlightRecorder
+
+        recorder = FlightRecorder(run_dir=str(config.save_dir),
+                                  memory_every=0)
         service = ContinuousBatchingService.from_model(
             model, params, tok, slots=args.max_batch,
             chunk=args.decode_chunk, window_ms=args.batch_window_ms,
-            warm_buckets=warm_buckets,
+            warm_buckets=warm_buckets, prefix_cache=prefix_cfg,
+            recorder=recorder,
         )
     elif want == "static":
+        # the static micro-batch scheduler's shared-group prefill does
+        # not consult the pool (group members already share one
+        # prefill); prefix caching rides the continuous/plain paths
         service = BatchedGenerationService.from_model(
             model, params, tok, max_batch=args.max_batch,
             window_ms=args.batch_window_ms,
         )
-    else:  # plain serialized service
-        service = probe
+    else:  # plain serialized service — rebuilt so the pool attaches
+        service = (GenerationService.from_model(
+            model, params, tok, prefix_cache=prefix_cfg)
+            if prefix_cfg.get("enabled") else probe)
     logger.info("scheduler: %s", type(service).__name__)
     # on-demand profiling (POST /profile): captures land next to the
     # serving run's logs
@@ -518,6 +570,15 @@ if __name__ == "__main__":
                              "empty disables (default). Pairs with "
                              "compile_cache: a restarted server reads "
                              "the whole ladder from disk")
+    parser.add_argument("--prefix-cache", default="auto",
+                        choices=("auto", "on", "off"),
+                        help="paged KV prefix cache (engine/kvcache.py)"
+                             ": auto follows the config's "
+                             "serving.prefix_cache block; on/off "
+                             "override it. Shared prompt prefixes "
+                             "(system / few-shot preambles) admit as "
+                             "an HBM block copy + suffix-only prefill "
+                             "instead of a full recompute")
     parser.add_argument("--decode-chunk", default=8, type=int,
                         help="continuous scheduler: BASE decode steps "
                              "per dispatch (admission latency bound); "

@@ -1,0 +1,121 @@
+"""The bench final-line contract (ISSUE 5 satellite; BENCH_r03-r05).
+
+Three failure classes the driver actually hit, pinned here:
+- rc=124: a bare ``python bench.py`` ran unbudgeted and was killed by
+  the harness timeout (r05) — bare runs now ALWAYS resolve a budget
+  (env ``BENCH_BUDGET_S``, else ~600 s).
+- parsed=null at rc=0: the final stdout line overflowed the driver's
+  ~2 KB tail capture (r03/r04) — the line now self-checks (re-parse +
+  size budget) and trims its summary BEFORE printing.
+- the emit path dying on an unserializable rung field — it degrades to
+  the headline-only line instead of printing nothing.
+"""
+import io
+import json
+import sys
+from contextlib import redirect_stdout
+from pathlib import Path
+
+import pytest
+
+sys.path.insert(0, str(Path(__file__).parent.parent))
+import bench  # noqa: E402
+
+
+def test_resolve_budget_default_env_and_explicit():
+    # bare run: hard default (never unlimited)
+    assert bench._resolve_budget(None, env={}) == bench.DEFAULT_BUDGET_S
+    # env override for bare runs
+    assert bench._resolve_budget(None, env={"BENCH_BUDGET_S": "120"}) \
+        == 120.0
+    # unparseable env falls back to the default, not to unlimited
+    assert bench._resolve_budget(None, env={"BENCH_BUDGET_S": "lots"}) \
+        == bench.DEFAULT_BUDGET_S
+    # explicit CLI wins, including the legacy-unlimited 0
+    assert bench._resolve_budget(25.0, env={"BENCH_BUDGET_S": "120"}) \
+        == 25.0
+    assert bench._resolve_budget(0.0, env={}) == 0.0
+
+
+def _payload(summary):
+    return {"metric": "m", "value": 1.0, "unit": "u",
+            "vs_baseline": 0.0, "steps/s": 10.0, "tokens/s": 100.0,
+            "summary": summary}
+
+
+def test_fit_final_line_passes_small_payloads_through():
+    p = _payload({"quick": {"steps_per_sec": 10.0}})
+    line = bench._fit_final_line(p)
+    assert json.loads(line) == p
+
+
+def test_fit_final_line_trims_oversize_and_keeps_quick():
+    summary = {"quick": {"steps_per_sec": 10.0}}
+    for i in range(40):
+        summary[f"rung{i}"] = {"x": "y" * 200}
+    line = bench._fit_final_line(p := _payload(summary))
+    assert len(line) <= bench.SUMMARY_LINE_BUDGET
+    d = json.loads(line)
+    # the load-bearing fields survive any trim
+    assert d["steps/s"] == 10.0 and d["tokens/s"] == 100.0
+    assert d["summary"]["quick"] == {"steps_per_sec": 10.0}
+    assert d["summary"]["truncated"] > 0
+    del p  # payload not mutated in place
+
+
+def test_fit_final_line_degrades_on_unserializable_summary():
+    class Evil:
+        pass
+
+    line = bench._fit_final_line(_payload({"quick": {"bad": Evil()}}))
+    d = json.loads(line)                      # still ONE parseable line
+    assert d["steps/s"] == 10.0
+
+
+def test_emit_final_line_end_to_end(monkeypatch):
+    """The real emit path: last stdout line parses, carries steps/s +
+    tokens/s, and fits the tail budget — with a full fake ladder
+    including an oversized rung."""
+    monkeypatch.setattr(bench, "_printed", bench.threading.Event())
+    rungs = {"quick": {"steps_per_sec": 12.5, "tokens_per_sec": 9999.0,
+                       "steps": 30}}
+    for name, keys in bench._SUMMARY_KEYS.items():
+        rungs.setdefault(name, {k: 1.25 for k in keys})
+    rungs["resnet50"] = {"images_per_sec": 100.0, "mfu": 0.1}
+    rungs["bloated"] = {"error": "x" * 5000}
+    monkeypatch.setattr(
+        bench, "_RESULTS", {"rungs": rungs, "ref": float("nan")})
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        bench._emit_final_line()
+    last = buf.getvalue().strip().splitlines()[-1]
+    assert len(last) <= bench.SUMMARY_LINE_BUDGET
+    d = json.loads(last)
+    assert d["steps/s"] == 12.5
+    assert d["tokens/s"] == 9999.0
+    assert "summary" in d
+
+
+@pytest.mark.slow
+def test_bare_bench_run_exits_zero_with_parseable_final_line(tmp_path):
+    """End to end: a bare ``python bench.py`` (no --budget-s) under a
+    small env budget exits 0 and its LAST stdout line is the JSON
+    contract — the exact invocation the harness makes (BENCH_r05)."""
+    import os
+    import subprocess
+
+    repo = Path(__file__).parent.parent
+    env = dict(os.environ, JAX_PLATFORMS="cpu", BENCH_BUDGET_S="70")
+    # cwd=repo (not tmp_path): the package may be import-from-source
+    # only, and the quick rung's artifacts/ dir is the standard one
+    proc = subprocess.run(
+        [sys.executable, str(repo / "bench.py")],
+        capture_output=True, text=True, timeout=300, env=env,
+        cwd=repo,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    last = proc.stdout.strip().splitlines()[-1]
+    assert len(last) <= bench.SUMMARY_LINE_BUDGET
+    d = json.loads(last)
+    assert d.get("steps/s") and d["steps/s"] > 0
+    assert d.get("tokens/s") and d["tokens/s"] > 0

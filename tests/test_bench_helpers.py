@@ -65,11 +65,17 @@ def test_compact_summary_is_small_and_complete():
     assert "total_bw_frac" not in s["decode"]
     assert len(s["failed_rung"]["error"]) <= 80
     assert s["unmapped"] == {"alpha": 1.5, "beta": 2}
-    line = json.dumps({"metric": "m", "value": 1.0, "unit": "u",
-                       "vs_baseline": 1.0, "summary": s},
-                      separators=(",", ":"))
-    # budget raised 1600 -> 1700 when the recorder-backed quick rung
-    # joined the table, -> 1800 for the warm_start compile-cache rung,
-    # -> 1900 for the quick_health overhead rung, -> 1950 for the
-    # chaos kill-and-recover rung; still inside the ~2 KB tail capture
-    assert len(line) < 1950, f"summary line too big: {len(line)}B"
+    # budget history: 1600 -> 1700 (quick rung) -> 1800 (warm_start)
+    # -> 1900 (quick_health) -> 1950 (chaos). The serve_prefix rung
+    # pushed the worst-case synthetic table past a fixed cap, so the
+    # cap is now ENFORCED at emit time instead of hoped for:
+    # _fit_final_line re-parses and trims the summary to
+    # SUMMARY_LINE_BUDGET before printing (tests/test_bench_contract
+    # covers the trim semantics; here we pin that the worst-case full
+    # table still goes through the enforcement fitting the budget).
+    line = bench._fit_final_line(
+        {"metric": "m", "value": 1.0, "unit": "u",
+         "vs_baseline": 1.0, "summary": s})
+    assert len(line) <= bench.SUMMARY_LINE_BUDGET, \
+        f"summary line too big: {len(line)}B"
+    json.loads(line)

@@ -1,0 +1,317 @@
+"""Paged KV block pool + radix prefix index (engine/kvcache.py).
+
+Host invariants first (block-granular matching, refcounts pin blocks
+against eviction, LRU order under a full pool), then the load-bearing
+device contract: greedy tokens after a WARM admit — prefix served from
+the pool, only the suffix prefilled — are identical to the cold path,
+on both the batch-1 plain service and the continuous slot engine
+(whose admits land at era-dependent slots and therefore exercise the
+canonical-space RoPE re-rotation).
+"""
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pytorch_distributed_template_tpu.config.registry import MODELS
+import pytorch_distributed_template_tpu.models  # noqa: F401
+from pytorch_distributed_template_tpu.engine.continuous import (
+    ContinuousBatchingService,
+)
+from pytorch_distributed_template_tpu.engine.kvcache import (
+    PrefixCache, RadixIndex, rotate_rows,
+)
+from pytorch_distributed_template_tpu.engine.serving import (
+    GenerationService,
+)
+
+VOCAB = 64
+BLOCK = 8
+
+
+@pytest.fixture(scope="module")
+def stack():
+    model = MODELS.get("Llama")(vocab_size=VOCAB, n_layer=2, n_head=4,
+                                n_kv_head=2, d_model=32, max_len=128)
+    params = model.init(
+        jax.random.key(0), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+    solo = GenerationService.from_model(model, params)
+    return model, params, solo
+
+
+def _ids(n, seed=0, lo=1):
+    rng = np.random.default_rng(seed)
+    return [int(x) for x in rng.integers(lo, VOCAB, n)]
+
+
+# ---------------------------------------------------------------------------
+# host-side: radix index + allocation invariants
+# ---------------------------------------------------------------------------
+
+
+def test_radix_insert_and_longest_match():
+    idx = RadixIndex(4)
+    ids = list(range(11))                       # 2 full blocks + 3 tail
+    free = iter(range(1, 100))
+    new, blocks, start = idx.insert(ids, lambda: next(free))
+    assert len(new) == 2 and blocks == [1, 2] and start == 0
+    nodes, got = idx.match(ids)
+    assert got == [1, 2]
+    # longest match is per FULL block: extending the prompt matches the
+    # same chain; a prompt diverging INSIDE block 2 (the "split point")
+    # shares only block 1 — block granularity means a partial edge is
+    # never split, it just doesn't match
+    assert idx.match(ids + [99])[1] == [1, 2]
+    assert idx.match(ids[:4] + [63, 63, 63, 63])[1] == [1]
+    assert idx.match([63] + ids[1:])[1] == []
+    # re-inserting is idempotent; a longer prompt extends the chain
+    new2, blocks2, _ = idx.insert(ids, lambda: next(free))
+    assert not new2 and not blocks2
+    _, blocks3, start3 = idx.insert(ids + list(range(11, 16)),
+                                    lambda: next(free))
+    assert start3 == 2 and len(blocks3) == 2    # blocks 3+4 are new
+
+
+def test_radix_refcount_pins_blocks_and_lru_evicts_in_order():
+    idx = RadixIndex(2)
+    free = iter(range(1, 100))
+    idx.insert([1, 2, 3, 4], lambda: next(free))    # chain A: blocks 1,2
+    idx.insert([5, 6], lambda: next(free))          # chain B: block 3
+    idx.insert([7, 8], lambda: next(free))          # chain C: block 4
+    nodes_a, blocks_a = idx.match([1, 2, 3, 4])
+    idx.acquire(nodes_a)
+    # LRU candidates are unreferenced LEAVES: B was touched before C's
+    # insert and never matched since, so B evicts first, then C; chain
+    # A is pinned by the acquire, so eviction then returns None even
+    # though A's leaf (block 2) is LRU-oldest
+    idx.match([7, 8])                               # refresh C
+    assert idx.evict_lru() == 3                     # B
+    assert idx.evict_lru() == 4                     # C
+    assert idx.evict_lru() is None                  # A pinned
+    idx.release(nodes_a)
+    assert idx.evict_lru() == 2                     # A's leaf first
+    assert idx.evict_lru() == 1                     # then its parent
+    assert idx.evict_lru() is None                  # empty
+
+
+def test_insert_never_evicts_its_own_walk_path():
+    """Extending a chain with the free list dry must NOT let LRU
+    eviction take a node on the very path being walked — detaching it
+    would link the new child under an unreachable subtree and leak its
+    blocks forever. The walk pins its path; with no other candidate,
+    the insert drops instead of corrupting."""
+    idx = RadixIndex(2)
+    free = iter([1, 2, 3])
+    idx.insert([1, 2, 3, 4], lambda: next(free))
+    new, blocks, _ = idx.insert([1, 2, 3, 4, 5, 6], idx.evict_lru)
+    assert blocks == []                           # dropped, not linked
+    assert idx.match([1, 2, 3, 4])[1] == [1, 2]   # chain intact
+    # with an UNRELATED evictable chain present, the same insert
+    # succeeds by evicting that one
+    idx.insert([9, 8], lambda: next(free))        # block 3
+    _, blocks2, _ = idx.insert([1, 2, 3, 4, 5, 6], idx.evict_lru)
+    assert blocks2 == [3]
+    assert idx.match([9, 8])[1] == []
+    assert idx.match([1, 2, 3, 4, 5, 6])[1] == [1, 2, 3]
+
+
+def test_pool_eviction_never_frees_in_use_and_counts(stack):
+    model, params, _ = stack
+    pc = PrefixCache(model, params, block_tokens=BLOCK, pool_blocks=4)
+    # 3 usable blocks (block 0 is scratch): fill them with one chain
+    ids_a = _ids(3 * BLOCK + 1, seed=1)
+    blocks, start = pc.plan_insert(ids_a)
+    assert start == 0 and len(blocks) == 3
+    assert pc.used_blocks() == 3
+    nodes, got, c = pc.lookup(ids_a)
+    assert got == blocks and c == 3 * BLOCK
+    # pool full + chain referenced: an insert for a new prompt cannot
+    # evict anything — it drops, and the drop is counted
+    dropped_before = pc.stats["prefix_dropped_inserts"]
+    blocks_b, _ = pc.plan_insert(_ids(BLOCK, seed=2))
+    assert blocks_b == []
+    assert pc.stats["prefix_dropped_inserts"] > dropped_before
+    pc.release(nodes)
+    # released: the same insert now LRU-evicts chain A's leaf
+    blocks_b, _ = pc.plan_insert(_ids(BLOCK, seed=2))
+    assert len(blocks_b) == 1
+    assert pc.stats["prefix_evictions"] == 1
+    # chain A lost exactly its evicted tail
+    _, got2, c2 = pc.lookup(ids_a)
+    assert c2 == 2 * BLOCK
+
+
+def test_lookup_never_serves_the_final_token(stack):
+    """The prompt's last token must be re-fed — its logits sample the
+    first output token — so an exactly-block-aligned, fully-cached
+    prompt still matches only a PROPER prefix."""
+    model, params, _ = stack
+    pc = PrefixCache(model, params, block_tokens=BLOCK, pool_blocks=8)
+    ids = _ids(2 * BLOCK, seed=3)
+    pc.plan_insert(ids)
+    nodes, blocks, c = pc.lookup(ids)
+    assert c == BLOCK and len(blocks) == 1
+    pc.release(nodes)
+
+
+def test_rotation_composes_to_absolute_angles():
+    """The canonical-space contract: K rotated at angle a then shifted
+    by delta equals K rotated at a+delta (RoPE composition) — the fact
+    the capture/extract kernels rely on."""
+    from pytorch_distributed_template_tpu.models.llama import (
+        apply_rope, rope_tables,
+    )
+
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(2, 6, 2, 8)).astype(np.float32))
+    pos_a = jnp.arange(6)
+    cos_a, sin_a = rope_tables(pos_a, 8)
+    cos_b, sin_b = rope_tables(pos_a + 5, 8)
+    shifted = rotate_rows(apply_rope(x, cos_a, sin_a),
+                          jnp.asarray([5, 5]), 10000.0)
+    direct = apply_rope(x, cos_b, sin_b)
+    np.testing.assert_allclose(np.asarray(shifted), np.asarray(direct),
+                               atol=1e-5)
+
+
+def test_unsupported_layouts_raise(stack):
+    model, params, _ = stack
+    win = MODELS.get("Llama")(vocab_size=VOCAB, n_layer=1, n_head=2,
+                              n_kv_head=2, d_model=16, max_len=64,
+                              window=32)
+    with pytest.raises(ValueError, match="non-rolling"):
+        PrefixCache(win, params, block_tokens=8, pool_blocks=8)
+    kvq = MODELS.get("Llama")(vocab_size=VOCAB, n_layer=1, n_head=2,
+                              n_kv_head=2, d_model=16, max_len=64,
+                              kv_quant="int8")
+    with pytest.raises(ValueError, match="full-precision"):
+        PrefixCache(kvq, params, block_tokens=8, pool_blocks=8)
+    # a config asking for it on an unsupported layout degrades LOUDLY
+    # to no pool instead of failing the server load
+    svc = GenerationService.from_model(
+        win, params, prefix_cache={"enabled": True})
+    assert svc.prefix_cache_stats() is None
+
+
+# ---------------------------------------------------------------------------
+# e2e: warm output == cold output
+# ---------------------------------------------------------------------------
+
+
+def test_plain_service_warm_equals_cold_greedy_and_sampled(stack):
+    model, params, solo = stack
+    warm = GenerationService.from_model(
+        model, params,
+        prefix_cache={"enabled": True, "block_tokens": BLOCK,
+                      "pool_blocks": 16})
+    prefix = _ids(3 * BLOCK, seed=4)
+    for i in range(3):
+        ids = prefix + _ids(5, seed=10 + i)
+        for kw in ({"temperature": 0.0},
+                   {"temperature": 0.9, "top_k": 8},
+                   {"temperature": 1.0, "top_p": 0.9}):
+            a = solo.generate(prompt_ids=ids, max_new_tokens=10,
+                              seed=i, **kw)
+            b = warm.generate(prompt_ids=ids, max_new_tokens=10,
+                              seed=i, **kw)
+            assert a["ids"] == b["ids"], (i, kw)
+    stats = warm.prefix_cache_stats()
+    assert stats["prefix_hit_tokens"] >= 2 * 3 * BLOCK
+    assert stats["prefix_hit_requests"] >= 2
+
+
+def test_continuous_shared_prefix_equivalence(stack):
+    """The acceptance bar: greedy tokens after a warm-prefix admit on
+    the slot engine are identical to the cold path — including mixed
+    sampled traffic sharing the engine and admits landing at nonzero
+    era positions (the re-rotation path)."""
+    model, params, solo = stack
+    service = ContinuousBatchingService.from_model(
+        model, params, slots=3, chunk=4, window_ms=30.0,
+        prefix_cache={"enabled": True, "block_tokens": BLOCK,
+                      "pool_blocks": 32})
+    prefix = _ids(2 * BLOCK + 3, seed=5)
+    rng = np.random.default_rng(6)
+
+    def mkreq(i):
+        return {
+            "prompt_ids": prefix + [int(x) for x in
+                                    rng.integers(1, VOCAB,
+                                                 int(rng.integers(2, 8)))],
+            "max_new_tokens": int(rng.integers(3, 10)),
+            "temperature": [0.0, 0.8, 1.0][i % 3],
+            "top_k": [0, 5, 0][i % 3],
+            "seed": i,
+        }
+
+    for wave in range(2):      # wave 2 is fully warm
+        reqs = [mkreq(10 * wave + i) for i in range(5)]
+        ref = [solo.generate(**r) for r in reqs]
+        out = [None] * len(reqs)
+        errs = []
+
+        def call(i):
+            try:
+                out[i] = service.generate(**reqs[i])
+            except Exception as e:  # noqa: BLE001
+                errs.append((i, e))
+
+        threads = [threading.Thread(target=call, args=(i,))
+                   for i in range(len(reqs))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=600)
+        assert not errs, errs
+        for i, (a, b) in enumerate(zip(out, ref)):
+            assert a["ids"] == b["ids"], (wave, i, reqs[i])
+    stats = service.prefix_cache_stats()
+    assert stats["prefix_hit_tokens"] > 0
+    assert stats["prefix_pool_blocks_used"] > 0
+
+
+def test_continuous_eviction_churn_stays_exact(stack):
+    """A pool far too small for the traffic (constant LRU eviction)
+    must still be token-exact — eviction changes WHAT is reused, never
+    what is computed."""
+    model, params, solo = stack
+    service = ContinuousBatchingService.from_model(
+        model, params, slots=2, chunk=4, window_ms=20.0,
+        prefix_cache={"enabled": True, "block_tokens": BLOCK,
+                      "pool_blocks": 3})
+    for i in range(4):
+        ids = _ids(2 * BLOCK + 2, seed=20 + i)   # distinct prefixes
+        a = solo.generate(prompt_ids=ids, max_new_tokens=6, seed=i)
+        b = service.generate(prompt_ids=ids, max_new_tokens=6, seed=i)
+        assert a["ids"] == b["ids"], i
+    # repeats of the LAST prompt hit what survived
+    ids = _ids(2 * BLOCK + 2, seed=23)
+    a = solo.generate(prompt_ids=ids, max_new_tokens=6, seed=99)
+    b = service.generate(prompt_ids=ids, max_new_tokens=6, seed=99)
+    assert a["ids"] == b["ids"]
+    assert service.prefix_cache_stats()["prefix_evictions"] > 0
+
+
+def test_gpt2_family_batch1_path(stack):
+    """Non-rotary cache contract (models/transformer.kv_cache_spec):
+    the batch-1 canonical path reuses GPT-2-family blocks verbatim."""
+    model = MODELS.get("TinyLM")(vocab_size=VOCAB, n_layer=2, n_head=2,
+                                 d_model=32, max_len=128)
+    params = model.init(
+        jax.random.key(1), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+    solo = GenerationService.from_model(model, params)
+    warm = GenerationService.from_model(
+        model, params,
+        prefix_cache={"enabled": True, "block_tokens": BLOCK,
+                      "pool_blocks": 16})
+    prefix = _ids(2 * BLOCK, seed=7)
+    for i in range(2):
+        ids = prefix + _ids(4, seed=30 + i)
+        a = solo.generate(prompt_ids=ids, max_new_tokens=8, seed=i)
+        b = warm.generate(prompt_ids=ids, max_new_tokens=8, seed=i)
+        assert a["ids"] == b["ids"], i
+    assert warm.prefix_cache_stats()["prefix_hit_tokens"] > 0
