@@ -1876,6 +1876,257 @@ def bench_chaos(kill_step: int = 3, epochs: int = 1, batch: int = 16,
     }
 
 
+def bench_serve_fleet(replicas: int = 3, n_requests: int = 24,
+                      prefix_groups: int = 6, prefix_len: int = 64,
+                      suffix_len: int = 16, new_tokens: int = 8,
+                      block_tokens: int = 16, rate_rps: float = 6.0,
+                      kill: bool = True, platform: str = "cpu") -> dict:
+    """Fleet front-door rung (ISSUE 6 tentpole): the cache-aware
+    router + admission control + supervised replicas, measured end to
+    end over real serve.py subprocesses (scripts/serve_fleet.py) and
+    the trace-replay load harness (fleet/loadgen):
+
+    - **prefix-hit uplift**: identical shared-prefix traces (disjoint
+      group tags, so each arm starts cold) replayed under
+      ``round_robin`` and ``cache_aware`` placement; the hit-token
+      RATE per arm is the replicas' own ``prefix_hit_tokens_total``
+      delta over the arm's prompt tokens. Acceptance: cache-aware
+      ≥ 1.5x round-robin (asserted here).
+    - **TTFT p50/p99** under Poisson AND bursty arrivals (the
+      streaming subset's first-delta timing through the full router
+      proxy path).
+    - **kill recovery**: one replica SIGKILLed mid-trace via the
+      admin endpoint — only its in-flight requests may fail, the
+      supervisor restarts it, the router re-admits it, and the rung
+      reports time-to-recovery. The fleet then drains on SIGTERM
+      (rc 0, no orphans) — asserted.
+
+    CPU children like chaos/warm_start (the parent may hold the
+    accelerator lock; routing mechanics are platform-independent).
+    ``BENCH_FLEET_REPLICAS`` overrides the replica count (the CI
+    fleet-smoke job runs 2 on a tiny budget)."""
+    import signal as signal_mod
+    import subprocess
+    import tempfile
+    import urllib.request
+
+    from pytorch_distributed_template_tpu.fleet import loadgen
+    from pytorch_distributed_template_tpu.fleet.replicas import (
+        http_json,
+    )
+
+    replicas = int(os.environ.get("BENCH_FLEET_REPLICAS", replicas))
+    repo = os.path.dirname(os.path.abspath(__file__))
+    env = dict(os.environ, JAX_PLATFORMS=platform)
+
+    def get_json(url, path, timeout=10.0):
+        return http_json(url + path, timeout)
+
+    def replica_hit_tokens(router_url) -> int:
+        """Sum prefix_hit_tokens_total over the replicas DIRECTLY
+        (poll-lag-free, unlike the router's aggregated series)."""
+        total = 0
+        for rep in get_json(router_url, "/healthz")["replicas"]:
+            if rep["url"]:
+                try:
+                    m = get_json(rep["url"], "/metrics?format=json")
+                    total += int(m.get("prefix_hit_tokens_total", 0))
+                except OSError:
+                    pass
+        return total
+
+    def healthy_count(router_url) -> int:
+        try:
+            hz = get_json(router_url, "/healthz", timeout=5.0)
+        except (OSError, ValueError):
+            return -1
+        return sum(1 for r in hz["replicas"]
+                   if r["state"] == "healthy")
+
+    with tempfile.TemporaryDirectory(prefix="bench-fleet-") as d:
+        art = os.path.join(d, "artifact")
+        subprocess.run(
+            [sys.executable,
+             os.path.join(repo, "scripts", "make_serving_artifact.py"),
+             "-o", art, "--max-len", "256",
+             "--block-tokens", str(block_tokens),
+             "--compile-cache-dir", os.path.join(d, "xla-cache")],
+            check=True, env=env, timeout=600, cwd=repo,
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+        run_dir = os.path.join(d, "fleet")
+        log_path = os.path.join(d, "fleet.log")
+
+        def log_tail(n: int = 1500) -> str:
+            try:
+                with open(log_path) as f:
+                    return f.read()[-n:]
+            except OSError:
+                return "<no log>"
+
+        log_f = open(log_path, "w")
+        try:
+            proc = subprocess.Popen(
+                [sys.executable,
+                 os.path.join(repo, "scripts", "serve_fleet.py"),
+                 "-r", os.path.join(art, "model"),
+                 "--replicas", str(replicas), "--port", "0",
+                 "--run-dir", run_dir, "--admin", "--poll-s", "0.3",
+                 "--readmit-after", "1", "--restart-delay", "0.5",
+                 "--block-tokens", str(block_tokens),
+                 "--", "--max-batch", "4", "--decode-chunk", "4"],
+                stdout=log_f, stderr=subprocess.STDOUT,
+                env=env, cwd=repo)
+        finally:
+            log_f.close()      # the child holds its own dup
+        _CHILD_PROCS.add(proc)
+        try:
+            url = None
+            deadline = time.time() + 420
+            while time.time() < deadline:
+                try:
+                    with open(log_path) as f:
+                        for line in f:
+                            if line.startswith("READY "):
+                                url = line.split()[1].strip()
+                                break
+                except OSError:
+                    pass
+                if url or proc.poll() is not None:
+                    break
+                time.sleep(0.5)
+            if url is None or proc.poll() is not None:
+                raise RuntimeError(
+                    "serve_fleet never READY: " + log_tail())
+            while (healthy_count(url) != replicas
+                   and time.time() < deadline):
+                time.sleep(1.0)
+            if healthy_count(url) != replicas:
+                raise RuntimeError(
+                    "replicas never all healthy: " + log_tail())
+
+            def arm(tag, policy=None, arrival="poisson", n=n_requests):
+                trace = loadgen.build_trace(
+                    n, seed=11, prefix_groups=prefix_groups,
+                    group_tag=tag, prefix_len=prefix_len,
+                    suffix_len=suffix_len, max_new_tokens=new_tokens,
+                    arrival=arrival, rate_rps=rate_rps,
+                    stream_frac=0.5)   # vocab default 256 = artifact's
+                before = replica_hit_tokens(url)
+                summary = loadgen.summarize(
+                    loadgen.replay(url, trace, timeout_s=300,
+                                   policy=policy), trace)
+                summary["hit_tokens"] = replica_hit_tokens(url) - before
+                summary["hit_rate"] = round(
+                    summary["hit_tokens"]
+                    / max(summary["prompt_tokens"], 1), 4)
+                return summary
+
+            # unmeasured warmup: compiles every admit/SSE path once
+            arm("w", n=max(2 * replicas, 4))
+            rr = arm("b", policy="round_robin")
+            ca = arm("a")                       # cache_aware default
+            bursty = arm("c", arrival="bursty")
+            if rr["errors"] or ca["errors"] or bursty["errors"]:
+                raise RuntimeError(
+                    f"fleet arms saw errors: rr={rr['errors']} "
+                    f"ca={ca['errors']} bursty={bursty['errors']}")
+            uplift = ca["hit_rate"] / max(rr["hit_rate"], 1e-9)
+            if ca["hit_rate"] <= 0:
+                raise RuntimeError(f"cache-aware arm hit nothing: {ca}")
+            # acceptance gate at the 3-replica configuration; at 2
+            # replicas round robin re-caches every hot prefix on both
+            # sides within a couple of repeats, so the PHYSICAL margin
+            # shrinks — CI's 2-replica smoke asserts nonzero hit rate
+            # instead (ISSUE 6)
+            if replicas >= 3 and uplift < 1.5:
+                raise RuntimeError(
+                    f"prefix-uplift contract violated: cache_aware "
+                    f"{ca['hit_rate']} vs round_robin "
+                    f"{rr['hit_rate']} (x{uplift:.2f} < 1.5)")
+
+            recovery_s = None
+            kill_errors = 0
+            if kill:
+                # kill r1 mid-trace: ONLY its in-flight may fail
+                trace = loadgen.build_trace(
+                    max(n_requests, 16), seed=13,
+                    prefix_groups=prefix_groups, group_tag="k",
+                    prefix_len=prefix_len, suffix_len=suffix_len,
+                    max_new_tokens=new_tokens, rate_rps=rate_rps / 2,
+                    stream_frac=0.5)
+                out = {}
+                th = threading.Thread(
+                    target=lambda: out.update(loadgen.replay(
+                        url, trace, timeout_s=300)))
+                th.start()
+                time.sleep(trace[-1]["t"] * 0.3)
+                req = urllib.request.Request(
+                    url + "/admin/kill?replica=r1", data=b"",
+                    method="POST")
+                killed = json.loads(urllib.request.urlopen(
+                    req, timeout=10).read())["killed"]
+                if not killed:
+                    raise RuntimeError("admin kill found no child")
+                t_kill = time.monotonic()
+                th.join(timeout=600)
+                summary = loadgen.summarize(out, trace)
+                kill_errors = summary["errors"]
+                slots = 4
+                if kill_errors > 2 * slots + 2:
+                    raise RuntimeError(
+                        f"replica kill failed {kill_errors} requests "
+                        f"(> in-flight bound {2 * slots + 2}): "
+                        f"{summary}")
+                deadline = time.time() + 300
+                while (healthy_count(url) != replicas
+                       and time.time() < deadline):
+                    time.sleep(0.5)
+                if healthy_count(url) != replicas:
+                    raise RuntimeError(
+                        "killed replica never re-admitted: " + log_tail())
+                recovery_s = round(time.monotonic() - t_kill, 3)
+                # traffic rebalances onto the recovered replica
+                probe = loadgen.summarize(loadgen.replay(
+                    url, loadgen.build_trace(
+                        4, seed=17, prefix_groups=1, group_tag="p",
+                        prefix_len=prefix_len, suffix_len=suffix_len,
+                        max_new_tokens=2, rate_rps=20.0,
+                        stream_frac=0.0),
+                    timeout_s=120))
+                if probe["errors"]:
+                    raise RuntimeError(
+                        f"post-recovery probe failed: {probe}")
+
+            # drain contract: SIGTERM -> rc 0, preemption-path exits,
+            # no orphans
+            proc.send_signal(signal_mod.SIGTERM)
+            rc = proc.wait(timeout=120)
+            if rc != 0 or "DRAINED" not in log_tail(1 << 20):
+                raise RuntimeError(
+                    f"fleet drain violated (rc={rc}): " + log_tail())
+        finally:
+            _CHILD_PROCS.discard(proc)
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait(timeout=30)
+    return {
+        "replicas": replicas,
+        "prefix_uplift": round(uplift, 3),
+        "ca_hit_rate": ca["hit_rate"],
+        "rr_hit_rate": rr["hit_rate"],
+        "agg_tok_s": ca["agg_tok_s"],
+        "shed_rate": ca["shed_rate"],
+        "ttft_p50_poisson_s": ca["ttft_p50_s"],
+        "ttft_p99_poisson_s": ca["ttft_p99_s"],
+        "ttft_p50_bursty_s": bursty["ttft_p50_s"],
+        "ttft_p99_bursty_s": bursty["ttft_p99_s"],
+        "tpot_p50_s": ca["tpot_p50_s"],
+        "time_to_recovery_s": recovery_s,
+        "kill_failed_requests": kill_errors,
+        "platform": platform,
+    }
+
+
 def _recorder_timed_loop(state, step_fn, batch_arrays, recorder, n,
                          batch, seq, monitor=None, health_keys=()):
     """One timed window of ``n`` steps through the flight recorder;
@@ -2070,6 +2321,10 @@ _SUMMARY_KEYS = {
     # (cold TTFT and the full percentiles live in the full ladder)
     "serve_prefix": ("warm_prefill_speedup", "ttft_p50_warm_s",
                      "ttft_p50_cold_s"),
+    # fleet rung: cache-aware routing uplift + the recovery headline
+    # (per-arm TTFT p99s and shed/kill counts live in the full ladder)
+    "serve_fleet": ("prefix_uplift", "ca_hit_rate",
+                    "ttft_p50_poisson_s", "time_to_recovery_s"),
     "decode_spec": ("speedup", "speedup_natural", "tokens_per_call"),
     "flash_attention_8k": ("speedup",),
 }
@@ -2400,6 +2655,18 @@ _LADDER = [
         (bench_serve_prefix, {"prefix_len": 256, "suffix_len": 16,
                               "n_layer": 2, "d_model": 128,
                               "n_requests": 4, "block_tokens": 32}),
+    ]),
+    # fleet front door: cache-aware router + admission control over
+    # real serve.py subprocess replicas, trace-replay load, mid-trace
+    # kill recovery, SIGTERM drain (fleet/; scripts/serve_fleet.py).
+    # LAST of the serving rungs: multi-minute (spawns a whole fleet),
+    # so small budgets skip it and CI runs it via --only serve_fleet
+    ("serve_fleet", [
+        (bench_serve_fleet, {}),
+        # fallback arm: 2 replicas, smaller trace, no kill (the
+        # cheapest configuration that still proves routing + shed)
+        (bench_serve_fleet, {"replicas": 2, "n_requests": 12,
+                             "prefix_groups": 4, "kill": False}),
     ]),
     # speculative decoding (prompt-lookup drafting): latency-oriented
     # batch-1 serving — speedup is workload-dependent, so the rung

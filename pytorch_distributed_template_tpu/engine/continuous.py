@@ -531,22 +531,14 @@ class ContinuousBatchingService(GenerationService):
             return result
         ids = self.encode_prompt(prompt, prompt_ids)
         stops = self.encode_stop(stop)
-        if len(stops) > self.MAX_STOPS:
-            raise ValueError(
-                f"at most {self.MAX_STOPS} stop tokens per request "
-                f"(got {len(stops)})")
         max_new = int(max_new_tokens)
-        if max_new < 1:
-            raise ValueError("max_new_tokens must be >= 1")
-        max_len = int(self.model.max_len)
-        if self._bucket(len(ids)) + max_new > max_len:
-            # checked on the BUCKETED length: admission rounds prompts
-            # up to the executable bucket, so a request that only fits
-            # unbucketed could never be admitted and would hang
-            raise ValueError(
-                f"prompt ({len(ids)} tokens, admission bucket "
-                f"{self._bucket(len(ids))}) + max_new_tokens "
-                f"({max_new}) exceeds model.max_len {max_len}")
+        # ONE owner for the enqueue rules (shared with serve.py's
+        # pre-SSE validate_request — a rule changed here cannot drift
+        # from the 400 path): stop-set width, max_new >= 1, and the
+        # budget on the BUCKETED prompt length (admission rounds
+        # prompts up to the executable bucket, so a request that only
+        # fits unbucketed could never be admitted and would hang)
+        self._validate_budget(ids, max_new, stops)
         seed = int(seed)
         if self._host_keys and seed >= 0:
             key_data = np.asarray(
@@ -573,7 +565,46 @@ class ContinuousBatchingService(GenerationService):
             raise req["error"]
         return req["result"]
 
+    def _validate_budget(self, ids, max_new: int, stops,
+                         speculative: int = 0) -> None:
+        """The slot engine's enqueue-time checks, for serve.py's
+        pre-SSE validation: speculative requests bypass the engine
+        (parent's plain budget rule); slot requests check the BUCKETED
+        prompt length (admission rounds prompts up to the executable
+        bucket — a request that only fits unbucketed could never admit
+        and would hang) and the static stop-set width."""
+        if speculative > 0:
+            return super()._validate_budget(ids, max_new, stops)
+        if len(stops) > self.MAX_STOPS:
+            raise ValueError(
+                f"at most {self.MAX_STOPS} stop tokens per request "
+                f"(got {len(stops)})")
+        if max_new < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        max_len = int(self.model.max_len)
+        if self._bucket(len(ids)) + max_new > max_len:
+            raise ValueError(
+                f"prompt ({len(ids)} tokens, admission bucket "
+                f"{self._bucket(len(ids))}) + max_new_tokens "
+                f"({max_new}) exceeds model.max_len {max_len}")
+
     # ---- scheduler internals --------------------------------------------
+
+    @classmethod
+    def _grow_cap(cls, live) -> int:
+        """Adaptive chunk-growth cap (x base chunk) for the CURRENT
+        live set: full ``GROW_MAX`` only when no live row can exit a
+        chunk early. Rows with stop tokens can finish mid-chunk, and
+        rows carrying a CANCEL event (streaming clients that may
+        disconnect) are honored at the next absorb — both classes cap
+        growth at ``GROW_MAX_STOPS`` so a freed slot (or a cancelled
+        client's slot) is recycled within a short chunk, not up to
+        GROW_MAX x chunk + one pipelined chunk later (ADVICE r5)."""
+        return (min(cls.GROW_MAX_STOPS, cls.GROW_MAX)
+                if any(m["req"]["stop"]
+                       or m["req"].get("cancel") is not None
+                       for m in live)
+                else cls.GROW_MAX)
 
     @staticmethod
     def _bucket(n: int) -> int:
@@ -1009,15 +1040,13 @@ class ContinuousBatchingService(GenerationService):
         # the tunnel; the uniform-burst case of the serve_mixed rung).
         # With free slots the base chunk stands, keeping admission
         # latency for new arrivals at one short chunk; with stop
-        # tokens in play rows can finish mid-chunk, so growth is
-        # capped at 4x to bound both the wasted frozen-row steps and
-        # the slot-recycle delay.
+        # tokens OR cancel events in play rows can exit mid-chunk
+        # (a disconnect is only honored at the next absorb), so
+        # growth is capped at 4x to bound the wasted frozen-row
+        # steps, the slot-recycle delay, and the cancel latency.
         if min_left > self._chunk and not any(
                 m is None for m in self._meta):
-            limit = min(min_left, self._chunk * (
-                min(self.GROW_MAX_STOPS, self.GROW_MAX)
-                if any(m["req"]["stop"] for m in live)
-                else self.GROW_MAX))
+            limit = min(min_left, self._chunk * self._grow_cap(live))
             grown = self._chunk
             while grown * 2 <= limit:
                 grown *= 2       # power-of-two LADDER: the executable

@@ -193,6 +193,41 @@ class GenerationService:
             raise ValueError(f"stop id outside [0, {self.vocab})")
         return ids
 
+    def validate_request(self, req: dict) -> None:
+        """Cheap host-side validation of a wire-format request body
+        (the dict serve.py reads off the socket): raises the same
+        ``ValueError`` the matching ``generate()`` call would, WITHOUT
+        touching the device. serve.py runs it before committing a 200
+        ``text/event-stream`` response, so a bad streaming request
+        gets the 400 its non-streaming twin gets instead of a 200 +
+        SSE error event (ADVICE r5). Numeric coercions mirror
+        serve._run_request — a non-numeric ``max_new_tokens`` is as
+        much a 400 as an over-budget one."""
+        ids = self.encode_prompt(req.get("prompt"),
+                                 req.get("prompt_ids"))
+        stops = self.encode_stop(req.get("stop"))
+        max_new = int(req.get("max_new_tokens", 64))
+        if max_new < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        float(req.get("temperature", 0.0))
+        int(req.get("top_k", 0))
+        float(req.get("top_p", 0.0))
+        int(req.get("seed", 0))
+        speculative = int(req.get("speculative", 0))
+        self._validate_budget(ids, max_new, stops,
+                              speculative=speculative)
+
+    def _validate_budget(self, ids, max_new: int, stops,
+                         speculative: int = 0) -> None:
+        """Scheduler-specific budget/shape checks (subclasses refine):
+        the plain and static paths reject prompt + budget past
+        ``max_len`` at enqueue."""
+        max_len = int(getattr(self.model, "max_len", 0) or 0)
+        if max_len and len(ids) + max_new > max_len:
+            raise ValueError(
+                f"prompt ({len(ids)} tokens) + max_new_tokens "
+                f"({max_new}) exceeds model.max_len {max_len}")
+
     def decode_text(self, ids):
         """Generated ids -> text, when the model has a text form
         (byte vocab or a recovered tokenizer); else None."""
@@ -534,20 +569,15 @@ class BatchedGenerationService(GenerationService):
                 speculative=speculative, stop=stop,
             )
         # validate in the CALLER's thread: bad input must raise here
-        # (HTTP 400), not poison the worker
+        # (HTTP 400), not poison the worker. The budget rule lives in
+        # _validate_budget (ONE owner, shared with serve.py's pre-SSE
+        # validate_request): group keys pin max_new_tokens, so if
+        # every member individually fits, padding to the longest
+        # member's length fits too — one oversized request can never
+        # fail its batchmates
         ids = self.encode_prompt(prompt, prompt_ids)
         stops = self.encode_stop(stop)
-        max_len = int(getattr(self.model, "max_len", 0) or 0)
-        if max_len and len(ids) + int(max_new_tokens) > max_len:
-            # per-request budget check at ENQUEUE: group keys pin
-            # max_new_tokens, so if every member individually fits,
-            # padding to the longest member's length fits too — one
-            # oversized request can never fail its batchmates
-            raise ValueError(
-                f"prompt ({len(ids)} tokens) + max_new_tokens "
-                f"({int(max_new_tokens)}) exceeds model.max_len "
-                f"{max_len}"
-            )
+        self._validate_budget(ids, int(max_new_tokens), stops)
         req = {
             "ids": ids,
             "max_new_tokens": int(max_new_tokens),

@@ -1,0 +1,35 @@
+"""Serving fleet front door: cache-aware router, admission control,
+and a trace-replay load harness.
+
+One ``serve.py`` process cannot be "heavy traffic from millions of
+users" — this package composes the pieces the repo already has
+(``resilience.supervisor`` lifecycle, ``/healthz`` + ``/metrics``,
+the paged KV prefix cache's per-replica hit counters) into a fleet:
+
+- :mod:`.placement` — a host-side block-granular radix index over
+  prompt ids (mirroring ``engine/kvcache.RadixIndex``'s one-edge-per-
+  full-block contract) that remembers which replica last served each
+  prefix, plus the placement policy: shared-prefix traffic steers to
+  the replica already holding the blocks (SGLang-style cache-aware
+  scheduling), falling back to least-loaded.
+- :mod:`.admission` — admission control and backpressure: a bounded
+  waiting room with per-tenant weighted fair queueing (``X-Tenant``
+  header), 429 + ``Retry-After`` shedding past the watermark.
+- :mod:`.replicas` — replica lifecycle: N supervised ``serve.py``
+  children (one :class:`resilience.supervisor.Supervisor` each, so
+  exit classification / backoff / crash budget / drain are shared with
+  training), READY-line URL discovery, health polling with ejection +
+  re-admission, rolling drain-restarts, and reset-corrected
+  aggregation of the replicas' prefix-cache counters.
+- :mod:`.router` — the HTTP front door itself: request proxying
+  (including SSE streaming passthrough with disconnect-propagating
+  cancellation), ``/healthz`` + ``/metrics`` on the router, and the
+  flag-gated ``/admin`` kill/drain endpoints the chaos paths use.
+- :mod:`.loadgen` — a deterministic trace-replay load generator
+  (Poisson and bursty multi-tenant arrivals, shared-prefix mixture,
+  SSE + non-streaming, cancellations) and its latency/shed summary.
+
+Stdlib-only like the rest of the resilience layer: the router manages
+jax processes, it is not one — importing this package must never pull
+in jax. Entry point: ``scripts/serve_fleet.py``; docs: docs/FLEET.md.
+"""

@@ -1,0 +1,247 @@
+"""Admission control and backpressure for the fleet front door.
+
+Continuous batching only pays off when queue depth stays inside the
+batching sweet spot (Orca/vLLM lineage): past that point every admitted
+request just inflates everyone's TTFT, and an unbounded queue turns a
+traffic spike into a latency collapse that outlives the spike. The
+router therefore runs all traffic through ONE gate:
+
+- **Concurrency cap** — at most ``capacity_fn()`` requests are
+  in flight fleet-wide (the replica manager computes it from live
+  healthy-replica slots x an oversubscription factor, so capacity
+  breathes with ejections and recoveries).
+- **Bounded waiting room** — past the cap, requests wait in per-tenant
+  queues drained in *start-time weighted fair queueing* order: each
+  request gets a virtual-time finish tag ``max(global_vtime,
+  tenant_tag) + cost / weight``; grants always take the smallest tag.
+  A tenant flooding the fleet only stretches its OWN virtual clock —
+  a light tenant's next request tags barely past the global clock and
+  admits ahead of the flood's backlog (the ``X-Tenant`` header keys
+  the queue; weights are operator-set, default 1.0).
+- **Watermark shedding** — when the waiting room is full (globally, or
+  the tenant's own slice), the request is REJECTED NOW with 429 + a
+  ``Retry-After`` estimated from the current drain rate, instead of
+  queueing unboundedly: a shed client can back off and land later; a
+  queued-forever client times out after burning a slot's worth of
+  work. Waiters that outlive ``queue_timeout_s`` shed the same way.
+
+Pure stdlib + threads, no HTTP here: the router calls
+``submit()``/``release()`` around each proxied request, tests drive it
+directly with fake clocks-free determinism (grants are condition-
+variable broadcasts; ordering is the tag heap, not thread timing).
+"""
+from __future__ import annotations
+
+import heapq
+import itertools
+import math
+import threading
+import time
+from typing import Callable, Dict, Optional
+
+#: submit() outcomes (also the shed-counter keys in stats())
+ADMITTED = "admitted"
+SHED_WATERMARK = "shed_watermark"
+SHED_TENANT = "shed_tenant"
+SHED_TIMEOUT = "shed_timeout"
+
+
+class _Ticket:
+    # waiters sleep on the shared condition variable and check their
+    # own `granted` flag after each broadcast — no per-ticket event
+    __slots__ = ("tag", "seq", "tenant", "charge", "granted",
+                 "abandoned")
+
+    def __init__(self, tag: float, seq: int, tenant: str,
+                 charge: float):
+        self.tag = tag
+        self.seq = seq
+        self.tenant = tenant
+        self.charge = charge     # cost/weight, refunded on abandon
+        self.granted = False
+        self.abandoned = False
+
+    def __lt__(self, other):      # heap order: (tag, arrival seq)
+        return (self.tag, self.seq) < (other.tag, other.seq)
+
+
+class FairAdmission:
+    """The gate: concurrency cap + WFQ waiting room + watermark shed.
+
+    :param capacity_fn: live fleet capacity (max concurrent in-flight
+        requests); re-read at every grant decision so ejections and
+        recoveries take effect immediately.
+    :param weights: ``{tenant: weight}``; unlisted tenants get
+        ``default_weight``. Twice the weight ⇒ half the virtual cost
+        per request ⇒ ~twice the grant share under contention.
+    :param max_waiting: fleet-wide waiting-room bound (the shed
+        watermark): total queue depth never exceeds capacity + this.
+    :param max_waiting_per_tenant: per-tenant slice of the waiting
+        room (default: ``max_waiting`` — no per-tenant bound beyond
+        the global one).
+    :param queue_timeout_s: waiters older than this shed (429) rather
+        than holding a doomed connection open.
+    """
+
+    def __init__(self, capacity_fn: Callable[[], int],
+                 weights: Optional[Dict[str, float]] = None,
+                 default_weight: float = 1.0,
+                 max_waiting: int = 64,
+                 max_waiting_per_tenant: Optional[int] = None,
+                 queue_timeout_s: float = 30.0):
+        self._capacity_fn = capacity_fn
+        self._weights = dict(weights or {})
+        self._default_weight = float(default_weight)
+        self.max_waiting = int(max_waiting)
+        self.max_waiting_per_tenant = int(
+            max_waiting if max_waiting_per_tenant is None
+            else max_waiting_per_tenant)
+        self.queue_timeout_s = float(queue_timeout_s)
+        self._cv = threading.Condition()
+        self._heap: list = []
+        self._seq = itertools.count()
+        self._inflight = 0
+        self._waiting_total = 0
+        self._waiting_by_tenant: Dict[str, int] = {}
+        self._vtime = 0.0
+        self._tenant_tag: Dict[str, float] = {}
+        # EWMA of observed request service time, seeding Retry-After
+        self._avg_service_s = 1.0
+        self._stats: Dict[str, int] = {
+            ADMITTED: 0, SHED_WATERMARK: 0, SHED_TENANT: 0,
+            SHED_TIMEOUT: 0,
+        }
+        self._tenant_stats: Dict[str, Dict[str, int]] = {}
+
+    # -- bookkeeping --------------------------------------------------------
+
+    def weight(self, tenant: str) -> float:
+        w = float(self._weights.get(tenant, self._default_weight))
+        return w if w > 0 else 1.0
+
+    def _bump(self, tenant: str, outcome: str) -> None:
+        self._stats[outcome] += 1
+        t = self._tenant_stats.setdefault(
+            tenant, {ADMITTED: 0, SHED_WATERMARK: 0, SHED_TENANT: 0,
+                     SHED_TIMEOUT: 0})
+        t[outcome] += 1
+
+    def observe_service_s(self, seconds: float) -> None:
+        """Feed a completed request's duration into the Retry-After
+        estimator (EWMA, alpha 0.2)."""
+        with self._cv:
+            self._avg_service_s += 0.2 * (max(float(seconds), 0.01)
+                                          - self._avg_service_s)
+
+    def retry_after_s(self) -> int:
+        """Honest back-off hint: how long until the CURRENT backlog
+        drains at the current capacity and service rate, clamped to
+        [1, 60] so clients neither hammer nor give up."""
+        with self._cv:
+            cap = max(int(self._capacity_fn()), 1)
+            backlog = self._waiting_total + self._inflight
+            est = math.ceil(backlog * self._avg_service_s / cap)
+        return max(1, min(int(est), 60))
+
+    # -- the gate -----------------------------------------------------------
+
+    def submit(self, tenant: str, cost: float = 1.0,
+               timeout_s: Optional[float] = None) -> str:
+        """Ask to run one request. Returns :data:`ADMITTED` (caller
+        MUST ``release()`` when the request finishes) or a shed reason
+        (caller answers 429 and does NOT release)."""
+        timeout_s = (self.queue_timeout_s if timeout_s is None
+                     else float(timeout_s))
+        with self._cv:
+            cap = max(int(self._capacity_fn()), 0)
+            if self._inflight < cap and not self._heap:
+                self._inflight += 1
+                self._bump(tenant, ADMITTED)
+                return ADMITTED
+            if self._waiting_total >= self.max_waiting:
+                self._bump(tenant, SHED_WATERMARK)
+                return SHED_WATERMARK
+            if (self._waiting_by_tenant.get(tenant, 0)
+                    >= self.max_waiting_per_tenant):
+                self._bump(tenant, SHED_TENANT)
+                return SHED_TENANT
+            charge = max(float(cost), 1e-9) / self.weight(tenant)
+            tag = (max(self._vtime, self._tenant_tag.get(tenant, 0.0))
+                   + charge)
+            self._tenant_tag[tenant] = tag
+            ticket = _Ticket(tag, next(self._seq), tenant, charge)
+            heapq.heappush(self._heap, ticket)
+            self._waiting_total += 1
+            self._waiting_by_tenant[tenant] = (
+                self._waiting_by_tenant.get(tenant, 0) + 1)
+            # a grant slot may already be open (e.g. capacity grew):
+            self._grant_locked()
+            deadline = time.monotonic() + timeout_s
+            while not ticket.granted:
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    ticket.abandoned = True   # popped lazily
+                    self._waiting_total -= 1
+                    self._waiting_by_tenant[tenant] -= 1
+                    # REFUND the virtual-clock charge: a shed request
+                    # did no work, and leaving its charge in place
+                    # would keep penalizing the tenant's post-overload
+                    # traffic for requests that never ran (later
+                    # queued tags stacked on this one keep their
+                    # values — only future requests stop paying)
+                    self._tenant_tag[tenant] = (
+                        self._tenant_tag.get(tenant, 0.0)
+                        - ticket.charge)
+                    self._bump(tenant, SHED_TIMEOUT)
+                    return SHED_TIMEOUT
+                self._cv.wait(left)
+            self._bump(tenant, ADMITTED)
+            return ADMITTED
+
+    def release(self) -> None:
+        """One in-flight request finished: free its slot and grant the
+        smallest-tag waiter(s)."""
+        with self._cv:
+            self._inflight = max(self._inflight - 1, 0)
+            self._grant_locked()
+
+    def kick(self) -> None:
+        """Re-evaluate grants after an external capacity change (the
+        replica poller calls this on recovery — waiting requests must
+        not sit until the next release)."""
+        with self._cv:
+            self._grant_locked()
+
+    def _grant_locked(self) -> None:
+        cap = max(int(self._capacity_fn()), 0)
+        granted = False
+        while self._heap and self._inflight < cap:
+            ticket = heapq.heappop(self._heap)
+            if ticket.abandoned:
+                continue
+            ticket.granted = True
+            self._inflight += 1
+            self._waiting_total -= 1
+            self._waiting_by_tenant[ticket.tenant] -= 1
+            self._vtime = max(self._vtime, ticket.tag)
+            granted = True
+        if granted:
+            self._cv.notify_all()
+
+    # -- observability ------------------------------------------------------
+
+    def depths(self) -> dict:
+        with self._cv:
+            return {"inflight": self._inflight,
+                    "waiting": self._waiting_total,
+                    "capacity": max(int(self._capacity_fn()), 0)}
+
+    def stats(self) -> dict:
+        with self._cv:
+            out = dict(self._stats)
+            out["shed_total"] = (out[SHED_WATERMARK] + out[SHED_TENANT]
+                                 + out[SHED_TIMEOUT])
+            out["tenants"] = {t: dict(v)
+                              for t, v in self._tenant_stats.items()}
+            out["avg_service_s"] = round(self._avg_service_s, 4)
+        return out

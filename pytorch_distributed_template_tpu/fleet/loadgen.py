@@ -1,0 +1,357 @@
+"""Trace-replay load harness for the serving fleet.
+
+Serving numbers are only as honest as the traffic that produced them,
+so the bench's fleet rung replays a *deterministic trace* — built once
+from a seed, identical across arms — instead of ad-hoc request loops:
+
+- **Arrival process**: ``poisson`` (exponential inter-arrivals at
+  ``rate_rps``) or ``bursty`` (the same Poisson stream gated by an
+  on/off duty cycle at ``burst_factor`` x the rate inside bursts —
+  the arrival shape that actually breaks naive admission control).
+- **Multi-tenant**: each request carries an ``X-Tenant`` header drawn
+  from a weighted tenant mix (the router's WFQ is keyed on it).
+- **Shared-prefix mixture**: prompts are ``group prefix + unique
+  suffix`` over ``prefix_groups`` seeded groups — the SGLang-style
+  workload where cache-aware placement pays. Distinct group tags per
+  arm keep arms cold-start comparable.
+- **Transport mix**: a ``stream_frac`` fraction rides SSE (yielding
+  real TTFT/TPOT per token) and the rest plain JSON; a
+  ``cancel_frac`` fraction of streaming requests disconnects
+  mid-stream, exercising the router's cancel propagation.
+
+``replay`` drives a trace against any ``/generate`` endpoint (replica
+or router) with one thread per request honoring the arrival schedule;
+``summarize`` folds the results into the rung's numbers (aggregate
+tok/s, TTFT/TPOT p50/p99, shed rate, per-tenant shares). Stdlib-only;
+``python -m pytorch_distributed_template_tpu.fleet.loadgen --url ...``
+replays from the command line.
+"""
+from __future__ import annotations
+
+import http.client
+import json
+import random
+import socket
+import threading
+import time
+from typing import Dict, List, Optional
+from urllib.parse import urlsplit
+
+
+def build_trace(n_requests: int, seed: int = 0,
+                tenants=("t0", "t1", "t2"),
+                tenant_weights: Optional[Dict[str, float]] = None,
+                prefix_groups: int = 4, group_tag: str = "g",
+                prefix_len: int = 64, suffix_len: int = 16,
+                max_new_tokens: int = 8, temperature: float = 0.0,
+                arrival: str = "poisson", rate_rps: float = 8.0,
+                burst_duty: float = 0.25, burst_factor: float = 6.0,
+                burst_period_s: float = 2.0,
+                stream_frac: float = 0.5, cancel_frac: float = 0.0,
+                cancel_after_s: float = 0.5,
+                vocab: int = 256) -> List[dict]:
+    """Deterministic request trace: same seed ⇒ same trace, byte for
+    byte. ``group_tag`` namespaces the prefix groups — two arms with
+    different tags share NO prefixes, so each starts cold."""
+    rng = random.Random(f"loadgen:{seed}")
+    prefixes = []
+    for g in range(prefix_groups):
+        grng = random.Random(f"prefix:{seed}:{group_tag}:{g}")
+        prefixes.append([grng.randrange(1, vocab)
+                         for _ in range(prefix_len)])
+    tenants = list(tenants)
+    weights = [float((tenant_weights or {}).get(t, 1.0))
+               for t in tenants]
+    # arrival times: a Poisson stream, optionally duty-cycle gated into
+    # bursts (the gated stream keeps Poisson statistics INSIDE a burst)
+    times: List[float] = []
+    t = 0.0
+    burst_rate = rate_rps * burst_factor
+    while len(times) < n_requests:
+        if arrival == "poisson":
+            t += rng.expovariate(rate_rps)
+            times.append(t)
+        elif arrival == "bursty":
+            t += rng.expovariate(burst_rate)
+            if (t % burst_period_s) < burst_duty * burst_period_s:
+                times.append(t)
+        else:
+            raise ValueError(f"unknown arrival {arrival!r} "
+                             "(poisson|bursty)")
+    trace = []
+    for i, at in enumerate(times):
+        g = rng.randrange(prefix_groups)
+        suffix = [rng.randrange(1, vocab) for _ in range(suffix_len)]
+        stream = rng.random() < stream_frac
+        cancel = (stream and cancel_frac > 0
+                  and rng.random() < cancel_frac)
+        trace.append({
+            "i": i, "t": round(at, 4),
+            "tenant": rng.choices(tenants, weights=weights)[0],
+            "group": f"{group_tag}{g}",
+            "prompt_ids": prefixes[g] + suffix,
+            "max_new_tokens": int(max_new_tokens),
+            "temperature": float(temperature),
+            "stream": stream,
+            "cancel_after_s": (float(cancel_after_s) if cancel
+                               else None),
+        })
+    return trace
+
+
+def prompt_tokens(trace: List[dict]) -> int:
+    return sum(len(item["prompt_ids"]) for item in trace)
+
+
+def _percentile(sorted_vals: List[float], q: float) -> Optional[float]:
+    if not sorted_vals:
+        return None
+    if len(sorted_vals) == 1:
+        return sorted_vals[0]
+    pos = q * (len(sorted_vals) - 1)
+    lo = int(pos)
+    hi = min(lo + 1, len(sorted_vals) - 1)
+    frac = pos - lo
+    return sorted_vals[lo] * (1 - frac) + sorted_vals[hi] * frac
+
+
+def _run_one(base: str, item: dict, t_start: float, results: list,
+             lock: threading.Lock, timeout_s: float,
+             policy: Optional[str]) -> None:
+    rec = {"i": item["i"], "tenant": item["tenant"],
+           "group": item["group"], "stream": item["stream"],
+           "prompt_tokens": len(item["prompt_ids"]),
+           "ok": False, "shed": False, "cancelled": False,
+           "tokens": 0, "status": None, "error": None,
+           "ttft_s": None, "tpot_s": None, "total_s": None}
+    delay = t_start + item["t"] - time.monotonic()
+    if delay > 0:
+        time.sleep(delay)
+    url = urlsplit(base)
+    body = {k: item[k] for k in ("prompt_ids", "max_new_tokens",
+                                 "temperature")}
+    if item["stream"]:
+        body["stream"] = True
+    headers = {"Content-Type": "application/json",
+               "X-Tenant": item["tenant"]}
+    if policy:
+        headers["X-Fleet-Policy"] = policy
+    t0 = time.monotonic()
+    conn = http.client.HTTPConnection(url.hostname, url.port,
+                                      timeout=timeout_s)
+    try:
+        conn.request("POST", "/generate", body=json.dumps(body),
+                     headers=headers)
+        resp = conn.getresponse()
+        rec["status"] = resp.status
+        ct = resp.getheader("Content-Type", "")
+        if resp.status == 429:
+            rec["shed"] = True
+            rec["retry_after"] = resp.getheader("Retry-After")
+            resp.read()
+        elif resp.status != 200:
+            rec["error"] = f"http {resp.status}"
+            resp.read()
+        elif ct.startswith("text/event-stream"):
+            _consume_sse(resp, conn, item, rec, t0)
+        else:
+            data = json.loads(resp.read().decode("utf-8"))
+            rec["tokens"] = len(data.get("ids") or ())
+            rec["ok"] = True
+    except (OSError, http.client.HTTPException, ValueError) as e:
+        rec["error"] = f"{type(e).__name__}: {e}"
+    finally:
+        conn.close()
+        rec["total_s"] = round(time.monotonic() - t0, 4)
+        with lock:
+            results.append(rec)
+
+
+def _sse_socket(resp, conn):
+    """The live socket under an SSE response. With HTTP/1.0
+    close-delimited responses http.client detaches the socket from the
+    connection at ``getresponse()`` (``conn.sock`` is None) — the
+    response's buffered reader holds it."""
+    sock = getattr(conn, "sock", None)
+    if sock is None:
+        raw = getattr(getattr(resp, "fp", None), "raw", None)
+        sock = getattr(raw, "_sock", None)
+    return sock
+
+
+def _consume_sse(resp, conn, item: dict, rec: dict,
+                 t0: float) -> None:
+    """Read ``data:`` events until done; first token delta stamps TTFT,
+    the delta cadence yields TPOT. A ``cancel_after_s`` request closes
+    the connection mid-stream (the router propagates the disconnect as
+    a slot-engine cancel)."""
+    cancel_after = item.get("cancel_after_s")
+    sock = _sse_socket(resp, conn) if cancel_after is not None else None
+    t_first = t_last = None
+    try:
+        while True:
+            if cancel_after is not None:
+                elapsed = time.monotonic() - t0
+                if elapsed >= cancel_after or sock is None:
+                    rec["cancelled"] = True
+                    rec["ok"] = True   # a deliberate cancel = success
+                    return
+                sock.settimeout(cancel_after - elapsed)
+            try:
+                line = resp.readline()
+            except (socket.timeout, OSError):
+                rec["cancelled"] = True
+                rec["ok"] = True
+                return
+            if not line:
+                rec["error"] = rec["error"] or "stream truncated"
+                return
+            if not line.startswith(b"data: "):
+                continue
+            event = json.loads(line[len(b"data: "):])
+            if "error" in event:
+                rec["error"] = event["error"]
+                return
+            now = time.monotonic()
+            if event.get("done"):
+                rec["tokens"] = (len(event.get("ids") or ())
+                                 or rec["tokens"])
+                rec["ok"] = True
+                if (t_first is not None and t_last is not None
+                        and rec["tokens"] > 1 and t_last > t_first):
+                    rec["tpot_s"] = round(
+                        (t_last - t_first) / (rec["tokens"] - 1), 5)
+                return
+            ids = event.get("ids") or ()
+            if ids:
+                if t_first is None:
+                    t_first = now
+                    rec["ttft_s"] = round(now - t0, 4)
+                t_last = now
+                rec["tokens"] += len(ids)
+    finally:
+        # conn.close() alone cannot reach a detached socket — closing
+        # the RESPONSE is what actually hangs up (the cancel signal)
+        try:
+            resp.close()
+        except OSError:
+            pass
+
+
+def replay(base_url: str, trace: List[dict], timeout_s: float = 120.0,
+           policy: Optional[str] = None) -> dict:
+    """Replay a trace against ``base_url`` honoring its arrival
+    schedule (one thread per request). Returns ``{"results": [...],
+    "wall_s": ...}``."""
+    results: List[dict] = []
+    lock = threading.Lock()
+    t_start = time.monotonic() + 0.05
+    threads = [
+        threading.Thread(target=_run_one,
+                         args=(base_url, item, t_start, results, lock,
+                               timeout_s, policy),
+                         daemon=True)
+        for item in trace
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=timeout_s + trace[-1]["t"] + 30.0)
+    wall_s = time.monotonic() - t_start
+    return {"results": results, "wall_s": round(wall_s, 3)}
+
+
+def summarize(replayed: dict, trace: Optional[List[dict]] = None
+              ) -> dict:
+    """Fold a replay into the rung's numbers. TTFT/TPOT percentiles
+    come from the streaming subset (the only honest first-token
+    signal); aggregate tok/s counts every generated token over the
+    replay wall clock."""
+    results = replayed["results"]
+    wall_s = max(replayed["wall_s"], 1e-9)
+    ttfts = sorted(r["ttft_s"] for r in results
+                   if r["ttft_s"] is not None)
+    tpots = sorted(r["tpot_s"] for r in results
+                   if r["tpot_s"] is not None)
+    totals = sorted(r["total_s"] for r in results
+                    if r["ok"] and r["total_s"] is not None)
+    n = len(results)
+    shed = sum(r["shed"] for r in results)
+    errors = sum(1 for r in results if r["error"])
+    tokens = sum(r["tokens"] for r in results)
+    per_tenant: Dict[str, dict] = {}
+    for r in results:
+        t = per_tenant.setdefault(
+            r["tenant"], {"requests": 0, "ok": 0, "shed": 0,
+                          "tokens": 0})
+        t["requests"] += 1
+        t["ok"] += int(r["ok"])
+        t["shed"] += int(r["shed"])
+        t["tokens"] += r["tokens"]
+    out = {
+        "requests": n,
+        "ok": sum(r["ok"] for r in results),
+        "shed": shed,
+        "errors": errors,
+        "cancelled": sum(r["cancelled"] for r in results),
+        "shed_rate": round(shed / n, 4) if n else 0.0,
+        "error_rate": round(errors / n, 4) if n else 0.0,
+        "tokens_out": tokens,
+        "agg_tok_s": round(tokens / wall_s, 2),
+        "wall_s": round(wall_s, 3),
+        "ttft_p50_s": _percentile(ttfts, 0.5),
+        "ttft_p99_s": _percentile(ttfts, 0.99),
+        "tpot_p50_s": _percentile(tpots, 0.5),
+        "tpot_p99_s": _percentile(tpots, 0.99),
+        "latency_p50_s": _percentile(totals, 0.5),
+        "latency_p99_s": _percentile(totals, 0.99),
+        "per_tenant": per_tenant,
+    }
+    if trace is not None:
+        out["prompt_tokens"] = prompt_tokens(trace)
+    return out
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    p = argparse.ArgumentParser(
+        description="trace-replay load generator for /generate "
+                    "endpoints (fleet router or a single serve.py)")
+    p.add_argument("--url", required=True,
+                   help="base URL, e.g. http://127.0.0.1:8900")
+    p.add_argument("--n", type=int, default=32)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--arrival", default="poisson",
+                   choices=("poisson", "bursty"))
+    p.add_argument("--rate", type=float, default=8.0, metavar="RPS")
+    p.add_argument("--tenants", default="t0,t1,t2")
+    p.add_argument("--prefix-groups", type=int, default=4)
+    p.add_argument("--prefix-len", type=int, default=64)
+    p.add_argument("--suffix-len", type=int, default=16)
+    p.add_argument("--max-new-tokens", type=int, default=8)
+    p.add_argument("--stream-frac", type=float, default=0.5)
+    p.add_argument("--cancel-frac", type=float, default=0.0)
+    p.add_argument("--group-tag", default="g")
+    p.add_argument("--policy", default=None,
+                   help="X-Fleet-Policy override (cache_aware|"
+                        "least_loaded|round_robin)")
+    p.add_argument("--timeout-s", type=float, default=120.0)
+    args = p.parse_args(argv)
+    trace = build_trace(
+        args.n, seed=args.seed,
+        tenants=[t for t in args.tenants.split(",") if t],
+        prefix_groups=args.prefix_groups, group_tag=args.group_tag,
+        prefix_len=args.prefix_len, suffix_len=args.suffix_len,
+        max_new_tokens=args.max_new_tokens, arrival=args.arrival,
+        rate_rps=args.rate, stream_frac=args.stream_frac,
+        cancel_frac=args.cancel_frac)
+    summary = summarize(replay(args.url, trace,
+                               timeout_s=args.timeout_s,
+                               policy=args.policy), trace)
+    print(json.dumps(summary, indent=2))
+    return 0 if summary["errors"] == 0 else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

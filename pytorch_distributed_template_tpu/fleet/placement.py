@@ -1,0 +1,235 @@
+"""Cache-aware request placement: a fleet-level radix over prompt ids.
+
+The serving replicas each run a paged KV prefix cache whose radix index
+is block-granular — one edge per FULL ``block_tokens``-id chunk, no
+partial-edge splits (``engine/kvcache.RadixIndex``, the vLLM
+hash-per-block contract). Routing can only exploit that cache if the
+router's own view of "who holds which prefix" uses the SAME chunking:
+:class:`FleetRadix` mirrors the trie host-side, but instead of pool
+block ids its nodes carry the set of replicas that were last routed a
+prompt through that prefix. A match therefore predicts, per replica,
+how many prompt tokens would be served from its pool instead of
+recomputed — the exact quantity the replicas report back as
+``prefix_hit_tokens_total``.
+
+The router cannot see the replicas' evictions, so the index is a
+best-effort *prediction*, kept honest three ways: it is bounded
+(LRU-evicting leaves past ``max_nodes``, like the device pool it
+mirrors), a replica's entries are dropped wholesale when the replica
+dies (its pool restarts empty), and a stale prediction costs only a
+cold prefill on the chosen replica — correctness never depends on it.
+
+Placement (:func:`choose_replica`) is SGLang-style cache-aware
+scheduling: send the request to the replica with the deepest cached
+prefix, UNLESS that replica is overloaded relative to the least-loaded
+candidate (``load_spread``) — affinity must never turn one hot prefix
+into a hotspot that queues while other replicas idle. No match (or the
+``least_loaded`` policy) falls back to least-loaded; ``round_robin``
+ignores both and is the bench's control arm.
+
+Text prompts (no ids on the wire) key the trie on their UTF-8 bytes:
+the affinity signal — "these two requests share a long literal prefix"
+— is the same one the replica's tokenizer would produce, and the
+router must not load a tokenizer (stdlib-only, model-agnostic).
+"""
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, Optional, Tuple
+
+#: routing decision labels (router metrics count requests per reason)
+REASON_PREFIX = "prefix"
+REASON_LEAST_LOADED = "least_loaded"
+REASON_ROUND_ROBIN = "round_robin"
+
+POLICIES = ("cache_aware", "least_loaded", "round_robin")
+
+
+def affinity_ids(body: dict) -> list:
+    """Wire request body -> the id sequence the radix keys on:
+    ``prompt_ids`` verbatim when present, else the UTF-8 bytes of
+    ``prompt``. Malformed payloads (the replica will 400 them anyway)
+    key as empty — they route least-loaded and never touch the trie."""
+    ids = body.get("prompt_ids")
+    if isinstance(ids, (list, tuple)):
+        try:
+            return [int(i) for i in ids]
+        except (TypeError, ValueError):
+            return []
+    prompt = body.get("prompt")
+    if prompt is None:
+        return []
+    return list(str(prompt).encode("utf-8"))
+
+
+class FleetRadix:
+    """Block-granular trie over prompt ids -> replicas that hold them.
+
+    One edge per full ``block_tokens``-id chunk; matching walks whole
+    blocks, so two prompts diverging mid-block share nothing for that
+    block — byte-for-byte the contract of the replica-side index this
+    predicts. Nodes carry the replica ids routed through them and an
+    LRU clock; the node count is bounded by evicting the least-
+    recently-used leaf (children keep ancestors alive by construction,
+    exactly like the device pool's eviction)."""
+
+    def __init__(self, block_tokens: int = 32, max_nodes: int = 4096):
+        if int(block_tokens) < 1:
+            raise ValueError("block_tokens must be >= 1")
+        self.block = int(block_tokens)
+        self.max_nodes = int(max_nodes)
+        self.root: dict = {"children": {}, "replicas": set(),
+                           "parent": None, "chunk": None, "last_use": 0}
+        self.nodes = 0
+        self._clock = 0
+
+    def _tick(self) -> int:
+        self._clock += 1
+        return self._clock
+
+    def _chunks(self, ids) -> list:
+        ids = list(ids)
+        n = len(ids) // self.block
+        return [tuple(ids[i * self.block:(i + 1) * self.block])
+                for i in range(n)]
+
+    def match(self, ids) -> Dict[str, int]:
+        """Longest cached prefix per replica: ``{replica_id: predicted
+        hit tokens}`` (deepest node containing the replica wins). Like
+        the replica's own lookup, the match is PROPER — the final
+        prompt token is never served from cache — so the walk is capped
+        at ``(len(ids) - 1) // block`` full blocks."""
+        now = self._tick()
+        out: Dict[str, int] = {}
+        node = self.root
+        limit = max((len(list(ids)) - 1) // self.block, 0)
+        for depth, chunk in enumerate(self._chunks(ids)[:limit], 1):
+            node = node["children"].get(chunk)
+            if node is None:
+                break
+            node["last_use"] = now
+            for rid in node["replicas"]:
+                out[rid] = depth * self.block
+        return out
+
+    def record(self, ids, replica_id: str) -> int:
+        """Note that ``replica_id`` was just routed a prompt: after the
+        admit, its pool holds every FULL block of ``ids``. Creates
+        missing nodes, stamps the replica down the whole path, and
+        LRU-evicts past ``max_nodes``. Returns blocks walked."""
+        now = self._tick()
+        node = self.root
+        walked = 0
+        for chunk in self._chunks(ids):
+            nxt = node["children"].get(chunk)
+            if nxt is None:
+                nxt = {"children": {}, "replicas": set(), "parent": node,
+                       "chunk": chunk, "last_use": now}
+                node["children"][chunk] = nxt
+                self.nodes += 1
+            nxt["replicas"].add(replica_id)
+            nxt["last_use"] = now
+            node = nxt
+            walked += 1
+        if self.nodes > self.max_nodes:
+            self._evict_batch(protect_from=now)
+        return walked
+
+    def drop_replica(self, replica_id: str) -> int:
+        """A replica died or restarted: its pool is empty, so every
+        prediction naming it is stale. Removes it everywhere and prunes
+        the subtrees no replica claims anymore; returns nodes pruned."""
+        pruned = 0
+        stack = [self.root]
+        leaves: List[dict] = []
+        while stack:
+            node = stack.pop()
+            node["replicas"].discard(replica_id)
+            for child in node["children"].values():
+                stack.append(child)
+            if node is not self.root and not node["children"]:
+                leaves.append(node)
+        for node in leaves:
+            # walk up from each leaf deleting replica-less chains
+            while (node is not None and node is not self.root
+                   and not node["children"] and not node["replicas"]):
+                parent = node["parent"]
+                del parent["children"][node["chunk"]]
+                node["parent"] = None
+                self.nodes -= 1
+                pruned += 1
+                node = parent
+        return pruned
+
+    def _evict_batch(self, protect_from: int) -> None:
+        """Prune back toward ~90% of ``max_nodes`` in ONE trie walk:
+        collect every leaf, evict least-recently-used first, never
+        touching nodes stamped at the current clock (``protect_from``
+        — the chain being recorded must survive its own insertion).
+        record() runs under the router's placement lock on every
+        request, so eviction must be amortized-cheap — one O(trie)
+        sweep per ~0.1*max_nodes insertions, not one per node."""
+        target = max(int(self.max_nodes * 0.9), 1)
+        leaves: List[dict] = []
+        stack = [self.root]
+        while stack:
+            node = stack.pop()
+            for child in node["children"].values():
+                if child["children"]:
+                    stack.append(child)
+                elif child["last_use"] < protect_from:
+                    leaves.append(child)
+        leaves.sort(key=lambda n: n["last_use"])
+        for node in leaves:
+            if self.nodes <= target:
+                break
+            del node["parent"]["children"][node["chunk"]]
+            node["parent"] = None
+            self.nodes -= 1
+
+
+def choose_replica(candidates: Iterable[Tuple[str, float]],
+                   matches: Dict[str, int],
+                   policy: str = "cache_aware",
+                   rr_counter: int = 0,
+                   min_match_tokens: int = 1,
+                   load_spread: float = 4.0
+                   ) -> Optional[Tuple[str, str]]:
+    """Pick a replica for one request -> ``(replica_id, reason)``.
+
+    ``candidates``: ``(replica_id, load)`` pairs for the HEALTHY
+    replicas (load = the router's per-replica queue estimate: its own
+    in-flight accounting plus the replica's last-polled queue depth).
+    ``matches``: :meth:`FleetRadix.match` for the request's ids.
+
+    ``cache_aware``: the deepest-match replica wins (ties break toward
+    lighter load) unless its load exceeds the least-loaded candidate
+    by more than ``load_spread`` — a popular prefix must never queue
+    behind itself while the rest of the fleet idles; past the spread
+    the request goes least-loaded (and the radix will record the
+    prefix THERE, so the hot prefix naturally replicates). Returns
+    None when ``candidates`` is empty (caller answers 503)."""
+    cands = sorted(candidates)          # stable: by (rid, load)
+    if not cands:
+        return None
+    if policy == "round_robin":
+        rid, _ = cands[rr_counter % len(cands)]
+        return rid, REASON_ROUND_ROBIN
+    least_load = min(load for _, load in cands)
+    # rotate among the equally-least-loaded (an idle fleet would
+    # otherwise pile every new prefix onto the lexicographically
+    # first replica until load breaks the tie)
+    tied = [rid for rid, load in cands if load <= least_load]
+    least_rid = tied[rr_counter % len(tied)]
+    if policy != "least_loaded":
+        scored = [(matches.get(rid, 0), rid, load)
+                  for rid, load in cands
+                  if matches.get(rid, 0) >= max(min_match_tokens, 1)]
+        if scored:
+            best_tokens = max(s[0] for s in scored)
+            hit_rid, hit_load = min(
+                ((rid, load) for tok, rid, load in scored
+                 if tok == best_tokens),
+                key=lambda c: (c[1], c[0]))
+            if hit_load - least_load <= load_spread:
+                return hit_rid, REASON_PREFIX
+    return least_rid, REASON_LEAST_LOADED

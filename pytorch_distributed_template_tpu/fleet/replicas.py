@@ -1,0 +1,472 @@
+"""Replica lifecycle for the serving fleet: spawn, watch, eject, heal.
+
+Each replica is one ``serve.py`` process wrapped in its own
+:class:`resilience.supervisor.Supervisor` (run in a thread), so the
+fleet inherits the training stack's process management verbatim: exit
+classification (a drained replica exits :data:`EXIT_PREEMPTED` and
+restarts budget-free; a SIGKILL is a crash that burns backoff budget),
+crash-loop give-up, and drain-on-SIGTERM. The manager adds what a
+fleet needs on top:
+
+- **URL discovery** — replicas bind ``--port 0`` and print ``READY
+  http://host:port``; the poller tails each replica's log for the
+  newest READY line, so a restarted replica (new port) is re-found
+  without any bind-race bookkeeping.
+- **Health polling → ejection / re-admission** — one poller thread
+  scrapes every replica's ``/metrics?format=json`` (queue depth, live
+  slots, prefix-cache counters in one call). ``eject_after``
+  consecutive failures eject the replica: no new traffic, its entries
+  drop from the placement radix (its pool restarts empty).
+  ``readmit_after`` consecutive successes re-admit it and record the
+  time-to-recovery.
+- **Counter aggregation** — per-replica monotonic counters
+  (requests, generated tokens, prefix hit tokens, ...) are folded
+  into fleet-level series with counter-reset correction, so a replica
+  restart never makes the fleet's ``prefix_hit_tokens_total`` jump
+  backwards.
+- **Chaos / rolling restarts** — ``kill_replica`` (SIGKILL through
+  the supervisor: the bench's mid-trace failure injection) and
+  ``drain_replica`` (stop routing, wait for in-flight to finish,
+  SIGTERM ⇒ the replica's preemption path ⇒ supervised restart: a
+  rolling restart costs zero failed requests).
+
+Stdlib-only; every lifecycle event is one JSONL line in
+``router.jsonl`` (same :class:`EventLog` as the supervisor's), which
+``scripts/telemetry_report.py --fleet`` folds into its report.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import signal as signal_mod
+import threading
+import time
+import urllib.request
+from pathlib import Path
+from typing import Dict, List, Optional
+
+from ..resilience.supervisor import EventLog, Supervisor, SupervisorConfig
+from .placement import FleetRadix, choose_replica
+
+STARTING = "starting"
+HEALTHY = "healthy"
+EJECTED = "ejected"
+DRAINING = "draining"
+
+#: per-replica monotonic counters folded (reset-corrected) into
+#: fleet-level aggregates on every poll
+AGGREGATED_COUNTERS = (
+    "requests_total", "requests_completed", "tokens_generated_total",
+    "cancelled_total", "prefix_hit_tokens_total",
+    "prefix_hit_requests_total", "prefix_lookups_total",
+    "prefix_evictions_total",
+)
+
+
+def http_json(url: str, timeout_s: float = 5.0) -> dict:
+    """GET ``url`` -> parsed JSON (the one copy of this helper — the
+    poller, the bench rung, and the tests all scrape with it)."""
+    with urllib.request.urlopen(url, timeout=timeout_s) as resp:
+        return json.loads(resp.read().decode("utf-8"))
+
+
+class Replica:
+    """One fleet member: a supervised ``serve.py`` child (``cmd``) or
+    an externally managed server (``url`` — attach mode, tests)."""
+
+    def __init__(self, rid: str, cmd: Optional[List[str]] = None,
+                 url: Optional[str] = None,
+                 run_dir: Optional[Path] = None,
+                 sup_cfg: Optional[SupervisorConfig] = None):
+        if (cmd is None) == (url is None):
+            raise ValueError("a replica needs exactly one of cmd/url")
+        self.rid = rid
+        self.cmd = list(cmd) if cmd else None
+        self.url = url
+        self.managed = cmd is not None
+        self.state = STARTING
+        self.inflight = 0              # router-accounted live requests
+        self.fail_streak = 0
+        self.ok_streak = 0
+        self.polled: dict = {}         # last /metrics?format=json
+        self.cum: Dict[str, float] = {k: 0 for k in AGGREGATED_COUNTERS}
+        self._last_raw: Dict[str, float] = {}
+        self.ejected_at: Optional[float] = None
+        self.supervisor: Optional[Supervisor] = None
+        self.thread: Optional[threading.Thread] = None
+        self.log_path: Optional[Path] = None
+        if self.managed:
+            assert run_dir is not None
+            rdir = Path(run_dir) / rid
+            rdir.mkdir(parents=True, exist_ok=True)
+            self.log_path = rdir / "serve.log"
+            # COPY before specializing: callers naturally share one
+            # policy config across replicas, and mutating it in place
+            # would point every child's log at the last replica's file
+            cfg = dataclasses.replace(
+                sup_cfg or SupervisorConfig(),
+                events_path=str(rdir / "supervisor.jsonl"),
+                child_output_path=str(self.log_path))
+            self.supervisor = Supervisor(self.cmd, cfg)
+
+    # -- URL discovery ------------------------------------------------------
+
+    def discover_url(self) -> Optional[str]:
+        """Newest ``READY http://...`` line in the replica's log (the
+        log is append-only across restarts, so last wins). Attach-mode
+        replicas keep their fixed URL."""
+        if not self.managed:
+            return self.url
+        try:
+            with open(self.log_path, "rb") as f:
+                f.seek(0, os.SEEK_END)
+                f.seek(max(f.tell() - 16384, 0))
+                tail = f.read().decode("utf-8", errors="replace")
+        except OSError:
+            return self.url
+        for line in reversed(tail.splitlines()):
+            if line.startswith("READY "):
+                self.url = line.split()[1].strip()
+                break
+        return self.url
+
+    def absorb_counters(self, polled: dict) -> None:
+        """Fold this poll's monotonic counters into the cumulative
+        fleet series, treating a drop as a restart (the new value IS
+        the delta since reset)."""
+        for key in AGGREGATED_COUNTERS:
+            new = polled.get(key)
+            if not isinstance(new, (int, float)):
+                continue
+            last = self._last_raw.get(key, 0)
+            self.cum[key] += (new - last) if new >= last else new
+            self._last_raw[key] = new
+
+    def load_estimate(self) -> float:
+        """The router's per-replica queue estimate: its own live
+        in-flight accounting plus the replica's last-reported internal
+        queue depth (requests the replica has accepted but not yet
+        slotted)."""
+        return self.inflight + float(self.polled.get("queue_depth", 0))
+
+    def slots(self, default: int = 1) -> int:
+        return int(self.polled.get("slots", default) or default)
+
+
+class FleetManager:
+    """Owns the replicas, the placement radix, and the poller."""
+
+    def __init__(self, replicas: List[Replica],
+                 run_dir, policy: str = "cache_aware",
+                 block_tokens: int = 32, radix_max_nodes: int = 4096,
+                 min_match_tokens: int = 1, load_spread: float = 4.0,
+                 poll_s: float = 1.0, poll_timeout_s: float = 2.0,
+                 eject_after: int = 2, readmit_after: int = 2,
+                 queue_factor: float = 2.0, slots_hint: int = 4,
+                 snapshot_every: int = 20,
+                 on_capacity_change=None):
+        self.replicas = {r.rid: r for r in replicas}
+        self.policy = policy
+        self.radix = FleetRadix(block_tokens=block_tokens,
+                                max_nodes=radix_max_nodes)
+        self.min_match_tokens = int(min_match_tokens)
+        self.load_spread = float(load_spread)
+        self.poll_s = float(poll_s)
+        self.poll_timeout_s = float(poll_timeout_s)
+        self.eject_after = int(eject_after)
+        self.readmit_after = int(readmit_after)
+        self.queue_factor = float(queue_factor)
+        self.slots_hint = int(slots_hint)
+        self.snapshot_every = int(snapshot_every)
+        self.on_capacity_change = on_capacity_change
+        self.run_dir = Path(run_dir)
+        self.run_dir.mkdir(parents=True, exist_ok=True)
+        self.events = EventLog(self.run_dir / "router.jsonl")
+        self._lock = threading.Lock()
+        self._rr = 0
+        self._polls = 0
+        self._stop = threading.Event()
+        self._poller: Optional[threading.Thread] = None
+        self.stats = {
+            "ejections_total": 0, "readmissions_total": 0,
+            "kills_total": 0, "drains_total": 0,
+            "routed_prefix_total": 0, "routed_least_loaded_total": 0,
+            "routed_round_robin_total": 0, "dispatch_errors_total": 0,
+        }
+        self.recoveries_s: List[float] = []
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self) -> None:
+        self.events.log("start", replicas=len(self.replicas),
+                        policy=self.policy)
+        for r in self.replicas.values():
+            if r.managed:
+                r.thread = threading.Thread(
+                    target=r.supervisor.run, daemon=True,
+                    name=f"fleet-sup-{r.rid}")
+                r.thread.start()
+        self._poller = threading.Thread(target=self._poll_loop,
+                                        daemon=True, name="fleet-poll")
+        self._poller.start()
+
+    def stop(self, timeout_s: float = 60.0) -> None:
+        """Drain the whole fleet: every supervisor SIGTERM-drains its
+        replica (serve.py finishes in-flight requests and exits via the
+        preemption path), and the poller stops. Blocks until the
+        supervisor threads exit (no orphan processes) or timeout."""
+        self._stop.set()
+        self.events.log("drain_fleet")
+        for r in self.replicas.values():
+            if r.managed and r.supervisor is not None:
+                r.supervisor.request_drain()
+        deadline = time.monotonic() + timeout_s
+        for r in self.replicas.values():
+            if r.thread is not None:
+                r.thread.join(max(deadline - time.monotonic(), 0.1))
+        if self._poller is not None:
+            self._poller.join(timeout=5.0)
+        # final counter snapshot BEFORE the stopped marker: periodic
+        # snapshots fire only every snapshot_every polls, so without
+        # this a short run (or the tail of any run) would leave
+        # telemetry_report --fleet with no routing/shed counters at all
+        self.events.log("snapshot", **self.snapshot_counters())
+        self.events.log("stopped", orphans=sum(
+            1 for r in self.replicas.values()
+            if r.thread is not None and r.thread.is_alive()))
+        self.events.close()
+
+    # -- health polling -----------------------------------------------------
+
+    def _poll_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.poll_once()
+            except Exception:       # noqa: BLE001 — poller must survive
+                pass
+            self._stop.wait(self.poll_s)
+
+    def poll_once(self) -> None:
+        """One health sweep over every replica (also called directly
+        by tests — all state transitions happen here). Scrapes run
+        CONCURRENTLY, one short-lived thread per replica: a dead
+        replica costs the sweep one poll_timeout_s total, not one per
+        dead replica — otherwise ejection/recovery latency would scale
+        with how broken the fleet already is."""
+        scraped: Dict[str, Optional[dict]] = {}
+
+        def scrape(rep: Replica) -> None:
+            url = rep.discover_url()
+            polled = None
+            if url:
+                try:
+                    polled = http_json(url + "/metrics?format=json",
+                                       self.poll_timeout_s)
+                except (OSError, ValueError):
+                    polled = None
+            scraped[rep.rid] = polled
+
+        threads = [threading.Thread(target=scrape, args=(r,),
+                                    daemon=True)
+                   for r in self.replicas.values()]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=self.poll_timeout_s + 2.0)
+        capacity_changed = False
+        for r in self.replicas.values():
+            url = r.url
+            polled = scraped.get(r.rid)
+            with self._lock:
+                if polled is not None:
+                    r.polled = polled
+                    r.absorb_counters(polled)
+                    r.ok_streak += 1
+                    r.fail_streak = 0
+                    if (r.state in (STARTING, EJECTED)
+                            and r.ok_streak >= self.readmit_after):
+                        was_ejected = r.state == EJECTED
+                        r.state = HEALTHY
+                        capacity_changed = True
+                        recovery_s = None
+                        if r.ejected_at is not None:
+                            recovery_s = round(
+                                time.monotonic() - r.ejected_at, 3)
+                            self.recoveries_s.append(recovery_s)
+                            r.ejected_at = None
+                        if was_ejected:
+                            self.stats["readmissions_total"] += 1
+                        self.events.log(
+                            "readmit" if was_ejected else "ready",
+                            replica=r.rid, url=url,
+                            recovery_s=recovery_s)
+                else:
+                    r.ok_streak = 0
+                    r.fail_streak += 1
+                    if (r.state in (HEALTHY, DRAINING)
+                            and r.fail_streak >= self.eject_after):
+                        r.state = EJECTED
+                        r.ejected_at = time.monotonic()
+                        capacity_changed = True
+                        self.stats["ejections_total"] += 1
+                        # its pool restarts empty: predictions naming
+                        # it are stale the moment it comes back
+                        self.radix.drop_replica(r.rid)
+                        self.events.log("eject", replica=r.rid, url=url,
+                                        fail_streak=r.fail_streak)
+        self._polls += 1
+        if self.snapshot_every and self._polls % self.snapshot_every == 0:
+            self.events.log("snapshot", **self.snapshot_counters())
+        if capacity_changed and self.on_capacity_change is not None:
+            self.on_capacity_change()
+
+    # -- routing ------------------------------------------------------------
+
+    def capacity(self) -> int:
+        """Fleet-wide concurrency cap for admission control: healthy
+        slots x oversubscription (a bounded per-replica queue keeps the
+        continuous engines inside the batching sweet spot)."""
+        with self._lock:
+            cap = sum(r.slots(self.slots_hint) * self.queue_factor
+                      for r in self.replicas.values()
+                      if r.state == HEALTHY)
+        return int(cap)
+
+    def healthy(self) -> List[Replica]:
+        with self._lock:
+            return [r for r in self.replicas.values()
+                    if r.state == HEALTHY]
+
+    def route(self, ids, policy: Optional[str] = None,
+              exclude=()) -> Optional[tuple]:
+        """Place one request -> ``(replica, reason)`` or None (no
+        healthy replica). Records the placement in the radix so the
+        NEXT shared-prefix request finds it."""
+        with self._lock:
+            cands = [(r.rid, r.load_estimate())
+                     for r in self.replicas.values()
+                     if r.state == HEALTHY and r.rid not in exclude]
+            picked = choose_replica(
+                cands, self.radix.match(ids),
+                policy=policy or self.policy, rr_counter=self._rr,
+                min_match_tokens=self.min_match_tokens,
+                load_spread=self.load_spread)
+            if picked is None:
+                return None
+            rid, reason = picked
+            self._rr += 1
+            self.stats[f"routed_{reason}_total"] += 1
+            self.radix.record(ids, rid)
+            return self.replicas[rid], reason
+
+    def begin(self, replica: Replica) -> None:
+        with self._lock:
+            replica.inflight += 1
+
+    def end(self, replica: Replica) -> None:
+        with self._lock:
+            replica.inflight = max(replica.inflight - 1, 0)
+
+    def note_dispatch_error(self, replica: Replica) -> None:
+        """A proxied request could not even reach the replica: count
+        it and fast-track the health machinery (the poller confirms)."""
+        with self._lock:
+            self.stats["dispatch_errors_total"] += 1
+            replica.ok_streak = 0
+
+    # -- chaos / rolling restart -------------------------------------------
+
+    def kill_replica(self, rid: str, sig: int = signal_mod.SIGKILL
+                     ) -> bool:
+        """Chaos injection: signal the replica's CHILD through its
+        supervisor (SIGKILL ⇒ crash-classified supervised restart)."""
+        r = self.replicas.get(rid)
+        if r is None or not r.managed or r.supervisor is None:
+            return False
+        ok = r.supervisor.signal_child(sig)
+        if ok:
+            with self._lock:
+                self.stats["kills_total"] += 1
+            self.events.log("kill", replica=rid, sig=int(sig))
+        return ok
+
+    def drain_replica(self, rid: str, grace_s: float = 30.0) -> bool:
+        """Rolling restart, zero failed requests: stop routing to the
+        replica, wait for its in-flight to finish (bounded), then
+        SIGTERM it — serve.py's drain path exits ``EXIT_PREEMPTED`` and
+        the supervisor restarts it budget-free; the poller re-admits it
+        when healthy. Runs async (returns immediately)."""
+        r = self.replicas.get(rid)
+        if r is None or not r.managed:
+            return False
+        with self._lock:
+            if r.state not in (HEALTHY, STARTING):
+                return False
+            r.state = DRAINING
+            self.stats["drains_total"] += 1
+        self.events.log("drain_replica", replica=rid)
+
+        def _finish():
+            deadline = time.monotonic() + grace_s
+            while time.monotonic() < deadline:
+                with self._lock:
+                    if r.inflight == 0:
+                        break
+                time.sleep(0.05)
+            r.supervisor.signal_child(signal_mod.SIGTERM)
+            with self._lock:
+                # the poller may have ejected the replica mid-drain
+                # (child died in the grace window) — don't clobber
+                # that transition, or its eventual recovery would log
+                # 'ready' instead of 'readmit' and skew the counters
+                if r.state == DRAINING:
+                    r.state = STARTING
+                r.ok_streak = 0
+
+        threading.Thread(target=_finish, daemon=True,
+                         name=f"fleet-drain-{rid}").start()
+        return True
+
+    # -- observability ------------------------------------------------------
+
+    def snapshot_counters(self) -> dict:
+        """Flat fleet-level counters (router /metrics + the periodic
+        ``snapshot`` event in router.jsonl)."""
+        with self._lock:
+            out = dict(self.stats)
+            for key in AGGREGATED_COUNTERS:
+                out[f"fleet_{key}"] = int(sum(
+                    r.cum[key] for r in self.replicas.values()))
+            out["replicas"] = len(self.replicas)
+            out["replicas_healthy"] = sum(
+                1 for r in self.replicas.values() if r.state == HEALTHY)
+            out["inflight"] = sum(r.inflight
+                                  for r in self.replicas.values())
+            out["radix_nodes"] = self.radix.nodes
+            if self.recoveries_s:
+                out["last_recovery_s"] = self.recoveries_s[-1]
+        return out
+
+    def snapshot(self) -> dict:
+        """Rich state for the router's ``/healthz``."""
+        with self._lock:
+            reps = [{
+                "id": r.rid, "url": r.url, "state": r.state,
+                "inflight": r.inflight,
+                "queue_depth": int(r.polled.get("queue_depth", 0)),
+                "slots": r.slots(self.slots_hint),
+                "requests_total": int(r.cum["requests_total"]),
+                "prefix_hit_tokens_total": int(
+                    r.cum["prefix_hit_tokens_total"]),
+            } for r in sorted(self.replicas.values(),
+                              key=lambda x: x.rid)]
+        healthy = sum(1 for x in reps if x["state"] == HEALTHY)
+        return {
+            "status": ("ok" if healthy == len(reps)
+                       else "degraded" if healthy else "unavailable"),
+            "policy": self.policy,
+            "capacity": self.capacity(),
+            "replicas": reps,
+            "recoveries_s": list(self.recoveries_s),
+        }

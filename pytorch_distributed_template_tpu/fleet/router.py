@@ -1,0 +1,351 @@
+"""The fleet front door: one HTTP server in front of N replicas.
+
+Request path (``POST /generate``):
+
+1. **Admission** (:mod:`.admission`): the request enters the
+   weighted-fair waiting room keyed on its ``X-Tenant`` header. Past
+   the watermark it is shed NOW — ``429`` with an honest
+   ``Retry-After`` — instead of queueing unboundedly.
+2. **Placement** (:mod:`.placement` via the manager): cache-aware by
+   default — the block-granular radix predicts which replica already
+   holds the prompt's prefix blocks and steers the request there
+   (bounded by the load spread), else least-loaded by live queue
+   estimate. ``X-Fleet-Policy: round_robin|least_loaded|cache_aware``
+   overrides per request (the bench's control arm).
+3. **Proxy**: the request body is forwarded verbatim. Non-streaming
+   responses relay status + body; ``"stream": true`` responses relay
+   the SSE byte stream line-by-line as it arrives, and a client
+   disconnect closes the upstream connection — which is exactly the
+   signal serve.py turns into a slot-engine CANCEL, so the
+   cancellation path composes through the router unchanged. A replica
+   that cannot even be reached retries ONCE on another replica (safe:
+   nothing was dispatched); a replica dying mid-response fails only
+   that request (502) — the kill-recovery contract.
+
+``GET /healthz`` reports per-replica state (the bench and the drain
+tooling read it); ``GET /metrics`` exposes the router's own counters
+plus reset-corrected fleet aggregates of the replicas' counters
+(Prometheus text, ``?format=json`` for JSON). Flag-gated ``POST
+/admin/kill`` / ``/admin/drain`` drive chaos tests and rolling
+restarts. Stdlib-only, like everything in this package.
+"""
+from __future__ import annotations
+
+import http.client
+import json
+import socket
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+from urllib.parse import parse_qsl, urlsplit
+
+from ..utils.promtext import prometheus_text  # noqa: F401 (re-export)
+from .admission import ADMITTED, FairAdmission
+from .placement import POLICIES, affinity_ids
+from .replicas import FleetManager
+
+
+class RouterStats:
+    """Router-level counters, one lock."""
+
+    FIELDS = ("requests_total", "stream_requests_total",
+              "unavailable_total", "proxy_retries_total",
+              "proxy_errors_total", "proxy_timeouts_total",
+              "client_disconnects_total", "admin_requests_total")
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._c = {f: 0 for f in self.FIELDS}
+
+    def bump(self, field: str, n: int = 1) -> None:
+        with self._lock:
+            self._c[field] += n
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return dict(self._c)
+
+
+def router_metrics(manager: FleetManager, admission: FairAdmission,
+                   stats: RouterStats) -> dict:
+    """The flat dict behind ``GET /metrics``: router counters, fleet
+    aggregates (reset-corrected replica counters), admission stats."""
+    out = dict(stats.snapshot())
+    mc = manager.snapshot_counters()
+    # two legitimate "inflight" gauges exist: requests the router has
+    # DISPATCHED to replicas (manager) vs requests ADMITTED through
+    # the gate (admission, includes pre-dispatch). Expose both instead
+    # of letting the dict merge silently pick one.
+    mc["proxy_inflight"] = mc.pop("inflight", 0)
+    out.update(mc)
+    adm = admission.stats()
+    out["admitted_total"] = adm[ADMITTED]
+    out["shed_total"] = adm["shed_total"]
+    out["shed_watermark_total"] = adm["shed_watermark"]
+    out["shed_tenant_total"] = adm["shed_tenant"]
+    out["shed_timeout_total"] = adm["shed_timeout"]
+    out["avg_service_s"] = adm["avg_service_s"]
+    out.update(admission.depths())   # inflight/waiting/capacity gauges
+    out["tenants"] = adm["tenants"]  # JSON-only (nested)
+    return out
+
+
+def make_fleet_handler(manager: FleetManager, admission: FairAdmission,
+                       stats: Optional[RouterStats] = None,
+                       allow_admin: bool = False,
+                       connect_timeout_s: float = 5.0,
+                       read_timeout_s: float = 600.0):
+    stats = stats or RouterStats()
+
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.0"   # connection close delimits SSE
+
+        # -- plumbing -------------------------------------------------------
+
+        def _send(self, code: int, payload: dict, headers=()) -> None:
+            body = json.dumps(payload).encode("utf-8")
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            for k, v in headers:
+                self.send_header(k, v)
+            self.end_headers()
+            self.wfile.write(body)
+
+        def _send_raw(self, code: int, body: bytes,
+                      content_type: str) -> None:
+            self.send_response(code)
+            self.send_header("Content-Type", content_type)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, fmt, *args):  # noqa: D102
+            pass
+
+        # -- read endpoints -------------------------------------------------
+
+        def do_GET(self):  # noqa: N802 (http.server API)
+            path, _, query = self.path.partition("?")
+            if path == "/metrics":
+                metrics = router_metrics(manager, admission, stats)
+                if "format=json" in query:
+                    return self._send(200, metrics)
+                return self._send_raw(
+                    200,
+                    prometheus_text(metrics, prefix="pdt_fleet")
+                    .encode("utf-8"),
+                    "text/plain; version=0.0.4")
+            if path != "/healthz":
+                return self._send(404, {"error": "unknown path"})
+            payload = manager.snapshot()
+            payload["admission"] = admission.depths()
+            self._send(200, payload)
+
+        # -- write endpoints ------------------------------------------------
+
+        def do_POST(self):  # noqa: N802
+            path, _, query = self.path.partition("?")
+            if path.startswith("/admin/"):
+                return self._admin(path, query)
+            if path != "/generate":
+                return self._send(404, {"error": "unknown path"})
+            self._generate()
+
+        def _admin(self, path: str, query: str) -> None:
+            stats.bump("admin_requests_total")
+            if not allow_admin:
+                return self._send(403, {
+                    "error": "admin endpoints disabled "
+                             "(serve_fleet --admin)"})
+            params = dict(parse_qsl(query))
+            rid = params.get("replica", "")
+            if path == "/admin/kill":
+                import signal as signal_mod
+
+                sig = (signal_mod.SIGTERM
+                       if params.get("sig", "KILL").upper() == "TERM"
+                       else signal_mod.SIGKILL)
+                ok = manager.kill_replica(rid, sig)
+                return self._send(200 if ok else 404,
+                                  {"killed": ok, "replica": rid})
+            if path == "/admin/drain":
+                ok = manager.drain_replica(rid)
+                return self._send(200 if ok else 404,
+                                  {"draining": ok, "replica": rid})
+            self._send(404, {"error": "unknown admin path"})
+
+        # -- the request path -----------------------------------------------
+
+        def _generate(self) -> None:
+            stats.bump("requests_total")
+            try:
+                n = int(self.headers.get("Content-Length", 0) or 0)
+                raw = self.rfile.read(n) if n else b"{}"
+                body = json.loads(raw or b"{}")
+                if not isinstance(body, dict):
+                    raise ValueError("body must be a JSON object")
+            except (ValueError, OSError) as e:
+                return self._send(400, {"error": f"bad request: {e}"})
+            tenant = (self.headers.get("X-Tenant") or "default")[:64]
+            policy = self.headers.get("X-Fleet-Policy") or None
+            if policy is not None and policy not in POLICIES:
+                return self._send(400, {
+                    "error": f"unknown policy {policy!r}; one of "
+                             f"{list(POLICIES)}"})
+            if body.get("stream"):
+                stats.bump("stream_requests_total")
+            if not manager.healthy():
+                stats.bump("unavailable_total")
+                return self._send(
+                    503, {"error": "no healthy replicas"},
+                    headers=[("Retry-After",
+                              str(admission.retry_after_s()))])
+            outcome = admission.submit(tenant)
+            if outcome != ADMITTED:
+                retry_s = admission.retry_after_s()
+                return self._send(
+                    429, {"error": "overloaded, retry later",
+                          "reason": outcome,
+                          "retry_after_s": retry_s},
+                    headers=[("Retry-After", str(retry_s))])
+            t0 = time.monotonic()
+            try:
+                self._route_and_proxy(body, raw, policy)
+            finally:
+                admission.release()
+                admission.observe_service_s(time.monotonic() - t0)
+
+        def _route_and_proxy(self, body: dict, raw: bytes,
+                             policy) -> None:
+            ids = affinity_ids(body)
+            excluded: set = set()
+            for _attempt in range(2):
+                picked = manager.route(ids, policy=policy,
+                                       exclude=excluded)
+                if picked is None:
+                    stats.bump("unavailable_total")
+                    return self._send(
+                        503, {"error": "no healthy replicas"},
+                        headers=[("Retry-After",
+                                  str(admission.retry_after_s()))])
+                replica, reason = picked
+                manager.begin(replica)
+                try:
+                    verdict = self._proxy(replica, raw)
+                finally:
+                    manager.end(replica)
+                if verdict != "retry":
+                    return
+                # connection-level failure before anything dispatched:
+                # safe to try one other replica (the health poller will
+                # eject the dead one on its own clock)
+                excluded.add(replica.rid)
+                manager.note_dispatch_error(replica)
+                stats.bump("proxy_retries_total")
+            stats.bump("proxy_errors_total")
+            self._send(502, {"error": "no replica reachable"})
+
+        def _proxy(self, replica, raw: bytes) -> str:
+            """Forward one request; returns ``done`` or ``retry``
+            (retry ONLY when nothing reached the replica)."""
+            url = urlsplit(replica.url)
+            # two timeouts, two failure classes: a replica that cannot
+            # even ACCEPT within connect_timeout_s is retry-safe
+            # (nothing was sent — don't strand this thread for the
+            # full generation budget on a blackholed port); once
+            # connected, reads get the generation-scale timeout
+            conn = http.client.HTTPConnection(
+                url.hostname, url.port, timeout=connect_timeout_s)
+            try:
+                try:
+                    conn.connect()
+                except OSError:       # refused, unreachable, OR timed
+                    return "retry"    # out connecting: nothing sent
+                conn.sock.settimeout(read_timeout_s)
+                try:
+                    conn.request(
+                        "POST", "/generate", body=raw,
+                        headers={"Content-Type": "application/json"})
+                except OSError:
+                    # send failed: the replica never got a complete
+                    # request — still retry-safe
+                    return "retry"
+                try:
+                    resp = conn.getresponse()
+                except socket.timeout:
+                    stats.bump("proxy_timeouts_total")
+                    self._send(504, {"error": "replica timed out"})
+                    return "done"
+                except OSError:
+                    # the request WAS delivered and may be executing:
+                    # retrying would double-run it and inflate fleet
+                    # counters — this is an in-flight casualty of the
+                    # replica's death (the kill-recovery contract)
+                    stats.bump("proxy_errors_total")
+                    self._send(502, {
+                        "error": "replica failed before responding"})
+                    return "done"
+                ct = resp.getheader("Content-Type",
+                                    "application/json")
+                if ct.startswith("text/event-stream"):
+                    self._relay_sse(resp, conn, ct)
+                    return "done"
+                try:
+                    data = resp.read()
+                except (http.client.HTTPException, OSError):
+                    # died mid-response: the request was in flight on
+                    # the replica — it fails, nothing retries (the
+                    # kill-recovery contract: a replica death costs
+                    # exactly its in-flight requests)
+                    stats.bump("proxy_errors_total")
+                    self._send(502, {
+                        "error": "replica failed mid-response"})
+                    return "done"
+                self._send_raw(resp.status, data, ct)
+                return "done"
+            finally:
+                conn.close()
+
+        def _relay_sse(self, resp, conn, content_type: str) -> None:
+            """Stream the replica's SSE bytes through as they arrive
+            (line-granular: events are ``data: ...\\n\\n`` frames, and
+            flushing on the blank separator keeps TTFT real). A client
+            disconnect closes the upstream connection — serve.py turns
+            that into a slot-engine cancel."""
+            self.send_response(resp.status)
+            self.send_header("Content-Type", content_type)
+            self.send_header("Cache-Control", "no-cache")
+            self.end_headers()
+            try:
+                while True:
+                    try:
+                        line = resp.readline()
+                    except (http.client.HTTPException, OSError):
+                        stats.bump("proxy_errors_total")
+                        return   # replica died mid-stream: truncate
+                    if not line:
+                        return   # upstream closed: stream complete
+                    self.wfile.write(line)
+                    if line == b"\n":
+                        self.wfile.flush()
+            except (BrokenPipeError, ConnectionError, OSError):
+                stats.bump("client_disconnects_total")
+                # closing the upstream socket (finally in _proxy) is
+                # the cancellation signal to the replica
+
+    return Handler
+
+
+def build_router(manager: FleetManager, admission: FairAdmission,
+                 host: str = "127.0.0.1", port: int = 0,
+                 stats: Optional[RouterStats] = None,
+                 allow_admin: bool = False,
+                 read_timeout_s: float = 600.0) -> ThreadingHTTPServer:
+    """Bind the front-door server (``port`` 0 picks a free one; the
+    bound address is ``server.server_address``)."""
+    handler = make_fleet_handler(
+        manager, admission, stats=stats, allow_admin=allow_admin,
+        read_timeout_s=read_timeout_s)
+    return ThreadingHTTPServer((host, port), handler)

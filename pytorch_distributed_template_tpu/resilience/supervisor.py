@@ -179,6 +179,9 @@ class SupervisorConfig:
     poll_s: float = 0.5
     events_path: str = "supervisor.jsonl"
     heartbeat_path: Optional[str] = None  # default: next to events_path
+    child_output_path: Optional[str] = None  # append child stdout+stderr
+    #                                 here (fleet replicas get one log
+    #                                 file each); None inherits ours
     rand: object = field(default=random.random, repr=False)
 
 
@@ -229,10 +232,46 @@ class Supervisor:
             self.heartbeat_path.unlink()
         except OSError:
             pass
-        child = subprocess.Popen(self.cmd, env=env)
+        if self.cfg.child_output_path:
+            # per-child log file (fleet replicas): APPEND so restarts
+            # extend one history; the fd is the child's after spawn
+            out = open(self.cfg.child_output_path, "ab", buffering=0)
+            try:
+                child = subprocess.Popen(self.cmd, env=env, stdout=out,
+                                         stderr=subprocess.STDOUT)
+            finally:
+                out.close()
+        else:
+            child = subprocess.Popen(self.cmd, env=env)
         self.events.log("spawn", attempt=attempt, pid=child.pid,
                         cmd=shlex.join(self.cmd) if attempt == 1 else None)
         return child
+
+    # -- external control (fleet manager) -----------------------------------
+
+    def request_drain(self) -> None:
+        """Ask the supervisor to stop: same effect as SIGTERM to it —
+        the current child is SIGTERM-drained (its preemption handler
+        runs) and the run loop exits without restarting. Thread-safe
+        and callable from embedders (the fleet manager runs one
+        supervisor per replica in a thread, where POSIX signals cannot
+        be delivered per-instance)."""
+        self._drain = True
+
+    def signal_child(self, sig: int) -> bool:
+        """Deliver ``sig`` to the CURRENT child, if one is running
+        (chaos injection / rolling restarts: SIGKILL ⇒ classified
+        crash, SIGTERM ⇒ the child's own drain path ⇒ preemption —
+        either way the run loop restarts it within policy). Returns
+        whether a live child was signalled."""
+        child = self._child
+        if child is None or child.poll() is not None:
+            return False
+        try:
+            child.send_signal(sig)
+        except OSError:
+            return False
+        return True
 
     def _heartbeat_age_s(self, spawned_at: float) -> float:
         try:

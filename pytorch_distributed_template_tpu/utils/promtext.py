@@ -1,0 +1,34 @@
+"""Prometheus text exposition (0.0.4) from a flat metrics dict.
+
+Shared by both serving tiers — ``serve.py`` (prefix ``pdt_serve``) and
+the fleet router (``pdt_fleet``) — and deliberately in utils/: the
+single-replica server must not import the fleet built on top of it for
+a formatting helper, and the fleet must stay jax-free. Stdlib-only.
+"""
+from __future__ import annotations
+
+
+def prometheus_text(metrics: dict, prefix: str = "pdt_serve") -> str:
+    """Flat numeric fields -> Prometheus exposition format.
+
+    Counters get a ``_total``-suffix-preserving counter TYPE;
+    everything else is a gauge. Nested dicts (latency percentiles)
+    flatten with an underscore; bools and the ``scheduler`` label
+    stay out (numeric series only)."""
+    lines = []
+
+    def emit(name: str, value) -> None:
+        kind = "counter" if name.endswith("_total") else "gauge"
+        lines.append(f"# TYPE {prefix}_{name} {kind}")
+        lines.append(f"{prefix}_{name} {value}")
+
+    for k, v in metrics.items():
+        if isinstance(v, bool) or k == "scheduler":
+            continue
+        if isinstance(v, (int, float)):
+            emit(k, v)
+        elif isinstance(v, dict):
+            for kk, vv in v.items():
+                if isinstance(vv, (int, float)):
+                    emit(f"{k}_{kk}", vv)
+    return "\n".join(lines) + "\n"

@@ -1,0 +1,114 @@
+#!/usr/bin/env python
+"""Random-init params-only serving artifact, in seconds.
+
+The fleet bench rung, the ``fleet-smoke`` CI job, and the serving
+smoke tests need something ``serve.py -r`` can load WITHOUT a training
+run: routing, admission control, SSE plumbing, and recovery mechanics
+are model-quality-independent, so a randomly initialized TinyLlama is
+exactly as good a traffic target as a trained one — and ~100x faster
+to produce. This writes the same artifact layout as
+``scripts/quantize_checkpoint.py`` / ``scripts/merge_lora.py``:
+
+    <out>/config.json     serving config (arch args, prefix cache,
+                          optional shared compile-cache dir)
+    <out>/model/          params-only orbax tree + meta sidecar
+
+    python scripts/make_serving_artifact.py -o /tmp/fleet-model
+    python serve.py -r /tmp/fleet-model/model --port 0
+
+The base config is ``configs/llama_debug.json`` (so every section the
+serving entrypoints expect is present); arch args are overridden from
+the CLI. Byte-vocab (256) keeps text mode working tokenizer-free.
+"""
+from __future__ import annotations
+
+import argparse
+import copy
+import json
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+
+def make_artifact(out_dir, arch: str = "TinyLlama",
+                  vocab_size: int = 256, d_model: int = 64,
+                  n_layer: int = 2, n_head: int = 4,
+                  n_kv_head: int = 2, max_len: int = 256,
+                  block_tokens: int = 16, pool_blocks: int = 96,
+                  compile_cache_dir=None, seed: int = 0) -> Path:
+    """Build + save the artifact; returns the ``-r``-able model path.
+
+    Imports jax lazily so ``--help`` stays instant."""
+    import jax
+    import jax.numpy as jnp
+
+    import pytorch_distributed_template_tpu.models  # noqa: F401
+    from pytorch_distributed_template_tpu.checkpoint.manager import (
+        save_serving_params,
+    )
+    from pytorch_distributed_template_tpu.config.registry import MODELS
+
+    out_dir = Path(out_dir)
+    out_dir.mkdir(parents=True, exist_ok=True)
+    arch_args = {
+        "vocab_size": int(vocab_size), "d_model": int(d_model),
+        "n_layer": int(n_layer), "n_head": int(n_head),
+        "n_kv_head": int(n_kv_head), "max_len": int(max_len),
+    }
+    model = MODELS.get(arch)(**arch_args)
+    params = model.init(jax.random.key(int(seed)),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    cfg = copy.deepcopy(json.loads(
+        (REPO / "configs" / "llama_debug.json").read_text()))
+    cfg["name"] = "FleetDebug"
+    cfg["arch"] = {"type": arch, "args": arch_args}
+    cfg["serving"] = {"prefix_cache": {
+        "enabled": True, "block_tokens": int(block_tokens),
+        "pool_blocks": int(pool_blocks), "eviction": "lru",
+    }}
+    if compile_cache_dir:
+        cfg["compile_cache"] = {"dir": str(compile_cache_dir)}
+    (out_dir / "config.json").write_text(json.dumps(cfg, indent=2))
+    return save_serving_params(
+        out_dir / "model", jax.device_get(params),
+        meta={"arch": arch, "source": "random-init", "seed": int(seed)},
+    )
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        description="random-init params-only serving artifact "
+                    "(fleet bench / CI / smoke traffic target)")
+    p.add_argument("-o", "--out", required=True,
+                   help="artifact directory (config.json + model/)")
+    p.add_argument("--arch", default="TinyLlama")
+    p.add_argument("--vocab-size", type=int, default=256)
+    p.add_argument("--d-model", type=int, default=64)
+    p.add_argument("--n-layer", type=int, default=2)
+    p.add_argument("--n-head", type=int, default=4)
+    p.add_argument("--n-kv-head", type=int, default=2)
+    p.add_argument("--max-len", type=int, default=256)
+    p.add_argument("--block-tokens", type=int, default=16,
+                   help="prefix-cache block size baked into the "
+                        "artifact's serving config")
+    p.add_argument("--pool-blocks", type=int, default=96)
+    p.add_argument("--compile-cache-dir", default=None,
+                   help="shared persistent XLA cache dir baked into "
+                        "the config (fleet replicas warm each other)")
+    p.add_argument("--seed", type=int, default=0)
+    args = p.parse_args(argv)
+    path = make_artifact(
+        args.out, arch=args.arch, vocab_size=args.vocab_size,
+        d_model=args.d_model, n_layer=args.n_layer,
+        n_head=args.n_head, n_kv_head=args.n_kv_head,
+        max_len=args.max_len, block_tokens=args.block_tokens,
+        pool_blocks=args.pool_blocks,
+        compile_cache_dir=args.compile_cache_dir, seed=args.seed)
+    print(f"ARTIFACT {path}", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

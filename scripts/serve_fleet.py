@@ -1,0 +1,213 @@
+#!/usr/bin/env python
+"""Serving fleet CLI: N supervised serve.py replicas + the front door.
+
+One command turns a checkpoint (or params-only serving artifact) into
+a fleet: each replica is a ``serve.py`` child wrapped in its own
+resilience supervisor (crash ⇒ backoff restart, drained stop ⇒
+budget-free preemption restart), and the router in front of them does
+cache-aware placement, per-tenant weighted fair queueing, watermark
+shedding (429 + Retry-After), health-based ejection/re-admission, and
+SSE passthrough with cancel propagation (docs/FLEET.md).
+
+    # three replicas behind one port; everything after -- goes to
+    # each serve.py (e.g. scheduler knobs)
+    python scripts/serve_fleet.py -r saved/.../model_best \\
+        --replicas 3 --port 8900 -- --max-batch 8 --decode-chunk 4
+
+    # front an already-running set of servers (no spawning)
+    python scripts/serve_fleet.py --attach \\
+        http://127.0.0.1:8001,http://127.0.0.1:8002
+
+SIGTERM (or Ctrl-C) drains the whole fleet: the router stops, every
+supervisor SIGTERM-drains its replica (serve.py finishes in-flight
+requests and exits via the preemption path, rc 75), and the process
+exits 0 with no orphans. ``--admin`` enables ``POST
+/admin/kill|drain?replica=rN`` — the chaos/rolling-restart hooks the
+bench and CI use. Prints ``READY http://host:port`` once the router
+is bound; replica readiness is visible on ``GET /healthz``.
+
+Stdlib-only (the router manages jax processes, it is not one); run
+evidence lands under ``--run-dir``: ``router.jsonl`` (lifecycle +
+periodic counter snapshots — ``scripts/telemetry_report.py --fleet``
+renders it) and per-replica ``rN/serve.log`` + ``rN/supervisor.jsonl``.
+"""
+from __future__ import annotations
+
+import argparse
+import signal
+import sys
+import threading
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+from pytorch_distributed_template_tpu.fleet.admission import (  # noqa: E402
+    FairAdmission,
+)
+from pytorch_distributed_template_tpu.fleet.replicas import (  # noqa: E402
+    FleetManager, Replica,
+)
+from pytorch_distributed_template_tpu.fleet.router import (  # noqa: E402
+    build_router,
+)
+from pytorch_distributed_template_tpu.resilience.supervisor import (  # noqa: E402
+    SupervisorConfig,
+)
+
+
+def parse_weights(spec: str) -> dict:
+    """``"pro:4,free:1"`` -> ``{"pro": 4.0, "free": 1.0}``."""
+    out = {}
+    for part in (spec or "").split(","):
+        part = part.strip()
+        if not part:
+            continue
+        name, _, w = part.partition(":")
+        try:
+            out[name] = float(w or 1.0)
+        except ValueError:
+            raise SystemExit(f"--tenant-weights: bad entry {part!r} "
+                             "(want name:weight)")
+    return out
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        description="fleet front door: cache-aware router over N "
+                    "supervised serve.py replicas",
+        epilog="arguments after -- are passed to every serve.py")
+    p.add_argument("-r", "--resume", default=None,
+                   help="checkpoint / serving artifact every replica "
+                        "serves (required unless --attach)")
+    p.add_argument("-c", "--config", default=None,
+                   help="config passed through to serve.py")
+    p.add_argument("--replicas", type=int, default=2)
+    p.add_argument("--attach", default=None, metavar="URL[,URL...]",
+                   help="front these already-running servers instead "
+                        "of spawning replicas")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=8900,
+                   help="router port (0 picks a free one, printed on "
+                        "READY)")
+    p.add_argument("--run-dir", default="fleet_run",
+                   help="router.jsonl + per-replica logs/events")
+    # placement
+    p.add_argument("--policy", default="cache_aware",
+                   choices=("cache_aware", "least_loaded",
+                            "round_robin"))
+    p.add_argument("--block-tokens", type=int, default=32,
+                   help="affinity-radix block size — match the "
+                        "replicas' serving.prefix_cache.block_tokens")
+    p.add_argument("--load-spread", type=float, default=4.0,
+                   help="cache-aware: fall back to least-loaded when "
+                        "the prefix-holding replica's queue estimate "
+                        "exceeds the lightest one's by more than this")
+    # admission / backpressure
+    p.add_argument("--queue-factor", type=float, default=2.0,
+                   help="per-replica oversubscription: fleet capacity "
+                        "= healthy slots x this")
+    p.add_argument("--max-waiting", type=int, default=64,
+                   help="waiting-room watermark: requests past it "
+                        "shed with 429 + Retry-After")
+    p.add_argument("--queue-timeout-s", type=float, default=30.0,
+                   help="waiters older than this shed (429)")
+    p.add_argument("--tenant-weights", default="",
+                   metavar="NAME:W,...",
+                   help="weighted fair queueing weights per X-Tenant "
+                        "value (default 1.0 each)")
+    # health
+    p.add_argument("--poll-s", type=float, default=1.0)
+    p.add_argument("--eject-after", type=int, default=2,
+                   help="consecutive failed health polls before a "
+                        "replica stops receiving traffic")
+    p.add_argument("--readmit-after", type=int, default=2)
+    # replica supervision
+    p.add_argument("--max-restarts", type=int, default=10)
+    p.add_argument("--restart-delay", type=float, default=1.0,
+                   metavar="S")
+    p.add_argument("--read-timeout-s", type=float, default=600.0,
+                   help="per-request upstream read timeout")
+    p.add_argument("--admin", action="store_true",
+                   help="enable POST /admin/kill and /admin/drain "
+                        "(chaos injection, rolling restarts)")
+    return p
+
+
+def main(argv=None) -> int:
+    args, rest = build_parser().parse_known_args(argv)
+    if rest and rest[0] == "--":
+        rest = rest[1:]
+    run_dir = Path(args.run_dir)
+    run_dir.mkdir(parents=True, exist_ok=True)
+    if args.attach:
+        urls = [u.strip() for u in args.attach.split(",") if u.strip()]
+        replicas = [Replica(f"r{i}", url=u)
+                    for i, u in enumerate(urls)]
+    else:
+        if not args.resume:
+            print("serve_fleet: need -r/--resume (or --attach)",
+                  file=sys.stderr)
+            return 2
+        serve_py = REPO / "serve.py"
+        replicas = []
+        for i in range(max(args.replicas, 1)):
+            rid = f"r{i}"
+            cmd = [sys.executable, str(serve_py), "-r", args.resume,
+                   "--host", "127.0.0.1", "--port", "0",
+                   "-s", str(run_dir / rid / "save")]
+            if args.config:
+                cmd += ["-c", args.config]
+            cmd += rest
+            replicas.append(Replica(
+                rid, cmd=cmd, run_dir=run_dir,
+                sup_cfg=SupervisorConfig(
+                    max_restarts=args.max_restarts,
+                    restart_delay_s=args.restart_delay,
+                    max_delay_s=30.0, poll_s=0.2,
+                    stable_runtime_s=120.0)))
+    manager = FleetManager(
+        replicas, run_dir=run_dir, policy=args.policy,
+        block_tokens=args.block_tokens,
+        min_match_tokens=args.block_tokens,
+        load_spread=args.load_spread, poll_s=args.poll_s,
+        eject_after=args.eject_after,
+        readmit_after=args.readmit_after,
+        queue_factor=args.queue_factor)
+    admission = FairAdmission(
+        manager.capacity, weights=parse_weights(args.tenant_weights),
+        max_waiting=args.max_waiting,
+        queue_timeout_s=args.queue_timeout_s)
+    # recoveries must re-open the gate for queued waiters immediately
+    manager.on_capacity_change = admission.kick
+    server = build_router(manager, admission, host=args.host,
+                          port=args.port, allow_admin=args.admin,
+                          read_timeout_s=args.read_timeout_s)
+
+    draining = threading.Event()
+
+    def _on_signal(signum, frame):  # noqa: ARG001
+        if draining.is_set():
+            return
+        draining.set()
+        threading.Thread(target=server.shutdown, daemon=True).start()
+
+    signal.signal(signal.SIGTERM, _on_signal)
+    signal.signal(signal.SIGINT, _on_signal)
+    manager.start()
+    host, port = server.server_address[:2]
+    print(f"READY http://{host}:{port}", flush=True)
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    # drain: every supervisor SIGTERMs its replica (serve.py finishes
+    # in-flight work, exits rc 75), threads join, no orphans
+    manager.stop()
+    server.server_close()
+    print("DRAINED", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

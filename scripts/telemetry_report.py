@@ -27,6 +27,10 @@ Report fields (JSON with ``--json``, markdown otherwise):
 - supervisor restart counters (``--supervisor supervisor.jsonl`` or a
   ``supervisor.jsonl`` inside ``--run-dir``): restarts by cause
   (crash/hang/preemption), give-up reason, clean completion;
+- fleet front-door lifecycle (``--fleet router.jsonl`` or one inside
+  ``--run-dir``): routed-by-policy counters, prefix-routed fraction,
+  shed/dispatch errors, ejections/re-admissions with recovery times,
+  and whether the fleet drained clean (no orphans);
 - top host spans by total time (from ``trace.json``);
 - the bench final line's headline numbers.
 
@@ -252,6 +256,54 @@ def analyze_supervisor(path) -> dict:
     return out
 
 
+def analyze_fleet(path) -> dict:
+    """Fold a fleet router's ``router.jsonl`` (fleet/replicas.py
+    EventLog: lifecycle events + periodic counter snapshots) into the
+    operator's questions: how much traffic, how much shed, how was it
+    routed, how many ejections/recoveries and how fast, and did the
+    fleet drain clean."""
+    counts: dict = {}
+    last_snapshot: dict = {}
+    recoveries = []
+    orphans = None
+    for rec in load_jsonl(path):
+        ev = rec.get("event")
+        counts[ev] = counts.get(ev, 0) + 1
+        if ev == "snapshot":
+            last_snapshot = rec
+        elif ev == "readmit" and rec.get("recovery_s") is not None:
+            recoveries.append(float(rec["recovery_s"]))
+        elif ev == "stopped":
+            orphans = rec.get("orphans")
+    out: dict = {
+        "replicas": last_snapshot.get("replicas"),
+        "replicas_healthy": last_snapshot.get("replicas_healthy"),
+        "ejections": counts.get("eject", 0),
+        "readmissions": counts.get("readmit", 0),
+        "kills": counts.get("kill", 0),
+        "rolling_drains": counts.get("drain_replica", 0),
+        "drained_clean": (None if orphans is None else orphans == 0),
+    }
+    for key in ("routed_prefix_total", "routed_least_loaded_total",
+                "routed_round_robin_total", "dispatch_errors_total",
+                "fleet_requests_total", "fleet_prefix_hit_tokens_total",
+                "fleet_tokens_generated_total"):
+        if key in last_snapshot:
+            out[key] = last_snapshot[key]
+    routed = sum(out.get(k, 0) or 0
+                 for k in ("routed_prefix_total",
+                           "routed_least_loaded_total",
+                           "routed_round_robin_total"))
+    if routed:
+        out["prefix_routed_frac"] = round(
+            (out.get("routed_prefix_total", 0) or 0) / routed, 4)
+    if recoveries:
+        out["recovery_s_mean"] = round(
+            sum(recoveries) / len(recoveries), 3)
+        out["recovery_s_max"] = round(max(recoveries), 3)
+    return {k: v for k, v in out.items() if v is not None}
+
+
 def analyze_anomalies(run_dir) -> dict:
     """Summarize the ``anomaly_*.json`` forensic bundles in a run dir."""
     files = sorted(Path(run_dir).glob("anomaly_*.json"))
@@ -348,6 +400,7 @@ def to_markdown(report: dict) -> str:
     table("Flight recorder", report.get("telemetry", {}))
     table("Prefix cache (serving)", report.get("prefix_cache", {}))
     table("Supervisor", report.get("supervisor", {}))
+    table("Fleet (router)", report.get("fleet", {}))
     tr = report.get("trace") or {}
     if tr.get("top_spans"):
         lines.append("## Host spans (top by total time)")
@@ -411,6 +464,11 @@ def main(argv=None) -> int:
                    help="explicit supervisor.jsonl path (the "
                         "resilience supervisor's lifecycle log; "
                         "--run-dir also auto-discovers one)")
+    p.add_argument("--fleet", type=str, default=None,
+                   help="explicit router.jsonl path (the serving "
+                        "fleet front door's lifecycle log, "
+                        "scripts/serve_fleet.py --run-dir; --run-dir "
+                        "here also auto-discovers one)")
     p.add_argument("--bench", type=str, default=None,
                    help="bench output: final-line JSON file or a "
                         "captured stdout stream (tee)")
@@ -453,6 +511,12 @@ def main(argv=None) -> int:
             sup_path = cand if cand.exists() else None
         if sup_path is not None:
             report["supervisor"] = analyze_supervisor(sup_path)
+        fleet_path = args.fleet
+        if fleet_path is None and run_dir is not None:
+            cand = run_dir / "router.jsonl"
+            fleet_path = cand if cand.exists() else None
+        if fleet_path is not None:
+            report["fleet"] = analyze_fleet(fleet_path)
         if run_dir is not None:
             report["anomalies"] = analyze_anomalies(run_dir)
         bench = None

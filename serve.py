@@ -89,6 +89,10 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import signal
+import sys
+import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 if os.environ.get("JAX_PLATFORMS"):
@@ -117,7 +121,10 @@ from pytorch_distributed_template_tpu.observability.telemetry import (  # noqa: 
     compile_cache_stats,
 )
 from pytorch_distributed_template_tpu.resilience.supervisor import (  # noqa: E402
-    ENV_EVENTS, read_supervisor_stats,
+    ENV_EVENTS, EXIT_PREEMPTED, read_supervisor_stats,
+)
+from pytorch_distributed_template_tpu.utils.promtext import (  # noqa: E402
+    prometheus_text,
 )
 from pytorch_distributed_template_tpu.utils.compile_cache import (  # noqa: E402
     configure_compile_cache,
@@ -243,32 +250,40 @@ def service_metrics(service: GenerationService) -> dict:
     return out
 
 
-def prometheus_text(metrics: dict, prefix: str = "pdt_serve") -> str:
-    """Flat numeric fields -> Prometheus exposition format (0.0.4).
-
-    Counters get a ``_total``-suffix-preserving counter TYPE; everything
-    else is a gauge. Nested dicts (latency percentiles) flatten with an
-    underscore."""
-    lines = []
-
-    def emit(name: str, value) -> None:
-        kind = "counter" if name.endswith("_total") else "gauge"
-        lines.append(f"# TYPE {prefix}_{name} {kind}")
-        lines.append(f"{prefix}_{name} {value}")
-
-    for k, v in metrics.items():
-        if isinstance(v, bool) or k == "scheduler":
-            continue
-        if isinstance(v, (int, float)):
-            emit(k, v)
-        elif isinstance(v, dict):
-            for kk, vv in v.items():
-                if isinstance(vv, (int, float)):
-                    emit(f"{k}_{kk}", vv)
-    return "\n".join(lines) + "\n"
+# prometheus_text lives in utils/promtext.py (stdlib-only, below both
+# serving tiers — the fleet router emits the same exposition format
+# with a pdt_fleet prefix) and stays re-exported here for callers.
 
 
-def make_handler(service: GenerationService, profiler=None):
+class ActiveRequests:
+    """In-flight HTTP request gauge: the SIGTERM drain path waits on
+    this hitting zero, which (responses complete only after generate()
+    returns, SSE included) is exactly "no request mid-generation"."""
+
+    def __init__(self):
+        self._n = 0
+        self._lock = threading.Lock()
+
+    def __enter__(self):
+        with self._lock:
+            self._n += 1
+        return self
+
+    def __exit__(self, *exc):
+        with self._lock:
+            self._n -= 1
+        return False
+
+    @property
+    def count(self) -> int:
+        with self._lock:
+            return self._n
+
+
+def make_handler(service: GenerationService, profiler=None,
+                 active: ActiveRequests | None = None):
+    active = active or ActiveRequests()
+
     class Handler(BaseHTTPRequestHandler):
         def _send(self, code: int, payload: dict) -> None:
             body = json.dumps(payload).encode("utf-8")
@@ -289,6 +304,10 @@ def make_handler(service: GenerationService, profiler=None):
             self.wfile.write(body)
 
         def do_GET(self):  # noqa: N802 (http.server API)
+            with active:
+                self._get()
+
+        def _get(self):
             path, _, query = self.path.partition("?")
             if path == "/metrics":
                 metrics = service_metrics(service)
@@ -315,6 +334,10 @@ def make_handler(service: GenerationService, profiler=None):
             self._send(200, payload)
 
         def do_POST(self):  # noqa: N802
+            with active:
+                self._post()
+
+        def _post(self):
             path, _, query = self.path.partition("?")
             if path == "/profile":
                 return self._profile(query)
@@ -390,7 +413,15 @@ def make_handler(service: GenerationService, profiler=None):
             Content-Length — connection close delimits it (HTTP/1.0
             framing, curl -N friendly)."""
             import queue as queue_mod
-            import threading
+
+            # cheap host-side validation BEFORE committing the 200 SSE
+            # response: a bad streaming body must 400 exactly like the
+            # identical non-streaming body (ADVICE r5) — once the
+            # event-stream headers are out, errors can only arrive as
+            # a 200 + error event, which retry logic and load
+            # balancers cannot see. Raises ValueError -> _post's
+            # handler maps it to 400.
+            service.validate_request(req)
 
             q: "queue_mod.Queue" = queue_mod.Queue()
             out: dict = {}
@@ -522,9 +553,31 @@ def main(args, config):
     # on-demand profiling (POST /profile): captures land next to the
     # serving run's logs
     profiler = OnDemandProfiler(config.save_dir)
+    active = ActiveRequests()
     server = ThreadingHTTPServer(
-        (args.host, args.port), make_handler(service, profiler=profiler)
+        (args.host, args.port),
+        make_handler(service, profiler=profiler, active=active)
     )
+    # drain on SIGTERM (the preemption path, same contract as the
+    # trainer's): stop accepting, let in-flight requests finish
+    # (bounded by --drain-grace-s), exit EXIT_PREEMPTED so a
+    # supervising fleet (scripts/serve_fleet.py) classifies the stop
+    # as a budget-free preemption — a rolling restart costs zero
+    # failed requests
+    draining = threading.Event()
+
+    def _on_sigterm(signum, frame):  # noqa: ARG001
+        if draining.is_set():
+            return
+        draining.set()
+        # shutdown() blocks until serve_forever exits, and this
+        # handler runs ON the serve_forever thread — do it elsewhere
+        threading.Thread(target=server.shutdown, daemon=True).start()
+
+    try:
+        signal.signal(signal.SIGTERM, _on_sigterm)
+    except ValueError:
+        pass  # not the main thread (embedded use)
     logger.info(
         "serving %s (vocab %d%s) on http://%s:%d — POST /generate, "
         "GET /healthz", service.arch, service.vocab,
@@ -537,6 +590,14 @@ def main(args, config):
         server.serve_forever()
     except KeyboardInterrupt:
         pass
+    if draining.is_set():
+        deadline = time.monotonic() + args.drain_grace_s
+        while active.count and time.monotonic() < deadline:
+            time.sleep(0.05)
+        server.server_close()
+        logger.info("drained (%d request(s) still open); exiting via "
+                    "the preemption path", active.count)
+        sys.exit(EXIT_PREEMPTED)
 
 
 if __name__ == "__main__":
@@ -579,6 +640,10 @@ if __name__ == "__main__":
                              "(system / few-shot preambles) admit as "
                              "an HBM block copy + suffix-only prefill "
                              "instead of a full recompute")
+    parser.add_argument("--drain-grace-s", default=30.0, type=float,
+                        help="SIGTERM drain: how long to wait for "
+                             "in-flight requests to finish before "
+                             "exiting (preemption path, rc 75)")
     parser.add_argument("--decode-chunk", default=8, type=int,
                         help="continuous scheduler: BASE decode steps "
                              "per dispatch (admission latency bound); "

@@ -125,6 +125,54 @@ def test_adaptive_chunk_growth_cuts_dispatches(stack):
     assert service.stats["chunks"] <= 8, service.stats
 
 
+def test_grow_cap_considers_cancel_events():
+    """Chunk growth caps at GROW_MAX_STOPS whenever a live row can
+    exit mid-chunk — via a stop token OR a cancel event (a streaming
+    client's disconnect is only honored at the next absorb, so a
+    GROW_MAX-length chunk would delay both the cancelled response and
+    the slot free; ADVICE r5). Pure host logic, no engine needed."""
+    svc = ContinuousBatchingService
+
+    def live(stop=(), cancel=None):
+        return [{"req": {"stop": list(stop), "cancel": cancel}}]
+
+    assert svc._grow_cap(live()) == svc.GROW_MAX
+    assert svc._grow_cap(live(stop=[7])) == min(svc.GROW_MAX_STOPS,
+                                                svc.GROW_MAX)
+    # a cancel EVENT (set or not — the disconnect can land any time)
+    # now caps growth exactly like a stop set
+    assert svc._grow_cap(live(cancel=threading.Event())) == min(
+        svc.GROW_MAX_STOPS, svc.GROW_MAX)
+    # a row whose request never carried a cancel handle doesn't
+    mixed = live() + live(cancel=threading.Event())
+    assert svc._grow_cap(mixed) == min(svc.GROW_MAX_STOPS,
+                                       svc.GROW_MAX)
+
+
+def test_validate_request_matches_enqueue_rules(stack):
+    """serve.py's pre-SSE validation must reject exactly what
+    generate() would: budget on the BUCKETED prompt length, the
+    static stop-set width, and every encode-level error."""
+    model, params, _ = stack
+    service = ContinuousBatchingService.from_model(
+        model, params, slots=2, chunk=2, window_ms=5.0)
+    ok = {"prompt_ids": [3, 5, 7], "max_new_tokens": 4}
+    service.validate_request(ok)      # must not raise
+    bads = [
+        {"prompt_ids": [3, 5, 7], "max_new_tokens": 0},
+        {"prompt_ids": [3, 5, 7],
+         "max_new_tokens": int(model.max_len)},   # bucketed overflow
+        {"prompt_ids": [3],
+         "stop": list(range(service.MAX_STOPS + 1))},
+        {"prompt_ids": "nope"},
+        {"prompt_ids": [3], "max_new_tokens": "many"},
+        {},
+    ]
+    for bad in bads:
+        with pytest.raises(ValueError):
+            service.validate_request(bad)
+
+
 def test_mid_flight_admission_exact(stack, service):
     """Arrivals while the engine is mid-decode prefill into free slots
     without disturbing running rows (the continuous-batching point)."""

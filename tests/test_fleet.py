@@ -1,0 +1,848 @@
+"""Fleet front door (pytorch_distributed_template_tpu/fleet): routing,
+admission control, health lifecycle, load harness.
+
+Fast tier drives the REAL router HTTP stack against fake in-process
+replicas (stdlib HTTP servers speaking serve.py's /metrics + /generate
+wire format — no jax, no subprocesses): placement affinity, least-
+loaded fallback, watermark shedding, tenant fairness, ejection /
+re-admission, SSE passthrough. The slow tier runs the whole thing for
+real: scripts/serve_fleet.py over two serve.py replicas on a random-
+init artifact — loadgen traffic, an injected SIGKILL, supervised
+recovery, and a clean SIGTERM fleet drain with no orphans.
+"""
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from pathlib import Path
+
+import pytest
+
+from pytorch_distributed_template_tpu.fleet.admission import (
+    ADMITTED, SHED_WATERMARK, FairAdmission,
+)
+from pytorch_distributed_template_tpu.fleet.loadgen import (
+    _percentile, build_trace, replay, summarize,
+)
+from pytorch_distributed_template_tpu.fleet.placement import (
+    FleetRadix, affinity_ids, choose_replica,
+)
+from pytorch_distributed_template_tpu.fleet.replicas import (
+    EJECTED, HEALTHY, FleetManager, Replica, http_json,
+)
+from pytorch_distributed_template_tpu.fleet.router import (
+    RouterStats, build_router, prometheus_text, router_metrics,
+)
+
+REPO = Path(__file__).parent.parent
+
+
+# ---------------------------------------------------------------------------
+# placement: the fleet radix + the chooser
+# ---------------------------------------------------------------------------
+
+
+def test_radix_match_is_block_granular_and_proper():
+    rx = FleetRadix(block_tokens=4)
+    ids = list(range(12))
+    assert rx.match(ids) == {}
+    rx.record(ids, "r0")
+    # a strict extension matches every full block...
+    assert rx.match(ids + [99]) == {"r0": 12}
+    # ...the identical prompt only a PROPER prefix (final token is
+    # never served from cache — mirrors PrefixCache.lookup)
+    assert rx.match(ids) == {"r0": 8}
+    # divergence mid-block shares nothing for that block
+    assert rx.match(ids[:7] + [99, 100]) == {"r0": 4}
+    # sub-block prompts can't match anything
+    assert rx.match(ids[:3]) == {}
+
+
+def test_radix_multi_replica_and_drop():
+    rx = FleetRadix(block_tokens=4)
+    ids = list(range(8))
+    rx.record(ids, "r0")
+    rx.record(ids, "r1")
+    assert rx.match(ids + [9]) == {"r0": 8, "r1": 8}
+    rx.drop_replica("r0")
+    assert rx.match(ids + [9]) == {"r1": 8}
+    rx.drop_replica("r1")           # replica-less chains are pruned
+    assert rx.nodes == 0
+
+
+def test_radix_bounded_lru_eviction():
+    rx = FleetRadix(block_tokens=2, max_nodes=3)
+    rx.record([1, 2, 3, 4], "r0")        # 2 nodes
+    rx.record([5, 6, 7, 8], "r0")        # +2 -> evicts the LRU leaf
+    assert rx.nodes <= 3
+    # the most recent chain survives whole
+    assert rx.match([5, 6, 7, 8, 9]) == {"r0": 4}
+
+
+def test_affinity_ids_wire_forms():
+    assert affinity_ids({"prompt_ids": [1, 2, 3]}) == [1, 2, 3]
+    assert affinity_ids({"prompt": "ab"}) == [97, 98]
+    assert affinity_ids({}) == []
+    assert affinity_ids({"prompt_ids": "oops"}) == []
+
+
+def test_choose_replica_policies():
+    cands = [("r0", 0.0), ("r1", 3.0)]
+    # deep match within the load spread wins
+    assert choose_replica(cands, {"r1": 64}) == ("r1", "prefix")
+    # ...but not past it (hot prefix must not become a hotspot)
+    assert choose_replica([("r0", 0.0), ("r1", 9.0)], {"r1": 64},
+                          load_spread=4.0) == ("r0", "least_loaded")
+    # no match falls back to least loaded; equal loads rotate
+    assert choose_replica(cands, {}) == ("r0", "least_loaded")
+    both_idle = [("r0", 0.0), ("r1", 0.0)]
+    picks = {choose_replica(both_idle, {}, rr_counter=i)[0]
+             for i in range(2)}
+    assert picks == {"r0", "r1"}
+    # explicit policies
+    assert choose_replica(cands, {"r1": 64},
+                          policy="least_loaded") == ("r0",
+                                                     "least_loaded")
+    assert choose_replica(cands, {}, policy="round_robin",
+                          rr_counter=3) == ("r1", "round_robin")
+    assert choose_replica([], {}) is None
+
+
+# ---------------------------------------------------------------------------
+# admission: WFQ + watermark
+# ---------------------------------------------------------------------------
+
+
+def test_admission_inline_grant_and_release():
+    adm = FairAdmission(lambda: 2)
+    assert adm.submit("a") == ADMITTED
+    assert adm.submit("a") == ADMITTED
+    assert adm.depths() == {"inflight": 2, "waiting": 0, "capacity": 2}
+    adm.release()
+    assert adm.depths()["inflight"] == 1
+
+
+def test_admission_watermark_shed_and_counters():
+    adm = FairAdmission(lambda: 0, max_waiting=0)
+    assert adm.submit("a") == SHED_WATERMARK
+    st = adm.stats()
+    assert st["shed_total"] == 1
+    assert st["tenants"]["a"][SHED_WATERMARK] == 1
+
+
+def test_admission_per_tenant_slice():
+    adm = FairAdmission(lambda: 0, max_waiting=10,
+                        max_waiting_per_tenant=0)
+    assert adm.submit("a") == "shed_tenant"
+
+
+def test_admission_timeout_sheds():
+    adm = FairAdmission(lambda: 0, max_waiting=4, queue_timeout_s=0.1)
+    t0 = time.monotonic()
+    assert adm.submit("a") == "shed_timeout"
+    assert time.monotonic() - t0 < 2.0
+
+
+def test_admission_wfq_prefers_light_tenant():
+    """With capacity 1 and a flood from the heavy tenant queued, the
+    light tenant's first request tags just past the global virtual
+    clock and admits ahead of the flood's BACKLOG (it cannot jump the
+    head-of-line request, which carries the same tag and an earlier
+    arrival — that is the fairness bound, not a defect)."""
+    adm = FairAdmission(lambda: 1, weights={"heavy": 1.0, "light": 1.0})
+    assert adm.submit("heavy") == ADMITTED       # occupies the slot
+    grants = []
+
+    def waiter(tenant):
+        if adm.submit(tenant) == ADMITTED:
+            grants.append(tenant)
+            time.sleep(0.01)
+            adm.release()
+
+    heavies = [threading.Thread(target=waiter, args=("heavy",))
+               for _ in range(3)]
+    for t in heavies:
+        t.start()
+    time.sleep(0.05)                 # heavy backlog tags 1, 2, 3
+    light = threading.Thread(target=waiter, args=("light",))
+    light.start()
+    time.sleep(0.05)
+    adm.release()                    # free the slot: grants drain
+    for t in heavies + [light]:
+        t.join(timeout=5)
+    assert grants.index("light") <= 1, grants
+    assert grants.count("heavy") == 3
+
+
+def test_admission_timeout_refunds_virtual_clock():
+    """Requests that shed on timeout did no work: their virtual-clock
+    charge is refunded, so a tenant whose spike timed out is not
+    starved behind fresher tenants after the overload clears."""
+    adm = FairAdmission(lambda: 0, max_waiting=8, queue_timeout_s=0.05)
+    for _ in range(3):
+        assert adm.submit("a") == "shed_timeout"
+    # the clock shows no residue from requests that never ran
+    assert adm._tenant_tag.get("a", 0.0) < 1e-6
+
+
+def test_admission_retry_after_tracks_backlog_and_clamps():
+    adm = FairAdmission(lambda: 1)
+    assert adm.retry_after_s() >= 1          # empty: still >= 1
+    assert adm.submit("a") == ADMITTED
+    adm.observe_service_s(7.0)               # slow service -> bigger hint
+    assert adm.retry_after_s() >= 2
+    adm.observe_service_s(10_000.0)
+    assert adm.retry_after_s() == 60         # clamped: don't lose clients
+
+
+# ---------------------------------------------------------------------------
+# fake replicas: serve.py's wire shape, no jax
+# ---------------------------------------------------------------------------
+
+
+class FakeReplica:
+    """A stdlib HTTP server speaking serve.py's /metrics + /generate
+    formats: configurable slots/queue_depth gauges, request recording,
+    optional per-request delay, SSE when asked."""
+
+    def __init__(self, slots=4, delay_s=0.0, sse_deltas=2, port=0,
+                 sse_delay_s=0.01):
+        self.slots = slots
+        self.delay_s = delay_s
+        self.sse_deltas = sse_deltas
+        self.sse_delay_s = sse_delay_s
+        self.broken_pipes = 0
+        self.queue_depth = 0
+        self.requests = []
+        self.counters = {"requests_total": 0,
+                         "prefix_hit_tokens_total": 0}
+        self._lock = threading.Lock()
+        fake = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def _json(self, code, payload):
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):  # noqa: N802
+                if self.path.startswith("/metrics"):
+                    with fake._lock:
+                        payload = dict(fake.counters)
+                    payload.update(slots=fake.slots,
+                                   queue_depth=fake.queue_depth,
+                                   live_slots=0)
+                    return self._json(200, payload)
+                self._json(200, {"status": "ok"})
+
+            def do_POST(self):  # noqa: N802
+                n = int(self.headers.get("Content-Length", 0))
+                body = json.loads(self.rfile.read(n) or b"{}")
+                with fake._lock:
+                    fake.requests.append(
+                        {"body": body,
+                         "tenant": self.headers.get("X-Tenant")})
+                    fake.counters["requests_total"] += 1
+                if fake.delay_s:
+                    time.sleep(fake.delay_s)
+                ids = list(range(body.get("max_new_tokens", 4)))
+                if body.get("stream"):
+                    self.send_response(200)
+                    self.send_header("Content-Type",
+                                     "text/event-stream")
+                    self.end_headers()
+                    per = max(len(ids) // fake.sse_deltas, 1)
+                    try:
+                        for i in range(0, len(ids), per):
+                            chunk = json.dumps({"ids": ids[i:i + per]})
+                            self.wfile.write(
+                                b"data: " + chunk.encode() + b"\n\n")
+                            self.wfile.flush()
+                            time.sleep(fake.sse_delay_s)
+                        fin = json.dumps({"ids": ids, "done": True})
+                        self.wfile.write(
+                            b"data: " + fin.encode() + b"\n\n")
+                    except (BrokenPipeError, ConnectionError,
+                            OSError):
+                        with fake._lock:
+                            fake.broken_pipes += 1
+                else:
+                    self._json(200, {"ids": ids, "stop_reason":
+                                     "length"})
+
+        self.server = ThreadingHTTPServer(("127.0.0.1", port), Handler)
+        self.port = self.server.server_address[1]
+        self.url = f"http://127.0.0.1:{self.port}"
+        self.thread = threading.Thread(
+            target=self.server.serve_forever, daemon=True)
+        self.thread.start()
+
+    def stop(self):
+        self.server.shutdown()
+        self.server.server_close()
+
+
+def _mk_fleet(tmp_path, fakes, **kw):
+    replicas = [Replica(f"r{i}", url=f.url)
+                for i, f in enumerate(fakes)]
+    kw.setdefault("readmit_after", 1)
+    kw.setdefault("eject_after", 2)
+    kw.setdefault("block_tokens", 4)
+    kw.setdefault("min_match_tokens", 4)
+    kw.setdefault("snapshot_every", 0)
+    manager = FleetManager(replicas, run_dir=tmp_path, **kw)
+    manager.poll_once()              # readmit_after=1 -> all healthy
+    return manager
+
+
+def _router(manager, admission=None, **kw):
+    admission = admission or FairAdmission(manager.capacity)
+    server = build_router(manager, admission, port=0, **kw)
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    url = f"http://127.0.0.1:{server.server_address[1]}"
+    return server, admission, url
+
+
+def _post(url, body, headers=None, timeout=30):
+    req = urllib.request.Request(
+        url + "/generate", data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json",
+                 **(headers or {})})
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return resp.status, json.loads(resp.read())
+
+
+def _get_json(url, path, timeout=10):
+    return http_json(url + path, timeout)
+
+
+# ---------------------------------------------------------------------------
+# router behavior over fake replicas
+# ---------------------------------------------------------------------------
+
+
+def test_router_prefix_affinity_and_spread(tmp_path):
+    fakes = [FakeReplica(), FakeReplica()]
+    manager = _mk_fleet(tmp_path, fakes)
+    server, _, url = _router(manager)
+    try:
+        shared = list(range(100, 112))        # 3 blocks of 4
+        for _ in range(3):
+            code, _ = _post(url, {"prompt_ids": shared,
+                                  "max_new_tokens": 2})
+            assert code == 200
+        # all three shared-prefix requests landed on ONE replica
+        counts = sorted(len(f.requests) for f in fakes)
+        assert counts == [0, 3], counts
+        assert manager.stats["routed_prefix_total"] == 2
+        # distinct prefixes spread over the idle fleet
+        for i in range(2):
+            _post(url, {"prompt_ids": [200 + 16 * i + j
+                                       for j in range(12)],
+                        "max_new_tokens": 2})
+        assert all(f.requests for f in fakes)
+    finally:
+        server.shutdown()
+        for f in fakes:
+            f.stop()
+
+
+def test_router_least_loaded_fallback_past_spread(tmp_path):
+    fakes = [FakeReplica(), FakeReplica()]
+    manager = _mk_fleet(tmp_path, fakes, load_spread=2.0)
+    server, _, url = _router(manager)
+    try:
+        shared = list(range(50, 62))
+        _post(url, {"prompt_ids": shared, "max_new_tokens": 2})
+        holder = next(i for i, f in enumerate(fakes) if f.requests)
+        # the prefix holder reports a deep internal queue
+        fakes[holder].queue_depth = 10
+        manager.poll_once()
+        _post(url, {"prompt_ids": shared + [7], "max_new_tokens": 2})
+        other = 1 - holder
+        assert len(fakes[other].requests) == 1
+        assert manager.stats["routed_least_loaded_total"] >= 1
+    finally:
+        server.shutdown()
+        for f in fakes:
+            f.stop()
+
+
+def test_router_round_robin_policy_header(tmp_path):
+    fakes = [FakeReplica(), FakeReplica()]
+    manager = _mk_fleet(tmp_path, fakes)
+    server, _, url = _router(manager)
+    try:
+        shared = list(range(60, 72))
+        for _ in range(4):
+            _post(url, {"prompt_ids": shared, "max_new_tokens": 2},
+                  headers={"X-Fleet-Policy": "round_robin"})
+        # round robin ignores affinity: both replicas saw traffic
+        assert all(len(f.requests) == 2 for f in fakes)
+        code = None
+        try:
+            _post(url, {"prompt_ids": shared},
+                  headers={"X-Fleet-Policy": "nope"})
+        except urllib.error.HTTPError as e:
+            code = e.code
+        assert code == 400
+    finally:
+        server.shutdown()
+        for f in fakes:
+            f.stop()
+
+
+def test_router_sheds_429_with_retry_after(tmp_path):
+    fakes = [FakeReplica(slots=1, delay_s=0.5)]
+    manager = _mk_fleet(tmp_path, fakes, queue_factor=1.0)
+    admission = FairAdmission(manager.capacity, max_waiting=0)
+    server, _, url = _router(manager, admission)
+    try:
+        results = []
+
+        def call(i):
+            try:
+                results.append(_post(url, {"prompt_ids": [i] * 8,
+                                           "max_new_tokens": 2})[0])
+            except urllib.error.HTTPError as e:
+                results.append(
+                    (e.code, e.headers.get("Retry-After")))
+        threads = [threading.Thread(target=call, args=(i,))
+                   for i in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=20)
+        sheds = [r for r in results if isinstance(r, tuple)
+                 and r[0] == 429]
+        assert sheds, results
+        assert all(int(ra) >= 1 for _, ra in sheds)
+        assert 200 in results          # and real work still flowed
+        m = router_metrics(manager, admission, RouterStats())
+        assert m["shed_total"] == len(sheds)
+    finally:
+        server.shutdown()
+        for f in fakes:
+            f.stop()
+
+
+def test_router_tenant_fairness_under_contention(tmp_path):
+    """Heavy tenant floods a capacity-1 fleet; the light tenant's
+    request admits ahead of the flood's backlog."""
+    fakes = [FakeReplica(slots=1, delay_s=0.15)]
+    manager = _mk_fleet(tmp_path, fakes, queue_factor=1.0)
+    admission = FairAdmission(manager.capacity, max_waiting=16)
+    server, _, url = _router(manager, admission)
+    try:
+        done = []
+
+        def call(tenant, i):
+            _post(url, {"prompt_ids": [i] * 8, "max_new_tokens": 2},
+                  headers={"X-Tenant": tenant}, timeout=60)
+            done.append(tenant)
+
+        heavies = [threading.Thread(target=call, args=("heavy", i))
+                   for i in range(5)]
+        for t in heavies:
+            t.start()
+        time.sleep(0.3)              # flood queued behind the slot
+        light = threading.Thread(target=call, args=("light", 99))
+        light.start()
+        light.join(timeout=30)
+        for t in heavies:
+            t.join(timeout=30)
+        # light arrived LAST; FIFO would finish it LAST. WFQ tags it
+        # just past the advancing virtual clock, so it overtakes the
+        # tail of the flood's backlog (how much depends on how many
+        # heavies drained before it arrived — assert the invariant,
+        # not the timing)
+        assert done.index("light") <= len(done) - 2, done
+    finally:
+        server.shutdown()
+        for f in fakes:
+            f.stop()
+
+
+def test_router_ejection_and_readmission(tmp_path):
+    fakes = [FakeReplica(), FakeReplica()]
+    manager = _mk_fleet(tmp_path, fakes, eject_after=2)
+    server, _, url = _router(manager)
+    try:
+        port = fakes[0].port
+        fakes[0].stop()
+        manager.poll_once()
+        manager.poll_once()
+        assert manager.replicas["r0"].state == EJECTED
+        assert manager.stats["ejections_total"] == 1
+        # traffic keeps flowing, on the survivor only
+        for i in range(3):
+            code, _ = _post(url, {"prompt_ids": [i] * 8,
+                                  "max_new_tokens": 2})
+            assert code == 200
+        assert len(fakes[1].requests) == 3
+        # resurrect on the SAME port -> re-admitted, traffic rebalances
+        revived = FakeReplica(port=port)
+        try:
+            manager.poll_once()
+            assert manager.replicas["r0"].state == HEALTHY
+            assert manager.stats["readmissions_total"] == 1
+            assert manager.recoveries_s
+            snap = manager.snapshot()
+            assert snap["status"] == "ok"
+        finally:
+            revived.stop()
+    finally:
+        server.shutdown()
+        fakes[1].stop()
+
+
+def test_router_503_when_no_healthy_replica(tmp_path):
+    manager = FleetManager(
+        [Replica("r0", url="http://127.0.0.1:1")],
+        run_dir=tmp_path, snapshot_every=0)
+    server, _, url = _router(manager)
+    try:
+        code = None
+        try:
+            _post(url, {"prompt_ids": [1, 2, 3]})
+        except urllib.error.HTTPError as e:
+            code = e.code
+        assert code == 503
+    finally:
+        server.shutdown()
+
+
+def test_router_sse_passthrough(tmp_path):
+    fakes = [FakeReplica(sse_deltas=3)]
+    manager = _mk_fleet(tmp_path, fakes)
+    server, _, url = _router(manager)
+    try:
+        req = urllib.request.Request(
+            url + "/generate",
+            data=json.dumps({"prompt_ids": [1] * 8,
+                             "max_new_tokens": 6,
+                             "stream": True}).encode(),
+            headers={"Content-Type": "application/json"})
+        events = []
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            assert resp.headers["Content-Type"].startswith(
+                "text/event-stream")
+            for line in resp:
+                if line.startswith(b"data: "):
+                    events.append(json.loads(line[6:]))
+        assert events[-1].get("done") is True
+        deltas = [e["ids"] for e in events[:-1]]
+        assert sum(len(d) for d in deltas) == 6
+    finally:
+        server.shutdown()
+        for f in fakes:
+            f.stop()
+
+
+def test_router_metrics_and_admin_gating(tmp_path):
+    fakes = [FakeReplica()]
+    manager = _mk_fleet(tmp_path, fakes)
+    server, admission, url = _router(manager)
+    try:
+        _post(url, {"prompt_ids": [1] * 8, "max_new_tokens": 2})
+        manager.poll_once()          # absorb replica counters
+        m = _get_json(url, "/metrics?format=json")
+        for key in ("requests_total", "shed_total",
+                    "fleet_requests_total", "replicas_healthy",
+                    "routed_least_loaded_total", "capacity"):
+            assert key in m, key
+        assert m["fleet_requests_total"] >= 1
+        text = urllib.request.urlopen(url + "/metrics").read().decode()
+        assert "# TYPE pdt_fleet_requests_total counter" in text
+        assert "pdt_fleet_replicas_healthy" in text
+        hz = _get_json(url, "/healthz")
+        assert hz["status"] == "ok" and hz["replicas"][0]["url"]
+        # admin is OFF by default
+        code = None
+        try:
+            req = urllib.request.Request(
+                url + "/admin/kill?replica=r0", data=b"", method="POST")
+            urllib.request.urlopen(req, timeout=5)
+        except urllib.error.HTTPError as e:
+            code = e.code
+        assert code == 403
+    finally:
+        server.shutdown()
+        for f in fakes:
+            f.stop()
+
+
+def test_replica_counter_reset_correction():
+    r = Replica("r0", url="http://x")
+    r.absorb_counters({"requests_total": 10})
+    r.absorb_counters({"requests_total": 14})
+    assert r.cum["requests_total"] == 14
+    # restart: the counter dropped — the new value IS the delta
+    r.absorb_counters({"requests_total": 3})
+    assert r.cum["requests_total"] == 17
+
+
+def test_prometheus_text_fleet_prefix():
+    text = prometheus_text({"a_total": 3, "b": 1.5,
+                            "nested": {"p50": 0.1}}, prefix="pdt_fleet")
+    assert "# TYPE pdt_fleet_a_total counter" in text
+    assert "pdt_fleet_b 1.5" in text
+    assert "pdt_fleet_nested_p50 0.1" in text
+
+
+# ---------------------------------------------------------------------------
+# loadgen
+# ---------------------------------------------------------------------------
+
+
+def test_loadgen_trace_deterministic_and_shaped():
+    a = build_trace(24, seed=3, arrival="poisson", cancel_frac=0.2)
+    b = build_trace(24, seed=3, arrival="poisson", cancel_frac=0.2)
+    assert a == b
+    assert all(a[i]["t"] <= a[i + 1]["t"] for i in range(len(a) - 1))
+    groups = {r["group"] for r in a}
+    assert 1 < len(groups) <= 4
+    # shared prefix inside a group, unique suffixes
+    by_group = {}
+    for r in a:
+        by_group.setdefault(r["group"], []).append(r["prompt_ids"])
+    for ids_list in by_group.values():
+        if len(ids_list) > 1:
+            assert ids_list[0][:64] == ids_list[1][:64]
+            assert ids_list[0][64:] != ids_list[1][64:]
+    # different group TAG shares no prefixes (arm isolation)
+    c = build_trace(8, seed=3, group_tag="x")
+    assert c[0]["prompt_ids"][:64] not in [
+        r["prompt_ids"][:64] for r in a]
+    bursty = build_trace(50, seed=1, arrival="bursty",
+                         burst_period_s=1.0, burst_duty=0.25)
+    assert all(
+        (r["t"] % 1.0) < 0.25 + 1e-6 for r in bursty)
+
+
+def test_loadgen_percentile():
+    assert _percentile([], 0.5) is None
+    assert _percentile([2.0], 0.99) == 2.0
+    assert _percentile([1.0, 2.0, 3.0], 0.5) == 2.0
+    assert abs(_percentile([1.0, 2.0], 0.99) - 1.99) < 1e-9
+
+
+def test_loadgen_replay_against_fake_replica():
+    fake = FakeReplica()
+    try:
+        trace = build_trace(8, seed=5, rate_rps=50.0, stream_frac=0.5,
+                            prefix_len=8, suffix_len=4,
+                            max_new_tokens=4)
+        summary = summarize(replay(fake.url, trace, timeout_s=30),
+                            trace)
+        assert summary["requests"] == 8
+        assert summary["ok"] == 8, summary
+        assert summary["errors"] == 0
+        assert summary["tokens_out"] == 8 * 4
+        assert summary["prompt_tokens"] == 8 * 12
+        # the streaming half produced TTFT numbers
+        assert summary["ttft_p50_s"] is not None
+        assert summary["per_tenant"]
+    finally:
+        fake.stop()
+
+
+def test_loadgen_cancellation_propagates_through_router(tmp_path):
+    """A cancel_after_s streaming request hangs up mid-stream; the
+    router propagates the disconnect upstream (the replica's next
+    write breaks — what serve.py turns into a slot-engine cancel)."""
+    fakes = [FakeReplica(sse_deltas=20, sse_delay_s=0.1)]
+    manager = _mk_fleet(tmp_path, fakes)
+    server, _, url = _router(manager)
+    try:
+        trace = build_trace(2, seed=9, rate_rps=50.0, stream_frac=1.0,
+                            cancel_frac=1.0, cancel_after_s=0.3,
+                            prefix_len=8, suffix_len=4,
+                            max_new_tokens=40)
+        summary = summarize(replay(url, trace, timeout_s=30), trace)
+        assert summary["cancelled"] == 2, summary
+        assert summary["errors"] == 0, summary
+        deadline = time.time() + 10
+        while fakes[0].broken_pipes < 2 and time.time() < deadline:
+            time.sleep(0.1)
+        assert fakes[0].broken_pipes == 2   # the replica FELT it
+    finally:
+        server.shutdown()
+        for f in fakes:
+            f.stop()
+
+
+def test_telemetry_report_fleet_section(tmp_path):
+    """``telemetry_report --fleet router.jsonl`` folds the router's
+    lifecycle log (the schema FleetManager.events emits) into the
+    fleet section — JSON mode so the fields are assertable."""
+    events = [
+        {"v": 1, "t": 1.0, "event": "start", "replicas": 2,
+         "policy": "cache_aware"},
+        {"v": 1, "t": 2.0, "event": "ready", "replica": "r0"},
+        {"v": 1, "t": 5.0, "event": "kill", "replica": "r1", "sig": 9},
+        {"v": 1, "t": 5.5, "event": "eject", "replica": "r1"},
+        {"v": 1, "t": 19.7, "event": "readmit", "replica": "r1",
+         "recovery_s": 14.2},
+        {"v": 1, "t": 20.0, "event": "snapshot", "replicas": 2,
+         "replicas_healthy": 2, "routed_prefix_total": 31,
+         "routed_least_loaded_total": 12,
+         "routed_round_robin_total": 0, "fleet_requests_total": 43,
+         "fleet_prefix_hit_tokens_total": 1920},
+        {"v": 1, "t": 31.0, "event": "stopped", "orphans": 0},
+    ]
+    path = tmp_path / "router.jsonl"
+    path.write_text("".join(json.dumps(e) + "\n" for e in events))
+    proc = subprocess.run(
+        [sys.executable,
+         str(REPO / "scripts" / "telemetry_report.py"),
+         "--fleet", str(path), "--json"],
+        capture_output=True, text=True, timeout=60, cwd=REPO)
+    assert proc.returncode == 0, proc.stderr
+    fleet = json.loads(proc.stdout)["fleet"]
+    assert fleet["ejections"] == 1 and fleet["readmissions"] == 1
+    assert fleet["kills"] == 1
+    assert fleet["drained_clean"] is True
+    assert fleet["recovery_s_mean"] == 14.2
+    assert fleet["fleet_prefix_hit_tokens_total"] == 1920
+    assert abs(fleet["prefix_routed_frac"] - 31 / 43) < 0.01
+
+
+# ---------------------------------------------------------------------------
+# slow tier: the real thing, end to end
+# ---------------------------------------------------------------------------
+
+
+def _wait_ready(log: Path, proc, deadline_s: float = 300.0) -> str:
+    deadline = time.time() + deadline_s
+    while time.time() < deadline:
+        text = log.read_text() if log.exists() else ""
+        for line in text.splitlines():
+            if line.startswith("READY "):
+                return line.split()[1].strip()
+        if proc.poll() is not None:
+            raise AssertionError(
+                "process exited early:\n" + text[-3000:])
+        time.sleep(0.5)
+    raise AssertionError("never READY:\n"
+                         + (log.read_text()[-3000:] if log.exists()
+                            else "<no log>"))
+
+
+def _healthy_count(url: str) -> int:
+    try:
+        hz = _get_json(url, "/healthz", timeout=5)
+    except (OSError, ValueError):
+        return -1
+    return sum(1 for r in hz["replicas"] if r["state"] == "healthy")
+
+
+@pytest.mark.slow
+def test_fleet_end_to_end_kill_drain_recover(tmp_path):
+    """The acceptance path: artifact -> 2-replica fleet -> loadgen
+    traffic (prefix routing observable on replica counters) -> SIGKILL
+    one replica (supervised crash restart, re-admission) -> SIGTERM
+    the fleet (clean preemption-path drain, no orphans)."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    art = tmp_path / "artifact"
+    subprocess.run(
+        [sys.executable, str(REPO / "scripts" /
+                             "make_serving_artifact.py"),
+         "-o", str(art), "--max-len", "256", "--block-tokens", "16",
+         "--compile-cache-dir", str(tmp_path / "xla-cache")],
+        check=True, env=env, timeout=600, cwd=REPO)
+    run_dir = tmp_path / "fleet"
+    log = tmp_path / "fleet.log"
+    with open(log, "w") as log_f:     # the child holds its own dup
+        proc = subprocess.Popen(
+            [sys.executable, str(REPO / "scripts" / "serve_fleet.py"),
+             "-r", str(art / "model"), "--replicas", "2", "--port",
+             "0", "--run-dir", str(run_dir), "--admin",
+             "--poll-s", "0.3", "--readmit-after", "1",
+             "--restart-delay", "0.5", "--block-tokens", "16",
+             "--", "--max-batch", "2", "--decode-chunk", "4"],
+            stdout=log_f, stderr=subprocess.STDOUT, env=env, cwd=REPO)
+    try:
+        url = _wait_ready(log, proc)
+        deadline = time.time() + 420
+        while _healthy_count(url) != 2 and time.time() < deadline:
+            time.sleep(1.0)
+        assert _healthy_count(url) == 2, log.read_text()[-3000:]
+
+        # traffic: small shared-prefix trace through the router
+        trace = build_trace(10, seed=7, rate_rps=2.0,
+                            prefix_groups=2, prefix_len=32,
+                            suffix_len=8, max_new_tokens=4,
+                            stream_frac=0.5)
+        summary = summarize(replay(url, trace, timeout_s=120), trace)
+        assert summary["errors"] == 0, summary
+        assert summary["ok"] == 10, summary
+        time.sleep(1.5)              # let the poller absorb counters
+        m = _get_json(url, "/metrics?format=json")
+        assert m["fleet_requests_total"] >= 10
+        assert m["routed_prefix_total"] >= 1, m
+        assert m["fleet_prefix_hit_tokens_total"] > 0, m
+
+        # chaos: SIGKILL r0's child through the admin endpoint
+        req = urllib.request.Request(url + "/admin/kill?replica=r0",
+                                     data=b"", method="POST")
+        assert json.loads(urllib.request.urlopen(
+            req, timeout=10).read())["killed"] is True
+        t_kill = time.monotonic()
+        deadline = time.time() + 300
+        saw_down = False
+        while time.time() < deadline:
+            n = _healthy_count(url)
+            if n < 2:
+                saw_down = True
+            if saw_down and n == 2:
+                break
+            time.sleep(0.5)
+        assert saw_down, "kill never observed on /healthz"
+        assert _healthy_count(url) == 2, log.read_text()[-3000:]
+        recovery_s = time.monotonic() - t_kill
+        # recovered replica takes traffic again
+        code, _ = _post(url, {"prompt_ids": [5] * 33,
+                              "max_new_tokens": 2}, timeout=120)
+        assert code == 200
+        sup = (run_dir / "r0" / "supervisor.jsonl").read_text()
+        assert '"cause": "crash"' in sup, sup
+
+        # drain: SIGTERM the fleet -> rc 0, replicas exit via the
+        # preemption path, no orphan processes
+        proc.send_signal(signal.SIGTERM)
+        rc = proc.wait(timeout=120)
+        assert rc == 0, log.read_text()[-3000:]
+        assert "DRAINED" in log.read_text()
+        pids = []
+        for rid in ("r0", "r1"):
+            for line in (run_dir / rid /
+                         "supervisor.jsonl").read_text().splitlines():
+                rec = json.loads(line)
+                if rec.get("event") == "spawn":
+                    pids.append(rec["pid"])
+        time.sleep(1.0)
+        for pid in pids:
+            try:
+                os.kill(pid, 0)
+            except ProcessLookupError:
+                continue
+            raise AssertionError(f"orphan replica pid {pid}")
+        print(f"fleet e2e ok: recovery {recovery_s:.1f}s")
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=30)

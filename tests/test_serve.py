@@ -251,6 +251,51 @@ def test_stream_disconnect_cancels_generation(llama_server):
     assert len(after["ids"]) == 8
 
 
+def test_stream_bad_request_returns_400_not_sse(llama_server):
+    """Streaming requests validate BEFORE the 200 text/event-stream
+    headers commit: a body the non-streaming path would 400 gets the
+    SAME 400 (status + JSON error) with stream: true — not a 200 SSE
+    error event (ADVICE r5; serve.py pre-SSE validate_request)."""
+    bad_bodies = [
+        {"prompt_ids": [5, 6, 7], "max_new_tokens": 0, "stream": True},
+        {"prompt_ids": [5, 6, 7], "max_new_tokens": 9999,
+         "stream": True},                       # budget > max_len
+        {"prompt_ids": "oops", "stream": True},
+        {"prompt_ids": [5], "stream": True,
+         "stop": list(range(20))},              # > MAX_STOPS
+        {"stream": True},                       # no prompt at all
+    ]
+    for body in bad_bodies:
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            _post(llama_server, body, timeout=60)
+        assert exc.value.code == 400, body
+        assert exc.value.headers.get("Content-Type") == \
+            "application/json"
+        assert "error" in json.loads(exc.value.read()), body
+    # a VALID body with stream: true still passes validation and
+    # actually streams (guards against an over-strict validator
+    # rejecting healthy streaming traffic)
+    import http.client
+    import urllib.parse as up
+
+    u = up.urlparse(llama_server)
+    conn = http.client.HTTPConnection(u.hostname, u.port, timeout=300)
+    conn.request("POST", "/generate",
+                 body=json.dumps({"prompt_ids": [5, 6, 7],
+                                  "max_new_tokens": 4,
+                                  "stream": True}),
+                 headers={"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    assert resp.status == 200
+    assert resp.getheader("Content-Type") == "text/event-stream"
+    events = [json.loads(line[len("data: "):])
+              for line in resp.read().decode().splitlines()
+              if line.startswith("data: ")]
+    conn.close()
+    assert events and events[-1].get("done") is True
+    assert len(events[-1]["ids"]) == 4 and "error" not in events[-1]
+
+
 def _post(url, payload, timeout=300):
     req = urllib.request.Request(
         url + "/generate", data=json.dumps(payload).encode(),
