@@ -259,6 +259,129 @@ def _warm_admit_fn(model, feed: int, k: int, n_stop: int, nb: int,
     return admit
 
 
+@functools.lru_cache(maxsize=64)
+def _paged_admit_fn(model, feed: int, k: int, n_stop: int, nb: int):
+    """TRUE paged admission (ISSUE 7 tentpole): NO cache build, NO
+    scatter copy. The shared cache is gone — the engine's cache pytree
+    IS the block pool, and this executable (a) writes the group's block
+    tables into the shared table array (the entire "warm admit" for the
+    cached prefix: a pointer update), (b) prefills ONLY each row's
+    uncached suffix through the model's paged path (its K/V lands
+    directly in the row's private pool pages), and (c) samples first
+    tokens + writes slot state, all in one dispatch.
+
+    Positions are row-local: row ``j``'s suffix occupies window lanes
+    ``pad_j..feed-1`` at positions ``c_j..L_j-1`` (``rs_j = L_j - feed``
+    is lane 0's position; lanes below ``pad_j`` write the scratch
+    page). Shared radix pages cover positions ``< c_j`` and are never
+    written — warm admit device-copy bytes are ZERO by construction.
+
+    ``ints`` columns: [slot, budget, pad_0.., stop_0..stop_{W-1}, rs].
+    Donates the pool cache, tables, slot arrays, and starts.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from .generate import _sample_rows_traced
+
+    @functools.partial(jax.jit, donate_argnums=(1, 2, 3, 4))
+    def admit(params, cache, tables, arrays, starts, prompts, ints,
+              floats, keys_data_k, topk_k, tables_k):
+        slots = ints[:, 0]
+        budgets_k = ints[:, 1]
+        pad_k = ints[:, 2]
+        stops_k = ints[:, 3:3 + n_stop]
+        rs_k = ints[:, 3 + n_stop]
+        temps_k = floats[:, 0]
+        ps_k = floats[:, 1]
+        keys = jax.random.wrap_key_data(keys_data_k)
+        tables = tables.at[slots].set(tables_k)
+        logits, vs = model.apply(
+            {"params": params, "cache": cache}, prompts,
+            train=False, decode=True, prefill=True, mutable=["cache"],
+            pad_lens=pad_k, block_tables=tables_k, row_starts=rs_k,
+        )
+        cache = dict(vs["cache"])
+        tok0 = _sample_rows_traced(
+            jax.vmap(jax.random.fold_in)(keys,
+                                         jnp.zeros((k,), jnp.int32)),
+            logits[:, -1], temps_k, topk_k, ps_k,
+        )
+        starts = starts.at[slots].set(rs_k + feed)
+        (tok, emitted, done, budgets, pad_lens, keys_data, stops,
+         temps, ks, ps) = arrays
+        arrays_out = (
+            tok.at[slots].set(tok0),
+            emitted.at[slots].set(jnp.ones((k,), jnp.int32)),
+            done.at[slots].set(jnp.zeros((k,), bool)),
+            budgets.at[slots].set(budgets_k),
+            pad_lens.at[slots].set(jnp.zeros((k,), jnp.int32)),
+            keys_data.at[slots].set(keys_data_k),
+            stops.at[slots].set(stops_k),
+            temps.at[slots].set(temps_k),
+            ks.at[slots].set(topk_k),
+            ps.at[slots].set(ps_k),
+        )
+        return cache, tables, arrays_out, starts, tok0
+
+    return admit
+
+
+@functools.lru_cache(maxsize=16)
+def _paged_chunk_fn(model, steps: int, n_stop: int):
+    """``steps`` in-graph paged decode steps: every slot's single token
+    feeds at its OWN row-local position (``starts``) and its K/V
+    appends into its private pool page through the block table
+    (models/llama._paged_attention); attention reads the pool in place
+    (ops/flash paged kernel on TPU). Frozen rows pass ``pad_lens=1`` so
+    their (ignored) writes land in the scratch page — a done row can
+    never dirty a page the radix index might share. Donates the pool
+    cache."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from .generate import _isin, _sample_rows_traced
+
+    @functools.partial(jax.jit, donate_argnums=1)
+    def chunk(params, cache, tables, starts, tok, emitted, done, budgets,
+              pad_lens, keys_data, stops, temps, ks, ps):
+        del pad_lens               # paged rows have no left-pad space
+        keys = jax.random.wrap_key_data(keys_data)
+        done = done | _isin(tok, stops) | (emitted >= budgets)
+
+        def body(carry, _):
+            cache, starts, tok, emitted, done = carry
+            logits, vs = model.apply(
+                {"params": params, "cache": cache}, tok[:, None],
+                train=False, decode=True, mutable=["cache"],
+                pad_lens=done.astype(jnp.int32),
+                block_tables=tables, row_starts=starts,
+            )
+            lg = logits[:, -1]
+            step_keys = jax.vmap(jax.random.fold_in)(keys, emitted)
+            nxt = lax.cond(
+                jnp.any((temps > 0.0) & ~done),
+                lambda: _sample_rows_traced(step_keys, lg, temps, ks,
+                                            ps),
+                lambda: jnp.argmax(lg, axis=-1).astype(jnp.int32),
+            )
+            nxt = jnp.where(done, 0, nxt)
+            live = (~done).astype(jnp.int32)
+            emitted = emitted + live
+            starts = starts + live
+            done = done | _isin(nxt, stops) | (emitted >= budgets)
+            return (dict(vs["cache"]), starts, nxt, emitted, done), nxt
+
+        (cache, starts, tok, emitted, done), toks = lax.scan(
+            body, (cache, starts, tok, emitted, done), None,
+            length=steps)
+        return cache, starts, jnp.swapaxes(toks, 0, 1), tok, emitted, \
+            done
+
+    return chunk
+
+
 @functools.lru_cache(maxsize=16)
 def _chunk_fn(model, steps: int, n_stop: int):
     """``steps`` in-graph decode steps over all slots: per-row rng
@@ -337,9 +460,11 @@ class ContinuousBatchingService(GenerationService):
 
     def _setup(self, model, params, tokenizer=None, slots: int = 8,
                chunk: int = 8, window_ms: float = 5.0,
-               warm_buckets=None, prefix_cache=None, recorder=None):
+               warm_buckets=None, prefix_cache=None, recorder=None,
+               spec_draft_layers: int = 0):
         super()._setup(model, params, tokenizer,
-                       prefix_cache=prefix_cache)
+                       prefix_cache=prefix_cache,
+                       spec_draft_layers=spec_draft_layers)
         self._recorder = recorder
         if not self._pad_ok:
             raise ValueError(
@@ -350,6 +475,16 @@ class ContinuousBatchingService(GenerationService):
 
         self._slots = int(slots)
         self._chunk = int(chunk)
+        # TRUE paged decode (ISSUE 7): with a paged-capable pool the
+        # shared contiguous cache is replaced by the block pool + a
+        # per-slot block table — warm admits become pointer updates
+        # (zero device copy), decode reads pool pages in place, and
+        # finished requests' pages adopt into the radix index with no
+        # capture kernel. Unsupported layouts keep the round-5 scatter
+        # fallback below, unchanged.
+        self._paged = self._prefix is not None and self._prefix.paged
+        self._tables = None          # [slots, nb_max] device block table
+        self._starts = None          # [slots] row-local next-fed position
         # host-side key derivation: the default threefry impl's key
         # data for integer seed s is [s >> 32, s & 0xffffffff]; going
         # through jax.random.key() per request costs a device round
@@ -387,7 +522,9 @@ class ContinuousBatchingService(GenerationService):
             )
         self.stats = {"requests": 0, "completed": 0, "chunks": 0,
                       "admissions": 0, "eras": 0, "max_active": 0,
-                      "tokens_generated": 0, "cancelled": 0}
+                      "tokens_generated": 0, "cancelled": 0,
+                      "paged_chunks": 0, "paged_admissions": 0,
+                      "deferred_admissions": 0}
         self._warm_chunk_ladder()
         self._worker_thread = threading.Thread(
             target=self._worker, daemon=True, name="gen-continuous")
@@ -424,9 +561,33 @@ class ContinuousBatchingService(GenerationService):
         from .generate import fresh_cache
 
         total = int(self.model.max_len)
-        cache = fresh_cache(self.model, self.params, self._slots, total)
         self._init_arrays()
         arrays = self._arrays
+        if self._paged:
+            # paged warmup runs against the REAL pool: with an all -1
+            # table every write lands in the scratch page and every
+            # read is masked, so executing the ladder cannot dirty a
+            # sharable page — and the executables warmed are exactly
+            # the dispatch-path ones
+            import jax.numpy as jnp
+
+            cache = self._prefix.paged_cache()
+            tables = jnp.full((self._slots, self._prefix.nb_max), -1,
+                              jnp.int32)
+            starts = jnp.zeros((self._slots,), jnp.int32)
+            steps = self._chunk
+            while steps <= min(self._chunk * self.GROW_MAX, total):
+                fn = _paged_chunk_fn(self.model, steps, self.MAX_STOPS)
+                out = fn(self.params, cache, tables, starts, *arrays)
+                cache, starts = out[0], out[1]
+                steps *= 2
+            if self._warm_buckets:
+                cache = self._warm_admit_ladder_paged(cache, tables,
+                                                      starts, arrays)
+            self._prefix.sync_pool_from_cache(cache)
+            self._arrays = None
+            return
+        cache = fresh_cache(self.model, self.params, self._slots, total)
         steps = self._chunk
         while steps <= min(self._chunk * self.GROW_MAX, total):
             fn = _chunk_fn(self.model, steps, self.MAX_STOPS)
@@ -436,6 +597,42 @@ class ContinuousBatchingService(GenerationService):
         if self._warm_buckets:
             self._warm_admit_ladder(cache, arrays)
         self._arrays = None          # the worker builds its own state
+
+    def _warm_admit_ladder_paged(self, cache, tables, starts, arrays):
+        """Paged twin of ``_warm_admit_ladder``: every admission in
+        paged mode runs through ``_paged_admit_fn`` specialized on the
+        FEED bucket (the uncached-suffix window), so the whole
+        power-of-two sub-ladder up to the largest configured bucket is
+        primed. Dummy rows are fully-padded (all writes -> scratch, all
+        reads masked); returns the donated-through cache for the pool
+        sync."""
+        import jax
+        import jax.numpy as jnp
+
+        k, W = self._slots, self.MAX_STOPS
+        nb = self._prefix.nb_max
+        kd = np.asarray(jax.random.key_data(jax.random.key(0)))
+        keys_data = jnp.asarray(np.tile(kd, (k, 1)))
+        b, buckets = 16, []
+        while b <= max(self._warm_buckets):
+            buckets.append(b)
+            b *= 2
+        for feed in buckets:
+            ints = np.zeros((k, 4 + W), np.int32)
+            ints[:, 0] = np.arange(k)
+            ints[:, 1] = 1                  # budget 1
+            ints[:, 2] = feed               # all lanes padded
+            ints[:, 3:3 + W] = -1
+            ints[:, 3 + W] = -feed          # rs: last lane at position 0
+            cache, tables, arrays, starts, _ = _paged_admit_fn(
+                self.model, feed, k, W, nb)(
+                self.params, cache, tables, arrays, starts,
+                jnp.zeros((k, feed), jnp.int32), jnp.asarray(ints),
+                jnp.zeros((k, 2), jnp.float32), keys_data,
+                jnp.zeros((k,), jnp.int32),
+                jnp.full((k, nb), -1, jnp.int32))
+        jax.block_until_ready(arrays[0])
+        return cache
 
     def _warm_admit_ladder(self, cache, arrays):
         """Execute the admit executable for every configured bucket on
@@ -637,6 +834,8 @@ class ContinuousBatchingService(GenerationService):
         collapsed 201 -> 43 tok/s from exactly that)."""
         import jax.numpy as jnp
 
+        if self._paged:
+            return self._admit_group_paged(reqs, slots)
         n = len(reqs)
         k = self._slots
         W = self.MAX_STOPS
@@ -702,6 +901,11 @@ class ContinuousBatchingService(GenerationService):
                 for nodes, _, _ in matches:
                     self._prefix.release(nodes)
                 raise
+            # the scatter arm's admit-copy cost, made observable (the
+            # paged path above never pays it): every cached block each
+            # row reused crossed HBM into the fresh group cache
+            self._prefix.record_copy_bytes(
+                sum(len(m[1]) for m in matches))
             # inserts + the ref release ride one helper (its finally
             # owns the release from here on)
             self._insert_prefixes(reqs, slots, ints, matches)
@@ -712,6 +916,107 @@ class ContinuousBatchingService(GenerationService):
                 "pad_len": int(ints[j, 2]), "done": False,
             }
         self.stats["admissions"] += n
+
+    def _reserve_pages(self, r):
+        """Host-side page reservation for one paged admission —
+        ``PrefixCache.paged_plan`` owns the math (lookup + private
+        chain covering the uncached suffix AND the full decode budget,
+        up front so a mid-decode row can never block on the pool).
+        ``None`` = pool exhausted right now — the caller defers the
+        admission (completions free pages; progress is guaranteed
+        because one full-budget chain always fits an otherwise-idle
+        pool, enforced at PrefixCache construction). A deferred
+        request re-reserves EVERY tick: only its first attempt may
+        count toward the hit/lookup stats, or a second of deferral
+        would fabricate hundreds of phantom hit-tokens."""
+        first = not r.get("_page_retry")
+        r["_page_retry"] = True
+        return self._prefix.paged_plan(r["ids"], r["budget"],
+                                       record=first)
+
+    def _admit_group_paged(self, reqs: list, slots: list):
+        """Paged admission: ONE dispatch writes the group's block
+        tables (the whole warm-prefix "copy" — a pointer update),
+        prefills ONLY each row's uncached suffix straight into its
+        private pool pages, and samples first tokens. Zero admit-path
+        device copies; ``scatter_blocks`` never runs here. After the
+        dispatch, each prompt's full blocks ADOPT into the radix index
+        in place — the group's own pages become sharable with no
+        capture kernel. Page reservations were made by
+        ``_reserve_pages`` in ``_tick`` (so a dry pool defers the
+        request instead of stranding a slot)."""
+        import jax.numpy as jnp
+
+        pf = self._prefix
+        bt = pf.block
+        n = len(reqs)
+        k = self._slots
+        W = self.MAX_STOPS
+        nb = pf.nb_max
+        pad_reqs = reqs + [reqs[-1]] * (k - n)
+        pad_slots = list(slots) + [slots[-1]] * (k - n)
+        feed = self._bucket(max(
+            len(r["ids"]) - r["_pages"]["c"] for r in reqs))
+        prompts = np.zeros((k, feed), np.int32)
+        ints = np.zeros((k, 4 + W), np.int32)
+        floats = np.zeros((k, 2), np.float32)
+        topks = np.zeros((k,), np.int32)
+        tables_k = np.full((k, nb), -1, np.int32)
+        for j, r in enumerate(pad_reqs):
+            plan = r["_pages"]
+            ids, c = plan["ids"], plan["c"]
+            s = len(ids) - c               # uncached suffix (>= 1: the
+            # radix lookup never serves the final prompt token)
+            prompts[j, feed - s:] = ids[c:]
+            ints[j, 0] = pad_slots[j]
+            ints[j, 1] = r["budget"]
+            ints[j, 2] = feed - s          # leading invalid lanes
+            ints[j, 3:3 + W] = -1
+            for jj, sid in enumerate(r["stop"]):
+                ints[j, 3 + jj] = sid
+            ints[j, 3 + W] = len(ids) - feed   # lane 0's position
+            floats[j] = (r["temperature"], r["top_p"])
+            topks[j] = r["top_k"]
+            for i, b in enumerate(plan["blocks"]):
+                tables_k[j, i] = b
+            for idx, bid in plan["private"].items():
+                tables_k[j, idx] = bid
+        keys_data = jnp.asarray(
+            np.stack([r["key_data"] for r in pad_reqs]))
+        try:
+            (self._cache, self._tables, self._arrays, self._starts,
+             tok0) = _paged_admit_fn(self.model, feed, k, W, nb)(
+                self.params, self._cache, self._tables, self._arrays,
+                self._starts, jnp.asarray(prompts), jnp.asarray(ints),
+                jnp.asarray(floats), keys_data, jnp.asarray(topks),
+                jnp.asarray(tables_k))
+        except Exception:
+            # a failed dispatch must not strand refs or leak pages
+            for r in reqs:
+                plan = r.pop("_pages")
+                pf.release(plan["nodes"])
+                pf.free_blocks(list(plan["private"].values()))
+            raise
+        pf.sync_pool_from_cache(self._cache)
+        for j, (r, slot) in enumerate(zip(reqs, slots)):
+            plan = r.pop("_pages")
+            # zero-copy insert of the prompt's own full blocks: the
+            # pages just written in place become sharable immediately
+            # (ref-pinned — this slot keeps reading them)
+            adopted, anodes = pf.adopt(
+                plan["ids"], dict(plan["private"]), acquire=True)
+            for bid in adopted:
+                for idx in [i for i, b in plan["private"].items()
+                            if b == bid]:
+                    del plan["private"][idx]
+            plan["adopt_nodes"] = anodes
+            self._meta[slot] = {
+                "req": r, "emitted": 1, "out": [],
+                "tok0_ref": (tok0, j),
+                "pad_len": 0, "done": False, "pages": plan,
+            }
+        self.stats["admissions"] += n
+        self.stats["paged_admissions"] += n
 
     def _init_arrays(self):
         """The persistent device slot state, built ONCE (and after an
@@ -745,12 +1050,24 @@ class ContinuousBatchingService(GenerationService):
         lru-cached like any other)."""
         tok, emitted, done, budgets, pad_lens, keys, stops, temps, \
             ks, ps = self._arrays
-        chunk = _chunk_fn(self.model, steps, self.MAX_STOPS)
-        with span("serve/chunk_dispatch", steps=steps):
-            cache, toks, tok, emitted, done = chunk(
-                self.params, self._cache, tok, emitted, done, budgets,
-                pad_lens, keys, stops, temps, ks, ps)
-        self._cache = cache
+        if self._paged:
+            chunk = _paged_chunk_fn(self.model, steps, self.MAX_STOPS)
+            with span("serve/chunk_dispatch", steps=steps, paged=True):
+                cache, starts, toks, tok, emitted, done = chunk(
+                    self.params, self._cache, self._tables,
+                    self._starts, tok, emitted, done, budgets,
+                    pad_lens, keys, stops, temps, ks, ps)
+            self._cache = cache
+            self._starts = starts
+            self._prefix.sync_pool_from_cache(cache)
+            self.stats["paged_chunks"] += 1
+        else:
+            chunk = _chunk_fn(self.model, steps, self.MAX_STOPS)
+            with span("serve/chunk_dispatch", steps=steps):
+                cache, toks, tok, emitted, done = chunk(
+                    self.params, self._cache, tok, emitted, done,
+                    budgets, pad_lens, keys, stops, temps, ks, ps)
+            self._cache = cache
         self._arrays = (tok, emitted, done, budgets, pad_lens, keys,
                         stops, temps, ks, ps)
         self._p += steps
@@ -789,8 +1106,14 @@ class ContinuousBatchingService(GenerationService):
                 # cancelled mid-flight: finalize with what's decoded,
                 # free the slot for waiting traffic (the device row
                 # keeps stepping until the slot is reused — bounded
-                # waste; the SLOT availability is the win)
+                # waste; the SLOT availability is the win). In paged
+                # mode the still-stepping zombie row keeps WRITING its
+                # private pool pages, so their cleanup defers until
+                # the slot is re-admitted or the engine idles
+                # (_finish_pages zombie arm) — freeing them now could
+                # hand a page the zombie still writes to a new request
                 m["done"] = True
+                m["zombie"] = True
             cb = m["req"].get("on_tokens")
             if cb is not None:
                 # delta = this absorb's emissions, minus stop ids (a
@@ -824,6 +1147,7 @@ class ContinuousBatchingService(GenerationService):
             }
             if self._prefix is not None:
                 snap = self._prefix.stats_snapshot()
+                chunks = max(self.stats.get("chunks", 0), 1)
                 rec.update(
                     prefix_hit_tokens_total=snap["prefix_hit_tokens"],
                     prefix_hit_requests_total=snap[
@@ -833,6 +1157,16 @@ class ContinuousBatchingService(GenerationService):
                     prefix_pool_blocks_used=snap[
                         "prefix_pool_blocks_used"],
                     prefix_pool_blocks=snap["prefix_pool_blocks"],
+                    prefix_pool_blocks_resident=snap[
+                        "prefix_pool_blocks_resident"],
+                    prefix_pool_blocks_referenced=snap[
+                        "prefix_pool_blocks_referenced"],
+                    prefix_adopted_blocks_total=snap[
+                        "prefix_adopted_blocks"],
+                    warm_admit_copy_bytes_total=snap[
+                        "warm_admit_copy_bytes"],
+                    paged_decode_frac=round(
+                        self.stats.get("paged_chunks", 0) / chunks, 4),
                 )
             self._recorder.record(self.stats["chunks"], **rec)
 
@@ -870,9 +1204,48 @@ class ContinuousBatchingService(GenerationService):
             for nodes, _, _ in matches:
                 self._prefix.release(nodes)
 
+    def _finish_pages(self, slot: int, m: dict) -> None:
+        """Paged end-of-request page bookkeeping: ADOPT the request's
+        full (prompt + decoded) blocks into the radix index in place —
+        the zero-copy insert that makes freshly decoded tokens
+        immediately sharable — then free the unadoptable tail and drop
+        the slot's refs. Cancelled rows are ZOMBIES (the device lane
+        keeps stepping into its private pages until the slot is
+        reused): their cleanup is stashed and re-run from the next
+        admit to this slot or the next idle tick."""
+        pf = self._prefix
+        plan = m.get("pages")
+        if plan is None:
+            return
+        if m.get("zombie"):
+            self._zombies[slot] = (plan, list(m["out"]),
+                                   int(m["emitted"]))
+            return
+        self._cleanup_pages(plan, list(m["out"]), int(m["emitted"]))
+
+    def _cleanup_pages(self, plan, out, emitted: int) -> None:
+        # PrefixCache.paged_finish owns the end-of-request page
+        # bookkeeping (adopt written blocks, free the tail, release
+        # plan + adopt refs) — shared with the batch-1 path
+        self._prefix.paged_finish(plan, out, emitted)
+
+    def _reap_zombies(self, slot=None) -> None:
+        """Run deferred page cleanup — for one slot (about to be
+        re-admitted: the admit dispatch replaces the zombie's row
+        state, so its writes stop targeting the old pages) or for all
+        (engine idle: no chunks dispatch, nothing steps)."""
+        slots = ([slot] if slot is not None
+                 else list(self._zombies.keys()))
+        for s in slots:
+            stash = self._zombies.pop(s, None)
+            if stash is not None:
+                self._cleanup_pages(*stash)
+
     def _complete(self, slot: int):
         m = self._meta[slot]
         req = m["req"]
+        if self._paged:
+            self._finish_pages(slot, m)
         resp = self._response(
             m["out"], stops=req["stop"], emitted=m["emitted"])
         ev = req.get("cancel")
@@ -919,6 +1292,7 @@ class ContinuousBatchingService(GenerationService):
         self._cache = None
         self._arrays = None
         self._p = 0
+        self._zombies: dict = {}
         pending: list = []
         while True:
             involved = [m["req"] for m in self._meta if m is not None]
@@ -949,6 +1323,37 @@ class ContinuousBatchingService(GenerationService):
                 for r in involved:
                     r["error"] = e
                     r["event"].set()
+                if self._paged:
+                    # drop every page reservation this wreckage holds:
+                    # leaked refs would pin pool pages against eviction
+                    # forever on a recovering server
+                    pf = self._prefix
+                    plans = (
+                        [m["pages"] for m in self._meta
+                         if m is not None and m.get("pages")]
+                        + [r["_pages"] for r in pending
+                           if r.get("_pages")]
+                        + [z[0] for z in self._zombies.values()]
+                    )
+                    for plan in plans:
+                        try:
+                            pf.release(plan["nodes"])
+                            pf.release(plan["adopt_nodes"])
+                            pf.free_blocks(
+                                list(plan["private"].values()))
+                        except Exception:  # noqa: BLE001 — best effort
+                            pass
+                    self._zombies = {}
+                    self._tables = None
+                    self._starts = None
+                    # a dispatch that failed AFTER donating the cache
+                    # leaves the pool's buffers dead — rebuilding the
+                    # next era's cache from them would fail forever.
+                    # Reset (content is unrecoverable) AFTER the plan
+                    # cleanup above, so its host bookkeeping ran
+                    # against the index that issued the refs.
+                    if not pf.pool_alive():
+                        pf.reset_pool()
                 pending.clear()
                 self._meta = [None] * self._slots
                 self._cache = None
@@ -976,12 +1381,32 @@ class ContinuousBatchingService(GenerationService):
                 self.stats["cancelled"] = (
                     self.stats.get("cancelled", 0) + 1)
                 self.stats["completed"] += 1
+        if self._paged and self._cache is not None:
+            # a batch-1 speculative request between ticks (same lock)
+            # may have reassigned the pool — its scatter insert's
+            # capture kernel donates the very leaves this cache
+            # aliases. Re-adopt before any dispatch touches them.
+            self._cache = self._prefix.refresh_cache_from_pool(
+                self._cache)
         if not active:
             # idle: new era (stale K/V is masked by pad_lens; only the
-            # position counter resets)
+            # position counter resets). Paged mode has NO eras — pages
+            # are position-independent — but idle is when zombie
+            # (cancelled) rows are provably quiescent, so their
+            # deferred page cleanup runs here.
             self._p = 0
             self.stats["eras"] += 1
-            if self._cache is None:
+            if self._paged:
+                import jax.numpy as jnp
+
+                self._reap_zombies()
+                if self._cache is None:
+                    self._cache = self._prefix.paged_cache()
+                    self._tables = jnp.full(
+                        (self._slots, self._prefix.nb_max), -1,
+                        jnp.int32)
+                    self._starts = jnp.zeros((self._slots,), jnp.int32)
+            elif self._cache is None:
                 self._cache = fresh_cache(
                     self.model, self.params, self._slots,
                     int(self.model.max_len))
@@ -991,8 +1416,9 @@ class ContinuousBatchingService(GenerationService):
         # prefix of pending requests tolerates: the OLDEST request is
         # always admitted (no starvation), and same-wave arrivals of
         # mixed lengths admit together when their budgets all still
-        # fit the era at the larger start position
-        if not active and pending:
+        # fit the era at the larger start position. (Paged rows carry
+        # their own positions — no era placement needed.)
+        if not active and pending and not self._paged:
             max_len = int(self.model.max_len)
             p_cand, chosen = 0, []
             # only the first `slots` pending requests can admit this
@@ -1014,7 +1440,25 @@ class ContinuousBatchingService(GenerationService):
         for r in list(pending):
             if not free:
                 break
-            if self._admissible(r) and self._p > 0:
+            if self._paged:
+                # position-free admission: reserve pool pages (shared
+                # prefix refs + a private chain for suffix AND budget).
+                # A dry pool DEFERS the request — completions free
+                # pages; FIFO order holds (we stop at the first
+                # un-reservable request instead of skipping it)
+                plan = self._reserve_pages(r)
+                if plan is None:
+                    self.stats["deferred_admissions"] += 1
+                    break
+                r["_pages"] = plan
+                pending.remove(r)
+                slot = free.pop(0)
+                # this slot's admit dispatch (this tick) neutralizes
+                # any zombie lane still writing its old pages
+                self._reap_zombies(slot)
+                b = self._bucket(len(r["ids"]))
+                groups.setdefault(b, []).append((r, slot))
+            elif self._admissible(r) and self._p > 0:
                 pending.remove(r)
                 b = self._bucket(len(r["ids"]))
                 groups.setdefault(b, []).append((r, free.pop(0)))
@@ -1030,8 +1474,12 @@ class ContinuousBatchingService(GenerationService):
             return
         min_left = min(m["req"]["budget"] - m["emitted"] for m in live)
         # era-end tail: the admission invariant bounds every live
-        # budget by max_len, so min 1 step always remains
-        steps = min(self._chunk, int(self.model.max_len) - self._p)
+        # budget by max_len, so min 1 step always remains. Paged rows
+        # carry their own positions and preallocated chains — no era,
+        # no tail clamp.
+        room = (10 ** 9 if self._paged
+                else int(self.model.max_len) - self._p)
+        steps = min(self._chunk, room)
         # ADAPTIVE chunk growth: when every slot is occupied, no slot
         # can free before min_left steps (a row only exits early via a
         # stop token) — so running one long chunk straight to min_left
@@ -1056,13 +1504,15 @@ class ContinuousBatchingService(GenerationService):
                 # compile, the same timing-nondeterminism the padded
                 # admission width kills (measured: the chunk=8 rung
                 # collapsed ~10x from exactly that before the warmup)
-            steps = min(grown, int(self.model.max_len) - self._p)
+            steps = min(grown, room)
         out1 = self._dispatch_chunk(steps)
         # dispatch ONE chunk ahead while the first runs, unless queue
         # traffic wants an admission slot between them or everyone
         # will finish inside the first chunk anyway
         min_left -= steps        # remaining after chunk 1
-        steps2 = min(self._chunk, int(self.model.max_len) - self._p)
+        steps2 = min(self._chunk,
+                     (10 ** 9 if self._paged
+                      else int(self.model.max_len) - self._p))
         if (self._queue.empty() and min_left > 0
                 and not any(m is None for m in self._meta)
                 and steps2 >= 1):

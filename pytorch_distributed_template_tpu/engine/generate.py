@@ -456,7 +456,7 @@ def generate_speculative(model, params, prompt: jnp.ndarray,
                          top_p: float = 0.0,
                          rng: Optional[jax.Array] = None,
                          pad_to: Optional[int] = None,
-                         stop_tokens=None):
+                         stop_tokens=None, draft_layers: int = 0):
     """Generation via self-speculative (prompt-lookup) decoding.
 
     GREEDY (``temperature <= 0``, the default) emits BIT-IDENTICAL
@@ -513,6 +513,22 @@ def generate_speculative(model, params, prompt: jnp.ndarray,
     counter; divergent per-row acceptance would need per-row
     counters), ``prompt >= ngram``.
 
+    ``draft_layers > 0`` (ISSUE 7): swap the n-gram drafter for a
+    DRAFT MODEL — the target's own first ``draft_layers`` blocks with
+    the final norm + LM head on top (``model.apply(exit_layer=...)``).
+    The draft shares the target's params AND its KV cache: draft steps
+    write layers ``0..draft_layers-1`` K/V at the speculative
+    positions, and the verify pass recomputes those exact rows from
+    the same tokens (identical values — overwrite, not corruption)
+    while filling the remaining layers, so draft/verify cache reuse is
+    free and rejection rewinds both at once via the one ``pos_index``.
+    Each iteration costs ``D`` early-exit steps (~``draft_layers /
+    n_layer`` of a full step each, decode being weight-bound) plus the
+    one fused ``D+1``-token verify. Greedy output stays BIT-IDENTICAL
+    to plain decode (the verifier decides every token); sampled mode
+    stays distribution-exact (the drafter is deterministic-greedy, so
+    the same rejection-sampling argument applies).
+
     ``pad_to`` (RoPE families only): left-pad the prompt to this
     length before compiling, so serving traffic with many distinct
     prompt lengths shares one executable per length bucket instead of
@@ -527,9 +543,11 @@ def generate_speculative(model, params, prompt: jnp.ndarray,
         raise ValueError("speculative decoding supports batch size 1 "
                          f"(got {b}) — the KV cache keeps one position "
                          "counter")
-    if t0 < ngram:
+    if not draft_layers and t0 < ngram:
         # checked on the REAL length: bucket padding must not let an
-        # under-ngram prompt slip through with pad zeros as its gram
+        # under-ngram prompt slip through with pad zeros as its gram.
+        # An early-exit draft (draft_layers > 0) never consults
+        # n-grams — same condition as speculative_from_cache.
         raise ValueError(f"prompt length {t0} < ngram {ngram}")
     pad = 0
     if pad_to is not None and int(pad_to) > t0:
@@ -551,6 +569,20 @@ def generate_speculative(model, params, prompt: jnp.ndarray,
     D, g = int(draft_len), int(ngram)
     if D < 1:
         raise ValueError("draft_len must be >= 1")
+    draft_layers = int(draft_layers)
+    if draft_layers:
+        import inspect
+
+        if not (0 < draft_layers < int(model.n_layer)):
+            raise ValueError(
+                f"draft_layers must be in (0, n_layer={model.n_layer}) "
+                f"(got {draft_layers}) — the early-exit draft needs a "
+                "strict prefix of the target's blocks")
+        if "exit_layer" not in inspect.signature(
+                type(model).__call__).parameters:
+            raise ValueError(
+                f"{type(model).__name__} has no exit_layer support: "
+                "the early-exit draft needs the Llama-family call path")
     if max_new_tokens <= 0:
         return (prompt, {}) if return_stats else prompt
     # the loop stops exactly at the budget, so the buffer needs slack
@@ -582,7 +614,8 @@ def generate_speculative(model, params, prompt: jnp.ndarray,
                      else np.full((1,), -1, np.int64))
     run = _spec_loop(model, L, D, g, t0, max_new_tokens,
                      float(temperature), int(top_k), float(top_p),
-                     padded=pad > 0, n_stop=int(stops_arr.shape[0]))
+                     padded=pad > 0, n_stop=int(stops_arr.shape[0]),
+                     draft_layers=draft_layers)
     rng = rng if rng is not None else jax.random.key(0)
     toks, n, iters = run(params, prompt, rng, jnp.int32(pad),
                          jnp.asarray(stops_arr, jnp.int32))
@@ -620,11 +653,73 @@ def generate_speculative(model, params, prompt: jnp.ndarray,
     return out
 
 
+def speculative_from_cache(model, params, prompt_ids, cache, last_logits,
+                           total: int, max_new_tokens: int,
+                           draft_len: int = 4, ngram: int = 2,
+                           temperature: float = 0.0, top_k: int = 0,
+                           top_p: float = 0.0,
+                           rng: Optional[jax.Array] = None,
+                           stop_tokens=None, draft_layers: int = 0):
+    """Speculative decoding continuing from an externally-prefilled
+    cache — the POOL-SHARED serving path (ISSUE 7): the caller builds
+    ``cache`` via ``kvcache.PrefixCache.warm_prefill(params, ids,
+    total)`` (cached prefix blocks + suffix-only prefill), so both the
+    target and its early-exit draft (``draft_layers``) skip the shared
+    prefix's prefill entirely — one cache, one pool, zero extra
+    memory. Contract: ``cache`` length ``total`` with ``pos_index ==
+    len(prompt_ids)``; ``last_logits`` are the prompt's last-position
+    logits. Output is token-identical (greedy) / distribution-exact
+    (sampled) to ``generate_speculative`` on the same inputs — the
+    same loop executable runs, only the prefill differs. Returns
+    ``(out [1, t0 + max_new], stats)``."""
+    import numpy as np
+
+    t0 = len(prompt_ids)
+    D, g = int(draft_len), int(ngram)
+    max_new_tokens = int(max_new_tokens)
+    L = int(total)
+    if L < t0 + max_new_tokens + 2 * (D + 1):
+        raise ValueError(
+            f"cache length {L} lacks the spec loop's overshoot slack "
+            f"(need >= {t0 + max_new_tokens + 2 * (D + 1)})")
+    if not draft_layers and t0 < g:
+        raise ValueError(f"prompt length {t0} < ngram {g}")
+    if stop_tokens is None:
+        stops_arr = np.full((1,), -1, np.int64)
+    else:
+        flat = [int(s) for s in stop_tokens]
+        stops_arr = (np.asarray(flat, np.int64) if flat
+                     else np.full((1,), -1, np.int64))
+    prompt = jnp.asarray(np.asarray(prompt_ids, np.int32)[None, :])
+    run = _spec_loop(model, L, D, g, t0, max_new_tokens,
+                     float(temperature), int(top_k), float(top_p),
+                     padded=False, n_stop=int(stops_arr.shape[0]),
+                     draft_layers=int(draft_layers), external=True)
+    rng = rng if rng is not None else jax.random.key(0)
+    toks, n, iters = run(params, prompt, rng, jnp.int32(0),
+                         jnp.asarray(stops_arr, jnp.int32),
+                         (dict(cache), last_logits))
+    emitted = min(int(n) - t0, max_new_tokens)
+    out = toks[None, : t0 + max_new_tokens]
+    if stop_tokens is not None and emitted < max_new_tokens:
+        keep = np.arange(out.shape[1]) < t0 + emitted
+        out = jnp.where(jnp.asarray(keep)[None, :], out, 0)
+    stats = {
+        "model_calls": int(iters),
+        "tokens_emitted": emitted,
+        "stopped": bool(stop_tokens is not None
+                        and emitted < max_new_tokens),
+        "tokens_per_call": round(float(emitted) / max(int(iters), 1), 3),
+    }
+    return out, stats
+
+
 @functools.lru_cache(maxsize=32)
 def _spec_loop(model, L: int, D: int, g: int, t0: int, max_new: int,
                temperature: float = 0.0, top_k: int = 0,
                top_p: float = 0.0, padded: bool = False,
-               n_stop: int = 1):
+               n_stop: int = 1, draft_layers: int = 0,
+               external: bool = False):
     """Compiled speculative generation: ONE dispatch per request —
     zero cache build, prompt prefill, token-buffer setup, and a
     ``lax.while_loop`` that drafts by n-gram lookup, verifies with one
@@ -648,42 +743,59 @@ def _spec_loop(model, L: int, D: int, g: int, t0: int, max_new: int,
     resolved"), not the program.
 
     The ``iters < max_new`` cap is belt-and-suspenders (each iteration
-    commits >= 1 token, so the commit condition terminates first)."""
+    commits >= 1 token, so the commit condition terminates first).
+
+    ``draft_layers > 0`` drafts with the early-exit head instead of
+    n-gram lookup (see ``generate_speculative``). ``external=True``
+    compiles the ``run_from_cache`` twin: the caller supplies a WARM
+    cache of length ``L`` with ``pos_index == t0`` plus the prompt's
+    last-position logits — the pool-shared serving path
+    (engine/serving), where kvcache.warm_prefill builds the cache from
+    radix blocks so BOTH the target and the early-exit draft skip the
+    shared prefix's prefill."""
     from jax import lax
 
     greedy = temperature <= 0
 
     @jax.jit
-    def run(params, prompt, rng, pad_len, stops):
-        # zero KV cache, built in-graph (shapes via eval_shape at trace
-        # time — no device work on the host path)
-        shapes = jax.eval_shape(
-            lambda p: model.apply(
-                {"params": p}, jnp.zeros((1, L), jnp.int32),
-                train=False, decode=True, mutable=["cache"],
-            ),
-            params,
-        )[1]["cache"]
-        cache = jax.tree.map(
-            lambda s: jnp.zeros(s.shape, s.dtype), shapes
-        )
-        # bucket padding (pad_to): pad slots masked from attention
+    def run(params, prompt, rng, pad_len, stops, ext=None):
         extra = ({"pad_lens": pad_len[None]} if padded else {})
-        logits, vs = model.apply(
-            {"params": params, "cache": cache}, prompt,
-            train=False, decode=True, prefill=True, mutable=["cache"],
-            **extra,
-        )
-        cache = vs["cache"]
+        if external:
+            # warm entry: cache + last logits arrive prefilled (the
+            # prefix pool's suffix-only prefill); invariant pos_index
+            # == t0 holds by the warm_prefill contract
+            cache, logits_last = ext
+            cache = dict(cache)
+        else:
+            # zero KV cache, built in-graph (shapes via eval_shape at
+            # trace time — no device work on the host path)
+            shapes = jax.eval_shape(
+                lambda p: model.apply(
+                    {"params": p}, jnp.zeros((1, L), jnp.int32),
+                    train=False, decode=True, mutable=["cache"],
+                ),
+                params,
+            )[1]["cache"]
+            cache = jax.tree.map(
+                lambda s: jnp.zeros(s.shape, s.dtype), shapes
+            )
+            # bucket padding (pad_to): pad slots masked from attention
+            logits, vs = model.apply(
+                {"params": params, "cache": cache}, prompt,
+                train=False, decode=True, prefill=True,
+                mutable=["cache"], **extra,
+            )
+            cache = vs["cache"]
+            logits_last = logits[:, -1]
         # two disjoint streams: the prefill token's and the loop's
         # (folding iters directly off ``rng`` could collide with the
         # prefill key at iteration counts past the constant)
         rng0, rng_loop = jax.random.split(rng)
         if greedy:
-            token0 = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+            token0 = jnp.argmax(logits_last, axis=-1).astype(jnp.int32)
         else:
             token0 = sample_logits(
-                rng0, logits[:, -1].astype(jnp.float32),
+                rng0, logits_last.astype(jnp.float32),
                 temperature, top_k, top_p,
             )
         toks = jnp.zeros((L,), jnp.int32)
@@ -703,30 +815,62 @@ def _spec_loop(model, L: int, D: int, g: int, t0: int, max_new: int,
 
         def body(state):
             toks, n, iters, cur_cache, done = state
-            # --- draft: latest earlier occurrence of the trailing g-gram
-            # (g static shift-compares, not a [L, g] gather — the gather
-            # form measured ~35% slower on the current toolchain)
-            key = lax.dynamic_slice(toks, (n - g,), (g,))
-            match = jnp.ones((L - g + 1,), bool)
-            for j in range(g):
-                match = match & (toks[j: L - g + 1 + j] == key[j])
-            # continuation must lie in committed history, and the match
-            # at i = n-g is the key itself — exclude it; bucket-pad
-            # slots are excluded too (drafting from pad zeros would
-            # only waste verify slots, never corrupt output)
-            valid = (starts + g) < n
-            if padded:
-                valid = valid & (starts >= pad_len)
-            cand = jnp.where(match & valid, starts, -1)
-            i = jnp.max(cand)
-            cont = jnp.where(i >= 0, i + g, n - 1)
-            draft = lax.dynamic_slice(toks, (cont,), (D,))
+            if draft_layers > 0:
+                # --- draft MODEL: D sequential early-exit steps (the
+                # target's first ``draft_layers`` blocks + head) over
+                # the SAME cache — each step writes the visited layers'
+                # K/V at the speculative position, which the verify
+                # pass below recomputes identically (accepted tokens)
+                # or rewinds past (rejected); greedy proposals keep the
+                # sampled-mode rejection math exact
+                def draft_one(j, st):
+                    dcache, cur, dr = st
+                    dlogits, dvs = model.apply(
+                        {"params": params, "cache": dcache}, cur,
+                        train=False, decode=True, mutable=["cache"],
+                        exit_layer=draft_layers, **extra,
+                    )
+                    nxt = jnp.argmax(dlogits[0, -1],
+                                     axis=-1).astype(jnp.int32)
+                    return (dict(dvs["cache"]), nxt[None, None],
+                            dr.at[j].set(nxt))
+
+                cur0 = lax.dynamic_slice(toks, (n - 1,), (1,))[None, :]
+                dcache, _, draft = lax.fori_loop(
+                    0, D, draft_one,
+                    (dict(cur_cache), cur0, jnp.zeros((D,), jnp.int32)))
+                # rewind the shared position counter for the verify
+                # pass (the draft advanced it by D)
+                ver_cache = dict(dcache)
+                ver_cache["pos_index"] = n - 1
+            else:
+                # --- draft: latest earlier occurrence of the trailing
+                # g-gram (g static shift-compares, not a [L, g] gather —
+                # the gather form measured ~35% slower on the current
+                # toolchain)
+                key = lax.dynamic_slice(toks, (n - g,), (g,))
+                match = jnp.ones((L - g + 1,), bool)
+                for j in range(g):
+                    match = match & (toks[j: L - g + 1 + j] == key[j])
+                # continuation must lie in committed history, and the
+                # match at i = n-g is the key itself — exclude it;
+                # bucket-pad slots are excluded too (drafting from pad
+                # zeros would only waste verify slots, never corrupt
+                # output)
+                valid = (starts + g) < n
+                if padded:
+                    valid = valid & (starts >= pad_len)
+                cand = jnp.where(match & valid, starts, -1)
+                i = jnp.max(cand)
+                cont = jnp.where(i >= 0, i + g, n - 1)
+                draft = lax.dynamic_slice(toks, (cont,), (D,))
+                ver_cache = cur_cache
 
             # --- verify: one chunked decode call on [last, d_1..d_D]
             chunk = lax.dynamic_slice(toks, (n - 1,), (1,))
             chunk = jnp.concatenate([chunk, draft])[None, :]  # [1, D+1]
             logits, vs = model.apply(
-                {"params": params, "cache": cur_cache}, chunk,
+                {"params": params, "cache": ver_cache}, chunk,
                 train=False, decode=True, mutable=["cache"], **extra,
             )
             if greedy:

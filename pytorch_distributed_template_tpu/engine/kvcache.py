@@ -224,6 +224,66 @@ def _warm_prefill_fn(model, total: int, feed: int, nb: int, block: int,
     return run
 
 
+@functools.lru_cache(maxsize=32)
+def _paged_prefill_fn(model, feed: int, nb: int):
+    """Compiled batch-1 PAGED prefill (ISSUE 7): no cache build, no
+    block scatter — the cache pytree IS the pool, the row's block
+    table maps its positions to pages (shared radix pages for the
+    cached prefix, freshly allocated private pages for the suffix),
+    and only the ``feed``-token uncached suffix runs through the
+    model, writing K/V straight into the private pages. Returns
+    ``(last_logits, cache)`` like ``_warm_prefill_fn`` — the paged
+    step loop takes over from there. The cache (= the pool) is
+    DONATED, like every other paged executable: XLA aliases the page
+    writes in place instead of copying every pool leaf per dispatch —
+    the caller must ``sync_pool_from_cache`` the returned cache (the
+    old leaves are dead)."""
+    import jax
+    import jax.numpy as jnp
+
+    @functools.partial(jax.jit, donate_argnums=1)
+    def run(params, cache, suffix, tables, rs):
+        logits, vs = model.apply(
+            {"params": params, "cache": cache}, suffix,
+            train=False, decode=True, prefill=True, mutable=["cache"],
+            pad_lens=jnp.zeros((1,), jnp.int32),
+            block_tables=tables, row_starts=rs,
+        )
+        return logits[:, -1], vs["cache"]
+
+    return run
+
+
+@functools.lru_cache(maxsize=32)
+def _paged_decode_fns(model, nb: int, temperature: float, top_k: int,
+                      top_p: float):
+    """Compiled batch-1 paged decode step per (model, sampling): one
+    token feeds at its row-local position, its K/V appends into the
+    row's private pool page through the block table, and attention
+    reads the pool in place (the Pallas paged kernel on TPU). The
+    cache is DONATED — without it XLA cannot alias the one-page
+    append back to the input and every emitted token would copy the
+    ENTIRE pool (orders of magnitude more HBM than the scatter arm
+    this path replaces). The caller's step loop reassigns ``cache``
+    each iteration and syncs the pool afterwards."""
+    import jax
+
+    from .generate import _sample_rows
+
+    @functools.partial(jax.jit, donate_argnums=1)
+    def step(params, cache, token, keys, tables, pos):
+        logits, vs = model.apply(
+            {"params": params, "cache": cache}, token[:, None],
+            train=False, decode=True, mutable=["cache"],
+            block_tables=tables, row_starts=pos,
+        )
+        nxt = _sample_rows(keys, logits[:, -1], temperature, top_k,
+                           top_p)
+        return nxt, vs["cache"]
+
+    return step
+
+
 class RadixIndex:
     """Block-granular radix/trie over prompt token ids.
 
@@ -348,7 +408,8 @@ class PrefixCache:
     """
 
     def __init__(self, model, params, block_tokens: int = 32,
-                 pool_blocks: int = 256, eviction: str = "lru"):
+                 pool_blocks: int = 256, eviction: str = "lru",
+                 paged: bool = True):
         import jax
         import jax.numpy as jnp
 
@@ -404,13 +465,56 @@ class PrefixCache:
             type(model).__call__).parameters
         self.index = RadixIndex(self.block)
         self._free = list(range(1, self.pool_blocks))  # 0 = scratch
+        # block ids allocated to live requests but NOT (yet) owned by
+        # the radix index — paged-mode private tail pages (prompt
+        # suffixes being written + decode appends). Disjoint from the
+        # index's blocks by construction; freed or adopted at request
+        # completion.
+        self._private: set = set()
         self._lock = threading.Lock()
         self.stats = {
             "prefix_lookups": 0, "prefix_hit_requests": 0,
             "prefix_hit_tokens": 0, "prefix_inserted_blocks": 0,
             "prefix_evictions": 0, "prefix_dropped_inserts": 0,
+            # device bytes copied by WARM admits: the scatter fallback
+            # pays one block-chain HBM copy per admit; the paged path
+            # must keep this at 0 (the ISSUE 7 gate is observable, not
+            # aspirational)
+            "warm_admit_copy_bytes": 0,
+            # zero-copy insertions: pages written in place by a request
+            # and handed to the radix index without any device work
+            "prefix_adopted_blocks": 0,
+            # batch-1 arm counts: which path actually served each
+            # request (serve.py derives an honest paged_decode_frac
+            # from these on the plain scheduler — the pool being
+            # paged-CAPABLE says nothing about what traffic got)
+            "batch1_paged_requests": 0,
+            "batch1_scatter_requests": 0,
         }
         self.nb_max = -(-int(model.max_len) // self.block)
+        # bytes of ONE pool block across every leaf — the unit of the
+        # copy-bytes accounting above
+        self.page_bytes = int(sum(
+            int(np.prod(leaf.shape[1:])) * leaf.dtype.itemsize
+            for leaf in self.pool.values()))
+        # TRUE paged decode (ISSUE 7): the engines read pool pages in
+        # place through per-row block tables — needs the model's paged
+        # call path AND a pool that can hold at least one full-budget
+        # request's chain; otherwise the scatter fallback serves
+        self.paged = bool(paged) and bool(spec.get("paged", False))
+        if bool(paged) and not spec.get("paged", False):
+            logger.warning(
+                "paged decode unavailable for %s (kv_cache_spec paged="
+                "False): warm admits use the scatter fallback",
+                type(model).__name__)
+        if self.paged and self.pool_blocks - 1 < self.nb_max:
+            logger.warning(
+                "prefix_cache.pool_blocks=%d cannot hold one full-"
+                "budget request (%d blocks for max_len=%d at "
+                "block_tokens=%d): paged decode disabled, scatter "
+                "fallback serves", self.pool_blocks, self.nb_max,
+                int(model.max_len), self.block)
+            self.paged = False
 
     # ---- host bookkeeping -------------------------------------------------
 
@@ -429,24 +533,41 @@ class PrefixCache:
         self.stats["prefix_evictions"] += 1
         return bid
 
-    def lookup(self, ids):
+    def lookup(self, ids, record: bool = True):
         """Longest cached, fully-blocked, PROPER prefix of ``ids`` ->
         ``(nodes, block_ids, cached_tokens)``; refs acquired (callers
         MUST ``release(nodes)`` once the copy kernel is dispatched).
         Proper: the prompt's final token is never served from cache —
         its logits must be computed to sample the first output token —
-        so ``cached_tokens <= len(ids) - 1``."""
+        so ``cached_tokens <= len(ids) - 1``.
+
+        ``record=False`` skips the hit/lookup counters: retries of the
+        SAME request (a deferred paged admission re-reserves every
+        tick) and routing probes must not inflate
+        ``prefix_hit_tokens`` — that counter feeds /metrics, the fleet
+        router, and the bench gates."""
         with self._lock:
-            self.stats["prefix_lookups"] += 1
+            if record:
+                self.stats["prefix_lookups"] += 1
             nodes, blocks = self.index.match(ids)
             limit = (len(ids) - 1) // self.block     # proper-prefix cap
             nodes, blocks = nodes[:limit], blocks[:limit]
             c = len(nodes) * self.block
             if c:
-                self.stats["prefix_hit_requests"] += 1
-                self.stats["prefix_hit_tokens"] += c
+                if record:
+                    self.stats["prefix_hit_requests"] += 1
+                    self.stats["prefix_hit_tokens"] += c
                 self.index.acquire(nodes)
             return nodes, blocks, c
+
+    def count_batch1(self, paged: bool) -> None:
+        """Tally which arm served one batch-1 request (paged in-place
+        vs scatter fallback) — the plain scheduler's honest
+        ``paged_decode_frac`` numerator/denominator."""
+        key = ("batch1_paged_requests" if paged
+               else "batch1_scatter_requests")
+        with self._lock:
+            self.stats[key] += 1
 
     def release(self, nodes):
         with self._lock:
@@ -461,15 +582,223 @@ class PrefixCache:
             self.stats["prefix_inserted_blocks"] += len(blocks)
             return blocks, start
 
+    # ---- paged-mode chains (ISSUE 7) --------------------------------------
+
+    def alloc_chain(self, n: int):
+        """Allocate ``n`` PRIVATE blocks for a request's uncached tail
+        (prompt suffix + decode budget), LRU-evicting unreferenced
+        radix leaves under pressure. All-or-nothing: on a dry pool the
+        partial allocation rolls back and ``None`` returns — the caller
+        defers the admission until completions free pages."""
+        with self._lock:
+            got = []
+            for _ in range(int(n)):
+                bid = self._alloc()
+                if bid is None:
+                    self._free.extend(got)
+                    return None
+                got.append(bid)
+            self._private.update(got)
+            return got
+
+    def free_blocks(self, ids) -> None:
+        """Return private blocks to the free list (request completed or
+        admission rolled back)."""
+        if not ids:
+            return
+        with self._lock:
+            for bid in ids:
+                self._private.discard(bid)
+            self._free.extend(ids)
+
+    def adopt(self, token_ids, owned: dict, acquire: bool = False):
+        """ZERO-COPY radix insert: hand privately-written pool pages to
+        the index so other requests share them — no capture kernel, no
+        device work; the K/V is already canonical in place (ISSUE 7:
+        "decoded tokens append into pool blocks the radix index can
+        immediately share").
+
+        ``owned`` maps full-block INDEX of ``token_ids`` -> private
+        block id holding that block's K/V. The walk creates missing
+        nodes where we own the page and stops at a missing node we
+        cannot supply; where a node already exists (a concurrent
+        request adopted the same content first) the private duplicate
+        stays private — the caller frees it after completion.
+
+        Returns ``(adopted_ids, nodes)``: the block ids now owned by
+        the index (no longer private) and, when ``acquire``, the
+        CREATED nodes ref-pinned for the (still-reading) caller to
+        release at completion (pre-existing duplicates need no pin —
+        the caller keeps reading its own private copy)."""
+        bt = self.block
+        nfull = len(token_ids) // bt
+        with self._lock:
+            node = self.index.root
+            adopted, nodes = [], []
+            now = self.index._tick()
+            for i in range(nfull):
+                chunk = tuple(token_ids[i * bt:(i + 1) * bt])
+                nxt = node["children"].get(chunk)
+                if nxt is None:
+                    bid = owned.get(i)
+                    if bid is None:
+                        break
+                    nxt = {"children": {}, "block": int(bid),
+                           "parent": node, "chunk": chunk,
+                           "refs": 0, "last_use": now}
+                    node["children"][chunk] = nxt
+                    self.index.nodes += 1
+                    self._private.discard(int(bid))
+                    adopted.append(int(bid))
+                    if acquire:
+                        nxt["refs"] += 1
+                        nodes.append(nxt)
+                nxt["last_use"] = now
+                node = nxt
+            self.stats["prefix_adopted_blocks"] += len(adopted)
+            return adopted, nodes
+
+    def record_copy_bytes(self, n_blocks: int) -> None:
+        """Account one warm admit's device scatter copy (the fallback
+        arm): ``n_blocks`` pool blocks crossed HBM into a contiguous
+        per-slot cache."""
+        if n_blocks:
+            with self._lock:
+                self.stats["warm_admit_copy_bytes"] += (
+                    int(n_blocks) * self.page_bytes)
+
+    def sync_pool_from_cache(self, cache) -> None:
+        """Point ``self.pool`` at the pool leaves inside a paged cache
+        pytree (the engines donate the pool through their executables —
+        after each reassignment the old arrays are dead and this keeps
+        the canonical pool reference current). Host-only."""
+        import jax
+
+        flat = jax.tree_util.tree_flatten_with_path(dict(cache))[0]
+        by_path = {_path_str(p): leaf for p, leaf in flat}
+        self.pool = {ps: by_path[ps] for ps in self.pool}
+
+    def pool_alive(self, cache=None) -> bool:
+        """True when every pool leaf (of ``cache`` if given, else the
+        canonical pool) is still a live device buffer. Every paged
+        executable DONATES the pool — a dispatch that fails AFTER
+        donation leaves dead leaves behind, and syncing or re-wrapping
+        those would wedge the pool permanently."""
+        import jax
+
+        if cache is None:
+            leaves = list(self.pool.values())
+        else:
+            flat = jax.tree_util.tree_flatten_with_path(dict(cache))[0]
+            leaves = [leaf for p, leaf in flat
+                      if _path_str(p) in self.pool]
+        return not any(getattr(leaf, "is_deleted", lambda: False)()
+                       for leaf in leaves)
+
+    def reset_pool(self) -> None:
+        """Last-resort recovery after donation loss: reallocate zeroed
+        pool leaves and drop the ENTIRE radix index + private set (the
+        cached content died with the donated buffers — adopting or
+        matching against zeroed pages would serve garbage). Cumulative
+        counters survive; ``prefix_pool_resets`` records the event.
+        Callers must drop any cache pytree that aliased the old
+        pool."""
+        import jax.numpy as jnp
+
+        with self._lock:
+            self.pool = {ps: jnp.zeros(leaf.shape, leaf.dtype)
+                         for ps, leaf in self.pool.items()}
+            self.index = RadixIndex(self.block)
+            self._free = list(range(1, self.pool_blocks))
+            self._private = set()
+            self.stats["prefix_pool_resets"] = (
+                self.stats.get("prefix_pool_resets", 0) + 1)
+        logger.warning(
+            "prefix pool reset after donation loss: cached content "
+            "dropped, pool reallocated")
+
+    def refresh_cache_from_pool(self, cache):
+        """Re-adopt the canonical pool leaves into an engine's paged
+        cache pytree. A batch-1 request running between scheduler
+        ticks under the shared lock (serve.py routes speculative
+        requests that way) can reassign ``self.pool`` — its scatter
+        insert ends in the capture kernel, which DONATES the pool
+        leaves the engine's persistent cache aliases. Without this
+        swap the engine's next dispatch throws "buffer has been
+        deleted or donated"; with a non-donating capture it would be
+        worse — a silently stale pool missing the request's freshly
+        inserted radix blocks. Host-only pointer surgery; returns
+        ``cache`` unchanged when already current."""
+        import jax
+
+        flat = jax.tree_util.tree_flatten_with_path(dict(cache))[0]
+        by_path = {_path_str(p): leaf for p, leaf in flat}
+        if all(by_path.get(ps) is leaf
+               for ps, leaf in self.pool.items()):
+            return cache
+        out = dict(cache)
+        for ps, leaf in self.pool.items():
+            parts = ps.split("/")
+            node = out
+            for part in parts[:-1]:
+                node[part] = dict(node[part])
+                node = node[part]
+            node[parts[-1]] = leaf
+        return out
+
+    def paged_cache(self, extra=None) -> dict:
+        """A decode-cache pytree whose K/V leaves ARE the pool pages —
+        what the paged engines hand to ``model.apply`` alongside a
+        block table. Non-K/V cache entries (``pos_index``) ride in
+        ``extra``."""
+        import jax.numpy as jnp
+
+        out = {}
+        for ps, leaf in self.pool.items():
+            node = out
+            parts = ps.split("/")
+            for part in parts[:-1]:
+                node = node.setdefault(part, {})
+            node[parts[-1]] = leaf
+        out["pos_index"] = jnp.zeros((), jnp.int32)
+        if extra:
+            out.update(extra)
+        return out
+
     def stats_snapshot(self) -> dict:
         with self._lock:
             out = dict(self.stats)
+            resident = self.index.nodes
+            private = len(self._private)
+            referenced = private + self._count_referenced()
         out["prefix_pool_blocks"] = self.pool_blocks - 1
         out["prefix_pool_blocks_used"] = self.used_blocks()
+        # occupancy WITHOUT double counting (ISSUE 7 satellite):
+        # resident = unique shareable pages owned by the radix index;
+        # referenced = pages live requests are actually reading/writing
+        # (held shared refs + private tails). On the scatter fallback a
+        # hot prefix is resident here AND copied into per-slot caches —
+        # the split makes that visible instead of folding both into one
+        # "used" number.
+        out["prefix_pool_blocks_resident"] = resident
+        out["prefix_pool_blocks_referenced"] = referenced
+        out["prefix_paged"] = bool(self.paged)
         lk = out["prefix_lookups"]
         out["prefix_hit_rate"] = round(
             out["prefix_hit_requests"] / lk, 4) if lk else 0.0
         return out
+
+    def _count_referenced(self) -> int:
+        """Radix blocks currently ref-pinned by live requests (callers
+        hold the lock)."""
+        n, stack = 0, [self.index.root]
+        while stack:
+            node = stack.pop()
+            for child in node["children"].values():
+                if child["refs"] > 0:
+                    n += 1
+                stack.append(child)
+        return n
 
     # ---- device paths -----------------------------------------------------
 
@@ -492,11 +821,103 @@ class PrefixCache:
         )(self.pool, cache, jnp.asarray(np.asarray(slots, np.int32)),
           jnp.asarray(np.asarray(pads, np.int32)), jnp.asarray(ids))
 
-    def warm_prefill(self, params, ids, total: int):
+    def paged_plan(self, ids, budget: int, record: bool = True):
+        """Page reservation for one request: shared-prefix lookup
+        (refs held for the request's lifetime — decode reads those
+        pages in place) plus a private chain for the suffix and the
+        full budget, allocated up front so a mid-decode row can never
+        block on the pool. ``None`` when the pool cannot supply the
+        chain right now (batch-1 falls back to the scatter arm; the
+        continuous engine defers the admission and retries with
+        ``record=False``). ONE owner of the reservation math — the
+        continuous engine's ``_reserve_pages`` wraps this."""
+        nodes, blocks, c = self.lookup(ids, record=record)
+        n_need = -(-(len(ids) + int(budget)) // self.block) - \
+            c // self.block
+        priv = self.alloc_chain(n_need)
+        if priv is None:
+            self.release(nodes)
+            return None
+        return {
+            "ids": list(ids), "c": c, "nodes": nodes, "blocks": blocks,
+            "private": {c // self.block + i: bid
+                        for i, bid in enumerate(priv)},
+            # extra shared nodes acquired AFTER reservation (the
+            # continuous engine's group-admit dedup) — released by
+            # ``paged_finish`` with the plan's own refs
+            "adopt_nodes": [],
+        }
+
+    def paged_prefill(self, params, ids, budget: int):
+        """Batch-1 TRUE paged prefill: the cached prefix is a block-
+        table POINTER entry (zero device copy — contrast
+        ``warm_prefill``'s scatter), the suffix prefills straight into
+        private pages. Returns ``(last_logits, cache, tables, plan)``
+        or ``None`` when the pool is dry (caller falls back). The
+        caller drives the step loop with ``_paged_decode_fns`` and
+        MUST call ``paged_finish(plan, out_ids, emitted)`` when done."""
+        import jax.numpy as jnp
+
+        plan = self.paged_plan(ids, budget)
+        if plan is None:
+            return None
+        c = plan["c"]
+        feed = len(ids) - c
+        row = np.full((1, self.nb_max), -1, np.int32)
+        for i, b in enumerate(plan["blocks"]):
+            row[0, i] = b
+        for idx, bid in plan["private"].items():
+            row[0, idx] = bid
+        tables = jnp.asarray(row)
+        suffix = jnp.asarray(np.asarray(ids[c:], np.int32)[None, :])
+        try:
+            last_logits, cache = _paged_prefill_fn(
+                self.model, feed, self.nb_max)(
+                params, self.paged_cache(), suffix, tables,
+                jnp.asarray([c], jnp.int32))
+        except Exception:
+            # the prefill DONATES the pool — a dispatch that fails
+            # after donation leaves dead leaves behind, and every
+            # later request (paged or scatter) would dispatch against
+            # them. Mirror the caller's step-loop handler: normal
+            # cleanup while the pool is alive, full reset when the
+            # donation was lost (the plan's refs and pages die with
+            # the index — releasing against the fresh one would
+            # double-free).
+            if self.pool_alive():
+                self.release(plan["nodes"])
+                self.free_blocks(list(plan["private"].values()))
+            else:
+                self.reset_pool()
+            raise
+        self.sync_pool_from_cache(cache)
+        return last_logits, cache, tables, plan
+
+    def paged_finish(self, plan, out_ids, emitted: int) -> None:
+        """End-of-request paged bookkeeping: zero-copy ADOPT the full
+        (prompt + decoded) blocks into the radix index, free the
+        unadoptable tail, release the shared-prefix refs."""
+        ids = plan["ids"]
+        seq = list(ids) + [int(t) for t in out_ids]
+        # positions actually written: the prompt plus every fed decode
+        # token (the final sampled token is never fed back)
+        written = len(ids) + max(int(emitted) - 1, 0)
+        adopted, _ = self.adopt(seq[:written], dict(plan["private"]))
+        taken = set(adopted)
+        self.free_blocks([b for b in plan["private"].values()
+                          if b not in taken])
+        self.release(plan["nodes"])
+        self.release(plan.get("adopt_nodes") or [])
+
+    def warm_prefill(self, params, ids, total: int,
+                     record: bool = True):
         """Batch-1 prefill through the pool (the generate.py path):
         scatter the cached chain, feed only the suffix, then insert the
         prompt's own full blocks back. Returns ``(last_logits, cache,
         cached_tokens)`` — drop-in for engine/generate._prefill_fresh.
+        ``record=False`` when the request's lookup was already counted
+        (the paged arm's dry-pool fallback re-looks-up the SAME
+        request).
 
         A full MISS routes through the regular flash prefill
         (engine/generate._prefill_fresh — the cache K/V writes land
@@ -510,7 +931,7 @@ class PrefixCache:
         from .generate import _prefill_fresh
 
         L = len(ids)
-        nodes, blocks, c = self.lookup(ids)
+        nodes, blocks, c = self.lookup(ids, record=record)
         try:
             if c == 0:
                 prompt = jnp.asarray(np.asarray(ids, np.int32)[None, :])
@@ -527,6 +948,7 @@ class PrefixCache:
                     self._padded,
                 )(params, suffix, self.pool, jnp.asarray(bid),
                   jnp.int32(c))
+                self.record_copy_bytes(nb)   # the scatter arm's HBM cost
         finally:
             self.release(nodes)
         new_blocks, start = self.plan_insert(ids)

@@ -55,7 +55,8 @@ class GenerationService:
         obj._setup(model, params, tokenizer, **kw)
         return obj
 
-    def _setup(self, model, params, tokenizer=None, prefix_cache=None):
+    def _setup(self, model, params, tokenizer=None, prefix_cache=None,
+               spec_draft_layers: int = 0):
         import inspect
         import threading
 
@@ -90,9 +91,27 @@ class GenerationService:
                         block_tokens=int(cfg.get("block_tokens", 32)),
                         pool_blocks=int(cfg.get("pool_blocks", 256)),
                         eviction=cfg.get("eviction", "lru"),
+                        paged=bool(cfg.get("paged", True)),
                     )
                 except ValueError as e:
                     logger.warning("prefix cache disabled: %s", e)
+        # early-exit draft depth for speculative requests (ISSUE 7):
+        # 0 keeps the n-gram prompt-lookup drafter; > 0 drafts with the
+        # model's own first k blocks + head (engine/generate
+        # ``draft_layers``), sharing the target's cache and the prefix
+        # pool's warm blocks
+        self._spec_draft_layers = int(spec_draft_layers)
+        if self._spec_draft_layers and (
+                "exit_layer" not in inspect.signature(
+                    type(model).__call__).parameters
+                or not (0 < self._spec_draft_layers
+                        < int(getattr(model, "n_layer", 0)))):
+            logger.warning(
+                "speculative_draft_layers=%d unusable for %s (needs "
+                "exit_layer support and 0 < k < n_layer): falling back "
+                "to n-gram drafting", self._spec_draft_layers,
+                type(model).__name__)
+            self._spec_draft_layers = 0
         # scheduler subclasses overwrite this with richer dicts in
         # their own _setup (after this super() call); the plain
         # serialized service still exposes a token counter for /metrics
@@ -323,27 +342,83 @@ class GenerationService:
     def _generate_prefix_cached(self, ids, max_new: int,
                                 temperature: float, top_k: int,
                                 top_p: float, row_rngs):
-        """Batch-1 decode through the paged prefix pool: warm prefill
-        (kvcache.PrefixCache.warm_prefill — cached blocks scatter, only
-        the suffix runs through the model, the prompt's own full blocks
-        insert back) followed by the SAME step loop + per-(step, row)
-        key folding as engine/generate's eager path, so output matches
-        the cold path token for token (float-tolerance exact, like
-        every other batched-vs-solo contract in this stack). Caller
-        holds the lock and has validated budget/stops."""
+        """Batch-1 decode through the paged prefix pool. TWO arms:
+
+        - **paged** (kv_cache_spec paged=True, pool healthy): the
+          cached prefix is a block-table pointer entry — ZERO admit
+          copy — the suffix prefills straight into private pool pages,
+          decode reads the pool in place (ops/flash paged kernel on
+          TPU), and the finished request's pages adopt into the radix
+          index with no capture kernel.
+        - **scatter fallback** (unsupported layouts / dry pool):
+          kvcache.warm_prefill — cached blocks scatter into a
+          contiguous cache, suffix-only prefill, capture-copy insert.
+
+        Both use the SAME step-loop + per-(step, row) key folding as
+        engine/generate's eager path, so output matches the cold path
+        token for token (float-tolerance exact, like every other
+        batched-vs-solo contract in this stack). Caller holds the lock
+        and has validated budget/stops."""
         import jax.numpy as jnp
         import numpy as np
 
         from .generate import _decode_fns, _fold_all_rows, _sample_rows
+        from .kvcache import _paged_decode_fns
 
-        last_logits, cache, hit = self._prefix.warm_prefill(
-            self.params, ids, len(ids) + max_new)
-        _, step = _decode_fns(self.model, temperature, top_k, top_p)
         if temperature <= 0:
             keys_at = lambda i: row_rngs                   # noqa: E731
         else:
             all_keys = _fold_all_rows(row_rngs, max_new)
             keys_at = lambda i: all_keys[i]                # noqa: E731
+        if self._prefix.paged:
+            res = self._prefix.paged_prefill(self.params, ids, max_new)
+            if res is not None:
+                last_logits, cache, tables, plan = res
+                step = _paged_decode_fns(
+                    self.model, self._prefix.nb_max, temperature,
+                    top_k, top_p)
+                token = _sample_rows(keys_at(0), last_logits,
+                                     temperature, top_k, top_p)
+                out = [token[:, None]]
+                L = len(ids)
+                try:
+                    for i in range(1, max_new):
+                        token, cache = step(
+                            self.params, cache, token, keys_at(i),
+                            tables,
+                            jnp.asarray([L + i - 1], jnp.int32))
+                        out.append(token[:, None])
+                    row = np.asarray(jnp.concatenate(out, axis=1))[0]
+                except Exception:
+                    # a failed step must not strand refs or leak
+                    # pages. `cache` may be the pytree just DONATED
+                    # into the failing dispatch — syncing dead leaves
+                    # would wedge the shared pool for every later
+                    # request, so reset instead (the plan's refs and
+                    # pages die with the index; finishing against a
+                    # fresh index would double-free).
+                    if self._prefix.pool_alive(cache):
+                        self._prefix.sync_pool_from_cache(cache)
+                        self._prefix.paged_finish(plan, [], 0)
+                    else:
+                        self._prefix.reset_pool()
+                    raise
+                self._prefix.sync_pool_from_cache(cache)
+                # zero-copy insert: prompt AND decoded tokens become
+                # sharable in place
+                self._prefix.paged_finish(
+                    plan, [int(t) for t in row], max_new)
+                self._prefix.count_batch1(paged=True)
+                return row
+        self._prefix.count_batch1(paged=False)
+        # a dry-pool fall-through from the paged arm already recorded
+        # this request's lookup inside paged_plan — recording again
+        # here would double-count prefix_hit_tokens for the SAME
+        # request (the counter feeds /metrics and the bench gates)
+        last_logits, cache, hit = self._prefix.warm_prefill(
+            self.params, ids, len(ids) + max_new,
+            record=not self._prefix.paged)
+        _, step = _decode_fns(self.model, temperature, top_k, top_p)
         token = _sample_rows(keys_at(0), last_logits, temperature,
                              top_k, top_p)
         out = [token[:, None]]
@@ -381,6 +456,67 @@ class GenerationService:
         pad_to = min(bucket, limit)
         return pad_to if pad_to > t0 else None
 
+    def _spec_generate(self, arr, budget: int, draft: int,
+                       temperature: float, top_k: int, top_p: float,
+                       rng, stops):
+        """One speculative phase, POOL-SHARED when possible (ISSUE 7):
+        with a prefix pool attached, the prompt warm-prefills through
+        it (cached blocks + suffix-only prefill) and the spec loop
+        continues from that cache — the early-exit draft
+        (``speculative_draft_layers``) shares the same cache, so BOTH
+        target and draft skip the shared prefix's prefill. Without a
+        pool (or when the budget + overshoot slack does not fit
+        ``max_len``), the plain length-bucketed
+        ``generate_speculative`` runs as before."""
+        import numpy as np
+
+        from .generate import generate_speculative
+
+        t0 = arr.shape[1]
+        # getattr: tests drive _adaptive_speculative on a bare
+        # __new__-built service with no _setup (no pool, no draft cfg)
+        dl = getattr(self, "_spec_draft_layers", 0)
+        prefix = getattr(self, "_prefix", None)
+        L = t0 + int(budget) + 2 * (int(draft) + 1)
+        if prefix is not None and L <= int(self.model.max_len):
+            ids = [int(t) for t in np.asarray(arr)[0]]
+            # route through the pool only on an actual prefix HIT:
+            # the warm path's executables key on the EXACT (t0, L) —
+            # worth one compile when the prefill skip pays for it,
+            # but cold spec traffic of arbitrary lengths stays on the
+            # length-BUCKETED generate_speculative below (the probe
+            # must not count: it is not a served lookup)
+            probe, _, c = prefix.lookup(ids, record=False)
+            prefix.release(probe)
+            if c:
+                return self._spec_from_pool(
+                    prefix, ids, L, budget, draft, temperature,
+                    top_k, top_p, rng, stops, dl)
+        return generate_speculative(
+            self.model, self.params, arr, max_new_tokens=budget,
+            draft_len=draft, return_stats=True,
+            temperature=temperature, top_k=top_k, top_p=top_p,
+            rng=rng, pad_to=self._spec_pad_to(t0, budget, draft),
+            stop_tokens=stops or None, draft_layers=dl)
+
+    def _spec_from_pool(self, prefix, ids, L, budget, draft,
+                        temperature, top_k, top_p, rng, stops, dl):
+        """The pool-shared speculative arm (ISSUE 7): warm prefill
+        (cached blocks + suffix-only feed) continuing into the fused
+        spec loop; target AND early-exit draft skip the shared
+        prefix's prefill."""
+        from .generate import speculative_from_cache
+
+        last_logits, cache, hit = prefix.warm_prefill(
+            self.params, ids, L)
+        out, stats = speculative_from_cache(
+            self.model, self.params, ids, cache, last_logits, L,
+            budget, draft_len=draft, temperature=temperature,
+            top_k=top_k, top_p=top_p, rng=rng,
+            stop_tokens=stops or None, draft_layers=dl)
+        stats["prefix_hit_tokens"] = hit
+        return out, stats
+
     def _adaptive_speculative(self, arr, max_new: int, draft: int,
                               temperature: float, top_k: int,
                               top_p: float, seed: int, stops):
@@ -401,18 +537,13 @@ class GenerationService:
         import jax.numpy as jnp
         import numpy as np
 
-        from .generate import generate, generate_speculative
+        from .generate import generate
 
         t0 = arr.shape[1]
         probe = min(self.SPEC_PROBE, max_new)
         key = jax.random.key(seed)
-        out, stats = generate_speculative(
-            self.model, self.params, arr, max_new_tokens=probe,
-            draft_len=draft, return_stats=True,
-            temperature=temperature, top_k=top_k, top_p=top_p,
-            rng=key, pad_to=self._spec_pad_to(t0, probe, draft),
-            stop_tokens=stops or None,
-        )
+        out, stats = self._spec_generate(
+            arr, probe, draft, temperature, top_k, top_p, key, stops)
         emitted = stats["tokens_emitted"]
         ids = [int(t) for t in np.asarray(out)[0, t0:t0 + emitted]]
         stats = dict(stats,
@@ -434,13 +565,9 @@ class GenerationService:
         t1 = arr2.shape[1]
         key2 = jax.random.fold_in(key, 1)
         if stats["probe_tokens_per_call"] >= self.SPEC_MIN_TOKENS_PER_CALL:
-            out2, s2 = generate_speculative(
-                self.model, self.params, arr2, max_new_tokens=rest,
-                draft_len=draft, return_stats=True,
-                temperature=temperature, top_k=top_k, top_p=top_p,
-                rng=key2, pad_to=self._spec_pad_to(t1, rest, draft),
-                stop_tokens=stops or None,
-            )
+            out2, s2 = self._spec_generate(
+                arr2, rest, draft, temperature, top_k, top_p, key2,
+                stops)
             em2 = s2["tokens_emitted"]
             calls = stats["model_calls"] + s2["model_calls"]
             stopped = s2["stopped"]
@@ -538,11 +665,13 @@ class BatchedGenerationService(GenerationService):
     PAD_BUCKET = 128
 
     def _setup(self, model, params, tokenizer=None,
-               max_batch: int = 8, window_ms: float = 25.0):
+               max_batch: int = 8, window_ms: float = 25.0,
+               spec_draft_layers: int = 0):
         import queue
         import threading
 
-        super()._setup(model, params, tokenizer)   # sets _pad_ok
+        super()._setup(model, params, tokenizer,   # sets _pad_ok
+                       spec_draft_layers=spec_draft_layers)
         self._max_batch = int(max_batch)
         self._window_s = float(window_ms) / 1e3
         self._queue: "queue.Queue" = queue.Queue()

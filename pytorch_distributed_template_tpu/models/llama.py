@@ -90,6 +90,17 @@ def apply_rope(x, cos, sin):
     return out.astype(x.dtype)
 
 
+def apply_rope_rows(x, cos, sin):
+    """Rotate [B, T, H, D] by PER-ROW tables [B, T, D] — the paged
+    decode path, where each row carries its own (row-local) positions
+    instead of one shared cache-slot vector."""
+    d = x.shape[-1]
+    xf = x.astype(jnp.float32)
+    rot = jnp.concatenate([-xf[..., d // 2:], xf[..., : d // 2]], axis=-1)
+    out = xf * cos[:, :, None, :] + rot * sin[:, :, None, :]
+    return out.astype(x.dtype)
+
+
 class LlamaAttention(nn.Module):
     d_model: int
     n_head: int
@@ -108,7 +119,7 @@ class LlamaAttention(nn.Module):
     @nn.compact
     def __call__(self, x, positions, train: bool, decode: bool = False,
                  decode_index=None, prefill: bool = False,
-                 pad_lens=None):
+                 pad_lens=None, block_tables=None, row_starts=None):
         b, t, _ = x.shape
         hd = self.d_model // self.n_head
         groups = self.n_head // self.n_kv_head
@@ -122,7 +133,8 @@ class LlamaAttention(nn.Module):
 
         if decode:
             ctx = self._cached_attention(q, k, v, decode_index, groups,
-                                         prefill, pad_lens)
+                                         prefill, pad_lens, block_tables,
+                                         row_starts)
         else:
             cos, sin = rope_tables(positions, hd, self.rope_base)
             q = apply_rope(q, cos, sin)
@@ -172,8 +184,62 @@ class LlamaAttention(nn.Module):
         ctx = ctx.reshape(b, t, self.n_head * hd)
         return dense(self.d_model, "o_proj")(ctx)
 
+    def _paged_attention(self, q, k, v, cached_k, cached_v,
+                         block_tables, row_starts, pad_lens):
+        """Paged decode (ISSUE 7): the supplied cache leaves ARE the KV
+        block pool's ``[pool_blocks, block_tokens, KVH, D]`` pages, and
+        this row's token positions map to pages through its block table
+        — warm prefix admits are pointer updates, never HBM copies
+        (engine/kvcache.py owns the tables).
+
+        Positions are ROW-LOCAL (row ``b``'s lane ``i`` sits at
+        ``row_starts[b] + i``; its RoPE angle is that position itself),
+        so page content is canonical — position/era-independent — and
+        the radix index can share pages between requests byte-for-byte.
+        ``pad_lens`` here counts the leading INVALID lanes of THIS
+        call's window (a right-aligned suffix feed, or 1 on a frozen
+        1-token decode row): their K/V writes land in the reserved
+        scratch page and their outputs are garbage the caller ignores.
+        New K/V always lands in the row's PRIVATE tail pages — the
+        engine never feeds a position covered by a shared radix page —
+        so a write can never corrupt a page another row is reading."""
+        from ..ops.attention import paged_gqa_attention
+        from ..engine.kvcache import SCRATCH_BLOCK
+
+        b, t, _, d = q.shape
+        pool_k, pool_v = cached_k.value, cached_v.value
+        bt = pool_k.shape[1]
+        nb = block_tables.shape[1]
+        lane = jnp.arange(t)
+        pos = row_starts[:, None] + lane[None, :]            # [B, t]
+        safe_pos = jnp.clip(pos, 0, nb * bt - 1)
+        cos, sin = rope_tables(safe_pos.reshape(-1), d, self.rope_base)
+        cos = cos.reshape(b, t, d)
+        sin = sin.reshape(b, t, d)
+        q = apply_rope_rows(q, cos, sin)
+        k = apply_rope_rows(k, cos, sin)
+        if pad_lens is None:
+            pad_lens = jnp.zeros((b,), jnp.int32)
+        valid = lane[None, :] >= pad_lens[:, None]
+        page = jnp.take_along_axis(block_tables, safe_pos // bt, axis=1)
+        ok = valid & (page >= 0)
+        flat_idx = jnp.where(ok, page * bt + safe_pos % bt,
+                             SCRATCH_BLOCK * bt + safe_pos % bt)
+
+        def put(pool, new):
+            flat = pool.reshape(-1, *pool.shape[2:])
+            flat = flat.at[flat_idx.reshape(-1)].set(
+                new.astype(pool.dtype).reshape(b * t, *new.shape[2:]))
+            return flat.reshape(pool.shape)
+
+        cached_k.value = put(pool_k, k)
+        cached_v.value = put(pool_v, v)
+        return paged_gqa_attention(q, cached_k.value, cached_v.value,
+                                   block_tables, row_starts, pad_lens)
+
     def _cached_attention(self, q, k, v, cur, groups: int,
-                          prefill: bool = False, pad_lens=None):
+                          prefill: bool = False, pad_lens=None,
+                          block_tables=None, row_starts=None):
         """Incremental decode against a K/V cache stored at the KV-head
         count (GQA memory win; same single-position-counter contract as
         models/transformer.SelfAttention._cached_attention). RoPE rotates
@@ -278,6 +344,17 @@ class LlamaAttention(nn.Module):
         if not is_init:
             # shape-setting pass: allocate the cache, no attention needed
             return jnp.zeros((b, t, hq, d), q.dtype)
+        if block_tables is not None:
+            # paged decode: the supplied leaves are pool pages
+            # [P, bt, KVH, D]; positions ride in ``row_starts``, not the
+            # contiguous-cache machinery below (``cur`` is unused)
+            if kvq or self.window > 0:
+                raise ValueError(
+                    "paged decode needs a full-precision, non-rolling "
+                    "cache (engine/kvcache.py enforces this upstream)")
+            return self._paged_attention(q, k, v, cached_k, cached_v,
+                                         block_tables, row_starts,
+                                         pad_lens)
         if not rolling and t > cache_len:
             raise ValueError(f"decode input {t} exceeds cache {cache_len}")
         pos = cur + jnp.arange(t)
@@ -440,7 +517,8 @@ class LlamaBlock(nn.Module):
     @nn.compact
     def __call__(self, x, positions, train: bool, example_mask=None,
                  decode: bool = False, decode_index=None,
-                 prefill: bool = False, pad_lens=None):
+                 prefill: bool = False, pad_lens=None,
+                 block_tables=None, row_starts=None):
         h = RMSNorm(self.rms_eps, name="input_layernorm")(x)
         x = x + LlamaAttention(
             self.d_model, self.n_head, self.n_kv_head, self.dtype,
@@ -448,7 +526,8 @@ class LlamaBlock(nn.Module):
             window=self.window, quant=self.quant, kv_quant=self.kv_quant,
             lora_rank=self.lora_rank, lora_alpha=self.lora_alpha,
             name="self_attn",
-        )(h, positions, train, decode, decode_index, prefill, pad_lens)
+        )(h, positions, train, decode, decode_index, prefill, pad_lens,
+          block_tables, row_starts)
         h = RMSNorm(self.rms_eps, name="post_attention_layernorm")(x)
         if self.moe:
             # Mixtral-style sparse FFN: routed SwiGLU experts over the
@@ -524,7 +603,22 @@ class LlamaLM(nn.Module):
     @nn.compact
     def __call__(self, tokens, train: bool = False, example_mask=None,
                  decode: bool = False, prefill: bool = False,
-                 pad_lens=None):
+                 pad_lens=None, block_tables=None, row_starts=None,
+                 exit_layer: int = 0):
+        """``block_tables``/``row_starts`` (decode only): paged decode
+        against the KV block pool — the cache collection's K/V leaves
+        must be pool pages ``[P, block_tokens, KVH, D]`` and each row's
+        positions are row-local (engine/kvcache.py builds both).
+
+        ``exit_layer > 0``: early-exit forward — run only the first
+        ``exit_layer`` blocks, then the final norm + LM head. This is
+        the built-in DRAFT model for speculative decoding
+        (engine/generate.generate_speculative ``draft_layers``): the
+        draft shares the target's params AND its KV cache/pool pages —
+        layers past the exit are simply not visited, and the verify
+        pass recomputes+overwrites the visited layers' rows with
+        identical values for accepted tokens, so draft and verify reuse
+        one cache with zero extra memory."""
         if self.quant:
             from .quant import validate_quant_config
 
@@ -592,7 +686,9 @@ class LlamaLM(nn.Module):
                 LlamaBlock, static_argnums=(3, 5, 7),
                 policy=jax.checkpoint_policies.nothing_saveable,
             )
-        for i in range(self.n_layer):
+        n_run = (min(int(exit_layer), self.n_layer) if exit_layer
+                 else self.n_layer)
+        for i in range(n_run):
             x = block_cls(
                 d_model=self.d_model, n_head=self.n_head, n_kv_head=n_kv,
                 d_ff=d_ff, dtype=self.dtype, attn_impl=self.attn_impl,
@@ -606,7 +702,7 @@ class LlamaLM(nn.Module):
                 lora_alpha=self.lora_alpha,
                 name=f"layers_{i}",
             )(x, positions, train, example_mask, decode, start, prefill,
-              pad_lens)
+              pad_lens, block_tables, row_starts)
         x = RMSNorm(self.rms_eps, name="norm")(x)
         if zperm is not None:
             x = x[:, np.argsort(zperm)]
@@ -637,12 +733,19 @@ class LlamaLM(nn.Module):
         capture/extraction must shift rotations by the row's start slot
         (rotations compose additively — kvcache.rotate_rows); a rolling
         window or int8 KV cache disqualifies the layout for pooling
-        (position-dependent eviction / re-quantization per reuse)."""
+        (position-dependent eviction / re-quantization per reuse).
+
+        ``paged=True``: the family implements the TRUE paged decode
+        path (``block_tables``/``row_starts`` call args — attention
+        reads pool pages in place through the block table, ISSUE 7);
+        layouts without it fall back to ``kvcache.scatter_blocks``
+        copies into a contiguous cache."""
         return {
             "rotary": True,
             "rope_base": float(self.rope_base),
             "window": int(self.window),
             "kv_quant": self.kv_quant,
+            "paged": self.window == 0 and not self.kv_quant,
         }
 
     def partition_rules(self):

@@ -449,6 +449,9 @@ class TransformerLM(nn.Module):
             "rope_base": 0.0,
             "window": 0,
             "kv_quant": self.kv_quant,
+            # no block_tables decode path in this family: prefix reuse
+            # rides the scatter_blocks fallback arm (engine/kvcache.py)
+            "paged": False,
         }
 
     def partition_rules(self):

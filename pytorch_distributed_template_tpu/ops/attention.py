@@ -124,6 +124,26 @@ def grouped_query_attention(q, k, v, mask=None):
     return out.reshape(b, t, h, d).astype(dtype)
 
 
+def paged_gqa_attention(q, k_pool, v_pool, tables, row_starts, pad_lens,
+                        impl: str = "auto"):
+    """Decode attention straight from the paged KV block pool
+    (ops/flash.paged_attention): row ``b``'s keys/values are gathered
+    through its block table instead of a contiguous per-row cache, so a
+    warm prefix admit is a block-table pointer update, not an HBM
+    scatter (ISSUE 7). q: ``[B, T, Hq, D]``; pools: ``[P, bt, KVH, D]``
+    with ``Hq = KVH * g`` (the kernel pairs q head ``i`` with kv head
+    ``i // g``, same as :func:`grouped_query_attention`).
+
+    ``impl="auto"`` runs the Pallas kernel on TPU and the plain-JAX
+    gather oracle elsewhere (the oracle materializes the page gather —
+    fine for CPU tests, the exact HBM traffic the kernel avoids on
+    TPU)."""
+    from .flash import paged_attention
+
+    return paged_attention(q, k_pool, v_pool, tables, row_starts,
+                           pad_lens, impl=impl)
+
+
 def _online_update(m, l, o, scores, vb):
     """Flash-style online-softmax accumulator update for one key block.
 

@@ -591,6 +591,192 @@ def _on_tpu() -> bool:
         return False
 
 
+# ---------------------------------------------------------------------------
+# Paged attention: decode directly from the KV block pool (ISSUE 7 tentpole)
+# ---------------------------------------------------------------------------
+#
+# The serving-path KV cache lives in a bounded block pool
+# (engine/kvcache.py): one ``[pool_blocks, block_tokens, H, D]`` leaf per
+# cache leaf, with each request's logical token positions mapped to pool
+# blocks through a per-row BLOCK TABLE (vLLM/PagedAttention, Kwon et al.
+# SOSP 2023 — the TPU shape of it). This kernel consumes that layout
+# IN PLACE: grid (batch, q-head, kv-block) with the kv dimension
+# innermost, and the KV tile for (row b, block j) fetched straight from
+# the pool page ``tables[b, j]`` via Pallas scalar prefetch — the block
+# table drives the HBM->VMEM DMA index map, so a warm prefix admit is a
+# pointer update instead of the HBM scatter copy the round-5 path paid
+# per admit. Online-softmax state streams across the kv grid exactly
+# like ``_fwd_kernel``.
+#
+# Positions are ROW-LOCAL (canonical): row ``b``'s token at logical
+# position p lives at ``pool[tables[b, p // bt], p % bt]`` and its RoPE
+# angle is p itself — block content is therefore position- and
+# era-independent, which is what lets the radix index share pages
+# between requests with zero copies (engine/kvcache.py).
+
+PAGED_MIN_Q = 8      # q lanes padded up to this (Mosaic sublane tile)
+
+
+def _paged_kernel(tables_ref, starts_ref, pads_ref, q_ref, k_ref, v_ref,
+                  o_ref, m_scr, l_scr, acc_scr, *, scale: float, bt: int,
+                  nb: int):
+    # grid (B, Hq, NB), kv innermost. q_ref/o_ref: [1, T, 1, D];
+    # k_ref/v_ref: [1, bt, 1, D] — the pool page ``tables[b, j]`` for
+    # this row's j-th logical block (scalar-prefetched index map; -1
+    # lanes clip to the scratch page and are predicated away here).
+    # Scratch m/l: [T, 1] f32, acc: [T, D] f32.
+    b = pl.program_id(0)
+    j = pl.program_id(2)
+    t = q_ref.shape[1]
+
+    @pl.when(j == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    start = starts_ref[b]
+    pad = pads_ref[b]
+    page = tables_ref[b, j]
+
+    def _compute():
+        q = q_ref[0, :, 0].astype(jnp.float32) * scale     # [T, D]
+        k_blk = k_ref[0, :, 0].astype(jnp.float32)         # [bt, D]
+        v_blk = v_ref[0, :, 0].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )                                                  # [T, bt]
+        lane = lax.broadcasted_iota(jnp.int32, (t, bt), 0)
+        q_pos = start + lane
+        k_pos = j * bt + lax.broadcasted_iota(jnp.int32, (t, bt), 1)
+        # causal over ROW-LOCAL positions + leading pad lanes invalid
+        ok = (k_pos <= q_pos) & (lane >= pad)
+        s = jnp.where(ok, s, NEG_INF)
+        m = m_scr[...]
+        m_new = jnp.maximum(m, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        corr = jnp.exp(m - m_new)
+        l_scr[...] = l_scr[...] * corr + jnp.sum(p, axis=1, keepdims=True)
+        acc_scr[...] = acc_scr[...] * corr + jax.lax.dot_general(
+            p, v_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_scr[...] = m_new
+
+    # unused table lanes (-1: past the row's allocation) and blocks
+    # entirely beyond the last query position contribute nothing
+    pl.when((page >= 0) & (j * bt <= start + t - 1))(_compute)
+
+    @pl.when(j == nb - 1)
+    def _finalize():
+        l_safe = jnp.maximum(l_scr[...], 1e-30)
+        o_ref[0, :, 0] = (acc_scr[...] / l_safe).astype(o_ref.dtype)
+
+
+def paged_attention_ref(q, k_pool, v_pool, tables, row_starts, pad_lens):
+    """Plain-JAX oracle for :func:`paged_attention` (same contract):
+    gather every row's pages, mask, and run the grouped-query einsum.
+    Materializes the ``[B, NB*bt, KVH, D]`` gather — the HBM cost the
+    Pallas kernel exists to avoid — so it is the CPU/test path and the
+    allclose reference, not the TPU path."""
+    from .attention import grouped_query_attention
+
+    b, t, hq, d = q.shape
+    bt = k_pool.shape[1]
+    nb = tables.shape[1]
+    safe = jnp.maximum(tables, 0)
+    gather = lambda pool: pool[safe].reshape(          # noqa: E731
+        b, nb * bt, *pool.shape[2:])
+    k_all, v_all = gather(k_pool), gather(v_pool)
+    lane = jnp.arange(t)
+    q_pos = row_starts[:, None] + lane[None, :]                 # [B, T]
+    k_pos = jnp.arange(nb * bt)
+    used = jnp.repeat(tables >= 0, bt, axis=1)                  # [B, L]
+    ok = (
+        (k_pos[None, None, :] <= q_pos[:, :, None])
+        & (lane[None, :, None] >= pad_lens[:, None, None])
+        & used[:, None, :]
+    )                                                           # [B, T, L]
+    return grouped_query_attention(q, k_all, v_all, mask=ok[:, None])
+
+
+def paged_attention(q, k_pool, v_pool, tables, row_starts, pad_lens,
+                    impl: str = "auto", interpret: bool | None = None):
+    """Paged decode attention over the KV block pool.
+
+    :param q: ``[B, T, Hq, D]`` query rows (RoPE already applied at
+        their row-local positions), T = this call's token window.
+    :param k_pool / v_pool: ``[P, bt, KVH, D]`` pool leaves (page 0 is
+        the reserved scratch page).
+    :param tables: ``[B, NB]`` int32 block table — row ``b``'s logical
+        block ``j`` lives in pool page ``tables[b, j]``; ``-1`` =
+        unallocated (masked, fetch clipped to the scratch page).
+    :param row_starts: ``[B]`` int32 — row-local position of q lane 0
+        (may be negative when leading lanes are padding).
+    :param pad_lens: ``[B]`` int32 — number of leading INVALID q lanes
+        (their output rows are garbage; callers ignore them).
+    :param impl: ``"auto"`` (Pallas on TPU, oracle elsewhere),
+        ``"pallas"``, or ``"ref"``.
+    :returns: ``[B, T, Hq, D]`` attention output.
+
+    Query lane ``i`` of row ``b`` (valid iff ``i >= pad_lens[b]``)
+    attends key positions ``0 .. row_starts[b] + i`` through the block
+    table — the call's own tokens must already be written into the pool
+    (models/llama.py writes before attending, same as the contiguous
+    DUS path).
+    """
+    if impl == "auto":
+        impl = "pallas" if _on_tpu() else "ref"
+    if impl == "ref":
+        return paged_attention_ref(q, k_pool, v_pool, tables, row_starts,
+                                   pad_lens)
+    if interpret is None:
+        interpret = not _on_tpu()
+    b, t, hq, d = q.shape
+    p, bt, kvh, _ = k_pool.shape
+    nb = tables.shape[1]
+    groups = hq // kvh
+    t_pad = max(t, PAGED_MIN_Q)
+    if t_pad != t:
+        # LEFT-pad the q window (the last lane must stay last): the new
+        # lanes are invalid by construction
+        q = jnp.pad(q, ((0, 0), (t_pad - t, 0), (0, 0), (0, 0)))
+        row_starts = row_starts - (t_pad - t)
+        pad_lens = pad_lens + (t_pad - t)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(b, hq, nb),
+        in_specs=[
+            pl.BlockSpec((1, t_pad, 1, d),
+                         lambda bb, h, j, tbl, st, pd: (bb, 0, h, 0)),
+            pl.BlockSpec(
+                (1, bt, 1, d),
+                lambda bb, h, j, tbl, st, pd: (
+                    jnp.maximum(tbl[bb, j], 0), 0, h // groups, 0)),
+            pl.BlockSpec(
+                (1, bt, 1, d),
+                lambda bb, h, j, tbl, st, pd: (
+                    jnp.maximum(tbl[bb, j], 0), 0, h // groups, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, t_pad, 1, d),
+                               lambda bb, h, j, tbl, st, pd: (bb, 0, h, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((t_pad, 1), jnp.float32),
+            pltpu.VMEM((t_pad, 1), jnp.float32),
+            pltpu.VMEM((t_pad, d), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(_paged_kernel, scale=d ** -0.5, bt=bt, nb=nb),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, t_pad, hq, d), q.dtype),
+        interpret=interpret,
+    )(tables.astype(jnp.int32), row_starts.astype(jnp.int32),
+      pad_lens.astype(jnp.int32), q, k_pool, v_pool)
+    return out[:, t_pad - t:]
+
+
 def pick_block_sizes(t: int, d: int) -> tuple:
     """(block_q, block_k) for a [*, t, *, d] attention, from the round-3
     measurement sweep on TPU v5e (full fwd+bwd through ``jax.grad``,

@@ -194,7 +194,17 @@ def analyze_prefix(records: list) -> dict:
     for k in ("tokens_generated_total", "admissions_total",
               "prefix_hit_tokens_total", "prefix_hit_requests_total",
               "prefix_lookups_total", "prefix_evictions_total",
-              "prefix_pool_blocks"):
+              "prefix_pool_blocks",
+              # ISSUE 7 paged-decode observability: warm-admit device
+              # copy bytes (paged path: 0 — the zero-copy claim as a
+              # counter, not a slogan), the fraction of decode chunks
+              # served by the paged path, zero-copy radix adoptions,
+              # and the resident-vs-referenced occupancy split that
+              # stops hot prefixes double-counting
+              "warm_admit_copy_bytes_total", "paged_decode_frac",
+              "prefix_adopted_blocks_total",
+              "prefix_pool_blocks_resident",
+              "prefix_pool_blocks_referenced"):
         if last.get(k) is not None:
             out[k] = last[k]
     lookups = out.get("prefix_lookups_total")

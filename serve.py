@@ -231,6 +231,36 @@ def service_metrics(service: GenerationService) -> dict:
         out["prefix_pool_blocks"] = int(prefix["prefix_pool_blocks"])
         out["prefix_pool_blocks_used"] = int(
             prefix["prefix_pool_blocks_used"])
+        # occupancy WITHOUT double counting (ISSUE 7): resident =
+        # unique sharable pages the radix index owns; referenced =
+        # pages live requests actually read/write. On the scatter
+        # fallback a hot prefix is resident AND copied per-slot — the
+        # split makes that visible.
+        out["prefix_pool_blocks_resident"] = int(
+            prefix["prefix_pool_blocks_resident"])
+        out["prefix_pool_blocks_referenced"] = int(
+            prefix["prefix_pool_blocks_referenced"])
+        out["prefix_adopted_blocks_total"] = int(
+            prefix["prefix_adopted_blocks"])
+        # the ISSUE 7 gate, observable in production: device bytes warm
+        # admits copied (paged path: 0 — admits are pointer updates)
+        # and the fraction of decode chunks served by the paged path
+        out["warm_admit_copy_bytes_total"] = int(
+            prefix["warm_admit_copy_bytes"])
+        chunks = int(stats.get("chunks", 0) or 0)
+        if chunks:
+            out["paged_decode_frac"] = round(
+                int(stats.get("paged_chunks", 0)) / chunks, 4)
+        else:
+            # plain scheduler (or no traffic yet): derive from which
+            # arm actually served each batch-1 request — a
+            # paged-CAPABLE pool whose traffic all fell back to the
+            # scatter arm must NOT read 1.0
+            served = (int(prefix.get("batch1_paged_requests", 0))
+                      + int(prefix.get("batch1_scatter_requests", 0)))
+            out["paged_decode_frac"] = (
+                round(int(prefix.get("batch1_paged_requests", 0))
+                      / served, 4) if served else 0.0)
     # persistent-compile-cache counters (utils/compile_cache): a miss is
     # a real XLA compile, a hit an executable read back from disk —
     # restart cost and mid-traffic recompile storms as scrapeable series
@@ -514,6 +544,12 @@ def main(args, config):
         prefix_cfg["enabled"] = True
     elif args.prefix_cache == "off":
         prefix_cfg["enabled"] = False
+    # early-exit draft depth for speculative requests (ISSUE 7): the
+    # model's own first k blocks + head draft, sharing the target's
+    # cache and the prefix pool's warm blocks (engine/generate
+    # draft_layers); 0 keeps n-gram prompt lookup
+    spec_draft_layers = int((config.get("serving") or {}).get(
+        "speculative_draft_layers") or 0)
     want = args.scheduler
     if want == "auto":
         want = ("continuous" if probe._pad_ok and args.max_batch > 1
@@ -535,7 +571,7 @@ def main(args, config):
             model, params, tok, slots=args.max_batch,
             chunk=args.decode_chunk, window_ms=args.batch_window_ms,
             warm_buckets=warm_buckets, prefix_cache=prefix_cfg,
-            recorder=recorder,
+            recorder=recorder, spec_draft_layers=spec_draft_layers,
         )
     elif want == "static":
         # the static micro-batch scheduler's shared-group prefill does
@@ -544,11 +580,14 @@ def main(args, config):
         service = BatchedGenerationService.from_model(
             model, params, tok, max_batch=args.max_batch,
             window_ms=args.batch_window_ms,
+            spec_draft_layers=spec_draft_layers,
         )
     else:  # plain serialized service — rebuilt so the pool attaches
         service = (GenerationService.from_model(
-            model, params, tok, prefix_cache=prefix_cfg)
-            if prefix_cfg.get("enabled") else probe)
+            model, params, tok, prefix_cache=prefix_cfg,
+            spec_draft_layers=spec_draft_layers)
+            if prefix_cfg.get("enabled") or spec_draft_layers
+            else probe)
     logger.info("scheduler: %s", type(service).__name__)
     # on-demand profiling (POST /profile): captures land next to the
     # serving run's logs

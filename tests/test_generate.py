@@ -588,3 +588,93 @@ def test_speculative_guards():
     with pytest.raises(ValueError, match="non-rolling"):
         generate_speculative(rolling, params, jnp.zeros((1, 8), jnp.int32),
                              16)
+
+
+# --- early-exit draft model (draft_layers) + pool-shared spec (ISSUE 7) ------
+
+
+def test_speculative_draft_layers_matches_greedy_exactly():
+    """The early-exit DRAFT MODEL (the target's own first k blocks +
+    head, sharing its params and KV cache) may only change the
+    SCHEDULE: greedy output stays bit-identical to vanilla greedy and
+    to the n-gram drafter — the verifier decides every token."""
+    from pytorch_distributed_template_tpu.engine.generate import (
+        generate_speculative,
+    )
+
+    model = MODELS.get("Llama")(vocab_size=VOCAB, n_layer=4, n_head=4,
+                                n_kv_head=2, d_model=32, max_len=256)
+    base = np.random.default_rng(5).integers(0, VOCAB, 6).tolist()
+    prompt = jnp.asarray([base * 3], jnp.int32)
+    params = model.init(jax.random.key(0), prompt)["params"]
+    ref = generate(model, params, prompt, 40, temperature=0.0)
+    for dl in (1, 2, 3):
+        out, stats = generate_speculative(
+            model, params, prompt, 40, draft_len=4, return_stats=True,
+            draft_layers=dl)
+        np.testing.assert_array_equal(np.asarray(ref), np.asarray(out),
+                                      err_msg=f"draft_layers={dl}")
+    # sampled mode: top_k=1 collapses to greedy (deterministic e2e
+    # check of the rejection path under a model drafter)
+    out, _ = generate_speculative(
+        model, params, prompt, 40, draft_len=4, return_stats=True,
+        temperature=0.7, top_k=1, rng=jax.random.key(3),
+        draft_layers=2)
+    np.testing.assert_array_equal(np.asarray(ref), np.asarray(out))
+
+
+def test_speculative_draft_layers_guards():
+    from pytorch_distributed_template_tpu.engine.generate import (
+        generate_speculative,
+    )
+
+    model, params = _model_and_params(max_len=64)
+    prompt = jnp.zeros((1, 8), jnp.int32)
+    with pytest.raises(ValueError, match="draft_layers"):
+        generate_speculative(model, params, prompt, 8,
+                             draft_layers=model.n_layer)
+    with pytest.raises(ValueError, match="draft_layers"):
+        generate_speculative(model, params, prompt, 8, draft_layers=-1)
+    tl = MODELS.get("TinyLM")(vocab_size=VOCAB, n_layer=2, n_head=2,
+                              d_model=16, max_len=64)
+    tp = tl.init(jax.random.key(0), prompt)["params"]
+    with pytest.raises(ValueError, match="exit_layer"):
+        generate_speculative(tl, tp, prompt, 8, draft_layers=1)
+
+
+def test_speculative_from_cache_matches_cold_spec():
+    """The POOL-SHARED serving entry (speculative_from_cache): a warm
+    cache built through the prefix pool must continue into the SAME
+    tokens the cold speculative path emits — for both the n-gram and
+    the early-exit drafter."""
+    import pytorch_distributed_template_tpu.models  # noqa: F401
+    from pytorch_distributed_template_tpu.engine.generate import (
+        generate_speculative, speculative_from_cache,
+    )
+    from pytorch_distributed_template_tpu.engine.kvcache import (
+        PrefixCache,
+    )
+
+    model = MODELS.get("Llama")(vocab_size=VOCAB, n_layer=4, n_head=4,
+                                n_kv_head=2, d_model=32, max_len=256)
+    base = np.random.default_rng(5).integers(1, VOCAB, 6).tolist()
+    ids = base * 3
+    prompt = jnp.asarray([ids], jnp.int32)
+    params = model.init(jax.random.key(0), prompt)["params"]
+    pc = PrefixCache(model, params, block_tokens=8, pool_blocks=64)
+    new, D = 24, 4
+    total = len(ids) + new + 2 * (D + 1)
+    for dl in (0, 2):
+        ref = generate_speculative(
+            model, params, prompt, new, draft_len=D, draft_layers=dl)
+        # first call populates the pool, second actually hits
+        for _ in range(2):
+            last_logits, cache, hit = pc.warm_prefill(params, ids, total)
+            out, stats = speculative_from_cache(
+                model, params, ids, cache, last_logits, total, new,
+                draft_len=D, draft_layers=dl)
+        assert hit > 0                      # the warm arm really reused
+        np.testing.assert_array_equal(
+            np.asarray(ref)[0, :len(ids) + new], np.asarray(out)[0],
+            err_msg=f"draft_layers={dl}")
+        assert stats["tokens_emitted"] == new
