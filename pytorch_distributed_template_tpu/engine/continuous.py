@@ -53,6 +53,7 @@ import time
 import numpy as np
 
 from ..observability.trace import span
+from ..utils.promtext import percentile
 from .serving import GenerationService
 
 logger = logging.getLogger(__name__)
@@ -461,10 +462,11 @@ class ContinuousBatchingService(GenerationService):
     def _setup(self, model, params, tokenizer=None, slots: int = 8,
                chunk: int = 8, window_ms: float = 5.0,
                warm_buckets=None, prefix_cache=None, recorder=None,
-               spec_draft_layers: int = 0):
+               spec_draft_layers: int = 0, tracer=None, slo=None):
         super()._setup(model, params, tokenizer,
                        prefix_cache=prefix_cache,
-                       spec_draft_layers=spec_draft_layers)
+                       spec_draft_layers=spec_draft_layers,
+                       tracer=tracer, slo=slo)
         self._recorder = recorder
         if not self._pad_ok:
             raise ValueError(
@@ -500,6 +502,10 @@ class ContinuousBatchingService(GenerationService):
         self._window_s = float(window_ms) / 1e3
         self._queue: "queue_mod.Queue" = queue_mod.Queue()
         self._latencies: list = []
+        # server-side TTFT per request (ISSUE 8 satellite): stamped at
+        # the first absorb that hands a row its tokens — the earliest
+        # moment the first token is actually servable to the client
+        self._ttfts: list = []
         # prompt-length buckets whose (bucket, k) admit executables are
         # primed at startup alongside the chunk ladder: normalized
         # through the scheduler's own bucketing, deduped, and dropped
@@ -697,7 +703,7 @@ class ContinuousBatchingService(GenerationService):
                  max_new_tokens: int = 64, temperature: float = 0.0,
                  top_k: int = 0, top_p: float = 0.0, seed: int = 0,
                  speculative: int = 0, stop=None,
-                 on_tokens=None, cancel=None) -> dict:
+                 on_tokens=None, cancel=None, request_id=None) -> dict:
         """Same contract as the parent plus ``on_tokens``: a callback
         receiving each batch of freshly decoded token ids for THIS
         request as its chunks absorb (stop tokens filtered — the
@@ -722,7 +728,8 @@ class ContinuousBatchingService(GenerationService):
                 prompt=prompt, prompt_ids=prompt_ids,
                 max_new_tokens=max_new_tokens, temperature=temperature,
                 top_k=top_k, top_p=top_p, seed=seed,
-                speculative=speculative, stop=stop)
+                speculative=speculative, stop=stop,
+                request_id=request_id)
             if on_tokens is not None and result.get("ids"):
                 on_tokens(list(result["ids"]))   # single final delta
             return result
@@ -749,7 +756,7 @@ class ContinuousBatchingService(GenerationService):
             "ids": ids, "budget": max_new,
             "temperature": float(temperature), "top_k": int(top_k),
             "top_p": float(top_p), "seed": seed, "stop": stops,
-            "on_tokens": on_tokens, "cancel": cancel,
+            "on_tokens": on_tokens, "cancel": cancel, "rid": request_id,
             # raw key data, derived WITHOUT device work in the
             # caller's thread (host path above): per-request device
             # ops serialized burst arrivals through the tunnel
@@ -836,6 +843,10 @@ class ContinuousBatchingService(GenerationService):
 
         if self._paged:
             return self._admit_group_paged(reqs, slots)
+        t_admit0 = time.monotonic()
+        ev0 = (self._prefix.counter("prefix_evictions")
+               if self._prefix is not None and self._tracer is not None
+               else 0)
         n = len(reqs)
         k = self._slots
         W = self.MAX_STOPS
@@ -916,6 +927,33 @@ class ContinuousBatchingService(GenerationService):
                 "pad_len": int(ints[j, 2]), "done": False,
             }
         self.stats["admissions"] += n
+        if self._tracer is not None:
+            t_admit1 = time.monotonic()
+            evictions = (self._prefix.counter("prefix_evictions") - ev0
+                         if self._prefix is not None else 0)
+            for j, r in enumerate(reqs):
+                rid = r.get("rid")
+                if not rid:
+                    continue
+                # queue wait: enqueue -> this admit dispatch
+                self._tracer.add(rid, "queue_wait", r["t0"], t_admit0,
+                                 bucket=bucket)
+                hit = matches[j][2] if matches is not None else 0
+                self._tracer.add(
+                    rid, "admit", t_admit0, t_admit1,
+                    mode=("warm" if hit else "cold"),
+                    bucket=bucket, feed=feed, group=n,
+                    prefix_hit_tokens=hit,
+                    copy_blocks=(len(matches[j][1])
+                                 if matches is not None else 0))
+            if evictions:
+                # pool pressure attributed to the admission that paid
+                # it (the group's first traced request carries it)
+                rid = next((r.get("rid") for r in reqs
+                            if r.get("rid")), None)
+                if rid:
+                    self._tracer.event(rid, "kv_evictions",
+                                       blocks=evictions, group=n)
 
     def _reserve_pages(self, r):
         """Host-side page reservation for one paged admission —
@@ -931,6 +969,7 @@ class ContinuousBatchingService(GenerationService):
         would fabricate hundreds of phantom hit-tokens."""
         first = not r.get("_page_retry")
         r["_page_retry"] = True
+        r["_page_attempts"] = r.get("_page_attempts", 0) + 1
         return self._prefix.paged_plan(r["ids"], r["budget"],
                                        record=first)
 
@@ -949,6 +988,9 @@ class ContinuousBatchingService(GenerationService):
 
         pf = self._prefix
         bt = pf.block
+        t_admit0 = time.monotonic()
+        ev0 = (pf.counter("prefix_evictions")
+               if self._tracer is not None else 0)
         n = len(reqs)
         k = self._slots
         W = self.MAX_STOPS
@@ -1017,6 +1059,31 @@ class ContinuousBatchingService(GenerationService):
             }
         self.stats["admissions"] += n
         self.stats["paged_admissions"] += n
+        if self._tracer is not None:
+            t_admit1 = time.monotonic()
+            evictions = pf.counter("prefix_evictions") - ev0
+            for j, (r, slot) in enumerate(zip(reqs, slots)):
+                rid = r.get("rid")
+                if not rid:
+                    continue
+                plan = self._meta[slot]["pages"]
+                self._tracer.add(rid, "queue_wait", r["t0"], t_admit0,
+                                 bucket=self._bucket(len(r["ids"])))
+                self._tracer.add(
+                    rid, "admit", t_admit0, t_admit1, mode="paged",
+                    feed=feed, group=n,
+                    prefix_hit_tokens=plan["c"],
+                    # the paged contract: warm admits are pointer
+                    # updates — copy bytes are zero by construction
+                    copy_blocks=0,
+                    private_pages=len(plan["private"]),
+                    deferred=r.get("_page_attempts", 1) > 1)
+            if evictions:
+                rid = next((r.get("rid") for r in reqs
+                            if r.get("rid")), None)
+                if rid:
+                    self._tracer.event(rid, "kv_evictions",
+                                       blocks=evictions, group=n)
 
     def _init_arrays(self):
         """The persistent device slot state, built ONCE (and after an
@@ -1081,6 +1148,7 @@ class ContinuousBatchingService(GenerationService):
             toks = np.asarray(toks)
             emitted = np.asarray(emitted)
             done = np.asarray(done)
+        t_absorb = time.monotonic()
         tok0_np: dict = {}          # one D2H read per admission group
         for s in range(self._slots):
             m = self._meta[s]
@@ -1101,6 +1169,26 @@ class ContinuousBatchingService(GenerationService):
             m["out"].extend(int(t) for t in toks[s, :fresh])
             m["emitted"] = int(emitted[s])
             m["done"] = bool(done[s])
+            if "t_first" not in m and m["out"]:
+                # server-side TTFT: the first absorb that makes this
+                # row's first token servable (host-observed — the
+                # device produced it earlier, but nothing could be
+                # streamed before this force)
+                m["t_first"] = t_absorb
+                ttft = t_absorb - m["req"]["t0"]
+                self._ttfts.append(ttft)
+                if len(self._ttfts) > 1024:
+                    del self._ttfts[:512]
+                self.hist["ttft_seconds"].observe(ttft)
+                rid = m["req"].get("rid")
+                if self._tracer is not None and rid:
+                    self._tracer.event(rid, "first_token",
+                                      ttft_s=round(ttft, 6))
+            elif self._tracer is not None and fresh > 0:
+                rid = m["req"].get("rid")
+                if rid:
+                    self._tracer.event(rid, "decode_chunk",
+                                       tokens=fresh)
             ev = m["req"].get("cancel")
             if ev is not None and not m["done"] and ev.is_set():
                 # cancelled mid-flight: finalize with what's decoded,
@@ -1245,7 +1333,17 @@ class ContinuousBatchingService(GenerationService):
         m = self._meta[slot]
         req = m["req"]
         if self._paged:
+            ad0 = (self._prefix.counter("prefix_adopted_blocks")
+                   if self._tracer is not None else 0)
             self._finish_pages(slot, m)
+            if self._tracer is not None and req.get("rid"):
+                adopted = (self._prefix.counter("prefix_adopted_blocks")
+                           - ad0)
+                if adopted:
+                    # zero-copy radix adoption of this request's pages
+                    # (prompt + decoded tokens become sharable)
+                    self._tracer.event(req["rid"], "kv_adopt",
+                                       blocks=adopted)
         resp = self._response(
             m["out"], stops=req["stop"], emitted=m["emitted"])
         ev = req.get("cancel")
@@ -1260,10 +1358,30 @@ class ContinuousBatchingService(GenerationService):
         req["event"].set()
         self._meta[slot] = None
         self.stats["completed"] += 1
-        lat = time.monotonic() - req["t0"]
+        t_done = time.monotonic()
+        lat = t_done - req["t0"]
         self._latencies.append(lat)
         if len(self._latencies) > 1024:
             del self._latencies[:512]
+        # latency exports + SLO check at the engine's own observation
+        # point: e2e covers enqueue -> completion, TPOT the decode
+        # cadence after the first token (ISSUE 8)
+        self.hist["e2e_seconds"].observe(lat)
+        t_first = m.get("t_first")
+        emitted_n = int(m["emitted"])
+        ttft = (t_first - req["t0"]) if t_first is not None else None
+        if t_first is not None and emitted_n > 1:
+            self.hist["tpot_seconds"].observe(
+                (t_done - t_first) / (emitted_n - 1))
+        rid = req.get("rid")
+        if self._tracer is not None and rid:
+            self._tracer.event(
+                rid, "complete", e2e_s=round(lat, 6),
+                tokens=emitted_n, stop_reason=resp["stop_reason"])
+        if self._slo is not None and rid:
+            self._slo.observe(rid, ttft_s=ttft, e2e_s=lat,
+                              tokens=emitted_n,
+                              stop_reason=resp["stop_reason"])
 
     def queue_depth(self) -> int:
         """Requests waiting for a slot (not yet admitted)."""
@@ -1278,10 +1396,18 @@ class ContinuousBatchingService(GenerationService):
         lats = sorted(self._latencies[-1024:])
         if not lats:
             return {}
-        pick = lambda q: round(lats[min(len(lats) - 1,          # noqa: E731
-                                        int(q * len(lats)))], 4)
-        return {"p50_s": pick(0.50), "p95_s": pick(0.95),
-                "p99_s": pick(0.99), "n": len(lats)}
+        pick = lambda q: round(percentile(lats, q), 4)   # noqa: E731
+        out = {"p50_s": pick(0.50), "p95_s": pick(0.95),
+               "p99_s": pick(0.99), "n": len(lats)}
+        # server-side TTFT (ISSUE 8 satellite): stamped at the first
+        # absorb per request, so serving latency decomposes into
+        # first-token wait vs decode tail without a client in the loop
+        ttfts = sorted(self._ttfts[-1024:])
+        if ttfts:
+            tp = lambda q: round(percentile(ttfts, q), 4)    # noqa: E731
+            out.update(ttft_p50_s=tp(0.50), ttft_p95_s=tp(0.95),
+                       ttft_p99_s=tp(0.99))
+        return out
 
     def _worker(self):
         """The scheduler loop. Single thread owns the device state;

@@ -569,6 +569,15 @@ class PrefixCache:
         with self._lock:
             self.stats[key] += 1
 
+    def counter(self, name: str) -> int:
+        """One stats counter, cheaply. The engines diff these around
+        admissions/completions to attribute pool events (evictions,
+        zero-copy adoptions) to the request that triggered them in the
+        request-scoped trace (ISSUE 8) — a full ``stats_snapshot()``
+        per admit would rebuild the whole dict for one integer."""
+        with self._lock:
+            return int(self.stats.get(name, 0))
+
     def release(self, nodes):
         with self._lock:
             self.index.release(nodes)

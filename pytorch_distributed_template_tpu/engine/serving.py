@@ -56,9 +56,11 @@ class GenerationService:
         return obj
 
     def _setup(self, model, params, tokenizer=None, prefix_cache=None,
-               spec_draft_layers: int = 0):
+               spec_draft_layers: int = 0, tracer=None, slo=None):
         import inspect
         import threading
+
+        from ..utils.promtext import LatencyHistogram
 
         self.model, self.params, self.tokenizer = model, params, tokenizer
         self.vocab = int(getattr(self.model, "vocab_size", 0))
@@ -112,10 +114,54 @@ class GenerationService:
                 "to n-gram drafting", self._spec_draft_layers,
                 type(model).__name__)
             self._spec_draft_layers = 0
+        # request-scoped tracing + SLO plumbing (ISSUE 8,
+        # observability/reqtrace.py): the tracer appends request-keyed
+        # spans to this process's spans.jsonl, the SLO watcher turns
+        # per-request TTFT/e2e into breach counters + bounded
+        # slow-request dumps. Both optional (None = zero overhead);
+        # serve.py passes them in, library/test use stays untouched.
+        self._tracer = tracer
+        self._slo = slo
+        # fixed-bucket Prometheus histograms (utils/promtext): TTFT and
+        # TPOT fill only on schedulers that observe first-token time
+        # (the continuous engine); e2e fills everywhere — the fleet
+        # poller SUMS these bucket counters into aggregable
+        # fleet-level latency (averaging percentile gauges is not
+        # aggregation)
+        self.hist = {"ttft_seconds": LatencyHistogram(),
+                     "tpot_seconds": LatencyHistogram(),
+                     "e2e_seconds": LatencyHistogram()}
         # scheduler subclasses overwrite this with richer dicts in
         # their own _setup (after this super() call); the plain
         # serialized service still exposes a token counter for /metrics
         self.stats = {"tokens_generated": 0}
+
+    def _observe_request(self, request_id, t0: float, resp: dict,
+                         ttft_s=None) -> None:
+        """One completed request's latency bookkeeping for schedulers
+        WITHOUT their own engine-side observation point (plain and
+        static paths; the continuous engine observes in ``_complete``
+        where TTFT and token counts are known): e2e histogram, SLO
+        check, and the tracer's ``complete`` event."""
+        import time
+
+        e2e = time.monotonic() - t0
+        self.hist["e2e_seconds"].observe(e2e)
+        tokens = len(resp.get("ids") or ())
+        if self._tracer is not None and request_id:
+            self._tracer.event(request_id, "complete",
+                               e2e_s=round(e2e, 6), tokens=tokens,
+                               stop_reason=resp.get("stop_reason"))
+        if self._slo is not None and request_id:
+            self._slo.observe(request_id, ttft_s=ttft_s, e2e_s=e2e,
+                              tokens=tokens)
+
+    def slo_stats(self):
+        """SLO breach counters for /metrics (zeros when no watcher)."""
+        if self._slo is None:
+            return {"slo_breach_total": 0, "slo_ttft_breach_total": 0,
+                    "slo_e2e_breach_total": 0, "slo_dumps_written": 0}
+        return self._slo.stats()
 
     def prefix_cache_stats(self):
         """Prefix-cache counters + pool occupancy for /metrics, or
@@ -267,7 +313,8 @@ class GenerationService:
     def generate(self, prompt=None, prompt_ids=None,
                  max_new_tokens: int = 64, temperature: float = 0.0,
                  top_k: int = 0, top_p: float = 0.0, seed: int = 0,
-                 speculative: int = 0, stop=None) -> dict:
+                 speculative: int = 0, stop=None,
+                 request_id=None) -> dict:
         """One validated generation request ->
         ``{"ids", "text"?, "stop_reason", "speculative"?}``.
 
@@ -276,13 +323,20 @@ class GenerationService:
         request costs chip time proportional to what it EMITS, not its
         budget. The stop token is excluded from the response (its
         presence is reported as ``stop_reason: "stop"``).
+
+        ``request_id``: the request-scoped trace id (ISSUE 8) — keys
+        this request's spans/SLO observation when a tracer is attached;
+        otherwise inert.
         """
+        import time
+
         import jax
         import jax.numpy as jnp
         import numpy as np
 
         from .generate import generate
 
+        t_req = time.monotonic()
         ids = self.encode_prompt(prompt, prompt_ids)
         stops = self.encode_stop(stop)
         arr = jnp.asarray(np.asarray(ids, np.int32)[None, :])
@@ -297,6 +351,13 @@ class GenerationService:
                 resp = self._response(new_ids, stops=stops,
                                       emitted=len(new_ids))
                 resp["speculative"] = stats
+                if self._tracer is not None and request_id:
+                    self._tracer.event(
+                        request_id, "spec",
+                        tokens_per_call=stats.get("tokens_per_call"),
+                        model_calls=stats.get("model_calls"),
+                        disabled=stats.get("speculation_disabled"))
+                self._observe_request(request_id, t_req, resp)
                 return resp
             # row_rngs (not rng): the row stream is key(seed)
             # EXACTLY, matching what the micro-batched service
@@ -317,7 +378,9 @@ class GenerationService:
                 new_ids = self._generate_prefix_cached(
                     ids, int(max_new_tokens), float(temperature),
                     int(top_k), float(top_p), row_rngs)
-                return self._response(new_ids, stops=stops)
+                resp = self._response(new_ids, stops=stops)
+                self._observe_request(request_id, t_req, resp)
+                return resp
             if stops:
                 out, lengths = generate(
                     self.model, self.params, arr,
@@ -336,8 +399,10 @@ class GenerationService:
                     top_k=int(top_k), top_p=float(top_p),
                     row_rngs=row_rngs,
                 )
-        return self._response(np.asarray(out[0, arr.shape[1]:]),
+        resp = self._response(np.asarray(out[0, arr.shape[1]:]),
                               stops=stops, emitted=emitted)
+        self._observe_request(request_id, t_req, resp)
+        return resp
 
     def _generate_prefix_cached(self, ids, max_new: int,
                                 temperature: float, top_k: int,
@@ -666,12 +731,13 @@ class BatchedGenerationService(GenerationService):
 
     def _setup(self, model, params, tokenizer=None,
                max_batch: int = 8, window_ms: float = 25.0,
-               spec_draft_layers: int = 0):
+               spec_draft_layers: int = 0, tracer=None, slo=None):
         import queue
         import threading
 
         super()._setup(model, params, tokenizer,   # sets _pad_ok
-                       spec_draft_layers=spec_draft_layers)
+                       spec_draft_layers=spec_draft_layers,
+                       tracer=tracer, slo=slo)
         self._max_batch = int(max_batch)
         self._window_s = float(window_ms) / 1e3
         self._queue: "queue.Queue" = queue.Queue()
@@ -685,8 +751,10 @@ class BatchedGenerationService(GenerationService):
     def generate(self, prompt=None, prompt_ids=None,
                  max_new_tokens: int = 64, temperature: float = 0.0,
                  top_k: int = 0, top_p: float = 0.0, seed: int = 0,
-                 speculative: int = 0, stop=None) -> dict:
+                 speculative: int = 0, stop=None,
+                 request_id=None) -> dict:
         import threading
+        import time
 
         if speculative > 0:
             # batch-1 by construction (single cache position counter);
@@ -696,7 +764,9 @@ class BatchedGenerationService(GenerationService):
                 max_new_tokens=max_new_tokens, temperature=temperature,
                 top_k=top_k, top_p=top_p, seed=seed,
                 speculative=speculative, stop=stop,
+                request_id=request_id,
             )
+        t_req = time.monotonic()
         # validate in the CALLER's thread: bad input must raise here
         # (HTTP 400), not poison the worker. The budget rule lives in
         # _validate_budget (ONE owner, shared with serve.py's pre-SSE
@@ -727,6 +797,7 @@ class BatchedGenerationService(GenerationService):
         req["event"].wait()
         if "error" in req:
             raise req["error"]
+        self._observe_request(request_id, t_req, req["result"])
         return req["result"]
 
     def _group_key(self, req):

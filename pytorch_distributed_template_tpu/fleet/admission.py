@@ -39,6 +39,8 @@ import threading
 import time
 from typing import Callable, Dict, Optional
 
+from ..utils.promtext import LatencyHistogram
+
 #: submit() outcomes (also the shed-counter keys in stats())
 ADMITTED = "admitted"
 SHED_WATERMARK = "shed_watermark"
@@ -112,6 +114,12 @@ class FairAdmission:
             SHED_TIMEOUT: 0,
         }
         self._tenant_stats: Dict[str, Dict[str, int]] = {}
+        # WFQ wait-time histogram (ISSUE 8): every submit() observes
+        # how long it waited for a grant (0 on the inline fast path),
+        # so "was the p99 spent in the waiting room?" is a scrapeable
+        # series — and the per-request span the router records around
+        # submit() carries the same number into the stitched trace
+        self.wait_hist = LatencyHistogram()
 
     # -- bookkeeping --------------------------------------------------------
 
@@ -157,6 +165,7 @@ class FairAdmission:
             if self._inflight < cap and not self._heap:
                 self._inflight += 1
                 self._bump(tenant, ADMITTED)
+                self.wait_hist.observe(0.0)
                 return ADMITTED
             if self._waiting_total >= self.max_waiting:
                 self._bump(tenant, SHED_WATERMARK)
@@ -176,7 +185,8 @@ class FairAdmission:
                 self._waiting_by_tenant.get(tenant, 0) + 1)
             # a grant slot may already be open (e.g. capacity grew):
             self._grant_locked()
-            deadline = time.monotonic() + timeout_s
+            t_wait0 = time.monotonic()
+            deadline = t_wait0 + timeout_s
             while not ticket.granted:
                 left = deadline - time.monotonic()
                 if left <= 0:
@@ -193,9 +203,11 @@ class FairAdmission:
                         self._tenant_tag.get(tenant, 0.0)
                         - ticket.charge)
                     self._bump(tenant, SHED_TIMEOUT)
+                    self.wait_hist.observe(time.monotonic() - t_wait0)
                     return SHED_TIMEOUT
                 self._cv.wait(left)
             self._bump(tenant, ADMITTED)
+            self.wait_hist.observe(time.monotonic() - t_wait0)
             return ADMITTED
 
     def release(self) -> None:
@@ -244,4 +256,5 @@ class FairAdmission:
             out["tenants"] = {t: dict(v)
                               for t, v in self._tenant_stats.items()}
             out["avg_service_s"] = round(self._avg_service_s, 4)
+        out["wait_seconds"] = self.wait_hist.snapshot()
         return out

@@ -37,6 +37,8 @@ import time
 from typing import Dict, List, Optional
 from urllib.parse import urlsplit
 
+from ..utils.promtext import percentile as _percentile
+
 
 def build_trace(n_requests: int, seed: int = 0,
                 tenants=("t0", "t1", "t2"),
@@ -87,6 +89,12 @@ def build_trace(n_requests: int, seed: int = 0,
                   and rng.random() < cancel_frac)
         trace.append({
             "i": i, "t": round(at, 4),
+            # deterministic request id (ISSUE 8): attached as
+            # X-Request-Id on replay, so the client-measured TTFT/e2e
+            # in this summary JOINS the server-side span timelines per
+            # request in the stitcher — same seed, same ids, so two
+            # arms of a bench never collide (the group tag namespaces)
+            "rid": f"lg-{group_tag}-{seed}-{i:04d}",
             "tenant": rng.choices(tenants, weights=weights)[0],
             "group": f"{group_tag}{g}",
             "prompt_ids": prefixes[g] + suffix,
@@ -103,22 +111,11 @@ def prompt_tokens(trace: List[dict]) -> int:
     return sum(len(item["prompt_ids"]) for item in trace)
 
 
-def _percentile(sorted_vals: List[float], q: float) -> Optional[float]:
-    if not sorted_vals:
-        return None
-    if len(sorted_vals) == 1:
-        return sorted_vals[0]
-    pos = q * (len(sorted_vals) - 1)
-    lo = int(pos)
-    hi = min(lo + 1, len(sorted_vals) - 1)
-    frac = pos - lo
-    return sorted_vals[lo] * (1 - frac) + sorted_vals[hi] * frac
-
-
 def _run_one(base: str, item: dict, t_start: float, results: list,
              lock: threading.Lock, timeout_s: float,
              policy: Optional[str]) -> None:
-    rec = {"i": item["i"], "tenant": item["tenant"],
+    rec = {"i": item["i"], "rid": item.get("rid"),
+           "tenant": item["tenant"],
            "group": item["group"], "stream": item["stream"],
            "prompt_tokens": len(item["prompt_ids"]),
            "ok": False, "shed": False, "cancelled": False,
@@ -134,6 +131,8 @@ def _run_one(base: str, item: dict, t_start: float, results: list,
         body["stream"] = True
     headers = {"Content-Type": "application/json",
                "X-Tenant": item["tenant"]}
+    if item.get("rid"):
+        headers["X-Request-Id"] = item["rid"]
     if policy:
         headers["X-Fleet-Policy"] = policy
     t0 = time.monotonic()
@@ -306,6 +305,16 @@ def summarize(replayed: dict, trace: Optional[List[dict]] = None
         "latency_p50_s": _percentile(totals, 0.5),
         "latency_p99_s": _percentile(totals, 0.99),
         "per_tenant": per_tenant,
+        # per-request client measurements keyed by rid: the stitcher
+        # (scripts/trace_stitch.py --client) joins these onto the
+        # server-side span timelines, so attribution is against the
+        # CLIENT-measured e2e, residual included
+        "by_request": [
+            {"rid": r.get("rid"), "tenant": r["tenant"],
+             "ok": r["ok"], "shed": r["shed"], "status": r["status"],
+             "tokens": r["tokens"], "ttft_s": r["ttft_s"],
+             "total_s": r["total_s"]}
+            for r in sorted(results, key=lambda r: r["i"])],
     }
     if trace is not None:
         out["prompt_tokens"] = prompt_tokens(trace)

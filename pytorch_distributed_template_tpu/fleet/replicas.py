@@ -47,6 +47,9 @@ from pathlib import Path
 from typing import Dict, List, Optional
 
 from ..resilience.supervisor import EventLog, Supervisor, SupervisorConfig
+from ..utils.promtext import (
+    add_histograms, histogram_quantile, is_histogram, zero_histogram,
+)
 from .placement import FleetRadix, choose_replica
 
 STARTING = "starting"
@@ -60,8 +63,16 @@ AGGREGATED_COUNTERS = (
     "requests_total", "requests_completed", "tokens_generated_total",
     "cancelled_total", "prefix_hit_tokens_total",
     "prefix_hit_requests_total", "prefix_lookups_total",
-    "prefix_evictions_total",
+    "prefix_evictions_total", "slo_breach_total",
 )
+
+#: per-replica latency HISTOGRAMS (fixed shared buckets —
+#: utils/promtext) summed reset-corrected into fleet-level histograms:
+#: the aggregable form of fleet latency (ISSUE 8). Percentile gauges
+#: from N replicas cannot be averaged into a fleet percentile;
+#: bucket counters sum exactly.
+AGGREGATED_HISTOGRAMS = ("ttft_seconds", "tpot_seconds",
+                         "e2e_seconds")
 
 
 def http_json(url: str, timeout_s: float = 5.0) -> dict:
@@ -92,6 +103,9 @@ class Replica:
         self.polled: dict = {}         # last /metrics?format=json
         self.cum: Dict[str, float] = {k: 0 for k in AGGREGATED_COUNTERS}
         self._last_raw: Dict[str, float] = {}
+        self.cum_hist: Dict[str, dict] = {
+            k: zero_histogram() for k in AGGREGATED_HISTOGRAMS}
+        self._last_hist: Dict[str, dict] = {}
         self.ejected_at: Optional[float] = None
         self.supervisor: Optional[Supervisor] = None
         self.thread: Optional[threading.Thread] = None
@@ -142,6 +156,25 @@ class Replica:
             last = self._last_raw.get(key, 0)
             self.cum[key] += (new - last) if new >= last else new
             self._last_raw[key] = new
+        # histograms fold the same way, per bucket: a count drop means
+        # the replica restarted and the new snapshot IS the delta
+        for key in AGGREGATED_HISTOGRAMS:
+            snap = polled.get(key)
+            if not is_histogram(snap):
+                continue
+            last = self._last_hist.get(key)
+            if last is not None and (snap.get("count", 0)
+                                     >= last.get("count", 0)):
+                delta = add_histograms(
+                    add_histograms(zero_histogram(), snap), last,
+                    scale=-1.0)
+            else:
+                delta = add_histograms(zero_histogram(), snap)
+            add_histograms(self.cum_hist[key], delta)
+            self._last_hist[key] = {
+                "buckets": dict(snap.get("buckets") or {}),
+                "sum": snap.get("sum", 0.0),
+                "count": snap.get("count", 0)}
 
     def load_estimate(self) -> float:
         """The router's per-replica queue estimate: its own live
@@ -438,6 +471,22 @@ class FleetManager:
             for key in AGGREGATED_COUNTERS:
                 out[f"fleet_{key}"] = int(sum(
                     r.cum[key] for r in self.replicas.values()))
+            # fleet-level latency histograms: bucket-wise sums of the
+            # replicas' reset-corrected histograms — the honest
+            # aggregate (ISSUE 8) — plus quantile-estimate gauges for
+            # humans/dashboards without a PromQL engine
+            for key in AGGREGATED_HISTOGRAMS:
+                merged = zero_histogram()
+                for r in self.replicas.values():
+                    add_histograms(merged, r.cum_hist[key])
+                out[f"fleet_{key}"] = merged
+                if merged["count"]:
+                    base = key.replace("_seconds", "")
+                    for q, tag in ((0.5, "p50"), (0.95, "p95"),
+                                   (0.99, "p99")):
+                        est = histogram_quantile(merged, q)
+                        if est is not None:
+                            out[f"fleet_{base}_{tag}_s"] = est
             out["replicas"] = len(self.replicas)
             out["replicas_healthy"] = sum(
                 1 for r in self.replicas.values() if r.state == HEALTHY)

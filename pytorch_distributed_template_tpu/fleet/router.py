@@ -40,6 +40,10 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 from urllib.parse import parse_qsl, urlsplit
 
+from ..observability.reqtrace import (
+    mint_request_id, sanitize_request_id,
+)
+from ..utils.promtext import LatencyHistogram
 from ..utils.promtext import prometheus_text  # noqa: F401 (re-export)
 from .admission import ADMITTED, FairAdmission
 from .placement import POLICIES, affinity_ids
@@ -47,7 +51,10 @@ from .replicas import FleetManager
 
 
 class RouterStats:
-    """Router-level counters, one lock."""
+    """Router-level counters, one lock — plus the router's own
+    latency histograms (TTFT from the first relayed SSE payload, e2e
+    around the whole proxied request): the front door's view of client
+    latency, histogram-bucketed so it aggregates across routers."""
 
     FIELDS = ("requests_total", "stream_requests_total",
               "unavailable_total", "proxy_retries_total",
@@ -57,6 +64,8 @@ class RouterStats:
     def __init__(self):
         self._lock = threading.Lock()
         self._c = {f: 0 for f in self.FIELDS}
+        self.ttft_hist = LatencyHistogram()
+        self.e2e_hist = LatencyHistogram()
 
     def bump(self, field: str, n: int = 1) -> None:
         with self._lock:
@@ -68,10 +77,14 @@ class RouterStats:
 
 
 def router_metrics(manager: FleetManager, admission: FairAdmission,
-                   stats: RouterStats) -> dict:
+                   stats: RouterStats, slo=None) -> dict:
     """The flat dict behind ``GET /metrics``: router counters, fleet
     aggregates (reset-corrected replica counters), admission stats."""
     out = dict(stats.snapshot())
+    out["router_ttft_seconds"] = stats.ttft_hist.snapshot()
+    out["router_e2e_seconds"] = stats.e2e_hist.snapshot()
+    if slo is not None:
+        out.update(slo.stats())
     mc = manager.snapshot_counters()
     # two legitimate "inflight" gauges exist: requests the router has
     # DISPATCHED to replicas (manager) vs requests ADMITTED through
@@ -86,6 +99,8 @@ def router_metrics(manager: FleetManager, admission: FairAdmission,
     out["shed_tenant_total"] = adm["shed_tenant"]
     out["shed_timeout_total"] = adm["shed_timeout"]
     out["avg_service_s"] = adm["avg_service_s"]
+    # WFQ waiting-room time as a proper histogram (fleet/admission.py)
+    out["admission_wait_seconds"] = adm["wait_seconds"]
     out.update(admission.depths())   # inflight/waiting/capacity gauges
     out["tenants"] = adm["tenants"]  # JSON-only (nested)
     return out
@@ -95,11 +110,13 @@ def make_fleet_handler(manager: FleetManager, admission: FairAdmission,
                        stats: Optional[RouterStats] = None,
                        allow_admin: bool = False,
                        connect_timeout_s: float = 5.0,
-                       read_timeout_s: float = 600.0):
+                       read_timeout_s: float = 600.0,
+                       tracer=None, slo=None):
     stats = stats or RouterStats()
 
     class Handler(BaseHTTPRequestHandler):
         protocol_version = "HTTP/1.0"   # connection close delimits SSE
+        _rid = None   # per-request trace id, echoed on every response
 
         # -- plumbing -------------------------------------------------------
 
@@ -108,6 +125,8 @@ def make_fleet_handler(manager: FleetManager, admission: FairAdmission,
             self.send_response(code)
             self.send_header("Content-Type", "application/json")
             self.send_header("Content-Length", str(len(body)))
+            if self._rid:
+                self.send_header("X-Request-Id", self._rid)
             for k, v in headers:
                 self.send_header(k, v)
             self.end_headers()
@@ -118,6 +137,8 @@ def make_fleet_handler(manager: FleetManager, admission: FairAdmission,
             self.send_response(code)
             self.send_header("Content-Type", content_type)
             self.send_header("Content-Length", str(len(body)))
+            if self._rid:
+                self.send_header("X-Request-Id", self._rid)
             self.end_headers()
             self.wfile.write(body)
 
@@ -129,7 +150,8 @@ def make_fleet_handler(manager: FleetManager, admission: FairAdmission,
         def do_GET(self):  # noqa: N802 (http.server API)
             path, _, query = self.path.partition("?")
             if path == "/metrics":
-                metrics = router_metrics(manager, admission, stats)
+                metrics = router_metrics(manager, admission, stats,
+                                         slo=slo)
                 if "format=json" in query:
                     return self._send(200, metrics)
                 return self._send_raw(
@@ -180,45 +202,101 @@ def make_fleet_handler(manager: FleetManager, admission: FairAdmission,
 
         def _generate(self) -> None:
             stats.bump("requests_total")
-            try:
-                n = int(self.headers.get("Content-Length", 0) or 0)
-                raw = self.rfile.read(n) if n else b"{}"
-                body = json.loads(raw or b"{}")
-                if not isinstance(body, dict):
-                    raise ValueError("body must be a JSON object")
-            except (ValueError, OSError) as e:
-                return self._send(400, {"error": f"bad request: {e}"})
+            # request identity (ISSUE 8): honor the client's
+            # X-Request-Id or mint one here — the router is the first
+            # hop, so THIS id keys the request's spans end to end
+            # (admission wait, proxy hop, the replica's own spans) and
+            # is echoed on every response, shed or served
+            rid = (sanitize_request_id(self.headers.get("X-Request-Id"))
+                   or mint_request_id())
+            self._rid = rid
             tenant = (self.headers.get("X-Tenant") or "default")[:64]
-            policy = self.headers.get("X-Fleet-Policy") or None
-            if policy is not None and policy not in POLICIES:
-                return self._send(400, {
-                    "error": f"unknown policy {policy!r}; one of "
-                             f"{list(POLICIES)}"})
-            if body.get("stream"):
-                stats.bump("stream_requests_total")
-            if not manager.healthy():
-                stats.bump("unavailable_total")
-                return self._send(
-                    503, {"error": "no healthy replicas"},
-                    headers=[("Retry-After",
-                              str(admission.retry_after_s()))])
-            outcome = admission.submit(tenant)
-            if outcome != ADMITTED:
-                retry_s = admission.retry_after_s()
-                return self._send(
-                    429, {"error": "overloaded, retry later",
-                          "reason": outcome,
-                          "retry_after_s": retry_s},
-                    headers=[("Retry-After", str(retry_s))])
-            t0 = time.monotonic()
+            t_req = time.monotonic()
+            outcome = "error"
+            stream = False
+            holder: dict = {"t0": t_req}   # SSE relay stamps ttft_s
             try:
-                self._route_and_proxy(body, raw, policy)
+                try:
+                    n = int(self.headers.get("Content-Length", 0) or 0)
+                    raw = self.rfile.read(n) if n else b"{}"
+                    body = json.loads(raw or b"{}")
+                    if not isinstance(body, dict):
+                        raise ValueError("body must be a JSON object")
+                except (ValueError, OSError) as e:
+                    outcome = "bad_request"
+                    return self._send(400,
+                                      {"error": f"bad request: {e}"})
+                policy = self.headers.get("X-Fleet-Policy") or None
+                if policy is not None and policy not in POLICIES:
+                    outcome = "bad_request"
+                    return self._send(400, {
+                        "error": f"unknown policy {policy!r}; one of "
+                                 f"{list(POLICIES)}"})
+                stream = bool(body.get("stream"))
+                if stream:
+                    stats.bump("stream_requests_total")
+                if not manager.healthy():
+                    stats.bump("unavailable_total")
+                    outcome = "unavailable"
+                    return self._send(
+                        503, {"error": "no healthy replicas"},
+                        headers=[("Retry-After",
+                                  str(admission.retry_after_s()))])
+                # the WFQ waiting room — the span that answers "was
+                # the p99 spent queueing at the front door?"
+                t_aw = time.monotonic()
+                adm_outcome = admission.submit(tenant)
+                if tracer is not None:
+                    tracer.add(rid, "admission_wait", t_aw,
+                               time.monotonic(), tenant=tenant,
+                               outcome=adm_outcome)
+                if adm_outcome != ADMITTED:
+                    outcome = adm_outcome
+                    retry_s = admission.retry_after_s()
+                    return self._send(
+                        429, {"error": "overloaded, retry later",
+                              "reason": adm_outcome,
+                              "retry_after_s": retry_s},
+                        headers=[("Retry-After", str(retry_s))])
+                t0 = time.monotonic()
+                try:
+                    # only a request that actually reached a replica
+                    # counts as "proxied" — route-time 503/502s must
+                    # not land in the e2e histogram or breach an SLO
+                    # (an outage would otherwise drag fleet p50 DOWN
+                    # and dump never-served requests as slow)
+                    outcome = self._route_and_proxy(
+                        body, raw, policy, rid, tenant, holder)
+                finally:
+                    admission.release()
+                    admission.observe_service_s(time.monotonic() - t0)
             finally:
-                admission.release()
-                admission.observe_service_s(time.monotonic() - t0)
+                t_end = time.monotonic()
+                if outcome == "proxied":
+                    stats.e2e_hist.observe(t_end - t_req)
+                    if slo is not None:
+                        slo.observe(rid,
+                                    ttft_s=holder.get("ttft_s"),
+                                    e2e_s=t_end - t_req,
+                                    tenant=tenant, stream=stream)
+                if tracer is not None:
+                    tracer.add(rid, "request", t_req, t_end,
+                               tenant=tenant, outcome=outcome,
+                               stream=stream)
+                self._rid = None
 
         def _route_and_proxy(self, body: dict, raw: bytes,
-                             policy) -> None:
+                             policy, rid: str, tenant: str,
+                             holder: dict) -> str:
+            """Returns the request outcome: ``proxied`` (a replica
+            served it), ``proxy_failed`` (dispatched but the router
+            answered 504/502 or the replica died mid-stream — an
+            in-flight casualty, not a served request),
+            ``upstream_error`` (the replica's own 4xx/5xx, relayed
+            verbatim but not a served request), ``cancelled`` (client
+            disconnected mid-stream), ``unroutable`` (route-time 503),
+            or ``unreachable`` (502 after the retry). Only ``proxied``
+            requests enter the e2e histogram / SLO check."""
             ids = affinity_ids(body)
             excluded: set = set()
             for _attempt in range(2):
@@ -226,18 +304,31 @@ def make_fleet_handler(manager: FleetManager, admission: FairAdmission,
                                        exclude=excluded)
                 if picked is None:
                     stats.bump("unavailable_total")
-                    return self._send(
+                    self._send(
                         503, {"error": "no healthy replicas"},
                         headers=[("Retry-After",
                                   str(admission.retry_after_s()))])
+                    return "unroutable"
                 replica, reason = picked
                 manager.begin(replica)
+                t_p0 = time.monotonic()
                 try:
-                    verdict = self._proxy(replica, raw)
+                    verdict = self._proxy(replica, raw, rid, tenant,
+                                          holder)
                 finally:
                     manager.end(replica)
+                    if tracer is not None:
+                        # the proxy hop: connect + upstream execution
+                        # + relay — the stitcher subtracts the
+                        # replica's own handler span from this to get
+                        # pure hop overhead
+                        tracer.add(rid, "proxy", t_p0,
+                                   time.monotonic(),
+                                   replica=replica.rid, reason=reason)
                 if verdict != "retry":
-                    return
+                    return {"done": "proxied",
+                            "failed": "proxy_failed"}.get(verdict,
+                                                          verdict)
                 # connection-level failure before anything dispatched:
                 # safe to try one other replica (the health poller will
                 # eject the dead one on its own clock)
@@ -246,10 +337,19 @@ def make_fleet_handler(manager: FleetManager, admission: FairAdmission,
                 stats.bump("proxy_retries_total")
             stats.bump("proxy_errors_total")
             self._send(502, {"error": "no replica reachable"})
+            return "unreachable"
 
-        def _proxy(self, replica, raw: bytes) -> str:
-            """Forward one request; returns ``done`` or ``retry``
-            (retry ONLY when nothing reached the replica)."""
+        def _proxy(self, replica, raw: bytes, rid: str, tenant: str,
+                   holder: dict) -> str:
+            """Forward one request; returns ``done``, ``failed``
+            (dispatched, but the router synthesized a 504/502 error
+            response or the replica died mid-stream — not retry-safe,
+            and NOT a served request for latency/SLO purposes),
+            ``upstream_error`` (the replica answered 4xx/5xx —
+            relayed, but its ~1 ms error turnaround must not drag the
+            served-latency histograms down), ``cancelled`` (the
+            client hung up mid-stream), or ``retry`` (retry ONLY when
+            nothing reached the replica)."""
             url = urlsplit(replica.url)
             # two timeouts, two failure classes: a replica that cannot
             # even ACCEPT within connect_timeout_s is retry-safe
@@ -265,9 +365,14 @@ def make_fleet_handler(manager: FleetManager, admission: FairAdmission,
                     return "retry"    # out connecting: nothing sent
                 conn.sock.settimeout(read_timeout_s)
                 try:
+                    # propagate the request identity + tenant so the
+                    # replica's spans key on the SAME rid the router's
+                    # do — the whole point of the stitcher
                     conn.request(
                         "POST", "/generate", body=raw,
-                        headers={"Content-Type": "application/json"})
+                        headers={"Content-Type": "application/json",
+                                 "X-Request-Id": rid,
+                                 "X-Tenant": tenant})
                 except OSError:
                     # send failed: the replica never got a complete
                     # request — still retry-safe
@@ -277,7 +382,7 @@ def make_fleet_handler(manager: FleetManager, admission: FairAdmission,
                 except socket.timeout:
                     stats.bump("proxy_timeouts_total")
                     self._send(504, {"error": "replica timed out"})
-                    return "done"
+                    return "failed"
                 except OSError:
                     # the request WAS delivered and may be executing:
                     # retrying would double-run it and inflate fleet
@@ -286,12 +391,11 @@ def make_fleet_handler(manager: FleetManager, admission: FairAdmission,
                     stats.bump("proxy_errors_total")
                     self._send(502, {
                         "error": "replica failed before responding"})
-                    return "done"
+                    return "failed"
                 ct = resp.getheader("Content-Type",
                                     "application/json")
                 if ct.startswith("text/event-stream"):
-                    self._relay_sse(resp, conn, ct)
-                    return "done"
+                    return self._relay_sse(resp, conn, ct, holder)
                 try:
                     data = resp.read()
                 except (http.client.HTTPException, OSError):
@@ -302,21 +406,35 @@ def make_fleet_handler(manager: FleetManager, admission: FairAdmission,
                     stats.bump("proxy_errors_total")
                     self._send(502, {
                         "error": "replica failed mid-response"})
-                    return "done"
+                    return "failed"
                 self._send_raw(resp.status, data, ct)
-                return "done"
+                # the replica's own error responses (429 queue-full,
+                # 400 bad body, 500) relay verbatim but are NOT
+                # served requests: the replica itself excludes them
+                # from its e2e histogram, and a flood of ~1 ms 400s
+                # would otherwise collapse the router's p50
+                return "done" if resp.status < 400 else "upstream_error"
             finally:
                 conn.close()
 
-        def _relay_sse(self, resp, conn, content_type: str) -> None:
+        def _relay_sse(self, resp, conn, content_type: str,
+                       holder: dict) -> str:
             """Stream the replica's SSE bytes through as they arrive
             (line-granular: events are ``data: ...\\n\\n`` frames, and
             flushing on the blank separator keeps TTFT real). A client
             disconnect closes the upstream connection — serve.py turns
-            that into a slot-engine cancel."""
+            that into a slot-engine cancel. The first relayed payload
+            line stamps the router-observed TTFT into ``holder`` (the
+            SLO check) and the router's TTFT histogram. Returns the
+            ``_proxy`` verdict: ``done`` only when the replica closed
+            the stream itself — a truncated stream (``failed``) or a
+            client hang-up (``cancelled``) is not a served request,
+            same carve-out as the non-stream 504/502 paths."""
             self.send_response(resp.status)
             self.send_header("Content-Type", content_type)
             self.send_header("Cache-Control", "no-cache")
+            if self._rid:
+                self.send_header("X-Request-Id", self._rid)
             self.end_headers()
             try:
                 while True:
@@ -324,9 +442,14 @@ def make_fleet_handler(manager: FleetManager, admission: FairAdmission,
                         line = resp.readline()
                     except (http.client.HTTPException, OSError):
                         stats.bump("proxy_errors_total")
-                        return   # replica died mid-stream: truncate
+                        return "failed"   # died mid-stream: truncate
                     if not line:
-                        return   # upstream closed: stream complete
+                        return "done"     # upstream closed: complete
+                    if ("ttft_s" not in holder
+                            and line.startswith(b"data:")):
+                        ttft = time.monotonic() - holder["t0"]
+                        holder["ttft_s"] = ttft
+                        stats.ttft_hist.observe(ttft)
                     self.wfile.write(line)
                     if line == b"\n":
                         self.wfile.flush()
@@ -334,6 +457,7 @@ def make_fleet_handler(manager: FleetManager, admission: FairAdmission,
                 stats.bump("client_disconnects_total")
                 # closing the upstream socket (finally in _proxy) is
                 # the cancellation signal to the replica
+                return "cancelled"
 
     return Handler
 
@@ -342,10 +466,13 @@ def build_router(manager: FleetManager, admission: FairAdmission,
                  host: str = "127.0.0.1", port: int = 0,
                  stats: Optional[RouterStats] = None,
                  allow_admin: bool = False,
-                 read_timeout_s: float = 600.0) -> ThreadingHTTPServer:
+                 read_timeout_s: float = 600.0,
+                 tracer=None, slo=None) -> ThreadingHTTPServer:
     """Bind the front-door server (``port`` 0 picks a free one; the
-    bound address is ``server.server_address``)."""
+    bound address is ``server.server_address``). ``tracer``/``slo``
+    attach the request-scoped tracing + SLO layer
+    (observability/reqtrace.py) — optional, None = off."""
     handler = make_fleet_handler(
         manager, admission, stats=stats, allow_admin=allow_admin,
-        read_timeout_s=read_timeout_s)
+        read_timeout_s=read_timeout_s, tracer=tracer, slo=slo)
     return ThreadingHTTPServer((host, port), handler)

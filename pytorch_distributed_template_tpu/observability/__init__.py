@@ -1,6 +1,9 @@
 from .crosshost import CrossHostAggregator
 from .health import EwmaDetector, HealthMonitor, health_counters
 from .logging import setup_logging
+from .reqtrace import (
+    RequestTracer, SloWatcher, mint_request_id, sanitize_request_id,
+)
 from .tb import TensorboardWriter
 from .telemetry import FlightRecorder, read_jsonl
 from .trace import SpanRecorder, get_recorder, span

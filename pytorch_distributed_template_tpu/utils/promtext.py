@@ -4,17 +4,152 @@ Shared by both serving tiers — ``serve.py`` (prefix ``pdt_serve``) and
 the fleet router (``pdt_fleet``) — and deliberately in utils/: the
 single-replica server must not import the fleet built on top of it for
 a formatting helper, and the fleet must stay jax-free. Stdlib-only.
+
+Besides counters and gauges this module owns the latency HISTOGRAM
+support (ISSUE 8): fixed-bucket :class:`LatencyHistogram` instances
+for TTFT/TPOT/e2e whose snapshots render as proper
+``_bucket``/``_sum``/``_count`` series. Fixed buckets are the point —
+bucket counters from N replicas SUM into a fleet-level histogram
+(fleet/replicas.py aggregates them reset-corrected), which is the only
+honest way to get fleet-level percentiles; averaging per-replica
+percentile gauges is not aggregation.
 """
 from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+#: fixed latency buckets in seconds, shared by every exporter so
+#: fleet-level aggregation is a per-bucket sum. Range covers sub-10ms
+#: cache hits through multi-minute long-context generations.
+LATENCY_BUCKETS_S = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+                     1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0)
+_INF = "+Inf"
+
+
+def percentile(sorted_vals: List[float], q: float) -> Optional[float]:
+    """Linear-interpolation percentile over a pre-sorted list (the
+    numpy/``histogram_quantile`` convention). THE one percentile
+    helper for client- and server-side latency summaries — loadgen,
+    the trace stitcher, and the engines all route through it so their
+    percentiles never drift onto different conventions."""
+    if not sorted_vals:
+        return None
+    if len(sorted_vals) == 1:
+        return sorted_vals[0]
+    pos = q * (len(sorted_vals) - 1)
+    lo = int(pos)
+    hi = min(lo + 1, len(sorted_vals) - 1)
+    frac = pos - lo
+    return sorted_vals[lo] + (sorted_vals[hi] - sorted_vals[lo]) * frac
+
+
+class LatencyHistogram:
+    """Thread-safe fixed-bucket histogram (Prometheus semantics).
+
+    ``snapshot()`` returns ``{"buckets": {le: cumulative_count, ...,
+    "+Inf": n}, "sum": seconds, "count": n}`` — cumulative counts, so
+    snapshots from different processes aggregate by plain per-key
+    addition and ``histogram_quantile`` reads them directly."""
+
+    def __init__(self, buckets=LATENCY_BUCKETS_S):
+        self.buckets = tuple(float(b) for b in buckets)
+        self._lock = threading.Lock()
+        self._counts = [0] * (len(self.buckets) + 1)   # +Inf last
+        self._sum = 0.0
+        self._count = 0
+
+    def observe(self, seconds: float) -> None:
+        s = float(seconds)
+        i = len(self.buckets)
+        for j, b in enumerate(self.buckets):
+            if s <= b:
+                i = j
+                break
+        with self._lock:
+            self._counts[i] += 1
+            self._sum += s
+            self._count += 1
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            counts = list(self._counts)
+            total, count = self._sum, self._count
+        cum, buckets = 0, {}
+        for b, c in zip(self.buckets, counts):
+            cum += c
+            buckets[f"{b:g}"] = cum
+        buckets[_INF] = count
+        return {"buckets": buckets, "sum": round(total, 6),
+                "count": count}
+
+
+def is_histogram(value) -> bool:
+    """Does this metrics-dict value carry a histogram snapshot?"""
+    return (isinstance(value, dict) and "buckets" in value
+            and "count" in value
+            and isinstance(value["buckets"], dict))
+
+
+def zero_histogram() -> dict:
+    """An empty cumulative snapshot (aggregation identity)."""
+    buckets = {f"{b:g}": 0 for b in LATENCY_BUCKETS_S}
+    buckets[_INF] = 0
+    return {"buckets": buckets, "sum": 0.0, "count": 0}
+
+
+def add_histograms(into: dict, other: dict, scale: float = 1.0) -> dict:
+    """``into += other * scale`` per bucket/sum/count (scale -1 gives
+    subtraction — the reset-correction delta in fleet/replicas.py).
+    Mutates and returns ``into``; bucket keys are unioned."""
+    for le, c in (other.get("buckets") or {}).items():
+        into["buckets"][le] = (into["buckets"].get(le, 0)
+                               + scale * int(c))
+    into["sum"] = round(into.get("sum", 0.0)
+                        + scale * float(other.get("sum", 0.0)), 6)
+    into["count"] = int(into.get("count", 0)
+                        + scale * int(other.get("count", 0)))
+    return into
+
+
+def histogram_quantile(snapshot: dict, q: float) -> Optional[float]:
+    """Prometheus-style quantile estimate from a cumulative-bucket
+    snapshot: linear interpolation inside the bucket the quantile rank
+    lands in (the ``+Inf`` bucket clamps to the largest finite bound).
+    None when the histogram is empty."""
+    count = int(snapshot.get("count", 0))
+    if count <= 0:
+        return None
+    pairs: List[tuple] = []
+    inf_count = None
+    for le, c in (snapshot.get("buckets") or {}).items():
+        if le == _INF:
+            inf_count = int(c)
+            continue
+        pairs.append((float(le), int(c)))
+    pairs.sort()
+    rank = q * count
+    prev_le, prev_c = 0.0, 0
+    for le, c in pairs:
+        if c >= rank:
+            span = c - prev_c
+            frac = ((rank - prev_c) / span) if span > 0 else 1.0
+            return round(prev_le + (le - prev_le) * frac, 6)
+        prev_le, prev_c = le, c
+    # rank lands in +Inf: clamp to the largest finite bound
+    del inf_count
+    return round(pairs[-1][0], 6) if pairs else None
 
 
 def prometheus_text(metrics: dict, prefix: str = "pdt_serve") -> str:
     """Flat numeric fields -> Prometheus exposition format.
 
     Counters get a ``_total``-suffix-preserving counter TYPE;
-    everything else is a gauge. Nested dicts (latency percentiles)
-    flatten with an underscore; bools and the ``scheduler`` label
-    stay out (numeric series only)."""
+    histogram snapshots (see :func:`is_histogram`) render as
+    ``_bucket{le=...}`` + ``_sum`` + ``_count`` with TYPE histogram;
+    everything else is a gauge. Other nested dicts (latency
+    percentiles) flatten with an underscore; bools and the
+    ``scheduler`` label stay out (numeric series only)."""
     lines = []
 
     def emit(name: str, value) -> None:
@@ -22,11 +157,25 @@ def prometheus_text(metrics: dict, prefix: str = "pdt_serve") -> str:
         lines.append(f"# TYPE {prefix}_{name} {kind}")
         lines.append(f"{prefix}_{name} {value}")
 
+    def emit_histogram(name: str, snap: dict) -> None:
+        lines.append(f"# TYPE {prefix}_{name} histogram")
+        items = [(le, c) for le, c in snap["buckets"].items()]
+        items.sort(key=lambda kv: (kv[0] == _INF,
+                                   float(kv[0]) if kv[0] != _INF
+                                   else 0.0))
+        for le, c in items:
+            lines.append(
+                f'{prefix}_{name}_bucket{{le="{le}"}} {int(c)}')
+        lines.append(f"{prefix}_{name}_sum {snap.get('sum', 0.0)}")
+        lines.append(f"{prefix}_{name}_count {int(snap['count'])}")
+
     for k, v in metrics.items():
         if isinstance(v, bool) or k == "scheduler":
             continue
         if isinstance(v, (int, float)):
             emit(k, v)
+        elif is_histogram(v):
+            emit_histogram(k, v)
         elif isinstance(v, dict):
             for kk, vv in v.items():
                 if isinstance(vv, (int, float)):

@@ -51,6 +51,9 @@ from pytorch_distributed_template_tpu.fleet.replicas import (  # noqa: E402
 from pytorch_distributed_template_tpu.fleet.router import (  # noqa: E402
     build_router,
 )
+from pytorch_distributed_template_tpu.observability.reqtrace import (  # noqa: E402
+    RequestTracer, SloWatcher,
+)
 from pytorch_distributed_template_tpu.resilience.supervisor import (  # noqa: E402
     SupervisorConfig,
 )
@@ -131,6 +134,21 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--admin", action="store_true",
                    help="enable POST /admin/kill and /admin/drain "
                         "(chaos injection, rolling restarts)")
+    # request tracing + SLO (observability/reqtrace.py)
+    p.add_argument("--reqtrace", default="on", choices=("on", "off"),
+                   help="request-scoped span tracing: the router "
+                        "mints/propagates X-Request-Id and appends "
+                        "its spans to <run-dir>/spans.jsonl "
+                        "(scripts/trace_stitch.py merges them with "
+                        "the replicas' into one cross-process trace)")
+    p.add_argument("--slo-ttft-s", type=float, default=0.0,
+                   help="router-observed TTFT SLO threshold (streamed "
+                        "requests): breaches bump slo_breach_total on "
+                        "/metrics + bounded slow-request dumps under "
+                        "--run-dir (0 = off)")
+    p.add_argument("--slo-e2e-s", type=float, default=0.0,
+                   help="router-observed end-to-end SLO threshold "
+                        "(0 = off)")
     return p
 
 
@@ -158,6 +176,15 @@ def main(argv=None) -> int:
                    "-s", str(run_dir / rid / "save")]
             if args.config:
                 cmd += ["-c", args.config]
+            # replicas inherit the fleet's SLO/tracing posture (the
+            # ISSUE 8 contract puts slo_breach_total on BOTH router
+            # and replica /metrics); explicit flags after -- still win
+            if args.slo_ttft_s:
+                cmd += ["--slo-ttft-s", str(args.slo_ttft_s)]
+            if args.slo_e2e_s:
+                cmd += ["--slo-e2e-s", str(args.slo_e2e_s)]
+            if args.reqtrace == "off":
+                cmd += ["--reqtrace", "off"]
             cmd += rest
             replicas.append(Replica(
                 rid, cmd=cmd, run_dir=run_dir,
@@ -180,9 +207,19 @@ def main(argv=None) -> int:
         queue_timeout_s=args.queue_timeout_s)
     # recoveries must re-open the gate for queued waiters immediately
     manager.on_capacity_change = admission.kick
+    # request tracing + SLO plumbing (ISSUE 8): the router is the
+    # first hop — it mints X-Request-Id, records admission-wait and
+    # proxy-hop spans to <run-dir>/spans.jsonl, and checks TTFT/e2e
+    # SLOs against the thresholds (bounded slow_request_<rid>.json
+    # dumps land in --run-dir, counters on /metrics)
+    tracer = (RequestTracer(run_dir / "spans.jsonl", process="router")
+              if args.reqtrace != "off" else None)
+    slo = SloWatcher(ttft_s=args.slo_ttft_s, e2e_s=args.slo_e2e_s,
+                     dump_dir=run_dir, tracer=tracer)
     server = build_router(manager, admission, host=args.host,
                           port=args.port, allow_admin=args.admin,
-                          read_timeout_s=args.read_timeout_s)
+                          read_timeout_s=args.read_timeout_s,
+                          tracer=tracer, slo=slo)
 
     draining = threading.Event()
 

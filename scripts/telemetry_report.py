@@ -314,6 +314,40 @@ def analyze_fleet(path) -> dict:
     return {k: v for k, v in out.items() if v is not None}
 
 
+def analyze_reqtrace(run_dir=None, span_files=None) -> dict:
+    """Request-scoped tracing section (ISSUE 8): stitch every
+    ``spans.jsonl`` under the run dir (router + replicas) into
+    cross-process request timelines and fold the tail-latency
+    attribution into a flat table — stitched/partial counts, segment
+    p50/p99s, coverage (attributed fraction of e2e, residual NOT
+    hidden), and how many bounded slow-request SLO dumps the run left
+    behind. ``scripts/trace_stitch.py`` renders the full per-request
+    tables and the Perfetto trace from the same machinery."""
+    from pytorch_distributed_template_tpu.observability import reqtrace
+
+    files = reqtrace.resolve_span_files(span_files, run_dir)
+    if not files:
+        return {}
+    spans = reqtrace.load_spans(files)
+    if not spans:
+        return {}
+    report = reqtrace.stitch_spans(spans)
+    att = reqtrace.attribution(report)
+    out: dict = {
+        "span_files": len(files),
+        "requests": report["counts"]["requests"],
+        "stitched": report["counts"]["stitched"],
+        "partial": report["counts"]["partial"],
+    }
+    for k, v in att.items():
+        if isinstance(v, (int, float)):
+            out[k] = v
+    if run_dir is not None:
+        out["slow_request_dumps"] = len(
+            list(Path(run_dir).rglob("slow_request_*.json")))
+    return out
+
+
 def analyze_anomalies(run_dir) -> dict:
     """Summarize the ``anomaly_*.json`` forensic bundles in a run dir."""
     files = sorted(Path(run_dir).glob("anomaly_*.json"))
@@ -411,6 +445,8 @@ def to_markdown(report: dict) -> str:
     table("Prefix cache (serving)", report.get("prefix_cache", {}))
     table("Supervisor", report.get("supervisor", {}))
     table("Fleet (router)", report.get("fleet", {}))
+    table("Request tracing (p99 attribution)",
+          report.get("reqtrace", {}))
     tr = report.get("trace") or {}
     if tr.get("top_spans"):
         lines.append("## Host spans (top by total time)")
@@ -479,6 +515,12 @@ def main(argv=None) -> int:
                         "fleet front door's lifecycle log, "
                         "scripts/serve_fleet.py --run-dir; --run-dir "
                         "here also auto-discovers one)")
+    p.add_argument("--spans", type=str, nargs="*", default=None,
+                   help="explicit spans.jsonl paths for the "
+                        "request-tracing section (--run-dir also "
+                        "auto-discovers every spans.jsonl under it; "
+                        "scripts/trace_stitch.py renders the full "
+                        "per-request tables + Perfetto trace)")
     p.add_argument("--bench", type=str, default=None,
                    help="bench output: final-line JSON file or a "
                         "captured stdout stream (tee)")
@@ -527,6 +569,11 @@ def main(argv=None) -> int:
             fleet_path = cand if cand.exists() else None
         if fleet_path is not None:
             report["fleet"] = analyze_fleet(fleet_path)
+        if args.spans or run_dir is not None:
+            rt = analyze_reqtrace(run_dir=run_dir,
+                                  span_files=args.spans)
+            if rt:
+                report["reqtrace"] = rt
         if run_dir is not None:
             report["anomalies"] = analyze_anomalies(run_dir)
         bench = None

@@ -117,6 +117,9 @@ from pytorch_distributed_template_tpu.observability.health import (  # noqa: E40
 from pytorch_distributed_template_tpu.observability.profiler import (  # noqa: E402
     OnDemandProfiler,
 )
+from pytorch_distributed_template_tpu.observability.reqtrace import (  # noqa: E402
+    RequestTracer, SloWatcher, mint_request_id, sanitize_request_id,
+)
 from pytorch_distributed_template_tpu.observability.telemetry import (  # noqa: E402
     compile_cache_stats,
 )
@@ -152,10 +155,13 @@ def supervisor_restart_stats() -> dict:
 
 
 def _run_request(service: GenerationService, req: dict,
-                 on_tokens=None, cancel=None) -> dict:
+                 on_tokens=None, cancel=None,
+                 request_id=None) -> dict:
     """JSON request body -> GenerationService.generate kwargs. All
     encoding/validation/dispatch logic lives in the service (shared
-    with generate.py); this only maps the wire format."""
+    with generate.py); this only maps the wire format. ``request_id``
+    is the trace id from the ``X-Request-Id`` header (minted here when
+    the client sent none) — it keys the request's spans end to end."""
     kwargs = dict(
         prompt=req.get("prompt"),
         prompt_ids=req.get("prompt_ids"),
@@ -166,6 +172,7 @@ def _run_request(service: GenerationService, req: dict,
         seed=int(req.get("seed", 0)),
         speculative=int(req.get("speculative", 0)),
         stop=req.get("stop"),
+        request_id=request_id,
     )
     if on_tokens is not None:
         kwargs["on_tokens"] = on_tokens
@@ -273,6 +280,16 @@ def service_metrics(service: GenerationService) -> dict:
     out["anomaly_total"] = int(hc["anomaly_total"])
     out["straggler_windows_total"] = int(hc["straggler_windows_total"])
     out["profile_captures_total"] = int(hc["profile_captures_total"])
+    # request-tracing layer (ISSUE 8): fixed-bucket latency histograms
+    # (TTFT/TPOT/e2e — aggregable fleet-wide by bucket sums, unlike the
+    # percentile gauges above) and the SLO breach counters + bounded
+    # slow-request-dump count
+    hist = getattr(service, "hist", None)
+    if hist:
+        for k, h in hist.items():
+            out[k] = h.snapshot()
+    if hasattr(service, "slo_stats"):
+        out.update(service.slo_stats())
     # resilience-supervisor counters (when supervised / a log exists):
     # restarts_total scrapes as a counter; the cause string is JSON-only
     # (prometheus_text emits numeric fields exclusively)
@@ -311,15 +328,19 @@ class ActiveRequests:
 
 
 def make_handler(service: GenerationService, profiler=None,
-                 active: ActiveRequests | None = None):
+                 active: ActiveRequests | None = None, tracer=None):
     active = active or ActiveRequests()
 
     class Handler(BaseHTTPRequestHandler):
+        _rid = None   # set per /generate request; echoed on responses
+
         def _send(self, code: int, payload: dict) -> None:
             body = json.dumps(payload).encode("utf-8")
             self.send_response(code)
             self.send_header("Content-Type", "application/json")
             self.send_header("Content-Length", str(len(body)))
+            if self._rid:
+                self.send_header("X-Request-Id", self._rid)
             self.end_headers()
             self.wfile.write(body)
 
@@ -373,16 +394,37 @@ def make_handler(service: GenerationService, profiler=None,
                 return self._profile(query)
             if path != "/generate":
                 return self._send(404, {"error": "unknown path"})
+            # request identity (ISSUE 8): honor a propagated
+            # X-Request-Id (the fleet router mints one for fleet
+            # traffic), mint for direct traffic, echo on EVERY
+            # response — a client log line joins server-side spans
+            rid = (sanitize_request_id(self.headers.get("X-Request-Id"))
+                   or mint_request_id())
+            self._rid = rid
+            t0 = time.monotonic()
+            stream = False
             try:
                 n = int(self.headers.get("Content-Length", 0))
                 req = json.loads(self.rfile.read(n) or b"{}")
-                if req.get("stream"):
-                    return self._stream(req)
-                self._send(200, _run_request(service, req))
+                stream = bool(req.get("stream"))
+                if stream:
+                    return self._stream(req, rid)
+                out = _run_request(service, req, request_id=rid)
+                out["request_id"] = rid
+                self._send(200, out)
             except ValueError as e:
-                self._send(400, {"error": str(e)})
+                self._send(400, {"error": str(e), "request_id": rid})
             except Exception as e:  # surface, don't kill the server
-                self._send(500, {"error": f"{type(e).__name__}: {e}"})
+                self._send(500, {"error": f"{type(e).__name__}: {e}",
+                                 "request_id": rid})
+            finally:
+                if tracer is not None:
+                    # the replica-side handler span: receive -> last
+                    # byte out (SSE tail included) — the stitcher's
+                    # "replica" envelope for this request
+                    tracer.add(rid, "http", t0, time.monotonic(),
+                               stream=stream)
+                self._rid = None
 
         def _profile(self, query: str) -> None:
             """``POST /profile?steps=N[&timeout_s=S]``: on-demand
@@ -431,7 +473,7 @@ def make_handler(service: GenerationService, profiler=None,
                     else 500 if "error" in out else 200)
             self._send(code, out)
 
-        def _stream(self, req: dict) -> None:
+        def _stream(self, req: dict, rid=None) -> None:
             """Server-sent events: one ``data:`` line per absorbed
             token batch (``{"ids": [...]}``' deltas concatenate to the
             final ids), then a final ``data:`` carrying the complete
@@ -469,7 +511,9 @@ def make_handler(service: GenerationService, profiler=None,
                         service, req,
                         on_tokens=(lambda ids: q.put(("tokens", ids)))
                         if incremental else None,
-                        cancel=cancel_evt)
+                        cancel=cancel_evt, request_id=rid)
+                    if rid:
+                        r["request_id"] = rid
                     out["r"] = r
                     if not incremental and r.get("ids"):
                         q.put(("tokens", r["ids"]))  # one final delta
@@ -481,6 +525,8 @@ def make_handler(service: GenerationService, profiler=None,
             self.send_response(200)
             self.send_header("Content-Type", "text/event-stream")
             self.send_header("Cache-Control", "no-cache")
+            if rid:
+                self.send_header("X-Request-Id", rid)
             self.end_headers()
 
             def emit(payload: dict) -> None:
@@ -550,6 +596,24 @@ def main(args, config):
     # draft_layers); 0 keeps n-gram prompt lookup
     spec_draft_layers = int((config.get("serving") or {}).get(
         "speculative_draft_layers") or 0)
+    # request-scoped tracing + SLO layer (ISSUE 8): the tracer appends
+    # this process's request-keyed spans to <save_dir>/spans.jsonl
+    # (scripts/trace_stitch.py merges them with the router's into one
+    # cross-process timeline); the SLO watcher turns configured
+    # TTFT/e2e thresholds into slo_breach_total on /metrics + bounded
+    # slow_request_<rid>.json dumps. Thresholds: CLI wins, else the
+    # config's serving.slo block; no thresholds = counters stay 0.
+    tracer = None
+    if args.reqtrace != "off":
+        tracer = RequestTracer(config.save_dir / "spans.jsonl",
+                               process="serve")
+    slo_cfg = dict((config.get("serving") or {}).get("slo") or {})
+    slo = SloWatcher(
+        ttft_s=(args.slo_ttft_s or slo_cfg.get("ttft_s")),
+        e2e_s=(args.slo_e2e_s or slo_cfg.get("e2e_s")),
+        dump_dir=config.save_dir, tracer=tracer,
+        max_dumps=int(slo_cfg.get("max_dumps", 8)),
+        cooldown_s=float(slo_cfg.get("cooldown_s", 30.0)))
     want = args.scheduler
     if want == "auto":
         want = ("continuous" if probe._pad_ok and args.max_batch > 1
@@ -572,6 +636,7 @@ def main(args, config):
             chunk=args.decode_chunk, window_ms=args.batch_window_ms,
             warm_buckets=warm_buckets, prefix_cache=prefix_cfg,
             recorder=recorder, spec_draft_layers=spec_draft_layers,
+            tracer=tracer, slo=slo,
         )
     elif want == "static":
         # the static micro-batch scheduler's shared-group prefill does
@@ -581,13 +646,15 @@ def main(args, config):
             model, params, tok, max_batch=args.max_batch,
             window_ms=args.batch_window_ms,
             spec_draft_layers=spec_draft_layers,
+            tracer=tracer, slo=slo,
         )
-    else:  # plain serialized service — rebuilt so the pool attaches
-        service = (GenerationService.from_model(
+    else:  # plain serialized service — rebuilt so the pool/tracer
+        # attach (from_model on loaded params is cheap; the probe has
+        # neither)
+        service = GenerationService.from_model(
             model, params, tok, prefix_cache=prefix_cfg,
-            spec_draft_layers=spec_draft_layers)
-            if prefix_cfg.get("enabled") or spec_draft_layers
-            else probe)
+            spec_draft_layers=spec_draft_layers,
+            tracer=tracer, slo=slo)
     logger.info("scheduler: %s", type(service).__name__)
     # on-demand profiling (POST /profile): captures land next to the
     # serving run's logs
@@ -595,7 +662,8 @@ def main(args, config):
     active = ActiveRequests()
     server = ThreadingHTTPServer(
         (args.host, args.port),
-        make_handler(service, profiler=profiler, active=active)
+        make_handler(service, profiler=profiler, active=active,
+                     tracer=tracer)
     )
     # drain on SIGTERM (the preemption path, same contract as the
     # trainer's): stop accepting, let in-flight requests finish
@@ -679,6 +747,24 @@ if __name__ == "__main__":
                              "(system / few-shot preambles) admit as "
                              "an HBM block copy + suffix-only prefill "
                              "instead of a full recompute")
+    parser.add_argument("--reqtrace", default="on",
+                        choices=("on", "off"),
+                        help="request-scoped span tracing "
+                             "(observability/reqtrace.py): appends "
+                             "X-Request-Id-keyed spans to "
+                             "<save_dir>/spans.jsonl for the "
+                             "cross-process stitcher "
+                             "(scripts/trace_stitch.py)")
+    parser.add_argument("--slo-ttft-s", default=0.0, type=float,
+                        help="TTFT SLO threshold in seconds: breaches "
+                             "bump slo_breach_total on /metrics and "
+                             "write bounded slow_request_<rid>.json "
+                             "dumps (0 = use config serving.slo, else "
+                             "off)")
+    parser.add_argument("--slo-e2e-s", default=0.0, type=float,
+                        help="end-to-end latency SLO threshold in "
+                             "seconds (0 = use config serving.slo, "
+                             "else off)")
     parser.add_argument("--drain-grace-s", default=30.0, type=float,
                         help="SIGTERM drain: how long to wait for "
                              "in-flight requests to finish before "

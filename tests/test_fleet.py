@@ -13,6 +13,8 @@ recovery, and a clean SIGTERM fleet drain with no orphans.
 import json
 import os
 import signal
+import socket
+import struct
 import subprocess
 import sys
 import threading
@@ -212,11 +214,13 @@ class FakeReplica:
     optional per-request delay, SSE when asked."""
 
     def __init__(self, slots=4, delay_s=0.0, sse_deltas=2, port=0,
-                 sse_delay_s=0.01):
+                 sse_delay_s=0.01, error_code=None, sse_die_after=0):
         self.slots = slots
         self.delay_s = delay_s
         self.sse_deltas = sse_deltas
         self.sse_delay_s = sse_delay_s
+        self.error_code = error_code          # answer every POST with it
+        self.sse_die_after = sse_die_after    # RST after N SSE frames
         self.broken_pipes = 0
         self.queue_depth = 0
         self.requests = []
@@ -253,10 +257,14 @@ class FakeReplica:
                 with fake._lock:
                     fake.requests.append(
                         {"body": body,
-                         "tenant": self.headers.get("X-Tenant")})
+                         "tenant": self.headers.get("X-Tenant"),
+                         "rid": self.headers.get("X-Request-Id")})
                     fake.counters["requests_total"] += 1
                 if fake.delay_s:
                     time.sleep(fake.delay_s)
+                if fake.error_code:
+                    return self._json(fake.error_code,
+                                      {"error": "synthetic"})
                 ids = list(range(body.get("max_new_tokens", 4)))
                 if body.get("stream"):
                     self.send_response(200)
@@ -264,12 +272,26 @@ class FakeReplica:
                                      "text/event-stream")
                     self.end_headers()
                     per = max(len(ids) // fake.sse_deltas, 1)
+                    sent = 0
                     try:
                         for i in range(0, len(ids), per):
                             chunk = json.dumps({"ids": ids[i:i + per]})
                             self.wfile.write(
                                 b"data: " + chunk.encode() + b"\n\n")
                             self.wfile.flush()
+                            sent += 1
+                            if (fake.sse_die_after
+                                    and sent >= fake.sse_die_after):
+                                # simulate a replica crash mid-stream:
+                                # SO_LINGER 0 turns close() into a TCP
+                                # RST, so the router's readline raises
+                                # instead of seeing a clean EOF
+                                self.connection.setsockopt(
+                                    socket.SOL_SOCKET,
+                                    socket.SO_LINGER,
+                                    struct.pack("ii", 1, 0))
+                                self.connection.close()
+                                return
                             time.sleep(fake.sse_delay_s)
                         fin = json.dumps({"ids": ids, "done": True})
                         self.wfile.write(
@@ -719,6 +741,297 @@ def test_telemetry_report_fleet_section(tmp_path):
     assert fleet["recovery_s_mean"] == 14.2
     assert fleet["fleet_prefix_hit_tokens_total"] == 1920
     assert abs(fleet["prefix_routed_frac"] - 31 / 43) < 0.01
+
+
+# ---------------------------------------------------------------------------
+# request-scoped tracing through the router (ISSUE 8)
+# ---------------------------------------------------------------------------
+
+
+def test_router_request_id_round_trip_spans_and_slo(tmp_path):
+    """The tracing contract at the front door: a client-supplied
+    X-Request-Id is honored, propagated to the replica, echoed on the
+    response, and keys the router's admission_wait/proxy/request spans
+    in its spans.jsonl; an absent/hostile id gets a minted one. The
+    sub-latency SLO threshold proves the breach path (counter + dump),
+    and the router's own latency histograms fill."""
+    from pytorch_distributed_template_tpu.observability.reqtrace import (
+        RequestTracer, SloWatcher,
+    )
+
+    fakes = [FakeReplica()]
+    manager = _mk_fleet(tmp_path, fakes)
+    tracer = RequestTracer(tmp_path / "spans.jsonl", process="router")
+    slo = SloWatcher(e2e_s=1e-9, dump_dir=tmp_path / "dumps",
+                     tracer=tracer, cooldown_s=0.0)
+    server, _, url = _router(manager, tracer=tracer, slo=slo)
+    try:
+        req = urllib.request.Request(
+            url + "/generate",
+            data=json.dumps({"prompt_ids": [1] * 8,
+                             "max_new_tokens": 2}).encode(),
+            headers={"Content-Type": "application/json",
+                     "X-Request-Id": "cli-42", "X-Tenant": "acme"})
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            assert resp.status == 200
+            assert resp.headers["X-Request-Id"] == "cli-42"  # echoed
+        assert fakes[0].requests[-1]["rid"] == "cli-42"   # propagated
+        # hostile id: replaced by a minted one (still echoed)
+        req = urllib.request.Request(
+            url + "/generate",
+            data=json.dumps({"prompt_ids": [2] * 8,
+                             "max_new_tokens": 2}).encode(),
+            headers={"Content-Type": "application/json",
+                     "X-Request-Id": "../../etc/passwd"})
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            minted = resp.headers["X-Request-Id"]
+        assert minted and minted != "../../etc/passwd"
+        assert fakes[0].requests[-1]["rid"] == minted
+        tracer.flush()
+        recs = [json.loads(l) for l in
+                (tmp_path / "spans.jsonl").read_text().splitlines()]
+        spans_42 = [r for r in recs if r.get("rid") == "cli-42"]
+        names = {r["name"] for r in spans_42}
+        assert {"admission_wait", "proxy", "request"} <= names
+        by_name = {r["name"]: r for r in spans_42}
+        assert by_name["proxy"]["attrs"]["replica"] == "r0"
+        assert by_name["request"]["attrs"]["tenant"] == "acme"
+        assert by_name["request"]["attrs"]["outcome"] == "proxied"
+        # SLO: the 1 ns threshold breached on both requests, counters
+        # scrape via /metrics and the bounded dump carries a timeline
+        m = _get_json(url, "/metrics?format=json")
+        assert m["slo_breach_total"] == 2
+        assert m["slo_dumps_written"] >= 1
+        assert list((tmp_path / "dumps").glob("slow_request_*.json"))
+        # the router's e2e histogram filled (aggregable buckets, not
+        # a percentile gauge) and renders as a proper prom histogram
+        assert m["router_e2e_seconds"]["count"] == 2
+        assert m["admission_wait_seconds"]["count"] == 2
+        text = prometheus_text(m, prefix="pdt_fleet")
+        assert 'pdt_fleet_router_e2e_seconds_bucket{le="+Inf"} 2' \
+            in text
+        assert "# TYPE pdt_fleet_router_e2e_seconds histogram" in text
+    finally:
+        server.shutdown()
+        tracer.close()
+        for f in fakes:
+            f.stop()
+
+
+def test_router_unserved_requests_stay_out_of_latency_slo(tmp_path):
+    """A request that never reached a replica (dead fleet -> 502/503
+    after admission) must NOT land in router_e2e_seconds or breach an
+    SLO — an outage would otherwise drag fleet p50 DOWN and dump
+    never-served requests as 'slow' — and its request span carries
+    the real outcome, not 'proxied'."""
+    from pytorch_distributed_template_tpu.observability.reqtrace import (
+        RequestTracer, SloWatcher,
+    )
+
+    fakes = [FakeReplica()]
+    manager = _mk_fleet(tmp_path, fakes)
+    fakes[0].stop()          # dies AFTER the health poll: still HEALTHY
+    tracer = RequestTracer(tmp_path / "spans.jsonl", process="router")
+    slo = SloWatcher(e2e_s=1e-9, dump_dir=tmp_path / "dumps",
+                     tracer=tracer)
+    server, _, url = _router(manager, tracer=tracer, slo=slo)
+    try:
+        code = None
+        try:
+            _post(url, {"prompt_ids": [1] * 8, "max_new_tokens": 2},
+                  headers={"X-Request-Id": "dead-1"}, timeout=30)
+        except urllib.error.HTTPError as e:
+            code = e.code
+        assert code in (502, 503)
+        m = _get_json(url, "/metrics?format=json")
+        assert m["router_e2e_seconds"]["count"] == 0
+        assert m["slo_breach_total"] == 0
+        tracer.flush()
+        recs = [json.loads(l) for l in
+                (tmp_path / "spans.jsonl").read_text().splitlines()]
+        req_span = next(r for r in recs if r.get("rid") == "dead-1"
+                        and r["name"] == "request")
+        assert req_span["attrs"]["outcome"] in ("unroutable",
+                                                "unreachable")
+    finally:
+        server.shutdown()
+        tracer.close()
+
+
+def test_router_replica_timeout_is_proxy_failed_not_served(tmp_path):
+    """A request that DISPATCHED but came back as a synthesized 504
+    (replica read timeout) is an in-flight casualty, not a served
+    request: out of the e2e histogram and the SLO, and its request
+    span says proxy_failed."""
+    from pytorch_distributed_template_tpu.observability.reqtrace import (
+        RequestTracer, SloWatcher,
+    )
+
+    fakes = [FakeReplica(delay_s=3.0)]
+    manager = _mk_fleet(tmp_path, fakes)
+    tracer = RequestTracer(tmp_path / "spans.jsonl", process="router")
+    slo = SloWatcher(e2e_s=1e-9, dump_dir=tmp_path / "dumps",
+                     tracer=tracer)
+    server, _, url = _router(manager, tracer=tracer, slo=slo,
+                             read_timeout_s=0.5)
+    try:
+        code = None
+        try:
+            _post(url, {"prompt_ids": [1] * 8, "max_new_tokens": 2},
+                  headers={"X-Request-Id": "late-1"}, timeout=30)
+        except urllib.error.HTTPError as e:
+            code = e.code
+        assert code == 504
+        m = _get_json(url, "/metrics?format=json")
+        assert m["proxy_timeouts_total"] == 1
+        assert m["router_e2e_seconds"]["count"] == 0
+        assert m["slo_breach_total"] == 0
+        tracer.flush()
+        recs = [json.loads(l) for l in
+                (tmp_path / "spans.jsonl").read_text().splitlines()]
+        req_span = next(r for r in recs if r.get("rid") == "late-1"
+                        and r["name"] == "request")
+        assert req_span["attrs"]["outcome"] == "proxy_failed"
+    finally:
+        server.shutdown()
+        tracer.close()
+        for f in fakes:
+            f.stop()
+
+
+def test_router_upstream_error_is_relayed_but_not_served(tmp_path):
+    """A replica's own 4xx relays verbatim (status + rid echo) but is
+    NOT a served request: a flood of ~1 ms 429/400 turnarounds must
+    not collapse the router's e2e p50 or trip the SLO — the replica
+    already excludes them from its own histogram."""
+    from pytorch_distributed_template_tpu.observability.reqtrace import (
+        RequestTracer, SloWatcher,
+    )
+
+    fakes = [FakeReplica(error_code=429)]
+    manager = _mk_fleet(tmp_path, fakes)
+    tracer = RequestTracer(tmp_path / "spans.jsonl", process="router")
+    slo = SloWatcher(e2e_s=1e-9, dump_dir=tmp_path / "dumps",
+                     tracer=tracer)
+    server, _, url = _router(manager, tracer=tracer, slo=slo)
+    try:
+        code, echoed = None, None
+        try:
+            _post(url, {"prompt_ids": [1] * 8, "max_new_tokens": 2},
+                  headers={"X-Request-Id": "flood-1"})
+        except urllib.error.HTTPError as e:
+            code = e.code
+            echoed = e.headers.get("X-Request-Id")
+        assert code == 429
+        assert echoed == "flood-1"
+        m = _get_json(url, "/metrics?format=json")
+        assert m["router_e2e_seconds"]["count"] == 0
+        assert m["slo_breach_total"] == 0
+        tracer.flush()
+        recs = [json.loads(l) for l in
+                (tmp_path / "spans.jsonl").read_text().splitlines()]
+        req_span = next(r for r in recs if r.get("rid") == "flood-1"
+                        and r["name"] == "request")
+        assert req_span["attrs"]["outcome"] == "upstream_error"
+    finally:
+        server.shutdown()
+        tracer.close()
+        for f in fakes:
+            f.stop()
+
+
+def test_router_replica_death_mid_sse_is_not_served(tmp_path):
+    """A replica that RSTs mid-stream is an in-flight casualty — same
+    carve-out as the non-stream 504/502 paths: the truncated request
+    stays out of the e2e histogram and the SLO even though its first
+    token (and so a real TTFT) was relayed."""
+    from pytorch_distributed_template_tpu.observability.reqtrace import (
+        RequestTracer, SloWatcher,
+    )
+
+    fakes = [FakeReplica(sse_deltas=4, sse_die_after=1,
+                         sse_delay_s=0.05)]
+    manager = _mk_fleet(tmp_path, fakes)
+    tracer = RequestTracer(tmp_path / "spans.jsonl", process="router")
+    slo = SloWatcher(e2e_s=1e-9, dump_dir=tmp_path / "dumps",
+                     tracer=tracer)
+    server, _, url = _router(manager, tracer=tracer, slo=slo)
+    try:
+        req = urllib.request.Request(
+            url + "/generate",
+            data=json.dumps({"prompt_ids": [1] * 8,
+                             "max_new_tokens": 8,
+                             "stream": True}).encode(),
+            headers={"Content-Type": "application/json",
+                     "X-Request-Id": "dead-sse-1"})
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            assert resp.headers.get("X-Request-Id") == "dead-sse-1"
+            resp.read()   # drain until the router truncates
+        m = _get_json(url, "/metrics?format=json")
+        assert m["proxy_errors_total"] == 1
+        assert m["router_e2e_seconds"]["count"] == 0
+        assert m["slo_breach_total"] == 0
+        # the first frame DID reach the client before the crash, so
+        # the router-observed TTFT is real and stays
+        assert m["router_ttft_seconds"]["count"] == 1
+        tracer.flush()
+        recs = [json.loads(l) for l in
+                (tmp_path / "spans.jsonl").read_text().splitlines()]
+        req_span = next(r for r in recs
+                        if r.get("rid") == "dead-sse-1"
+                        and r["name"] == "request")
+        assert req_span["attrs"]["outcome"] == "proxy_failed"
+    finally:
+        server.shutdown()
+        tracer.close()
+        for f in fakes:
+            f.stop()
+
+
+def test_router_stamps_ttft_on_sse_and_loadgen_rids_join(tmp_path):
+    """Streamed requests: the router's TTFT histogram stamps on the
+    first relayed SSE payload, and loadgen's deterministic rids ride
+    X-Request-Id end to end — the join key for the stitcher."""
+    from pytorch_distributed_template_tpu.observability.reqtrace import (
+        RequestTracer,
+    )
+
+    fakes = [FakeReplica(sse_deltas=2)]
+    manager = _mk_fleet(tmp_path, fakes)
+    tracer = RequestTracer(tmp_path / "spans.jsonl", process="router")
+    server, _, url = _router(manager, tracer=tracer)
+    try:
+        trace = build_trace(3, seed=5, prefix_groups=1, group_tag="t",
+                            prefix_len=8, suffix_len=4,
+                            max_new_tokens=4, stream_frac=1.0,
+                            rate_rps=50.0)
+        assert [t["rid"] for t in trace] == \
+            ["lg-t-5-0000", "lg-t-5-0001", "lg-t-5-0002"]
+        summary = summarize(replay(url, trace, timeout_s=30), trace)
+        assert summary["errors"] == 0
+        # the summary's by_request rows carry the SAME rids the
+        # replica saw — client measurements join server spans
+        assert {r["rid"] for r in summary["by_request"]} == \
+            {t["rid"] for t in trace}
+        assert all(r["total_s"] is not None
+                   for r in summary["by_request"])
+        assert {r["rid"] for r in fakes[0].requests} == \
+            {t["rid"] for t in trace}
+        m = _get_json(url, "/metrics?format=json")
+        assert m["router_ttft_seconds"]["count"] == 3   # SSE stamped
+        # streams the replica completed ARE served requests (the
+        # mid-stream-death carve-out must not leak into the happy path)
+        assert m["router_e2e_seconds"]["count"] == 3
+        tracer.flush()
+        recs = [json.loads(l) for l in
+                (tmp_path / "spans.jsonl").read_text().splitlines()]
+        assert {r.get("rid") for r in recs if r.get("name") ==
+                "request"} == {t["rid"] for t in trace}
+    finally:
+        server.shutdown()
+        tracer.close()
+        for f in fakes:
+            f.stop()
 
 
 # ---------------------------------------------------------------------------

@@ -16,6 +16,10 @@ import pytest
 
 REPO = Path(__file__).parent.parent
 
+# run dirs per module fixture, so tests can find server-side artifacts
+# (spans.jsonl) without widening the fixtures' url-only contract
+SERVER_DIRS = {}
+
 
 @pytest.fixture(scope="module")
 def server(tmp_path_factory):
@@ -40,6 +44,7 @@ def server(tmp_path_factory):
     )
     trainer.train()
     ckpt = config.save_dir / "checkpoint-epoch1"
+    SERVER_DIRS["server"] = tmp
 
     # stdout to a FILE (not a pipe): readiness is polled with a real
     # deadline — a blocking readline() would hang the suite if the
@@ -427,3 +432,55 @@ def test_error_paths(server):
         with pytest.raises(urllib.error.HTTPError) as e:
             _post(server, {"prompt_ids": bad, "max_new_tokens": 2})
         assert e.value.code == 400, bad
+
+
+def test_request_id_round_trip_and_spans(server):
+    """The replica-side tracing contract (ISSUE 8): a client-supplied
+    X-Request-Id is echoed on the response header AND in the body,
+    keys the server's spans.jsonl records, and an absent id gets a
+    minted one; /metrics carries the aggregable latency histograms
+    and the SLO counters (0 — no thresholds configured here)."""
+    req = urllib.request.Request(
+        server + "/generate",
+        data=json.dumps({"prompt": "12:3",
+                         "max_new_tokens": 2}).encode(),
+        headers={"Content-Type": "application/json",
+                 "X-Request-Id": "rt-7"})
+    with urllib.request.urlopen(req, timeout=300) as r:
+        assert r.headers["X-Request-Id"] == "rt-7"      # echoed
+        assert json.loads(r.read())["request_id"] == "rt-7"
+    # no header -> the replica mints one (it IS the first hop here)
+    req = urllib.request.Request(
+        server + "/generate",
+        data=json.dumps({"prompt": "12:3",
+                         "max_new_tokens": 2}).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=300) as r:
+        minted = r.headers["X-Request-Id"]
+        assert minted and json.loads(r.read())["request_id"] == minted
+    # the spans.jsonl under the run dir keys records on the rid; the
+    # handler's http span lands AFTER the response bytes, so poll
+    names = set()
+    deadline = time.time() + 10
+    while time.time() < deadline and not {"http", "complete"} <= names:
+        for path in SERVER_DIRS["server"].rglob("spans.jsonl"):
+            for line in path.read_text().splitlines():
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                if rec.get("rid") == "rt-7":
+                    names.add(rec["name"])
+        time.sleep(0.2)
+    assert {"http", "complete"} <= names, names
+    # /metrics: histogram snapshots (JSON) + proper prom histogram
+    # series + SLO counters present even with no thresholds
+    with urllib.request.urlopen(server + "/metrics?format=json",
+                                timeout=60) as r:
+        m = json.loads(r.read())
+    assert m["e2e_seconds"]["count"] >= 2
+    assert m["slo_breach_total"] == 0
+    with urllib.request.urlopen(server + "/metrics", timeout=60) as r:
+        text = r.read().decode()
+    assert "# TYPE pdt_serve_e2e_seconds histogram" in text
+    assert 'pdt_serve_e2e_seconds_bucket{le="+Inf"}' in text
