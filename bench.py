@@ -2574,6 +2574,378 @@ def bench_serve_fleet(replicas: int = 3, n_requests: int = 24,
     }
 
 
+def bench_serve_chaos(replicas: int = 2, block_tokens: int = 16,
+                      wedge_deadline_ms: int = 60000,
+                      feasible_deadline_ms: int = 30000,
+                      n_deadline: int = 20, n_burst: int = 24,
+                      platform: str = "cpu") -> dict:
+    """Serving-path chaos rung (ISSUE 9 tentpole): a supervised fleet
+    walks the serving fault grammar under trace-replay load, and every
+    injected fault must resolve to a CLASSIFIED terminal outcome:
+
+    - **wedge arm**: replica r1 carries ``hang@tick:2`` — its
+      scheduler freezes while ``/healthz`` keeps answering. Requests
+      routed there 504 at their deadline (never strand), the poller's
+      frozen-progress detection ejects it within ``wedge_after``
+      polls, SIGKILLs it through its supervisor, and readmission
+      records time-to-recovery. r0 carries ``stall_stream`` (SSE
+      freezes without closing — the router's deadline-bounded read
+      truncates it) riding the same traffic.
+    - **deadline arm**: every request carries a feasible deadline and
+      a slice carries an infeasible (1 ms) one — the infeasible slice
+      MUST come back 504-classified and the feasible slice must hit
+      >= 99% compliance, while router-side ``proxy_latency`` /
+      ``proxy_blackhole`` faults fire and hedged requests (fixed
+      75 ms delay, wide budget) pick up the slow tail —
+      ``hedge_fired_total`` must be nonzero.
+    - **brownout arm**: a saturation burst drives replica queue depth
+      past the (aggressively tuned) brownout thresholds — the ladder
+      must ENGAGE (level > 0 observed on /metrics mid-burst) and
+      CLEAR (level back to 0 after the drain).
+
+    Gates (asserted here): zero stranded requests across every arm,
+    feasible-deadline compliance >= 0.99, infeasible slice fully
+    classified, wedged replica ejected (reason=wedged in router.jsonl)
+    and readmitted with recovery time, hedge_fired_total > 0,
+    brownout engaged and cleared. Router evidence (router.jsonl +
+    spans.jsonl) is copied into artifacts/ for the CI upload.
+    ``BENCH_CHAOS_REPLICAS`` overrides the replica count."""
+    import shutil
+    import signal as signal_mod
+    import subprocess
+    import tempfile
+
+    from pytorch_distributed_template_tpu.fleet import loadgen
+    from pytorch_distributed_template_tpu.fleet.replicas import (
+        http_json,
+    )
+
+    replicas = int(os.environ.get("BENCH_CHAOS_REPLICAS", replicas))
+    repo = os.path.dirname(os.path.abspath(__file__))
+    env = dict(os.environ, JAX_PLATFORMS=platform)
+    env.pop("PDT_FAULTS", None)   # aim faults via CLI, never ambient
+
+    def healthy_count(router_url) -> int:
+        try:
+            hz = http_json(router_url + "/healthz", 5.0)
+        except (OSError, ValueError):
+            return -1
+        return sum(1 for r in hz["replicas"]
+                   if r["state"] == "healthy")
+
+    with tempfile.TemporaryDirectory(prefix="bench-chaos-serve-") as d:
+        art = os.path.join(d, "artifact")
+        subprocess.run(
+            [sys.executable,
+             os.path.join(repo, "scripts", "make_serving_artifact.py"),
+             "-o", art, "--max-len", "256",
+             "--block-tokens", str(block_tokens),
+             "--compile-cache-dir", os.path.join(d, "xla-cache")],
+            check=True, env=env, timeout=600, cwd=repo,
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+        run_dir = os.path.join(d, "fleet")
+        log_path = os.path.join(d, "fleet.log")
+
+        def log_tail(n: int = 1500) -> str:
+            try:
+                with open(log_path) as f:
+                    return f.read()[-n:]
+            except OSError:
+                return "<no log>"
+
+        def save_evidence():
+            """router.jsonl + every spans.jsonl -> artifacts/ (the CI
+            chaos-serve-smoke job uploads them on failure)."""
+            try:
+                dst = os.path.join("artifacts", "serve_chaos")
+                os.makedirs(dst, exist_ok=True)
+                for name in ("router.jsonl", "spans.jsonl"):
+                    src = os.path.join(run_dir, name)
+                    if os.path.exists(src):
+                        shutil.copy(src, os.path.join(dst, name))
+                for rep_dir in sorted(os.listdir(run_dir)):
+                    sp = os.path.join(run_dir, rep_dir, "save")
+                    if not os.path.isdir(sp):
+                        continue
+                    for root, _, files in os.walk(sp):
+                        for f in files:
+                            if f == "spans.jsonl":
+                                shutil.copy(
+                                    os.path.join(root, f),
+                                    os.path.join(
+                                        dst, f"{rep_dir}_spans.jsonl"))
+                shutil.copy(log_path,
+                            os.path.join(dst, "fleet.log"))
+            except OSError:
+                pass
+
+        # fault plans (ISSUE 9 grammar): r1 wedges almost immediately
+        # on its first traffic (tick = its chunk counter); r0 stalls
+        # its 2nd SSE stream for LONGER than any deadline (the
+        # router's deadline-bounded read must be the thing that frees
+        # the client) and later drains its pool for 1.5 s; the router
+        # itself delays one proxied request and blackholes another.
+        r0_faults = ("slow_decode@tick:30:600ms;"
+                     "stall_stream@req:2:120s;"
+                     "pool_exhaust@tick:45:1500ms")
+        r1_faults = "hang@tick:2"
+        router_faults = ("proxy_latency@req:14:400ms;"
+                         "proxy_blackhole@req:17")
+        log_f = open(log_path, "w")
+        try:
+            proc = subprocess.Popen(
+                [sys.executable,
+                 os.path.join(repo, "scripts", "serve_fleet.py"),
+                 "-r", os.path.join(art, "model"),
+                 "--replicas", str(replicas), "--port", "0",
+                 "--run-dir", run_dir, "--admin",
+                 "--poll-s", "0.3", "--readmit-after", "1",
+                 # wedge window 5 polls (1.5 s): a PERMANENT freeze
+                 # (hang@tick) is caught in ~2 s, while the 600 ms
+                 # slow_decode pause — hedging's job, not ejection's —
+                 # can freeze at most ~3 polls and stays healthy
+                 "--wedge-after", "5", "--restart-delay", "0.5",
+                 "--block-tokens", str(block_tokens),
+                 "--hedge", "on", "--hedge-frac", "0.3",
+                 "--hedge-delay-ms", "75",
+                 "--router-faults", router_faults,
+                 "--replica-faults", f"r0={r0_faults}",
+                 "--replica-faults", f"r1={r1_faults}",
+                 # warm-buckets is LOAD-BEARING here: admit
+                 # executables compile at STARTUP (before READY), so
+                 # first-wave traffic never freezes the progress
+                 # counter behind a cold XLA compile — which the
+                 # wedge detector cannot distinguish from a hang
+                 "--", "--max-batch", "2", "--decode-chunk", "4",
+                 "--warm-buckets", "64",
+                 "--brownout", "on", "--brownout-queue-norm", "0.5",
+                 "--brownout-dwell-s", "1.0",
+                 "--brownout-max-new", "16"],
+                stdout=log_f, stderr=subprocess.STDOUT,
+                env=env, cwd=repo)
+        finally:
+            log_f.close()
+        _CHILD_PROCS.add(proc)
+        try:
+            url = None
+            deadline_t = time.time() + 420
+            while time.time() < deadline_t:
+                try:
+                    with open(log_path) as f:
+                        for line in f:
+                            if line.startswith("READY "):
+                                url = line.split()[1].strip()
+                                break
+                except OSError:
+                    pass
+                if url or proc.poll() is not None:
+                    break
+                time.sleep(0.5)
+            if url is None or proc.poll() is not None:
+                raise RuntimeError(
+                    "serve_fleet never READY: " + log_tail())
+            while (healthy_count(url) != replicas
+                   and time.time() < deadline_t):
+                time.sleep(1.0)
+            if healthy_count(url) != replicas:
+                raise RuntimeError(
+                    "replicas never all healthy: " + log_tail())
+
+            summaries = {}
+
+            # ---- arm W: wedge + stall under deadlines -------------
+            # round_robin so r1 is GUARANTEED traffic (its hang fires
+            # on its own chunk counter); generous deadlines bound the
+            # wedged/stalled requests — nothing may strand. ALL
+            # streaming: r0's stall_stream@req:2 counts streaming
+            # requests, so its target provably exists in THIS arm
+            # (where compliance is not gated) and not a later one
+            trace = loadgen.build_trace(
+                max(2 * replicas, 6), seed=21, prefix_groups=3,
+                group_tag="w", prefix_len=32, suffix_len=8,
+                max_new_tokens=8, rate_rps=3.0, stream_frac=1.0,
+                deadline_ms=wedge_deadline_ms)
+            summaries["wedge"] = loadgen.summarize(
+                loadgen.replay(url, trace, timeout_s=300,
+                               policy="round_robin"), trace)
+            # the wedged replica must be ejected (reason=wedged) and
+            # recovered: wait for full health, then read the events
+            deadline_t = time.time() + 300
+            while (healthy_count(url) != replicas
+                   and time.time() < deadline_t):
+                time.sleep(0.5)
+            if healthy_count(url) != replicas:
+                raise RuntimeError(
+                    "wedged replica never recovered: " + log_tail())
+            wedge_ejects, wedge_recovery = 0, None
+            with open(os.path.join(run_dir, "router.jsonl")) as f:
+                for line in f:
+                    try:
+                        ev = json.loads(line)
+                    except json.JSONDecodeError:
+                        continue
+                    if (ev.get("event") == "eject"
+                            and ev.get("reason") == "wedged"):
+                        wedge_ejects += 1
+                    if (ev.get("event") == "readmit"
+                            and ev.get("recovery_s") is not None):
+                        wedge_recovery = ev["recovery_s"]
+            if wedge_ejects < 1:
+                raise RuntimeError(
+                    "hang@tick never produced a wedged ejection: "
+                    + log_tail())
+            if wedge_recovery is None:
+                raise RuntimeError(
+                    "wedged replica ejected but never readmitted "
+                    "with a recovery time: " + log_tail())
+
+            # ---- arm D: deadlines + hedging + proxy faults --------
+            # all NON-streaming: every request here is hedge-eligible,
+            # so the blackholed proxy attempt is always rescued by the
+            # hedge (a blackholed SSE request would instead ride out
+            # its whole deadline and sink the compliance gate)
+            trace = loadgen.build_trace(
+                n_deadline, seed=23, prefix_groups=4, group_tag="d",
+                prefix_len=32, suffix_len=8, max_new_tokens=8,
+                rate_rps=4.0, stream_frac=0.0,
+                deadline_ms=feasible_deadline_ms,
+                infeasible_frac=0.2)
+            summaries["deadline"] = loadgen.summarize(
+                loadgen.replay(url, trace, timeout_s=300), trace)
+            sd = summaries["deadline"]
+            n_infeasible = sum(
+                1 for t in trace if not t["deadline_feasible"])
+            if sd["deadline_hit"] < n_infeasible:
+                raise RuntimeError(
+                    f"infeasible-deadline slice not fully classified "
+                    f"({sd['deadline_hit']} < {n_infeasible}): {sd}")
+            compliance = sd["deadline_compliance"]
+            if compliance is None or compliance < 0.99:
+                raise RuntimeError(
+                    f"feasible-deadline compliance {compliance} "
+                    f"< 0.99: {sd}")
+
+            # ---- arm B: saturation burst -> brownout ladder -------
+            # sample the replicas' brownout_level gauges DURING the
+            # burst (engage), then after the drain (clear)
+            seen_level = {"max": 0}
+            stop_sampling = threading.Event()
+
+            def replica_urls():
+                try:
+                    hz = http_json(url + "/healthz", 5.0)
+                    return [r["url"] for r in hz["replicas"]
+                            if r["url"]]
+                except (OSError, ValueError):
+                    return []
+
+            def sample():
+                while not stop_sampling.is_set():
+                    for u in replica_urls():
+                        try:
+                            m = http_json(
+                                u + "/metrics?format=json", 2.0)
+                            seen_level["max"] = max(
+                                seen_level["max"],
+                                int(m.get("brownout_level", 0)))
+                        except (OSError, ValueError):
+                            pass
+                    time.sleep(0.2)
+
+            sampler = threading.Thread(target=sample, daemon=True)
+            sampler.start()
+            trace = loadgen.build_trace(
+                n_burst, seed=29, prefix_groups=4, group_tag="b",
+                prefix_len=32, suffix_len=8, max_new_tokens=8,
+                arrival="bursty", rate_rps=8.0, burst_factor=8.0,
+                stream_frac=0.0, deadline_ms=wedge_deadline_ms)
+            summaries["burst"] = loadgen.summarize(
+                loadgen.replay(url, trace, timeout_s=300), trace)
+            stop_sampling.set()
+            sampler.join(timeout=5)
+            engaged = seen_level["max"]
+            if engaged < 1:
+                raise RuntimeError(
+                    "brownout never engaged under the saturation "
+                    f"burst (max level {engaged}): "
+                    f"{summaries['burst']}")
+            cleared = False
+            deadline_t = time.time() + 60
+            while time.time() < deadline_t:
+                levels = []
+                for u in replica_urls():
+                    try:
+                        m = http_json(u + "/metrics?format=json", 2.0)
+                        levels.append(int(m.get("brownout_level", 0)))
+                    except (OSError, ValueError):
+                        pass
+                if levels and max(levels) == 0:
+                    cleared = True
+                    break
+                time.sleep(1.0)
+            if not cleared:
+                raise RuntimeError(
+                    "brownout engaged but never cleared after the "
+                    "burst drained: " + log_tail())
+
+            # ---- fleet-wide gates ---------------------------------
+            rm = http_json(url + "/metrics?format=json", 10.0)
+            stranded = sum(s["stranded"] for s in summaries.values())
+            if stranded:
+                raise RuntimeError(
+                    f"{stranded} request(s) STRANDED (no classified "
+                    f"terminal outcome): "
+                    f"{ {k: s['stranded'] for k, s in summaries.items()} }")
+            if int(rm.get("hedge_fired_total", 0)) < 1:
+                raise RuntimeError(
+                    f"hedging never fired (hedge_fired_total=0): {rm}")
+            if int(rm.get("deadline_expired_total", 0)) < 1:
+                raise RuntimeError(
+                    "deadline_expired_total stayed 0 under an "
+                    "infeasible-deadline slice — the deadline path "
+                    "is broken")
+            if int(rm.get("wedged_ejections_total", 0)) < 1:
+                raise RuntimeError(
+                    f"wedged_ejections_total stayed 0: {rm}")
+            save_evidence()
+
+            # drain contract: SIGTERM -> rc 0
+            proc.send_signal(signal_mod.SIGTERM)
+            rc = proc.wait(timeout=120)
+            if rc != 0 or "DRAINED" not in log_tail(1 << 20):
+                raise RuntimeError(
+                    f"fleet drain violated (rc={rc}): " + log_tail())
+        except BaseException:
+            save_evidence()
+            raise
+        finally:
+            _CHILD_PROCS.discard(proc)
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait(timeout=30)
+    return {
+        "replicas": replicas,
+        "stranded_total": 0,
+        "deadline_compliance": compliance,
+        "deadline_hit_total": sum(
+            s["deadline_hit"] for s in summaries.values()),
+        "deadline_expired_total": int(
+            rm.get("deadline_expired_total", 0)),
+        "hedge_fired_total": int(rm.get("hedge_fired_total", 0)),
+        "hedge_won_total": int(rm.get("hedge_won_total", 0)),
+        "hedge_cancelled_total": int(
+            rm.get("hedge_cancelled_total", 0)),
+        "wedged_ejections": wedge_ejects,
+        "wedge_recovery_s": wedge_recovery,
+        "wedge_detect_polls": 5,
+        "brownout_engaged_level": engaged,
+        "brownout_cleared": True,
+        "shed_rate_burst": summaries["burst"]["shed_rate"],
+        "agg_tok_s_deadline": summaries["deadline"]["agg_tok_s"],
+        "platform": platform,
+    }
+
+
 def _recorder_timed_loop(state, step_fn, batch_arrays, recorder, n,
                          batch, seq, monitor=None, health_keys=()):
     """One timed window of ``n`` steps through the flight recorder;
@@ -2892,6 +3264,13 @@ _SUMMARY_KEYS = {
                     "slo_breach_total"),
     "decode_spec": ("speedup", "speedup_natural", "tokens_per_call"),
     "flash_attention_8k": ("speedup",),
+    # serving-path chaos (ISSUE 9): the zero-stranded contract, the
+    # feasible-deadline compliance gate, hedging proof-of-fire, and
+    # the wedge/brownout recovery headlines
+    "serve_chaos": ("stranded_total", "deadline_compliance",
+                    "hedge_fired_total", "wedged_ejections",
+                    "wedge_recovery_s", "brownout_engaged_level",
+                    "brownout_cleared"),
 }
 
 
@@ -3249,6 +3628,16 @@ _LADDER = [
         # cheapest configuration that still proves routing + shed)
         (bench_serve_fleet, {"replicas": 2, "n_requests": 12,
                              "prefix_groups": 4, "kill": False}),
+    ]),
+    # serving-path chaos (ISSUE 9): the fault grammar walked against a
+    # live fleet — wedge detection + restart, deadline propagation
+    # under infeasible slices, hedged requests over proxy faults,
+    # brownout engage/clear under a saturation burst. Multi-minute
+    # like serve_fleet; CI runs it via --only serve_chaos
+    ("serve_chaos", [
+        (bench_serve_chaos, {}),
+        # fallback arm: shorter deadline traffic, smaller burst
+        (bench_serve_chaos, {"n_deadline": 12, "n_burst": 16}),
     ]),
     # speculative decoding (prompt-lookup drafting): latency-oriented
     # batch-1 serving — speedup is workload-dependent, so the rung

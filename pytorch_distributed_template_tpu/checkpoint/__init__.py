@@ -1,4 +1,6 @@
 from .manager import (
-    CheckpointManager, load_serving_meta, restore_serving_params,
-    save_serving_params, warm_start_params,
+    ArtifactCorrupt, CheckpointManager, load_serving_meta,
+    restore_serving_params, save_serving_params,
+    verify_artifact_manifest, warm_start_params,
+    write_artifact_manifest,
 )

@@ -492,8 +492,93 @@ def _saveable(state) -> dict:
 # ---------------------------------------------------------------------------
 
 
+MANIFEST_SUFFIX = ".manifest.json"
+
+
+class ArtifactCorrupt(RuntimeError):
+    """A serving artifact failed its manifest checksum: refuse LOUDLY
+    instead of serving garbage weights (ISSUE 9 satellite). Carries
+    the failing file(s) so the operator knows what rotted."""
+
+
+def _artifact_digests(path: Path) -> dict:
+    """``{relpath: {"sha256", "bytes"}}`` over every regular file in
+    the artifact tree, sorted for a stable manifest."""
+    import hashlib
+
+    out = {}
+    for f in sorted(p for p in path.rglob("*") if p.is_file()):
+        h = hashlib.sha256()
+        with open(f, "rb") as fh:
+            for chunk in iter(lambda: fh.read(1 << 20), b""):
+                h.update(chunk)
+        out[str(f.relative_to(path))] = {
+            "sha256": h.hexdigest(), "bytes": f.stat().st_size}
+    return out
+
+
+def write_artifact_manifest(path) -> Path:
+    """Checksum manifest sidecar (``<name>.manifest.json``) over a
+    serving artifact's file tree — what :func:`verify_artifact_manifest`
+    checks at load time."""
+    path = Path(path).resolve()
+    manifest = {"files": _artifact_digests(path), "algo": "sha256"}
+    mpath = path.parent / f"{path.name}{MANIFEST_SUFFIX}"
+    mpath.write_text(json.dumps(manifest, indent=2))
+    return mpath
+
+
+def verify_artifact_manifest(path) -> bool:
+    """Re-hash the artifact tree against its manifest sidecar.
+
+    Returns False when no manifest exists (pre-manifest artifacts stay
+    loadable); raises :class:`ArtifactCorrupt` on any mismatch —
+    missing files, size drift, digest drift. The ``ckpt_corrupt``
+    fault kind (resilience/faults.py) perturbs the OBSERVED digest of
+    the first manifest entry, proving the refusal path end to end
+    without destroying the artifact on disk."""
+    path = Path(path).resolve()
+    mpath = path.parent / f"{path.name}{MANIFEST_SUFFIX}"
+    if not mpath.exists():
+        return False
+    manifest = json.loads(mpath.read_text())
+    want = manifest.get("files") or {}
+    got = _artifact_digests(path)
+    spec = faults.on_artifact_load()
+    if spec is not None and got:
+        first = sorted(got)[0]
+        got[first] = dict(got[first],
+                          sha256="0" * 64)   # deterministic bit-flip
+        logger.warning("fault ckpt_corrupt: perturbed digest of %s "
+                       "(%s)", first, spec.describe())
+    bad = []
+    for rel, meta in want.items():
+        have = got.get(rel)
+        if have is None:
+            bad.append(f"{rel}: MISSING")
+        elif have["sha256"] != meta["sha256"]:
+            bad.append(f"{rel}: sha256 {have['sha256'][:12]}... != "
+                       f"manifest {meta['sha256'][:12]}...")
+        elif have["bytes"] != meta["bytes"]:
+            bad.append(f"{rel}: {have['bytes']}B != manifest "
+                       f"{meta['bytes']}B")
+    extra = sorted(set(got) - set(want))
+    if extra:
+        bad.append(f"unmanifested files: {extra}")
+    if bad:
+        raise ArtifactCorrupt(
+            f"serving artifact {path} FAILED its checksum manifest — "
+            f"REFUSING to serve possibly-garbage weights:\n  "
+            + "\n  ".join(bad))
+    logger.info("artifact manifest verified: %s (%d files)",
+                path, len(want))
+    return True
+
+
 def save_serving_params(path, params, meta: dict) -> Path:
-    """Write a params-only orbax tree + ``<name>.meta.json`` sidecar.
+    """Write a params-only orbax tree + ``<name>.meta.json`` sidecar
+    + ``<name>.manifest.json`` checksum manifest (load verifies it —
+    a corrupted artifact must refuse loudly, ISSUE 9).
 
     Blocks until the write is durable (serving artifacts are produced by
     a one-shot CLI, not inside a hot training loop — nothing overlaps)."""
@@ -506,6 +591,7 @@ def save_serving_params(path, params, meta: dict) -> Path:
         (path.parent / f"{path.name}.meta.json").write_text(
             json.dumps(meta, indent=2)
         )
+        write_artifact_manifest(path)
     logger.info("Saved serving params: %s", path)
     return path
 
@@ -529,6 +615,11 @@ def restore_serving_params(path, template_params, shardings=None):
     on multi-host meshes, where a host-local restore + device_put cannot
     address other hosts' devices (same constraint as
     engine/state.create_sharded_train_state)."""
+    # integrity gate BEFORE the restore (ISSUE 9 satellite): an
+    # artifact with a manifest must hash clean, or the load refuses
+    # loudly — serving garbage weights is the one failure mode no
+    # downstream detector catches
+    verify_artifact_manifest(path)
     if shardings is None:
         abstract = jax.tree.map(
             lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype),

@@ -462,12 +462,17 @@ class ContinuousBatchingService(GenerationService):
     def _setup(self, model, params, tokenizer=None, slots: int = 8,
                chunk: int = 8, window_ms: float = 5.0,
                warm_buckets=None, prefix_cache=None, recorder=None,
-               spec_draft_layers: int = 0, tracer=None, slo=None):
+               spec_draft_layers: int = 0, tracer=None, slo=None,
+               brownout=None):
         super()._setup(model, params, tokenizer,
                        prefix_cache=prefix_cache,
                        spec_draft_layers=spec_draft_layers,
                        tracer=tracer, slo=slo)
         self._recorder = recorder
+        # pool_exhaust fault window: until this monotonic instant the
+        # prefix pool reports dry (paged admissions defer, scatter
+        # lookups miss) — 0 = no window active
+        self._pool_dry_until = 0.0
         if not self._pad_ok:
             raise ValueError(
                 f"{type(model).__name__} is not pad-capable (RoPE "
@@ -477,6 +482,7 @@ class ContinuousBatchingService(GenerationService):
 
         self._slots = int(slots)
         self._chunk = int(chunk)
+        self._init_brownout(brownout)   # needs _slots/_chunk above
         # TRUE paged decode (ISSUE 7): with a paged-capable pool the
         # shared contiguous cache is replaced by the block pool + a
         # per-slot block table — warm admits become pointer updates
@@ -530,11 +536,115 @@ class ContinuousBatchingService(GenerationService):
                       "admissions": 0, "eras": 0, "max_active": 0,
                       "tokens_generated": 0, "cancelled": 0,
                       "paged_chunks": 0, "paged_admissions": 0,
-                      "deferred_admissions": 0}
+                      "deferred_admissions": 0, "deadline_expired": 0,
+                      "brownout_clamped": 0}
         self._warm_chunk_ladder()
         self._worker_thread = threading.Thread(
             target=self._worker, daemon=True, name="gen-continuous")
         self._worker_thread.start()
+
+    # ---- brownout ladder (ISSUE 9) ---------------------------------------
+
+    def _init_brownout(self, cfg) -> None:
+        """Attach the hysteresis ladder (utils/brownout.py) from a
+        ``serving.brownout`` config dict (``{"enabled": true, ...}``)
+        or a prebuilt controller. Off by default: degradation modes
+        change observable behavior (clamped budgets), so the operator
+        opts in."""
+        from ..utils.brownout import BrownoutController
+
+        self._brownout = None
+        self._bo_queue_norm = 1.0
+        self._bo_max_new = 0
+        self._bo_breach_ewma = 0.0
+        self._bo_last = (0, 0)          # (breaches, completed) marks
+        self._bo_lock = threading.Lock()
+        if cfg is None:
+            return
+        if isinstance(cfg, BrownoutController):
+            self._brownout = cfg
+            return
+        cfg = dict(cfg)
+        if not cfg.get("enabled"):
+            return
+        # queue_norm: queue depth equal to slots*queue_norm reads as
+        # pressure 1.0 ("at capacity") — the ladder thresholds are in
+        # those units
+        self._bo_queue_norm = float(cfg.get("queue_norm", 1.0))
+        # level-3 budget cap; 0 derives a default from the chunk size
+        self._bo_max_new = int(cfg.get("max_new_cap", 0)) \
+            or self._chunk * 4
+        kw = {}
+        if "enter" in cfg:
+            kw["enter"] = tuple(cfg["enter"])
+        if "exit" in cfg:
+            kw["exit"] = tuple(cfg["exit"])
+        self._brownout = BrownoutController(
+            dwell_s=float(cfg.get("dwell_s", 2.0)),
+            on_change=self._on_brownout_change, **kw)
+
+    def _on_brownout_change(self, old: int, new: int,
+                            pressure: float) -> None:
+        logger.warning("brownout level %d -> %d (pressure %.2f)",
+                       old, new, pressure)
+        if self._recorder is not None:
+            self._recorder.record(
+                self.stats["chunks"], event="brownout",
+                brownout_level=new, brownout_prev=old,
+                brownout_pressure=round(pressure, 4))
+
+    @property
+    def brownout_level(self) -> int:
+        return self._brownout.level if self._brownout is not None else 0
+
+    def brownout_stats(self) -> dict:
+        if self._brownout is None:
+            return {"brownout_level": 0}
+        # scrape-driven refresh: ticks only run under traffic, so an
+        # idle engine's ladder would otherwise freeze at its last
+        # level forever — each /metrics read feeds the controller the
+        # CURRENT pressure (hysteresis dwell still applies, so scrapes
+        # cannot flap it)
+        with self._bo_lock:
+            self._brownout.update(self._brownout_pressure())
+            return self._brownout.stats()
+
+    def _brownout_pressure(self, waiting: int = 0) -> float:
+        """Normalized pressure: the max of (a) waiting requests
+        (still-queued plus the tick's drained-but-unadmitted pending
+        set — the worker drains the queue into ``pending`` before each
+        tick, so the raw qsize alone under-reads) over
+        ``slots * queue_norm``, (b) the pool's live-referenced page
+        fraction (resident-but-shareable pages are a HEALTHY cache —
+        only pages pinned by live requests signal pressure), and
+        (c) an EWMA of the recent SLO breach rate (breaches per
+        completion), each normalized so 1.0 ≈ at capacity."""
+        p = (self._queue.qsize() + waiting) / max(
+            self._slots * self._bo_queue_norm, 1e-9)
+        if self._prefix is not None:
+            snap = self._prefix.stats_snapshot()
+            total = max(snap.get("prefix_pool_blocks", 0), 1)
+            p = max(p, snap.get("prefix_pool_blocks_referenced", 0)
+                    / total)
+        if self._slo is not None:
+            s = self._slo.stats()
+            breaches = s.get("slo_breach_total", 0)
+            completed = self.stats.get("completed", 0)
+            db = breaches - self._bo_last[0]
+            dc = completed - self._bo_last[1]
+            if dc > 0:
+                self._bo_breach_ewma += 0.3 * (
+                    min(db / dc, 1.0) - self._bo_breach_ewma)
+                self._bo_last = (breaches, completed)
+            p = max(p, self._bo_breach_ewma)
+        return p
+
+    def _pool_dry(self) -> bool:
+        """The pool_exhaust fault window: while active, the paged
+        reservation path reports dry (admissions defer) and the
+        scatter lookup path reports a miss."""
+        return (self._pool_dry_until > 0.0
+                and time.monotonic() < self._pool_dry_until)
 
     def _warm_chunk_ladder(self):
         """Compile every chunk length the scheduler can pick — base
@@ -581,15 +691,17 @@ class ContinuousBatchingService(GenerationService):
             tables = jnp.full((self._slots, self._prefix.nb_max), -1,
                               jnp.int32)
             starts = jnp.zeros((self._slots,), jnp.int32)
+            if self._warm_buckets:
+                self._warm_paged_signatures(cache, tables, starts,
+                                            arrays, total)
+                self._arrays = None
+                return
             steps = self._chunk
             while steps <= min(self._chunk * self.GROW_MAX, total):
                 fn = _paged_chunk_fn(self.model, steps, self.MAX_STOPS)
                 out = fn(self.params, cache, tables, starts, *arrays)
                 cache, starts = out[0], out[1]
                 steps *= 2
-            if self._warm_buckets:
-                cache = self._warm_admit_ladder_paged(cache, tables,
-                                                      starts, arrays)
             self._prefix.sync_pool_from_cache(cache)
             self._arrays = None
             return
@@ -604,14 +716,11 @@ class ContinuousBatchingService(GenerationService):
             self._warm_admit_ladder(cache, arrays)
         self._arrays = None          # the worker builds its own state
 
-    def _warm_admit_ladder_paged(self, cache, tables, starts, arrays):
-        """Paged twin of ``_warm_admit_ladder``: every admission in
-        paged mode runs through ``_paged_admit_fn`` specialized on the
-        FEED bucket (the uncached-suffix window), so the whole
-        power-of-two sub-ladder up to the largest configured bucket is
-        primed. Dummy rows are fully-padded (all writes -> scratch, all
-        reads masked); returns the donated-through cache for the pool
-        sync."""
+    def _warm_admit_once_paged(self, feed, cache, tables, arrays,
+                               starts):
+        """Execute ONE paged admission wave at ``feed`` on the given
+        state (dummy rows: fully padded, budget 1 — every write lands
+        in the scratch page) and return the donated-through state."""
         import jax
         import jax.numpy as jnp
 
@@ -619,26 +728,85 @@ class ContinuousBatchingService(GenerationService):
         nb = self._prefix.nb_max
         kd = np.asarray(jax.random.key_data(jax.random.key(0)))
         keys_data = jnp.asarray(np.tile(kd, (k, 1)))
-        b, buckets = 16, []
+        ints = np.zeros((k, 4 + W), np.int32)
+        ints[:, 0] = np.arange(k)
+        ints[:, 1] = 1                  # budget 1
+        ints[:, 2] = feed               # all lanes padded
+        ints[:, 3:3 + W] = -1
+        ints[:, 3 + W] = -feed          # rs: last lane at position 0
+        return _paged_admit_fn(self.model, feed, k, W, nb)(
+            self.params, cache, tables, arrays, starts,
+            jnp.zeros((k, feed), jnp.int32), jnp.asarray(ints),
+            jnp.zeros((k, 2), jnp.float32), keys_data,
+            jnp.zeros((k,), jnp.int32),
+            jnp.full((k, nb), -1, jnp.int32))[:4]
+
+    def _warm_paged_signatures(self, cache, tables, starts, arrays,
+                               total: int):
+        """Warm the paged executables at the SIGNATURES live traffic
+        actually dispatches. A jit signature includes each argument's
+        commitment/sharding, not just its shape: the pool starts life
+        as uncommitted ``jnp.zeros`` but every jit OUTPUT is committed,
+        so after the first real admission all engine state is committed
+        — a ladder warmed only on construction-time (uncommitted)
+        state compiles executables the dispatch path never hits, and
+        the first arrival wave stalls behind fresh XLA compiles anyway
+        (measured: ~2 s on CPU — long enough to trip the fleet's
+        wedged-replica detector). Three signature classes cover the
+        engine's lifetime:
+
+        1. **first admission**: committed pool cache + fresh
+           (uncommitted) tables/slot arrays — happens exactly once;
+        2. **steady-state chunks**: everything committed (all chunk
+           inputs come out of an admit/chunk dispatch);
+        3. **steady-state admissions**: everything committed.
+
+        Bootstrap: one admission on the all-uncommitted construction
+        state (its signature is never dispatched again — the price of
+        obtaining committed state without guessing shardings), pool
+        synced so ``paged_cache()`` hands back committed leaves, then
+        classes 1-3 executed in dispatch order per feed bucket /
+        chunk-ladder step."""
+        import jax
+        import jax.numpy as jnp
+
+        k = self._slots
+        nb = self._prefix.nb_max
+        b, feeds = 16, []
         while b <= max(self._warm_buckets):
-            buckets.append(b)
+            feeds.append(b)
             b *= 2
-        for feed in buckets:
-            ints = np.zeros((k, 4 + W), np.int32)
-            ints[:, 0] = np.arange(k)
-            ints[:, 1] = 1                  # budget 1
-            ints[:, 2] = feed               # all lanes padded
-            ints[:, 3:3 + W] = -1
-            ints[:, 3 + W] = -feed          # rs: last lane at position 0
-            cache, tables, arrays, starts, _ = _paged_admit_fn(
-                self.model, feed, k, W, nb)(
-                self.params, cache, tables, arrays, starts,
-                jnp.zeros((k, feed), jnp.int32), jnp.asarray(ints),
-                jnp.zeros((k, 2), jnp.float32), keys_data,
-                jnp.zeros((k,), jnp.int32),
-                jnp.full((k, nb), -1, jnp.int32))
+        # bootstrap: commit every state leaf the way jit outputs are
+        cache, tables, arrays, starts = self._warm_admit_once_paged(
+            feeds[0], cache, tables, arrays, starts)
+        self._prefix.sync_pool_from_cache(cache)
+        # class 1: committed pool, FRESH uncommitted tables/arrays —
+        # the first real admission's exact signature, per feed bucket
+        self._init_arrays()
+        for feed in feeds:
+            out = self._warm_admit_once_paged(
+                feed, self._prefix.paged_cache(),
+                jnp.full((k, nb), -1, jnp.int32), self._arrays,
+                jnp.zeros((k,), jnp.int32))
+            self._init_arrays()     # fresh (uncommitted) per feed
+            self._prefix.sync_pool_from_cache(out[0])
+        cache, tables, arrays, starts = out
+        # class 2: the chunk ladder on fully-committed state,
+        # rebuilding the arrays tuple exactly as _dispatch_chunk does
+        steps = self._chunk
+        while steps <= min(self._chunk * self.GROW_MAX, total):
+            fn = _paged_chunk_fn(self.model, steps, self.MAX_STOPS)
+            cache, starts, _, tok, emitted, done = fn(
+                self.params, cache, tables, starts, *arrays)
+            arrays = (tok, emitted, done) + tuple(arrays[3:])
+            steps *= 2
+        # class 3: steady-state admissions (everything committed)
+        for feed in feeds:
+            cache, tables, arrays, starts = \
+                self._warm_admit_once_paged(feed, cache, tables,
+                                            arrays, starts)
         jax.block_until_ready(arrays[0])
-        return cache
+        self._prefix.sync_pool_from_cache(cache)
 
     def _warm_admit_ladder(self, cache, arrays):
         """Execute the admit executable for every configured bucket on
@@ -703,7 +871,8 @@ class ContinuousBatchingService(GenerationService):
                  max_new_tokens: int = 64, temperature: float = 0.0,
                  top_k: int = 0, top_p: float = 0.0, seed: int = 0,
                  speculative: int = 0, stop=None,
-                 on_tokens=None, cancel=None, request_id=None) -> dict:
+                 on_tokens=None, cancel=None, request_id=None,
+                 deadline=None) -> dict:
         """Same contract as the parent plus ``on_tokens``: a callback
         receiving each batch of freshly decoded token ids for THIS
         request as its chunks absorb (stop tokens filtered — the
@@ -720,7 +889,20 @@ class ContinuousBatchingService(GenerationService):
         dropped without ever taking a slot. Speculative requests
         (``speculative > 0``) bypass the slot engine (batch-1 under
         the parent's lock) and IGNORE ``cancel`` — they run their
-        whole budget."""
+        whole budget.
+
+        ``deadline``: an optional :class:`reqtrace.Deadline` (ISSUE 9).
+        Treated as a CANCEL the engine raises itself: a queued request
+        whose deadline expires is dropped before taking a slot, and a
+        decoding row is finalized at its next absorb with
+        ``stop_reason: "deadline"`` and whatever tokens it produced —
+        the slot frees for live traffic instead of decoding tokens
+        nobody is waiting for."""
+        if speculative > 0 and self.brownout_level >= 1:
+            # brownout level 1 (no_spec): speculative decode's extra
+            # verify bandwidth goes back to the batch — the request is
+            # served, just without the latency optimization
+            speculative = 0
         if speculative > 0:
             # batch-1 by construction; runs under the parent's lock
             # (the scheduler's own dispatches take the same lock)
@@ -729,7 +911,7 @@ class ContinuousBatchingService(GenerationService):
                 max_new_tokens=max_new_tokens, temperature=temperature,
                 top_k=top_k, top_p=top_p, seed=seed,
                 speculative=speculative, stop=stop,
-                request_id=request_id)
+                request_id=request_id, deadline=deadline)
             if on_tokens is not None and result.get("ids"):
                 on_tokens(list(result["ids"]))   # single final delta
             return result
@@ -757,6 +939,7 @@ class ContinuousBatchingService(GenerationService):
             "temperature": float(temperature), "top_k": int(top_k),
             "top_p": float(top_p), "seed": seed, "stop": stops,
             "on_tokens": on_tokens, "cancel": cancel, "rid": request_id,
+            "deadline": deadline,
             # raw key data, derived WITHOUT device work in the
             # caller's thread (host path above): per-request device
             # ops serialized burst arrivals through the tunnel
@@ -807,6 +990,7 @@ class ContinuousBatchingService(GenerationService):
         return (min(cls.GROW_MAX_STOPS, cls.GROW_MAX)
                 if any(m["req"]["stop"]
                        or m["req"].get("cancel") is not None
+                       or m["req"].get("deadline") is not None
                        for m in live)
                 else cls.GROW_MAX)
 
@@ -861,7 +1045,12 @@ class ContinuousBatchingService(GenerationService):
         # insert can never evict a block this group is about to read.
         matches = None
         if self._prefix is not None:
-            matches = [self._prefix.lookup(r["ids"]) for r in reqs]
+            if self._pool_dry():
+                # pool_exhaust fault window (scatter arm): every
+                # lookup misses — admissions pay the full prefill
+                matches = [([], [], 0) for _ in reqs]
+            else:
+                matches = [self._prefix.lookup(r["ids"]) for r in reqs]
             feed = self._bucket(max(
                 len(r["ids"]) - m[2] for r, m in zip(reqs, matches)))
         else:
@@ -967,6 +1156,13 @@ class ContinuousBatchingService(GenerationService):
         request re-reserves EVERY tick: only its first attempt may
         count toward the hit/lookup stats, or a second of deferral
         would fabricate hundreds of phantom hit-tokens."""
+        if self._pool_dry():
+            # pool_exhaust fault window: the pool reports dry — the
+            # caller defers exactly as it would for genuine exhaustion
+            # (the machinery under test). ``_page_retry`` stays unset:
+            # no lookup ran, so the first REAL attempt still records.
+            r["_page_attempts"] = r.get("_page_attempts", 0) + 1
+            return None
         first = not r.get("_page_retry")
         r["_page_retry"] = True
         r["_page_attempts"] = r.get("_page_attempts", 0) + 1
@@ -1202,6 +1398,15 @@ class ContinuousBatchingService(GenerationService):
                 # hand a page the zombie still writes to a new request
                 m["done"] = True
                 m["zombie"] = True
+            dl = m["req"].get("deadline")
+            if (dl is not None and not m["done"]
+                    and dl.expired(t_absorb)):
+                # deadline expired mid-decode: the engine raises the
+                # cancel itself (ISSUE 9) — same zombie bookkeeping as
+                # a client disconnect, but classified "deadline"
+                m["done"] = True
+                m["zombie"] = True
+                m["deadline"] = True
             cb = m["req"].get("on_tokens")
             if cb is not None:
                 # delta = this absorb's emissions, minus stop ids (a
@@ -1354,6 +1559,12 @@ class ContinuousBatchingService(GenerationService):
             # that genuinely hit its stop token keeps "stop"
             resp["stop_reason"] = "cancelled"
             self.stats["cancelled"] = self.stats.get("cancelled", 0) + 1
+        if (m.get("deadline") and resp["stop_reason"] == "length"
+                and m["emitted"] < req["budget"]):
+            # finalized by its own expired deadline, not by budget
+            resp["stop_reason"] = "deadline"
+            self.stats["deadline_expired"] = (
+                self.stats.get("deadline_expired", 0) + 1)
         req["result"] = resp
         req["event"].set()
         self._meta[slot] = None
@@ -1365,8 +1576,14 @@ class ContinuousBatchingService(GenerationService):
             del self._latencies[:512]
         # latency exports + SLO check at the engine's own observation
         # point: e2e covers enqueue -> completion, TPOT the decode
-        # cadence after the first token (ISSUE 8)
-        self.hist["e2e_seconds"].observe(lat)
+        # cadence after the first token (ISSUE 8). Cancelled and
+        # deadline-truncated requests stay OUT of the served-e2e
+        # histogram (ISSUE 9): their latency is the client's/deadline's
+        # choice, and counting them would reward truncation with
+        # "better" tails. TPOT stays in — the decode cadence was real.
+        served = resp["stop_reason"] not in ("cancelled", "deadline")
+        if served:
+            self.hist["e2e_seconds"].observe(lat)
         t_first = m.get("t_first")
         emitted_n = int(m["emitted"])
         ttft = (t_first - req["t0"]) if t_first is not None else None
@@ -1489,23 +1706,43 @@ class ContinuousBatchingService(GenerationService):
     def _tick(self, pending: list):
         """One scheduler round under the lock: era management,
         admissions, one (or two, pipelined) chunk dispatches."""
+        from ..resilience import faults
+
         from .generate import fresh_cache
 
+        # serving fault hook (ISSUE 9): slow_decode sleeps here, hang
+        # wedges this thread forever (the designated wedge — /healthz
+        # keeps answering from the HTTP threads), pool_exhaust comes
+        # back as a spec whose duration opens the dry-pool window
+        spec = faults.on_serve_tick(self.stats["chunks"])
+        if spec is not None:
+            self._pool_dry_until = time.monotonic() + spec.duration_s
+            logger.warning("fault pool_exhaust: pool reads dry for "
+                           "%.2fs", spec.duration_s)
+        if self._brownout is not None:
+            with self._bo_lock:
+                self._brownout.update(
+                    self._brownout_pressure(waiting=len(pending)))
         active = any(m is not None for m in self._meta)
-        # drop queued requests whose cancel event fired before they
-        # ever took a slot (zero device work spent on them) — BEFORE
-        # era-start positioning, so a cancelled request's bucket or
-        # budget can't inflate/starve the new era's position
+        # drop queued requests whose cancel event fired — or whose
+        # deadline expired — before they ever took a slot (zero device
+        # work spent on them) — BEFORE era-start positioning, so a
+        # dead request's bucket or budget can't inflate/starve the new
+        # era's position
         for r in list(pending):
             ev = r.get("cancel")
-            if ev is not None and ev.is_set():
+            dl = r.get("deadline")
+            dead = (ev is not None and ev.is_set())
+            expired = (not dead and dl is not None and dl.expired())
+            if dead or expired:
                 pending.remove(r)
                 resp = self._response([], stops=r["stop"], emitted=0)
-                resp["stop_reason"] = "cancelled"
+                resp["stop_reason"] = ("cancelled" if dead
+                                       else "deadline")
                 r["result"] = resp
                 r["event"].set()
-                self.stats["cancelled"] = (
-                    self.stats.get("cancelled", 0) + 1)
+                key = "cancelled" if dead else "deadline_expired"
+                self.stats[key] = self.stats.get(key, 0) + 1
                 self.stats["completed"] += 1
         if self._paged and self._cache is not None:
             # a batch-1 speculative request between ticks (same lock)
@@ -1566,6 +1803,15 @@ class ContinuousBatchingService(GenerationService):
         for r in list(pending):
             if not free:
                 break
+            if (self.brownout_level >= 3
+                    and r["budget"] > self._bo_max_new):
+                # brownout level 3 (clamp_budget): long generations
+                # finish short so slots recycle under saturation; the
+                # response's stop_reason stays "length" — honest, the
+                # budget WAS exhausted, just a browned-out budget
+                r["budget"] = self._bo_max_new
+                self.stats["brownout_clamped"] = (
+                    self.stats.get("brownout_clamped", 0) + 1)
             if self._paged:
                 # position-free admission: reserve pool pages (shared
                 # prefix refs + a private chain for suffix AND budget).
@@ -1618,8 +1864,10 @@ class ContinuousBatchingService(GenerationService):
         # (a disconnect is only honored at the next absorb), so
         # growth is capped at 4x to bound the wasted frozen-row
         # steps, the slot-recycle delay, and the cancel latency.
-        if min_left > self._chunk and not any(
-                m is None for m in self._meta):
+        # brownout level 2 (short_chunks): growth disabled — admission
+        # latency for the queue beats saturated-throughput batching
+        if (min_left > self._chunk and self.brownout_level < 2
+                and not any(m is None for m in self._meta)):
             limit = min(min_left, self._chunk * self._grow_cap(live))
             grown = self._chunk
             while grown * 2 <= limit:

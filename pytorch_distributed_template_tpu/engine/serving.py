@@ -29,6 +29,15 @@ from .evaluator import restore_template_state
 logger = logging.getLogger(__name__)
 
 
+class DeadlineExceeded(RuntimeError):
+    """A request's ``X-Deadline-Ms`` budget expired before (or while)
+    it could be served (ISSUE 9). serve.py maps this to HTTP 504 with
+    the ``X-Deadline-Expired`` marker header; the continuous engine
+    never raises it mid-flight (an expired decoding row finalizes
+    with its partial tokens and ``stop_reason: "deadline"`` instead —
+    truncation beats throwing work away)."""
+
+
 class GenerationService:
     """The request-level generation entry shared by BOTH front-ends
     (generate.py one-shot CLI, serve.py HTTP server): prompt encoding +
@@ -314,7 +323,7 @@ class GenerationService:
                  max_new_tokens: int = 64, temperature: float = 0.0,
                  top_k: int = 0, top_p: float = 0.0, seed: int = 0,
                  speculative: int = 0, stop=None,
-                 request_id=None) -> dict:
+                 request_id=None, deadline=None) -> dict:
         """One validated generation request ->
         ``{"ids", "text"?, "stop_reason", "speculative"?}``.
 
@@ -327,6 +336,12 @@ class GenerationService:
         ``request_id``: the request-scoped trace id (ISSUE 8) — keys
         this request's spans/SLO observation when a tracer is attached;
         otherwise inert.
+
+        ``deadline``: optional :class:`reqtrace.Deadline` (ISSUE 9).
+        The plain path honors it at dispatch boundaries only (checked
+        at entry and after the lock wait — a generation already on the
+        chip runs out); the continuous scheduler overrides this with
+        true mid-flight cancellation at chunk absorbs.
         """
         import time
 
@@ -337,10 +352,18 @@ class GenerationService:
         from .generate import generate
 
         t_req = time.monotonic()
+        if deadline is not None and deadline.expired(t_req):
+            raise DeadlineExceeded(
+                "deadline expired before dispatch")
         ids = self.encode_prompt(prompt, prompt_ids)
         stops = self.encode_stop(stop)
         arr = jnp.asarray(np.asarray(ids, np.int32)[None, :])
         with self._lock:
+            if deadline is not None and deadline.expired():
+                # the lock wait ate the budget: shed before spending
+                # chip time on tokens nobody is waiting for
+                raise DeadlineExceeded(
+                    "deadline expired waiting for the chip")
             emitted = None
             if speculative > 0:
                 new_ids, stats = self._adaptive_speculative(
@@ -752,7 +775,7 @@ class BatchedGenerationService(GenerationService):
                  max_new_tokens: int = 64, temperature: float = 0.0,
                  top_k: int = 0, top_p: float = 0.0, seed: int = 0,
                  speculative: int = 0, stop=None,
-                 request_id=None) -> dict:
+                 request_id=None, deadline=None) -> dict:
         import threading
         import time
 
@@ -764,9 +787,11 @@ class BatchedGenerationService(GenerationService):
                 max_new_tokens=max_new_tokens, temperature=temperature,
                 top_k=top_k, top_p=top_p, seed=seed,
                 speculative=speculative, stop=stop,
-                request_id=request_id,
+                request_id=request_id, deadline=deadline,
             )
         t_req = time.monotonic()
+        if deadline is not None and deadline.expired(t_req):
+            raise DeadlineExceeded("deadline expired before dispatch")
         # validate in the CALLER's thread: bad input must raise here
         # (HTTP 400), not poison the worker. The budget rule lives in
         # _validate_budget (ONE owner, shared with serve.py's pre-SSE
@@ -786,6 +811,7 @@ class BatchedGenerationService(GenerationService):
             # per-ROW stop sets in the loop executable, so requests
             # with different stops still share a batch (not in the key)
             "stop": stops,
+            "deadline": deadline,
             "event": threading.Event(),
         }
         # group key computed HERE, in the caller's thread: a raising
@@ -866,6 +892,22 @@ class BatchedGenerationService(GenerationService):
 
         from .generate import generate
 
+        # shed members whose deadline expired in the batching window
+        # BEFORE forming the batch (ISSUE 9): a static group decodes to
+        # the longest member, so one already-dead request would cost
+        # everyone its budget
+        live = []
+        for r in batch:
+            dl = r.get("deadline")
+            if dl is not None and dl.expired():
+                r["error"] = DeadlineExceeded(
+                    "deadline expired in the batch queue")
+                r["event"].set()
+            else:
+                live.append(r)
+        if not live:
+            return
+        batch = live
         t0 = max(len(r["ids"]) for r in batch)
         if self._pad_ok:
             # round the padded length up to a small shape menu (powers

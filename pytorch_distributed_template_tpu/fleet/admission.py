@@ -114,6 +114,12 @@ class FairAdmission:
             SHED_TIMEOUT: 0,
         }
         self._tenant_stats: Dict[str, Dict[str, int]] = {}
+        # brownout level 4 (shed_tenants, ISSUE 9): set by the router
+        # from the fleet-wide pressure signal; at >= 4 the per-tenant
+        # waiting-room slice tightens to a quarter (floor 1), so the
+        # heaviest tenants shed first while light tenants keep flowing
+        self._brownout_level = 0
+        self._brownout_shed = 0
         # WFQ wait-time histogram (ISSUE 8): every submit() observes
         # how long it waited for a grant (0 on the inline fast path),
         # so "was the p99 spent in the waiting room?" is a scrapeable
@@ -140,6 +146,18 @@ class FairAdmission:
         with self._cv:
             self._avg_service_s += 0.2 * (max(float(seconds), 0.01)
                                           - self._avg_service_s)
+
+    def set_brownout_level(self, level: int) -> None:
+        """Feed the fleet brownout level (router poll loop). Only
+        level >= 4 changes behavior here — the earlier rungs of the
+        ladder are replica-side."""
+        with self._cv:
+            self._brownout_level = int(level)
+
+    def _tenant_cap_locked(self) -> int:
+        if self._brownout_level >= 4:
+            return max(self.max_waiting_per_tenant // 4, 1)
+        return self.max_waiting_per_tenant
 
     def retry_after_s(self) -> int:
         """Honest back-off hint: how long until the CURRENT backlog
@@ -171,8 +189,10 @@ class FairAdmission:
                 self._bump(tenant, SHED_WATERMARK)
                 return SHED_WATERMARK
             if (self._waiting_by_tenant.get(tenant, 0)
-                    >= self.max_waiting_per_tenant):
+                    >= self._tenant_cap_locked()):
                 self._bump(tenant, SHED_TENANT)
+                if self._brownout_level >= 4:
+                    self._brownout_shed += 1
                 return SHED_TENANT
             charge = max(float(cost), 1e-9) / self.weight(tenant)
             tag = (max(self._vtime, self._tenant_tag.get(tenant, 0.0))
@@ -256,5 +276,6 @@ class FairAdmission:
             out["tenants"] = {t: dict(v)
                               for t, v in self._tenant_stats.items()}
             out["avg_service_s"] = round(self._avg_service_s, 4)
+            out["brownout_shed_total"] = self._brownout_shed
         out["wait_seconds"] = self.wait_hist.snapshot()
         return out

@@ -51,6 +51,9 @@ def build_trace(n_requests: int, seed: int = 0,
                 burst_period_s: float = 2.0,
                 stream_frac: float = 0.5, cancel_frac: float = 0.0,
                 cancel_after_s: float = 0.5,
+                deadline_ms: Optional[int] = None,
+                infeasible_frac: float = 0.0,
+                infeasible_ms: int = 1,
                 vocab: int = 256) -> List[dict]:
     """Deterministic request trace: same seed ⇒ same trace, byte for
     byte. ``group_tag`` namespaces the prefix groups — two arms with
@@ -87,6 +90,16 @@ def build_trace(n_requests: int, seed: int = 0,
         stream = rng.random() < stream_frac
         cancel = (stream and cancel_frac > 0
                   and rng.random() < cancel_frac)
+        # deadline mixture (ISSUE 9): every request carries the
+        # feasible budget; an infeasible_frac slice gets a budget that
+        # CANNOT be met (these MUST come back 504-classified — they
+        # are the deadline-shed arm of the chaos gate, and excluded
+        # from the feasible-compliance ratio)
+        dl, feasible = None, True
+        if deadline_ms is not None:
+            dl = int(deadline_ms)
+            if infeasible_frac > 0 and rng.random() < infeasible_frac:
+                dl, feasible = int(infeasible_ms), False
         trace.append({
             "i": i, "t": round(at, 4),
             # deterministic request id (ISSUE 8): attached as
@@ -103,6 +116,8 @@ def build_trace(n_requests: int, seed: int = 0,
             "stream": stream,
             "cancel_after_s": (float(cancel_after_s) if cancel
                                else None),
+            "deadline_ms": dl,
+            "deadline_feasible": feasible,
         })
     return trace
 
@@ -119,6 +134,9 @@ def _run_one(base: str, item: dict, t_start: float, results: list,
            "group": item["group"], "stream": item["stream"],
            "prompt_tokens": len(item["prompt_ids"]),
            "ok": False, "shed": False, "cancelled": False,
+           "deadline": False,
+           "deadline_ms": item.get("deadline_ms"),
+           "deadline_feasible": item.get("deadline_feasible", True),
            "tokens": 0, "status": None, "error": None,
            "ttft_s": None, "tpot_s": None, "total_s": None}
     delay = t_start + item["t"] - time.monotonic()
@@ -133,6 +151,8 @@ def _run_one(base: str, item: dict, t_start: float, results: list,
                "X-Tenant": item["tenant"]}
     if item.get("rid"):
         headers["X-Request-Id"] = item["rid"]
+    if item.get("deadline_ms") is not None:
+        headers["X-Deadline-Ms"] = str(int(item["deadline_ms"]))
     if policy:
         headers["X-Fleet-Policy"] = policy
     t0 = time.monotonic()
@@ -148,6 +168,11 @@ def _run_one(base: str, item: dict, t_start: float, results: list,
             rec["shed"] = True
             rec["retry_after"] = resp.getheader("Retry-After")
             resp.read()
+        elif resp.status == 504:
+            # deadline shed (ISSUE 9): a CLASSIFIED terminal outcome,
+            # not an error — the budget spoke, the fleet answered
+            rec["deadline"] = True
+            resp.read()
         elif resp.status != 200:
             rec["error"] = f"http {resp.status}"
             resp.read()
@@ -157,6 +182,8 @@ def _run_one(base: str, item: dict, t_start: float, results: list,
             data = json.loads(resp.read().decode("utf-8"))
             rec["tokens"] = len(data.get("ids") or ())
             rec["ok"] = True
+            if data.get("stop_reason") == "deadline":
+                rec["deadline"] = True   # served, but truncated
     except (OSError, http.client.HTTPException, ValueError) as e:
         rec["error"] = f"{type(e).__name__}: {e}"
     finally:
@@ -203,7 +230,15 @@ def _consume_sse(resp, conn, item: dict, rec: dict,
                 rec["ok"] = True
                 return
             if not line:
-                rec["error"] = rec["error"] or "stream truncated"
+                dl = item.get("deadline_ms")
+                if (dl is not None and (time.monotonic() - t0)
+                        >= dl / 1e3):
+                    # the router truncated the stream at the deadline
+                    # (ISSUE 9): a classified terminal outcome — the
+                    # client's own clock agrees the budget is spent
+                    rec["deadline"] = True
+                else:
+                    rec["error"] = rec["error"] or "stream truncated"
                 return
             if not line.startswith(b"data: "):
                 continue
@@ -216,6 +251,8 @@ def _consume_sse(resp, conn, item: dict, rec: dict,
                 rec["tokens"] = (len(event.get("ids") or ())
                                  or rec["tokens"])
                 rec["ok"] = True
+                if event.get("stop_reason") == "deadline":
+                    rec["deadline"] = True   # served, but truncated
                 if (t_first is not None and t_last is not None
                         and rec["tokens"] > 1 and t_last > t_first):
                     rec["tpot_s"] = round(
@@ -287,12 +324,33 @@ def summarize(replayed: dict, trace: Optional[List[dict]] = None
         t["ok"] += int(r["ok"])
         t["shed"] += int(r["shed"])
         t["tokens"] += r["tokens"]
+    # terminal-outcome accounting (ISSUE 9): a request is STRANDED
+    # when it never reached ANY classified outcome — no HTTP status,
+    # no deliberate cancel (client-side timeouts and connect failures
+    # land here), or its worker thread never even reported. The chaos
+    # rung gates stranded == 0: every fault must resolve to a
+    # classified terminal state, never a silent hang.
+    stranded = sum(1 for r in results
+                   if r["status"] is None and not r["cancelled"]
+                   and not r["deadline"])
+    missing = (len(trace) - n) if trace is not None else 0
+    deadline_hit = sum(r["deadline"] for r in results)
+    feasible = [r for r in results
+                if r.get("deadline_ms") is not None
+                and r.get("deadline_feasible", True)]
+    feasible_ok = sum(1 for r in feasible
+                      if r["ok"] and not r["deadline"])
     out = {
         "requests": n,
         "ok": sum(r["ok"] for r in results),
         "shed": shed,
         "errors": errors,
         "cancelled": sum(r["cancelled"] for r in results),
+        "deadline_hit": deadline_hit,
+        "stranded": stranded + missing,
+        "deadline_feasible": len(feasible),
+        "deadline_compliance": (round(feasible_ok / len(feasible), 4)
+                                if feasible else None),
         "shed_rate": round(shed / n, 4) if n else 0.0,
         "error_rate": round(errors / n, 4) if n else 0.0,
         "tokens_out": tokens,

@@ -100,6 +100,21 @@ class Replica:
         self.inflight = 0              # router-accounted live requests
         self.fail_streak = 0
         self.ok_streak = 0
+        # wedged-replica detection (ISSUE 9): the scheduler-progress
+        # counter from the last poll, the consecutive frozen-with-
+        # pending-work streak, and (while wedge-ejected) the frozen
+        # value readmission must move past. ``progressed`` is the
+        # startup-vs-liveness split (k8s startupProbe semantics):
+        # detection only ARMS once the replica has advanced at least
+        # once — a cold first arrival wave legitimately freezes the
+        # counter behind XLA compiles, and SIGKILLing a compiling
+        # replica just makes it compile again. A progress DECREASE
+        # (counter reset = the process restarted) re-disarms it.
+        self.progress: Optional[float] = None
+        self.progressed = False
+        self.stuck_streak = 0
+        self.wedged = False
+        self.wedge_progress: Optional[float] = None
         self.polled: dict = {}         # last /metrics?format=json
         self.cum: Dict[str, float] = {k: 0 for k in AGGREGATED_COUNTERS}
         self._last_raw: Dict[str, float] = {}
@@ -176,6 +191,26 @@ class Replica:
                 "sum": snap.get("sum", 0.0),
                 "count": snap.get("count", 0)}
 
+    @staticmethod
+    def progress_of(polled: dict) -> float:
+        """The monotonic scheduler-progress value from one poll:
+        serve.py exports ``scheduler_progress_total`` (ISSUE 9);
+        older/foreign replicas fall back to a sum of the monotonic
+        counters every serving tier maintains."""
+        v = polled.get("scheduler_progress_total")
+        if isinstance(v, (int, float)):
+            return float(v)
+        return float(polled.get("requests_completed", 0) or 0) \
+            + float(polled.get("tokens_generated_total", 0) or 0)
+
+    @staticmethod
+    def pending_of(polled: dict) -> bool:
+        """Does the replica hold work it should be progressing on?
+        An IDLE replica's frozen counters are healthy — only frozen
+        progress WITH queued or slotted requests is a wedge."""
+        return (float(polled.get("queue_depth", 0) or 0) > 0
+                or float(polled.get("live_slots", 0) or 0) > 0)
+
     def load_estimate(self) -> float:
         """The router's per-replica queue estimate: its own live
         in-flight accounting plus the replica's last-reported internal
@@ -198,7 +233,10 @@ class FleetManager:
                  eject_after: int = 2, readmit_after: int = 2,
                  queue_factor: float = 2.0, slots_hint: int = 4,
                  snapshot_every: int = 20,
-                 on_capacity_change=None):
+                 on_capacity_change=None,
+                 wedge_after: Optional[int] = None,
+                 wedge_grace_s: float = 60.0,
+                 restart_wedged: bool = True):
         self.replicas = {r.rid: r for r in replicas}
         self.policy = policy
         self.radix = FleetRadix(block_tokens=block_tokens,
@@ -209,6 +247,26 @@ class FleetManager:
         self.poll_timeout_s = float(poll_timeout_s)
         self.eject_after = int(eject_after)
         self.readmit_after = int(readmit_after)
+        # wedged-replica detection (ISSUE 9): a replica whose
+        # scheduler progress is frozen across this many polls WHILE it
+        # holds pending work is as dead as one that stopped answering
+        # — today's hang@tick fault answers /healthz forever. The
+        # default window is TIME-based and deliberately generous
+        # (wedge_grace_s): mid-life XLA compiles (a bucket shape first
+        # seen in traffic) legitimately freeze the counter for
+        # seconds, and SIGKILLing a compiling replica just makes it
+        # compile again — while a true hang is forever, so even a
+        # 60 s detection beats stranding (deadlines bound the requests
+        # meanwhile). Deployments with warmed ladders pass an explicit
+        # wedge_after to tighten it.
+        import math
+
+        self.wedge_after = (int(wedge_after) if wedge_after
+                            else max(int(eject_after),
+                                     math.ceil(float(wedge_grace_s)
+                                               / max(self.poll_s,
+                                                     1e-3))))
+        self.restart_wedged = bool(restart_wedged)
         self.queue_factor = float(queue_factor)
         self.slots_hint = int(slots_hint)
         self.snapshot_every = int(snapshot_every)
@@ -226,6 +284,7 @@ class FleetManager:
             "kills_total": 0, "drains_total": 0,
             "routed_prefix_total": 0, "routed_least_loaded_total": 0,
             "routed_round_robin_total": 0, "dispatch_errors_total": 0,
+            "wedged_ejections_total": 0, "wedge_restarts_total": 0,
         }
         self.recoveries_s: List[float] = []
 
@@ -315,12 +374,72 @@ class FleetManager:
                 if polled is not None:
                     r.polled = polled
                     r.absorb_counters(polled)
-                    r.ok_streak += 1
                     r.fail_streak = 0
+                    # wedged-replica detection (ISSUE 9): frozen
+                    # scheduler progress WITH pending work, across
+                    # wedge_after successful polls, is as unhealthy as
+                    # a scrape failure — hang@tick answers /healthz
+                    # forever while every request routed there strands
+                    progress = Replica.progress_of(polled)
+                    pending = Replica.pending_of(polled)
+                    advanced = (r.progress is None
+                                or progress != r.progress)
+                    if r.progress is not None:
+                        if progress > r.progress:
+                            r.progressed = True   # liveness armed
+                        elif progress < r.progress:
+                            # counter reset = restarted process: back
+                            # to startup grace until it advances
+                            r.progressed = False
+                    if (r.state in (HEALTHY, DRAINING) and pending
+                            and r.progressed and not advanced):
+                        r.stuck_streak += 1
+                    else:
+                        r.stuck_streak = 0
+                    r.progress = progress
+                    if (r.state in (HEALTHY, DRAINING)
+                            and r.stuck_streak >= self.wedge_after):
+                        r.state = EJECTED
+                        r.wedged = True
+                        r.wedge_progress = progress
+                        r.stuck_streak = 0
+                        r.ok_streak = 0
+                        r.ejected_at = time.monotonic()
+                        capacity_changed = True
+                        self.stats["ejections_total"] += 1
+                        self.stats["wedged_ejections_total"] += 1
+                        self.radix.drop_replica(r.rid)
+                        self.events.log(
+                            "eject", replica=r.rid, url=url,
+                            reason="wedged",
+                            stuck_polls=self.wedge_after)
+                        # a wedged scheduler never un-wedges itself:
+                        # SIGKILL through the supervisor ⇒ crash-
+                        # classified restart ⇒ READY rediscovery ⇒
+                        # readmission (time-to-recovery recorded)
+                        if (self.restart_wedged and r.managed
+                                and r.supervisor is not None
+                                and r.supervisor.signal_child(
+                                    signal_mod.SIGKILL)):
+                            self.stats["wedge_restarts_total"] += 1
+                            self.events.log("wedge_restart",
+                                            replica=r.rid)
+                        continue
+                    if (r.state == EJECTED and r.wedged
+                            and pending
+                            and progress == r.wedge_progress):
+                        # still the SAME wedged process (frozen at the
+                        # ejection-time progress with work pending):
+                        # a healthy-looking scrape must NOT readmit it
+                        r.ok_streak = 0
+                    else:
+                        r.ok_streak += 1
                     if (r.state in (STARTING, EJECTED)
                             and r.ok_streak >= self.readmit_after):
                         was_ejected = r.state == EJECTED
                         r.state = HEALTHY
+                        r.wedged = False
+                        r.wedge_progress = None
                         capacity_changed = True
                         recovery_s = None
                         if r.ejected_at is not None:
@@ -370,6 +489,21 @@ class FleetManager:
         with self._lock:
             return [r for r in self.replicas.values()
                     if r.state == HEALTHY]
+
+    def _brownout_level_locked(self) -> int:
+        """ONE owner for which replicas count as 'live' for the fleet
+        brownout gauge (caller holds the lock)."""
+        return max((int(r.polled.get("brownout_level", 0) or 0)
+                    for r in self.replicas.values()
+                    if r.state in (HEALTHY, DRAINING)), default=0)
+
+    def brownout_level(self) -> int:
+        """The worst live replica's brownout-ladder level (ISSUE 9):
+        the fleet is as browned-out as its most-pressured member —
+        routing spreads load, so one replica at level 3 means the
+        others are close behind."""
+        with self._lock:
+            return self._brownout_level_locked()
 
     def route(self, ids, policy: Optional[str] = None,
               exclude=()) -> Optional[tuple]:
@@ -490,6 +624,8 @@ class FleetManager:
             out["replicas"] = len(self.replicas)
             out["replicas_healthy"] = sum(
                 1 for r in self.replicas.values() if r.state == HEALTHY)
+            # worst live replica's brownout level (gauge, ISSUE 9)
+            out["fleet_brownout_level"] = self._brownout_level_locked()
             out["inflight"] = sum(r.inflight
                                   for r in self.replicas.values())
             out["radix_nodes"] = self.radix.nodes

@@ -32,7 +32,9 @@ restarts. Stdlib-only, like everything in this package.
 from __future__ import annotations
 
 import http.client
+import itertools
 import json
+import queue as queue_mod
 import socket
 import threading
 import time
@@ -41,9 +43,11 @@ from typing import Optional
 from urllib.parse import parse_qsl, urlsplit
 
 from ..observability.reqtrace import (
+    DEADLINE_EXPIRED_HEADER, DEADLINE_HEADER, Deadline,
     mint_request_id, sanitize_request_id,
 )
-from ..utils.promtext import LatencyHistogram
+from ..resilience import faults
+from ..utils.promtext import LatencyHistogram, histogram_quantile
 from ..utils.promtext import prometheus_text  # noqa: F401 (re-export)
 from .admission import ADMITTED, FairAdmission
 from .placement import POLICIES, affinity_ids
@@ -59,7 +63,10 @@ class RouterStats:
     FIELDS = ("requests_total", "stream_requests_total",
               "unavailable_total", "proxy_retries_total",
               "proxy_errors_total", "proxy_timeouts_total",
-              "client_disconnects_total", "admin_requests_total")
+              "client_disconnects_total", "admin_requests_total",
+              # ISSUE 9: deadline propagation + hedged requests
+              "deadline_expired_total", "hedge_fired_total",
+              "hedge_won_total", "hedge_cancelled_total")
 
     def __init__(self):
         self._lock = threading.Lock()
@@ -71,9 +78,90 @@ class RouterStats:
         with self._lock:
             self._c[field] += n
 
+    def try_hedge(self, policy) -> bool:
+        """Atomically reserve one hedge against the budget: a
+        snapshot-then-bump from N racing request threads could fire
+        past ``frac`` when one slot remains — the check and the
+        increment must share the lock for the bound to hold."""
+        with self._lock:
+            if policy.allow(self._c["requests_total"],
+                            self._c["hedge_fired_total"]):
+                self._c["hedge_fired_total"] += 1
+                return True
+            return False
+
     def snapshot(self) -> dict:
         with self._lock:
             return dict(self._c)
+
+
+class HedgePolicy:
+    """Hedged requests ("The Tail at Scale", Dean & Barroso 2013):
+    when a non-streaming request has waited longer than the fleet's
+    p95, fire the SAME request at a second replica; first servable
+    response wins, the loser is cancelled upstream. Bounded by a
+    budget (``frac`` of all requests, default 5%) so hedging can never
+    double the fleet's load — it only spends extra work on the tail.
+
+    ``delay_ms`` > 0 pins a fixed hedge delay (tests, benches);
+    0 derives it per request from the router's own e2e
+    :class:`LatencyHistogram` at p95 — no hedging until the histogram
+    has ``min_samples`` observations (an empty histogram's p95 is
+    noise, and hedging on noise is just double execution).
+
+    Streaming requests never hedge: two live SSE relays cannot race
+    for one client connection, and the retry logic (PR 6) already
+    isolated the send-phase-safe path — hedging reuses exactly that
+    carve-out."""
+
+    def __init__(self, enabled: bool = False, frac: float = 0.05,
+                 delay_ms: float = 0.0, min_delay_ms: float = 20.0,
+                 min_samples: int = 20, margin_ms: float = 20.0):
+        self.enabled = bool(enabled)
+        self.frac = float(frac)
+        self.delay_ms = float(delay_ms)
+        self.min_delay_ms = float(min_delay_ms)
+        self.min_samples = int(min_samples)
+        #: a hedge must leave at least this much deadline after the
+        #: delay, or firing it would be work the budget cannot use
+        self.margin_s = float(margin_ms) / 1e3
+
+    def delay_s(self, e2e_hist: LatencyHistogram) -> Optional[float]:
+        """The hedge delay for the next request, or None (no hedging
+        right now)."""
+        if not self.enabled:
+            return None
+        if self.delay_ms > 0:
+            return self.delay_ms / 1e3
+        snap = e2e_hist.snapshot()
+        if snap.get("count", 0) < self.min_samples:
+            return None
+        q = histogram_quantile(snap, 0.95)
+        if q is None:
+            return None
+        return max(q, self.min_delay_ms / 1e3)
+
+    def allow(self, requests_total: int, fired_total: int) -> bool:
+        """The hedge budget: fired hedges stay <= frac of requests.
+        (The router reserves budget atomically via
+        :meth:`RouterStats.try_hedge`, which delegates here — this is
+        the one owner of the formula.)"""
+        return fired_total + 1 <= self.frac * max(requests_total, 1)
+
+
+def fleet_brownout_level(manager: FleetManager,
+                         admission: FairAdmission) -> int:
+    """The fleet-wide brownout gauge (ISSUE 9): the worst replica's
+    ladder level, escalated to level 4 (``shed_tenants``) when the
+    router's OWN waiting room is nearly full — and fed back into the
+    admission gate so the per-tenant shed actually engages."""
+    level = manager.brownout_level()
+    depths = admission.depths()
+    if (admission.max_waiting > 0
+            and depths["waiting"] >= 0.9 * admission.max_waiting):
+        level = max(level, 4)
+    admission.set_brownout_level(level)
+    return level
 
 
 def router_metrics(manager: FleetManager, admission: FairAdmission,
@@ -83,6 +171,9 @@ def router_metrics(manager: FleetManager, admission: FairAdmission,
     out = dict(stats.snapshot())
     out["router_ttft_seconds"] = stats.ttft_hist.snapshot()
     out["router_e2e_seconds"] = stats.e2e_hist.snapshot()
+    # fleet brownout gauge (ISSUE 9): worst replica level, escalated
+    # by the router's own waiting-room pressure
+    out["brownout_level"] = fleet_brownout_level(manager, admission)
     if slo is not None:
         out.update(slo.stats())
     mc = manager.snapshot_counters()
@@ -98,6 +189,7 @@ def router_metrics(manager: FleetManager, admission: FairAdmission,
     out["shed_watermark_total"] = adm["shed_watermark"]
     out["shed_tenant_total"] = adm["shed_tenant"]
     out["shed_timeout_total"] = adm["shed_timeout"]
+    out["brownout_shed_total"] = adm["brownout_shed_total"]
     out["avg_service_s"] = adm["avg_service_s"]
     # WFQ waiting-room time as a proper histogram (fleet/admission.py)
     out["admission_wait_seconds"] = adm["wait_seconds"]
@@ -111,8 +203,13 @@ def make_fleet_handler(manager: FleetManager, admission: FairAdmission,
                        allow_admin: bool = False,
                        connect_timeout_s: float = 5.0,
                        read_timeout_s: float = 600.0,
-                       tracer=None, slo=None):
+                       tracer=None, slo=None, hedge=None):
     stats = stats or RouterStats()
+    hedge = hedge or HedgePolicy(enabled=False)
+    # 1-based ordinal of requests reaching the proxy stage: the req
+    # unit of the router-side fault kinds (proxy_latency@req:N /
+    # proxy_blackhole@req:N)
+    proxy_ordinal = itertools.count(1)
 
     class Handler(BaseHTTPRequestHandler):
         protocol_version = "HTTP/1.0"   # connection close delimits SSE
@@ -232,9 +329,23 @@ def make_fleet_handler(manager: FleetManager, admission: FairAdmission,
                     return self._send(400, {
                         "error": f"unknown policy {policy!r}; one of "
                                  f"{list(POLICIES)}"})
+                # deadline propagation (ISSUE 9): the client's
+                # RELATIVE budget, anchored to the router's receipt
+                # (monotonic — skew-free); everything downstream is
+                # charged against it
+                try:
+                    deadline = Deadline.from_header(
+                        self.headers.get(DEADLINE_HEADER), t0=t_req)
+                except ValueError as e:
+                    outcome = "bad_request"
+                    return self._send(400, {"error": str(e)})
                 stream = bool(body.get("stream"))
                 if stream:
                     stats.bump("stream_requests_total")
+                # feed the fleet brownout gauge into the admission
+                # gate (level 4 tightens per-tenant slices) — cheap:
+                # two lock-protected reads per request
+                fleet_brownout_level(manager, admission)
                 if not manager.healthy():
                     stats.bump("unavailable_total")
                     outcome = "unavailable"
@@ -243,14 +354,29 @@ def make_fleet_handler(manager: FleetManager, admission: FairAdmission,
                         headers=[("Retry-After",
                                   str(admission.retry_after_s()))])
                 # the WFQ waiting room — the span that answers "was
-                # the p99 spent queueing at the front door?"
+                # the p99 spent queueing at the front door?". A
+                # deadlined request never waits past its own budget.
                 t_aw = time.monotonic()
-                adm_outcome = admission.submit(tenant)
+                sub_timeout = None
+                if deadline is not None:
+                    sub_timeout = max(
+                        min(admission.queue_timeout_s,
+                            deadline.remaining_s(t_aw)), 0.0)
+                adm_outcome = admission.submit(tenant,
+                                               timeout_s=sub_timeout)
                 if tracer is not None:
                     tracer.add(rid, "admission_wait", t_aw,
                                time.monotonic(), tenant=tenant,
                                outcome=adm_outcome)
                 if adm_outcome != ADMITTED:
+                    if (deadline is not None and deadline.expired()):
+                        # the admission wait ate the budget: the
+                        # honest answer is 504-dead, not 429-retry
+                        outcome = "deadline"
+                        return self._send(
+                            504, {"error": "deadline expired in "
+                                           "admission"},
+                            headers=[(DEADLINE_EXPIRED_HEADER, "1")])
                     outcome = adm_outcome
                     retry_s = admission.retry_after_s()
                     return self._send(
@@ -260,18 +386,35 @@ def make_fleet_handler(manager: FleetManager, admission: FairAdmission,
                         headers=[("Retry-After", str(retry_s))])
                 t0 = time.monotonic()
                 try:
-                    # only a request that actually reached a replica
-                    # counts as "proxied" — route-time 503/502s must
-                    # not land in the e2e histogram or breach an SLO
-                    # (an outage would otherwise drag fleet p50 DOWN
-                    # and dump never-served requests as slow)
-                    outcome = self._route_and_proxy(
-                        body, raw, policy, rid, tenant, holder)
+                    if deadline is not None and deadline.expired(t0):
+                        # admitted, but already dead: shed BEFORE the
+                        # proxy hop — a replica must never spend chip
+                        # time on a request nobody is waiting for
+                        outcome = "deadline"
+                        self._send(
+                            504, {"error": "deadline expired before "
+                                           "dispatch"},
+                            headers=[(DEADLINE_EXPIRED_HEADER, "1")])
+                    else:
+                        # only a request that actually reached a
+                        # replica counts as "proxied" — route-time
+                        # 503/502s must not land in the e2e histogram
+                        # or breach an SLO (an outage would otherwise
+                        # drag fleet p50 DOWN and dump never-served
+                        # requests as slow)
+                        outcome = self._route_and_proxy(
+                            body, raw, policy, rid, tenant, holder,
+                            deadline, stream)
                 finally:
                     admission.release()
                     admission.observe_service_s(time.monotonic() - t0)
             finally:
                 t_end = time.monotonic()
+                if outcome == "deadline":
+                    # ONE owner for the counter: every deadline path
+                    # (admission wait, pre-dispatch, proxy hop,
+                    # replica-marked response) funnels through here
+                    stats.bump("deadline_expired_total")
                 if outcome == "proxied":
                     stats.e2e_hist.observe(t_end - t_req)
                     if slo is not None:
@@ -287,19 +430,39 @@ def make_fleet_handler(manager: FleetManager, admission: FairAdmission,
 
         def _route_and_proxy(self, body: dict, raw: bytes,
                              policy, rid: str, tenant: str,
-                             holder: dict) -> str:
+                             holder: dict, deadline=None,
+                             stream: bool = False) -> str:
             """Returns the request outcome: ``proxied`` (a replica
             served it), ``proxy_failed`` (dispatched but the router
             answered 504/502 or the replica died mid-stream — an
             in-flight casualty, not a served request),
             ``upstream_error`` (the replica's own 4xx/5xx, relayed
             verbatim but not a served request), ``cancelled`` (client
-            disconnected mid-stream), ``unroutable`` (route-time 503),
-            or ``unreachable`` (502 after the retry). Only ``proxied``
-            requests enter the e2e histogram / SLO check."""
+            disconnected mid-stream), ``deadline`` (the budget
+            expired — out of the served SLO, like cancelled),
+            ``unroutable`` (route-time 503), or ``unreachable`` (502
+            after the retry). Only ``proxied`` requests enter the e2e
+            histogram / SLO check."""
             ids = affinity_ids(body)
+            # router-side fault hook (ISSUE 9): proxy_latency sleeps
+            # in place; a fired proxy_blackhole rides into the FIRST
+            # attempt (its connection never happens, nothing answers)
+            blackhole = faults.on_proxy_request(next(proxy_ordinal))
+            # hedged dispatch (non-streaming only): fire a second
+            # attempt after the p95-based delay, first servable
+            # response wins — bounded by the hedge budget and the
+            # remaining deadline (no hedge into a dead budget)
+            if not stream:
+                delay = hedge.delay_s(stats.e2e_hist)
+                if delay is not None and (
+                        deadline is None
+                        or deadline.remaining_s()
+                        > delay + hedge.margin_s):
+                    return self._hedged_proxy(
+                        ids, raw, policy, rid, tenant, deadline,
+                        blackhole, delay)
             excluded: set = set()
-            for _attempt in range(2):
+            for attempt in range(2):
                 picked = manager.route(ids, policy=policy,
                                        exclude=excluded)
                 if picked is None:
@@ -313,8 +476,11 @@ def make_fleet_handler(manager: FleetManager, admission: FairAdmission,
                 manager.begin(replica)
                 t_p0 = time.monotonic()
                 try:
-                    verdict = self._proxy(replica, raw, rid, tenant,
-                                          holder)
+                    verdict = self._proxy(
+                        replica, raw, rid, tenant, holder,
+                        deadline=deadline,
+                        blackhole=(blackhole if attempt == 0
+                                   else None))
                 finally:
                     manager.end(replica)
                     if tracer is not None:
@@ -331,7 +497,15 @@ def make_fleet_handler(manager: FleetManager, admission: FairAdmission,
                                                           verdict)
                 # connection-level failure before anything dispatched:
                 # safe to try one other replica (the health poller will
-                # eject the dead one on its own clock)
+                # eject the dead one on its own clock) — but NEVER
+                # into a budget that already expired (ISSUE 9): the
+                # retry would spend a replica on a dead request
+                if deadline is not None and deadline.expired():
+                    self._send(
+                        504, {"error": "deadline expired before "
+                                       "retry"},
+                        headers=[(DEADLINE_EXPIRED_HEADER, "1")])
+                    return "deadline"
                 excluded.add(replica.rid)
                 manager.note_dispatch_error(replica)
                 stats.bump("proxy_retries_total")
@@ -339,8 +513,98 @@ def make_fleet_handler(manager: FleetManager, admission: FairAdmission,
             self._send(502, {"error": "no replica reachable"})
             return "unreachable"
 
+        @staticmethod
+        def _proxy_headers(rid: str, tenant: str, deadline) -> dict:
+            """The propagated hop headers: request identity + tenant
+            (ISSUE 8) and the REMAINING deadline budget (ISSUE 9 —
+            relative ms, so the hop is clock-skew-free)."""
+            headers = {"Content-Type": "application/json",
+                       "X-Request-Id": rid, "X-Tenant": tenant}
+            if deadline is not None:
+                headers[DEADLINE_HEADER] = deadline.header_value()
+            return headers
+
+        @staticmethod
+        def _read_timeout_s(deadline) -> float:
+            """Upstream read timeout: the generation-scale budget,
+            bounded by the remaining deadline (+ a grace slice for the
+            replica's own truncate-and-respond path) — a wedged or
+            stalled replica costs a deadlined request its deadline,
+            never the full 600 s read budget."""
+            if deadline is None:
+                return read_timeout_s
+            return max(min(read_timeout_s,
+                           deadline.remaining_s() + 0.25), 0.05)
+
+        def _open_upstream(self, replica, raw: bytes, rid: str,
+                           tenant: str, deadline, state=None):
+            """Connect + send + await the status line for one
+            upstream attempt — the ONE owner of the hop's wire
+            mechanics (the live streaming path and the buffered
+            hedging path both consume it). Returns ``(verdict, conn,
+            resp)``: ``ok`` (resp live), ``retry`` (nothing reached
+            the replica — safe to try another), ``timeout`` (the
+            deadline-bounded read fired), or ``dead`` (the request
+            WAS delivered and the replica failed — not retry-safe).
+            The caller owns closing ``conn``. ``state`` (hedging)
+            gets the conn before any blocking call so a canceller can
+            close it."""
+            url = urlsplit(replica.url)
+            # two timeouts, two failure classes: a replica that
+            # cannot even ACCEPT within connect_timeout_s is
+            # retry-safe (nothing was sent — don't strand this thread
+            # for the full generation budget on a blackholed port);
+            # once connected, reads get the generation-scale timeout
+            # bounded by the remaining deadline
+            conn = http.client.HTTPConnection(
+                url.hostname, url.port, timeout=connect_timeout_s)
+            if state is not None:
+                state["conn"] = conn
+            try:
+                conn.connect()
+            except OSError:       # refused, unreachable, OR timed
+                return "retry", conn, None   # out: nothing sent
+            conn.sock.settimeout(self._read_timeout_s(deadline))
+            try:
+                # propagate the request identity + tenant so the
+                # replica's spans key on the SAME rid the router's
+                # do — plus the remaining deadline budget (ISSUE 9)
+                conn.request("POST", "/generate", body=raw,
+                             headers=self._proxy_headers(
+                                 rid, tenant, deadline))
+            except OSError:
+                # send failed: the replica never got a complete
+                # request — still retry-safe
+                return "retry", conn, None
+            try:
+                return "ok", conn, conn.getresponse()
+            except socket.timeout:
+                return "timeout", conn, None
+            except OSError:
+                # the request WAS delivered and may be executing:
+                # retrying would double-run it (the kill-recovery
+                # contract: a replica death costs its in-flight)
+                return "dead", conn, None
+
+        def _blackhole_wait(self, deadline, state=None) -> str:
+            """The ``proxy_blackhole`` fault: this attempt reaches no
+            replica and nothing ever answers. Waits until cancelled
+            (a hedge won — the no-double-execution proof: NOTHING was
+            sent), the deadline expires, or the read budget caps out;
+            returns the attempt verdict."""
+            cap = time.monotonic() + read_timeout_s
+            if deadline is not None:
+                cap = min(cap, deadline.deadline_at())
+            while time.monotonic() < cap:
+                if state is not None and state.get("cancelled"):
+                    return "cancelled"
+                time.sleep(0.02)
+            return ("deadline" if deadline is not None
+                    and deadline.expired() else "timeout")
+
         def _proxy(self, replica, raw: bytes, rid: str, tenant: str,
-                   holder: dict) -> str:
+                   holder: dict, deadline=None,
+                   blackhole=None) -> str:
             """Forward one request; returns ``done``, ``failed``
             (dispatched, but the router synthesized a 504/502 error
             response or the replica died mid-stream — not retry-safe,
@@ -348,46 +612,37 @@ def make_fleet_handler(manager: FleetManager, admission: FairAdmission,
             ``upstream_error`` (the replica answered 4xx/5xx —
             relayed, but its ~1 ms error turnaround must not drag the
             served-latency histograms down), ``cancelled`` (the
-            client hung up mid-stream), or ``retry`` (retry ONLY when
+            client hung up mid-stream), ``deadline`` (the budget
+            expired at this hop, or the replica marked its response
+            deadline-truncated), or ``retry`` (retry ONLY when
             nothing reached the replica)."""
-            url = urlsplit(replica.url)
-            # two timeouts, two failure classes: a replica that cannot
-            # even ACCEPT within connect_timeout_s is retry-safe
-            # (nothing was sent — don't strand this thread for the
-            # full generation budget on a blackholed port); once
-            # connected, reads get the generation-scale timeout
-            conn = http.client.HTTPConnection(
-                url.hostname, url.port, timeout=connect_timeout_s)
+            if blackhole is not None:
+                verdict = self._blackhole_wait(deadline)
+                if verdict == "deadline":
+                    self._send(
+                        504, {"error": "deadline expired (replica "
+                                       "unresponsive)"},
+                        headers=[(DEADLINE_EXPIRED_HEADER, "1")])
+                    return "deadline"
+                stats.bump("proxy_timeouts_total")
+                self._send(504, {"error": "replica timed out"})
+                return "failed"
+            verdict, conn, resp = self._open_upstream(
+                replica, raw, rid, tenant, deadline)
             try:
-                try:
-                    conn.connect()
-                except OSError:       # refused, unreachable, OR timed
-                    return "retry"    # out connecting: nothing sent
-                conn.sock.settimeout(read_timeout_s)
-                try:
-                    # propagate the request identity + tenant so the
-                    # replica's spans key on the SAME rid the router's
-                    # do — the whole point of the stitcher
-                    conn.request(
-                        "POST", "/generate", body=raw,
-                        headers={"Content-Type": "application/json",
-                                 "X-Request-Id": rid,
-                                 "X-Tenant": tenant})
-                except OSError:
-                    # send failed: the replica never got a complete
-                    # request — still retry-safe
+                if verdict == "retry":
                     return "retry"
-                try:
-                    resp = conn.getresponse()
-                except socket.timeout:
+                if verdict == "timeout":
+                    if deadline is not None and deadline.expired():
+                        self._send(
+                            504, {"error": "deadline expired waiting "
+                                           "for the replica"},
+                            headers=[(DEADLINE_EXPIRED_HEADER, "1")])
+                        return "deadline"
                     stats.bump("proxy_timeouts_total")
                     self._send(504, {"error": "replica timed out"})
                     return "failed"
-                except OSError:
-                    # the request WAS delivered and may be executing:
-                    # retrying would double-run it and inflate fleet
-                    # counters — this is an in-flight casualty of the
-                    # replica's death (the kill-recovery contract)
+                if verdict == "dead":
                     stats.bump("proxy_errors_total")
                     self._send(502, {
                         "error": "replica failed before responding"})
@@ -395,7 +650,8 @@ def make_fleet_handler(manager: FleetManager, admission: FairAdmission,
                 ct = resp.getheader("Content-Type",
                                     "application/json")
                 if ct.startswith("text/event-stream"):
-                    return self._relay_sse(resp, conn, ct, holder)
+                    return self._relay_sse(resp, conn, ct, holder,
+                                           deadline)
                 try:
                     data = resp.read()
                 except (http.client.HTTPException, OSError):
@@ -408,6 +664,11 @@ def make_fleet_handler(manager: FleetManager, admission: FairAdmission,
                         "error": "replica failed mid-response"})
                     return "failed"
                 self._send_raw(resp.status, data, ct)
+                # a replica-marked deadline response (200 + partial
+                # tokens, or its own 504) relays verbatim but is
+                # classified OUT of the served SLO, like cancelled
+                if resp.getheader(DEADLINE_EXPIRED_HEADER):
+                    return "deadline"
                 # the replica's own error responses (429 queue-full,
                 # 400 bad body, 500) relay verbatim but are NOT
                 # served requests: the replica itself excludes them
@@ -417,8 +678,226 @@ def make_fleet_handler(manager: FleetManager, admission: FairAdmission,
             finally:
                 conn.close()
 
+        def _proxy_buffered(self, replica, raw: bytes, rid: str,
+                            tenant: str, deadline, blackhole,
+                            state: dict) -> dict:
+            """One HEDGEABLE (non-streaming) proxy attempt: same wire
+            mechanics as ``_proxy`` but the response is BUFFERED and
+            returned instead of written — the hedging race in
+            ``_hedged_proxy`` decides whose buffer reaches the client,
+            so exactly one response is ever sent. ``state`` is the
+            race's shared slot: the canceller sets ``cancelled`` and
+            closes ``conn``, which surfaces here as an OSError the
+            verdict logic reclassifies."""
+
+            def verdict(v):
+                return {"verdict": ("cancelled" if state.get(
+                    "cancelled") else v)}
+
+            if blackhole is not None:
+                return {"verdict": self._blackhole_wait(deadline,
+                                                        state)}
+            wire, conn, resp = self._open_upstream(
+                replica, raw, rid, tenant, deadline, state=state)
+            try:
+                if wire == "retry":
+                    return verdict("retry")
+                if wire == "timeout":
+                    return verdict(
+                        "deadline" if deadline is not None
+                        and deadline.expired() else "timeout")
+                if wire == "dead":
+                    return verdict("failed")
+                ct = resp.getheader("Content-Type",
+                                    "application/json")
+                if ct.startswith("text/event-stream"):
+                    # hedged attempts are non-streaming by contract;
+                    # a replica answering SSE to a non-stream body is
+                    # a failure, not something to buffer
+                    return verdict("failed")
+                try:
+                    data = resp.read()
+                except (http.client.HTTPException, OSError):
+                    return verdict("failed")
+                return {
+                    "verdict": ("done" if resp.status < 400
+                                else "upstream_error"),
+                    "status": resp.status, "body": data, "ct": ct,
+                    "deadline_marked": bool(
+                        resp.getheader(DEADLINE_EXPIRED_HEADER)),
+                }
+            finally:
+                conn.close()
+
+        def _hedged_proxy(self, ids, raw: bytes, policy, rid: str,
+                          tenant: str, deadline, blackhole,
+                          delay_s: float) -> str:
+            """Hedged dispatch for a non-streaming request: start the
+            primary attempt, wait ``delay_s``; if it has not answered
+            and the hedge budget + remaining deadline allow, fire the
+            SAME request at a second replica. First servable response
+            (2xx/4xx/5xx from a replica) wins and is relayed; the
+            loser's connection closes (cancelled upstream — the slot
+            engine's disconnect cancel fires on the replica). Connect-
+            level failures keep the retry-once contract: a replacement
+            attempt on another replica, never into an expired
+            deadline."""
+            results: "queue_mod.Queue" = queue_mod.Queue()
+            excluded: set = set()
+            attempts: list = []
+            t_start = time.monotonic()
+
+            def launch(kind, bh):
+                picked = manager.route(ids, policy=policy,
+                                       exclude=excluded)
+                if picked is None:
+                    return None
+                replica, reason = picked
+                excluded.add(replica.rid)
+                manager.begin(replica)
+                state = {"conn": None, "cancelled": False,
+                         "replica": replica, "kind": kind}
+                attempts.append(state)
+
+                def run():
+                    t_p0 = time.monotonic()
+                    try:
+                        res = self._proxy_buffered(
+                            replica, raw, rid, tenant, deadline, bh,
+                            state)
+                    except Exception:   # noqa: BLE001 — one attempt's
+                        res = {"verdict": "failed"}   # wreck must not
+                    finally:            # strand the race
+                        manager.end(replica)
+                        if tracer is not None:
+                            tracer.add(rid, "proxy", t_p0,
+                                       time.monotonic(),
+                                       replica=replica.rid,
+                                       reason=reason, kind=kind)
+                    results.put((state, res))
+
+                threading.Thread(target=run, daemon=True).start()
+                return state
+
+            if launch("primary", blackhole) is None:
+                stats.bump("unavailable_total")
+                self._send(
+                    503, {"error": "no healthy replicas"},
+                    headers=[("Retry-After",
+                              str(admission.retry_after_s()))])
+                return "unroutable"
+            overall = t_start + read_timeout_s
+            if deadline is not None:
+                overall = min(overall, deadline.deadline_at())
+            hedge_done = False      # fired, or decided not to
+            retried = False
+            pending = 1
+            saw_timeout = False
+            saw_dead = False        # delivered, then the replica died
+
+            def cancel_losers(winner, count: bool = True):
+                """Close every other attempt's upstream connection
+                (the replica-side disconnect cancel). ``count``
+                distinguishes a race RESOLVED by a winner (the loser
+                is a cancelled hedge — counted) from exit-path hygiene
+                on a request that failed outright (not a hedge win,
+                not counted)."""
+                for s in attempts:
+                    if s is winner or s.get("settled"):
+                        continue
+                    s["cancelled"] = True
+                    conn = s.get("conn")
+                    if conn is not None:
+                        try:
+                            conn.close()   # upstream cancel signal
+                        except OSError:
+                            pass
+                    if count:
+                        stats.bump("hedge_cancelled_total")
+
+            while pending > 0:
+                now = time.monotonic()
+                if now >= overall:
+                    break
+                timeout = overall - now
+                if not hedge_done:
+                    timeout = min(timeout,
+                                  max(t_start + delay_s - now, 0.0))
+                try:
+                    state, res = results.get(
+                        timeout=max(timeout, 1e-3))
+                except queue_mod.Empty:
+                    if not hedge_done:
+                        hedge_done = True
+                        if ((deadline is None
+                                or deadline.remaining_s()
+                                > hedge.margin_s)
+                                and stats.try_hedge(hedge)):
+                            if launch("hedge", None) is not None:
+                                pending += 1
+                            else:
+                                # no second replica: refund the
+                                # atomically-reserved budget slot
+                                stats.bump("hedge_fired_total", -1)
+                    continue
+                pending -= 1
+                state["settled"] = True
+                v = res["verdict"]
+                if v == "cancelled":
+                    continue            # a loser we already counted
+                if v in ("done", "upstream_error"):
+                    cancel_losers(state)
+                    if state["kind"] == "hedge":
+                        stats.bump("hedge_won_total")
+                    self._send_raw(res["status"], res["body"],
+                                   res["ct"])
+                    if res.get("deadline_marked"):
+                        return "deadline"
+                    return ("proxied" if v == "done"
+                            else "upstream_error")
+                if v == "retry":
+                    # connect-level failure: nothing reached the
+                    # replica — replace the attempt (the retry-once
+                    # contract), unless the budget is dead or another
+                    # attempt is still racing
+                    manager.note_dispatch_error(state["replica"])
+                    if (not retried and pending == 0
+                            and (deadline is None
+                                 or not deadline.expired())):
+                        retried = True
+                        if launch("retry", None) is not None:
+                            stats.bump("proxy_retries_total")
+                            pending += 1
+                    continue
+                if v == "timeout":
+                    saw_timeout = True
+                elif v == "failed":
+                    saw_dead = True
+                # failed/timeout: wait for any remaining attempt
+            cancel_losers(None, count=False)
+            if deadline is not None and deadline.expired():
+                self._send(
+                    504, {"error": "deadline expired"},
+                    headers=[(DEADLINE_EXPIRED_HEADER, "1")])
+                return "deadline"
+            if saw_timeout or pending > 0:
+                stats.bump("proxy_timeouts_total")
+                self._send(504, {"error": "replica timed out"})
+                return "proxy_failed"
+            if saw_dead:
+                # delivered and possibly executed — the same
+                # in-flight-casualty classification as the non-hedged
+                # path, NOT "unreachable" (a replica was reached)
+                stats.bump("proxy_errors_total")
+                self._send(502, {
+                    "error": "replica failed before responding"})
+                return "proxy_failed"
+            stats.bump("proxy_errors_total")
+            self._send(502, {"error": "no replica reachable"})
+            return "unreachable"
+
         def _relay_sse(self, resp, conn, content_type: str,
-                       holder: dict) -> str:
+                       holder: dict, deadline=None) -> str:
             """Stream the replica's SSE bytes through as they arrive
             (line-granular: events are ``data: ...\\n\\n`` frames, and
             flushing on the blank separator keeps TTFT real). A client
@@ -436,15 +915,60 @@ def make_fleet_handler(manager: FleetManager, admission: FairAdmission,
             if self._rid:
                 self.send_header("X-Request-Id", self._rid)
             self.end_headers()
+            deadline_marked = False
             try:
                 while True:
+                    if deadline is not None:
+                        # WALL-CLOCK bound, not just per-read: a
+                        # replica that keeps dripping deltas re-arms
+                        # any fixed socket timeout forever — check the
+                        # budget between reads and re-arm the socket
+                        # to the REMAINING slice so neither a stall
+                        # nor a drip-feed holds the client past it.
+                        # (conn.sock detaches on close-delimited
+                        # responses — the RESPONSE's reader holds the
+                        # live socket.)
+                        if deadline.expired():
+                            return "deadline"
+                        sock = conn.sock or getattr(
+                            getattr(resp, "fp", None), "raw", None)
+                        sock = getattr(sock, "_sock", sock)
+                        try:
+                            if sock is not None:
+                                # _read_timeout_s keeps the
+                                # read_timeout_s cap: a huge client
+                                # deadline must never WEAKEN the
+                                # router's stall bound
+                                sock.settimeout(
+                                    self._read_timeout_s(deadline))
+                        except OSError:
+                            pass
                     try:
                         line = resp.readline()
+                    except socket.timeout:
+                        # the deadline-bounded upstream read fired: a
+                        # stalled (stall_stream) or wedged replica
+                        # cannot hold this client past its budget —
+                        # truncate the stream, classify honestly
+                        if (deadline is not None
+                                and deadline.expired()):
+                            return "deadline"
+                        stats.bump("proxy_timeouts_total")
+                        return "failed"
                     except (http.client.HTTPException, OSError):
                         stats.bump("proxy_errors_total")
                         return "failed"   # died mid-stream: truncate
                     if not line:
-                        return "done"     # upstream closed: complete
+                        # upstream closed: complete. A deadline-
+                        # truncated stream completed NORMALLY from the
+                        # wire's point of view — the final event's
+                        # stop_reason (sniffed below; SSE headers went
+                        # out long ago) reclassifies it out of the SLO
+                        return ("deadline" if deadline_marked
+                                else "done")
+                    if (line.startswith(b"data:")
+                            and b'"stop_reason": "deadline"' in line):
+                        deadline_marked = True
                     if ("ttft_s" not in holder
                             and line.startswith(b"data:")):
                         ttft = time.monotonic() - holder["t0"]
@@ -467,12 +991,16 @@ def build_router(manager: FleetManager, admission: FairAdmission,
                  stats: Optional[RouterStats] = None,
                  allow_admin: bool = False,
                  read_timeout_s: float = 600.0,
-                 tracer=None, slo=None) -> ThreadingHTTPServer:
+                 tracer=None, slo=None,
+                 hedge: Optional[HedgePolicy] = None
+                 ) -> ThreadingHTTPServer:
     """Bind the front-door server (``port`` 0 picks a free one; the
     bound address is ``server.server_address``). ``tracer``/``slo``
     attach the request-scoped tracing + SLO layer
-    (observability/reqtrace.py) — optional, None = off."""
+    (observability/reqtrace.py) — optional, None = off. ``hedge``
+    attaches the hedged-request policy (ISSUE 9) — None = no hedging."""
     handler = make_fleet_handler(
         manager, admission, stats=stats, allow_admin=allow_admin,
-        read_timeout_s=read_timeout_s, tracer=tracer, slo=slo)
+        read_timeout_s=read_timeout_s, tracer=tracer, slo=slo,
+        hedge=hedge)
     return ThreadingHTTPServer((host, port), handler)

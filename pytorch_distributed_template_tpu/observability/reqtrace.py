@@ -83,6 +83,72 @@ def sanitize_request_id(rid) -> Optional[str]:
 
 
 # ---------------------------------------------------------------------------
+# deadline propagation (ISSUE 9)
+# ---------------------------------------------------------------------------
+
+DEADLINE_HEADER = "X-Deadline-Ms"
+DEADLINE_EXPIRED_HEADER = "X-Deadline-Expired"
+
+#: clamp bounds for a client-supplied deadline budget (milliseconds):
+#: 0/negative is meaningless, and anything past an hour is "no deadline
+#: in practice" — clamping keeps hostile headers from minting huge ints
+MIN_DEADLINE_MS = 1
+MAX_DEADLINE_MS = 3_600_000
+
+
+class Deadline:
+    """A request's remaining time budget, monotonic-clock only.
+
+    The wire form is RELATIVE (``X-Deadline-Ms: 1500`` = "you have
+    1.5 s from receipt"), so propagation is clock-skew-free by
+    construction: each hop anchors the budget to its OWN
+    ``time.monotonic()`` at receipt and forwards the REMAINING budget
+    (``header_value()``), never an absolute timestamp two clocks could
+    disagree about. Wall-clock steps (NTP) cannot move a deadline
+    mid-request."""
+
+    __slots__ = ("t0", "budget_s")
+
+    def __init__(self, budget_s: float, t0: Optional[float] = None):
+        self.budget_s = float(budget_s)
+        self.t0 = time.monotonic() if t0 is None else float(t0)
+
+    @classmethod
+    def from_header(cls, value, t0: Optional[float] = None
+                    ) -> Optional["Deadline"]:
+        """Parse an ``X-Deadline-Ms`` header -> Deadline, or None when
+        absent. Raises ``ValueError`` on a malformed value (the caller
+        answers 400 — a silently dropped deadline would serve an
+        unbounded request the client thinks is bounded)."""
+        if value is None or (isinstance(value, str)
+                             and not value.strip()):
+            return None
+        ms = int(str(value).strip())     # ValueError on garbage
+        if ms <= 0:
+            raise ValueError(f"{DEADLINE_HEADER} must be a positive "
+                             f"integer (got {ms})")
+        ms = max(MIN_DEADLINE_MS, min(ms, MAX_DEADLINE_MS))
+        return cls(ms / 1e3, t0=t0)
+
+    def remaining_s(self, now: Optional[float] = None) -> float:
+        now = time.monotonic() if now is None else now
+        return self.budget_s - (now - self.t0)
+
+    def expired(self, now: Optional[float] = None) -> bool:
+        return self.remaining_s(now) <= 0.0
+
+    def header_value(self, now: Optional[float] = None) -> str:
+        """The REMAINING budget for the next hop (floor 1 ms: a
+        forwarded deadline of 0 would be a malformed header)."""
+        return str(max(int(round(self.remaining_s(now) * 1e3)),
+                       MIN_DEADLINE_MS))
+
+    def deadline_at(self) -> float:
+        """Absolute monotonic expiry (engine-internal convenience)."""
+        return self.t0 + self.budget_s
+
+
+# ---------------------------------------------------------------------------
 # the per-process tracer
 # ---------------------------------------------------------------------------
 
@@ -243,9 +309,17 @@ class SloWatcher:
     def enabled(self) -> bool:
         return self.ttft_s is not None or self.e2e_s is not None
 
+    #: terminal classifications that are OUT of the served-latency SLO:
+    #: a cancelled request's latency is the client's choice, a
+    #: deadline-truncated one's is the deadline's (ISSUE 9) — counting
+    #: either as a breach would punish the mechanisms that bound tails
+    EXEMPT_OUTCOMES = ("cancelled", "deadline")
+
     def observe(self, rid: str, ttft_s: Optional[float] = None,
                 e2e_s: Optional[float] = None, **extra) -> List[str]:
         """Returns the breach reasons (empty = inside SLO)."""
+        if extra.get("stop_reason") in self.EXEMPT_OUTCOMES:
+            return []
         reasons = []
         if (self.ttft_s is not None and ttft_s is not None
                 and ttft_s > self.ttft_s):

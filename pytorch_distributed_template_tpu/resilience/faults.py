@@ -44,6 +44,43 @@ kind             unit    effect at the hook point
                          path knows NOT to re-enter the checkpointer
 ===============  ======  ==========================================
 
+Serving-path kinds (ISSUE 9) — same grammar, attempt-gated and
+once-per-process like the training kinds, but triggered on the
+serving stack's own ordinals instead of training steps:
+
+==================  ====  ==========================================
+kind                unit  effect at the hook point
+==================  ====  ==========================================
+``slow_decode``     tick  ``time.sleep(arg)`` inside the continuous
+                          scheduler's round when its chunk counter
+                          reaches ``at`` (a slow replica: everything
+                          in flight there stalls; hedging/deadlines
+                          are the designated mitigation)
+``hang``            tick  the scheduler round blocks FOREVER at
+                          chunk ``at`` while ``/healthz`` and
+                          ``/metrics`` keep answering — the wedge
+                          the fleet poller's frozen-progress
+                          detection exists to catch
+``pool_exhaust``    tick  the paged prefix pool reports dry for
+                          ``arg`` (default 1s) starting at chunk
+                          ``at``: admissions defer, queues build,
+                          brownout pressure rises
+``stall_stream``    req   the ``at``-th ``/generate`` request of
+                          this serve.py process stalls its SSE
+                          stream after the first delta WITHOUT
+                          closing (the router's deadline-bounded
+                          read is what frees the client)
+``proxy_latency``   req   ``time.sleep(arg)`` before proxying the
+                          ``at``-th router request (a slow hop)
+``proxy_blackhole`` req   the first proxy attempt of the ``at``-th
+                          router request never reaches a replica
+                          and never answers (hedge/timeout territory)
+``ckpt_corrupt``    load  the ``at``-th serving-artifact load sees
+                          a corrupted manifest digest: the loader
+                          must refuse LOUDLY instead of serving
+                          garbage weights
+==================  ====  ==========================================
+
 Attempt gating: each spec fires only on one supervisor attempt
 (default the first), so a ``kill@step:5`` chaos run dies once and the
 restarted attempt — the supervisor exports ``PDT_ATTEMPT=n`` — sails
@@ -76,7 +113,24 @@ KINDS = {
     "slow_host": "step",
     "loader_raise": "batch",
     "ckpt_write_fail": "epoch",
+    # serving-path kinds (ISSUE 9): tick = the continuous scheduler's
+    # chunk counter, req = this process's /generate ordinal (router or
+    # replica — each counts its own), load = serving-artifact load
+    # ordinal. Same attempt gating + once-per-process as the training
+    # kinds; the supervisor's PDT_ATTEMPT export means a restarted
+    # replica sails past the fault that killed/wedged attempt 1.
+    "slow_decode": "tick",
+    "hang": "tick",
+    "pool_exhaust": "tick",
+    "stall_stream": "req",
+    "proxy_latency": "req",
+    "proxy_blackhole": "req",
+    "ckpt_corrupt": "load",
 }
+
+#: kinds whose optional arg is a duration (validated at parse time)
+_DURATION_KINDS = ("slow_host", "slow_decode", "pool_exhaust",
+                   "stall_stream", "proxy_latency")
 
 ENV_PLAN = "PDT_FAULTS"
 ENV_ATTEMPT = "PDT_ATTEMPT"
@@ -174,7 +228,7 @@ class FaultPlan:
                 )
             at = int(trigger[1])
             arg = trigger[2].strip() if len(trigger) == 3 else None
-            if kind == "slow_host" and arg is not None:
+            if kind in _DURATION_KINDS and arg is not None:
                 _parse_duration_s(arg)  # validate at parse time
             specs.append(FaultSpec(kind, unit, at, arg, attempt))
         return cls(specs)
@@ -229,8 +283,9 @@ def configure(text: Optional[str] = None,
 
 def reset() -> None:
     """Drop the plan entirely (tests)."""
-    global _plan, _attempt, _active, _watched_loader_id
+    global _plan, _attempt, _active, _watched_loader_id, _load_ordinal
     _plan, _attempt, _active, _watched_loader_id = None, 1, [], None
+    _load_ordinal = 0
 
 
 def watch_loader(loader) -> None:
@@ -337,6 +392,84 @@ def nan_grad_step() -> Optional[int]:
         if s.kind == "nan_grad":
             return s.at
     return None
+
+
+# ---------------------------------------------------------------------------
+# serving-path hook points (ISSUE 9)
+# ---------------------------------------------------------------------------
+
+#: serving-artifact load ordinal (1-based) for the ``load`` unit
+_load_ordinal = 0
+
+
+def on_serve_tick(tick: int):
+    """Continuous-scheduler hook, called once per scheduler round with
+    the engine's chunk counter. Handles ``slow_decode`` (sleep, then
+    continue) and ``hang`` (block this thread FOREVER — ``/healthz``
+    keeps answering from the HTTP threads, which is exactly the wedge
+    the fleet poller's frozen-progress detection exists for) in place;
+    returns the fired ``pool_exhaust`` spec (the engine owns the drain
+    window) or None."""
+    if _plan is None:
+        _ensure_configured()
+    if not _active:
+        return None
+    s = _take("slow_decode", tick)
+    if s is not None:
+        logger.warning("fault slow_decode: sleeping %.3fs at tick %d",
+                       s.duration_s, tick)
+        time.sleep(s.duration_s)
+    s = _take("hang", tick)
+    if s is not None:
+        logger.warning("fault hang: wedging scheduler at tick %d "
+                       "(healthz stays up)", tick)
+        import threading
+
+        threading.Event().wait()       # never set: wedged by design
+    return _take("pool_exhaust", tick)
+
+
+def on_serve_request(ordinal: int):
+    """Replica request hook (serve.py ``/generate`` ordinal, 1-based):
+    returns the fired ``stall_stream`` spec (the SSE handler owns the
+    stall) or None."""
+    if _plan is None:
+        _ensure_configured()
+    if not _active:
+        return None
+    return _take("stall_stream", ordinal)
+
+
+def on_proxy_request(ordinal: int):
+    """Router request hook (front-door ``/generate`` ordinal,
+    1-based). Handles ``proxy_latency`` in place (sleep before the
+    hop); returns the fired ``proxy_blackhole`` spec (the router's
+    proxy attempt owns the blackhole) or None."""
+    if _plan is None:
+        _ensure_configured()
+    if not _active:
+        return None
+    s = _take("proxy_latency", ordinal)
+    if s is not None:
+        logger.warning("fault proxy_latency: sleeping %.3fs before "
+                       "request %d", s.duration_s, ordinal)
+        time.sleep(s.duration_s)
+    return _take("proxy_blackhole", ordinal)
+
+
+def on_artifact_load():
+    """Serving-artifact load hook (checkpoint/manager manifest
+    verification): each call advances the process-global load ordinal;
+    returns the fired ``ckpt_corrupt`` spec or None. The verifier
+    perturbs its OBSERVED digest when the spec fires — deterministic
+    corruption without destroying the artifact on disk."""
+    global _load_ordinal
+    if _plan is None:
+        _ensure_configured()
+    _load_ordinal += 1
+    if not _active:
+        return None
+    return _take("ckpt_corrupt", _load_ordinal)
 
 
 def install_from_env_or_config(config_text: Optional[str]) -> None:

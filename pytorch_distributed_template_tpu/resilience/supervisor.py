@@ -182,6 +182,10 @@ class SupervisorConfig:
     child_output_path: Optional[str] = None  # append child stdout+stderr
     #                                 here (fleet replicas get one log
     #                                 file each); None inherits ours
+    child_env: Optional[dict] = None  # extra env for the child, merged
+    #                                 over ours (fleet chaos: one
+    #                                 replica gets its own PDT_FAULTS
+    #                                 plan while its siblings run clean)
     rand: object = field(default=random.random, repr=False)
 
 
@@ -223,6 +227,9 @@ class Supervisor:
 
     def _spawn(self, attempt: int) -> subprocess.Popen:
         env = dict(os.environ)
+        if self.cfg.child_env:
+            env.update({str(k): str(v)
+                        for k, v in self.cfg.child_env.items()})
         env[ENV_ATTEMPT] = str(attempt)
         env[ENV_EVENTS] = str(self.events.path)
         env[ENV_HEARTBEAT] = str(self.heartbeat_path)

@@ -71,6 +71,9 @@ def make_artifact(out_dir, arch: str = "TinyLlama",
     if compile_cache_dir:
         cfg["compile_cache"] = {"dir": str(compile_cache_dir)}
     (out_dir / "config.json").write_text(json.dumps(cfg, indent=2))
+    # save_serving_params also writes <model>.manifest.json — the
+    # per-file sha256 manifest restore_serving_params verifies before
+    # serving (a corrupted artifact refuses LOUDLY; ISSUE 9)
     return save_serving_params(
         out_dir / "model", jax.device_get(params),
         meta={"arch": arch, "source": "random-init", "seed": int(seed)},
@@ -107,6 +110,8 @@ def main(argv=None) -> int:
         pool_blocks=args.pool_blocks,
         compile_cache_dir=args.compile_cache_dir, seed=args.seed)
     print(f"ARTIFACT {path}", flush=True)
+    print(f"MANIFEST {path.parent / (path.name + '.manifest.json')}",
+          flush=True)
     return 0
 
 

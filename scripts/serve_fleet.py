@@ -49,11 +49,12 @@ from pytorch_distributed_template_tpu.fleet.replicas import (  # noqa: E402
     FleetManager, Replica,
 )
 from pytorch_distributed_template_tpu.fleet.router import (  # noqa: E402
-    build_router,
+    HedgePolicy, build_router,
 )
 from pytorch_distributed_template_tpu.observability.reqtrace import (  # noqa: E402
     RequestTracer, SloWatcher,
 )
+from pytorch_distributed_template_tpu.resilience import faults  # noqa: E402
 from pytorch_distributed_template_tpu.resilience.supervisor import (  # noqa: E402
     SupervisorConfig,
 )
@@ -125,6 +126,41 @@ def build_parser() -> argparse.ArgumentParser:
                    help="consecutive failed health polls before a "
                         "replica stops receiving traffic")
     p.add_argument("--readmit-after", type=int, default=2)
+    p.add_argument("--wedge-after", type=int, default=0,
+                   help="consecutive polls of frozen scheduler "
+                        "progress (with pending work, /healthz still "
+                        "answering) before a replica is ejected as "
+                        "WEDGED and SIGKILL-restarted (ISSUE 9). "
+                        "0 (default) derives a ~60 s window from "
+                        "--poll-s — generous on purpose: mid-life XLA "
+                        "compiles freeze the counter legitimately; "
+                        "tighten only with warmed ladders "
+                        "(--warm-buckets)")
+    p.add_argument("--no-restart-wedged", action="store_true",
+                   help="eject wedged replicas without the SIGKILL "
+                        "restart (attach mode / debugging)")
+    # hedged requests (ISSUE 9, non-streaming only)
+    p.add_argument("--hedge", default="off", choices=("on", "off"),
+                   help="hedged requests: after the p95-based delay "
+                        "an unanswered non-streaming request fires at "
+                        "a second replica, first response wins, the "
+                        "loser is cancelled upstream")
+    p.add_argument("--hedge-frac", type=float, default=0.05,
+                   help="hedge budget: at most this fraction of "
+                        "requests may hedge (Tail-at-Scale ~5%%)")
+    p.add_argument("--hedge-delay-ms", type=float, default=0.0,
+                   help="fixed hedge delay; 0 derives p95 from the "
+                        "router's own e2e histogram per request")
+    # deterministic fault injection (ISSUE 9; resilience/faults.py)
+    p.add_argument("--router-faults", default="",
+                   help="PDT_FAULTS-grammar plan for the ROUTER "
+                        "process (proxy_latency@req:N[:ms], "
+                        "proxy_blackhole@req:N)")
+    p.add_argument("--replica-faults", action="append", default=[],
+                   metavar="RID=PLAN",
+                   help="per-replica fault plan, exported as "
+                        "PDT_FAULTS into THAT child only (e.g. "
+                        "r1=hang@tick:5); repeatable")
     # replica supervision
     p.add_argument("--max-restarts", type=int, default=10)
     p.add_argument("--restart-delay", type=float, default=1.0,
@@ -152,12 +188,42 @@ def build_parser() -> argparse.ArgumentParser:
     return p
 
 
+def parse_replica_faults(entries) -> dict:
+    """``["r1=hang@tick:5", ...]`` -> ``{"r1": "hang@tick:5"}``,
+    validating each plan through the fault grammar NOW (a typo should
+    fail in milliseconds, not silently never fire in a chaos run)."""
+    from pytorch_distributed_template_tpu.resilience.faults import (
+        FaultPlan,
+    )
+
+    out = {}
+    for entry in entries or []:
+        rid, sep, plan = entry.partition("=")
+        if not sep or not rid.strip():
+            raise SystemExit(
+                f"--replica-faults: bad entry {entry!r} "
+                "(want RID=PLAN)")
+        try:
+            FaultPlan.parse(plan)
+        except ValueError as e:
+            raise SystemExit(f"--replica-faults {rid}: {e}")
+        out[rid.strip()] = plan
+    return out
+
+
 def main(argv=None) -> int:
     args, rest = build_parser().parse_known_args(argv)
     if rest and rest[0] == "--":
         rest = rest[1:]
     run_dir = Path(args.run_dir)
     run_dir.mkdir(parents=True, exist_ok=True)
+    replica_faults = parse_replica_faults(args.replica_faults)
+    if args.router_faults:
+        # the router's own plan (proxy_* kinds). configure() lets an
+        # operator-level PDT_FAULTS env override this — but that env
+        # would ALSO be inherited by every replica child, so the CLI
+        # flags are the per-process way to aim faults.
+        faults.configure(args.router_faults)
     if args.attach:
         urls = [u.strip() for u in args.attach.split(",") if u.strip()]
         replicas = [Replica(f"r{i}", url=u)
@@ -186,13 +252,21 @@ def main(argv=None) -> int:
             if args.reqtrace == "off":
                 cmd += ["--reqtrace", "off"]
             cmd += rest
+            # per-replica fault plans ride the child env (ISSUE 9):
+            # one replica gets its chaos while siblings run clean; a
+            # rid with no plan explicitly CLEARS any inherited
+            # PDT_FAULTS so an operator-level plan cannot leak into
+            # every child at once
+            child_env = {"PDT_FAULTS": replica_faults.get(rid, "")} \
+                if replica_faults else None
             replicas.append(Replica(
                 rid, cmd=cmd, run_dir=run_dir,
                 sup_cfg=SupervisorConfig(
                     max_restarts=args.max_restarts,
                     restart_delay_s=args.restart_delay,
                     max_delay_s=30.0, poll_s=0.2,
-                    stable_runtime_s=120.0)))
+                    stable_runtime_s=120.0,
+                    child_env=child_env)))
     manager = FleetManager(
         replicas, run_dir=run_dir, policy=args.policy,
         block_tokens=args.block_tokens,
@@ -200,7 +274,9 @@ def main(argv=None) -> int:
         load_spread=args.load_spread, poll_s=args.poll_s,
         eject_after=args.eject_after,
         readmit_after=args.readmit_after,
-        queue_factor=args.queue_factor)
+        queue_factor=args.queue_factor,
+        wedge_after=(args.wedge_after or None),
+        restart_wedged=not args.no_restart_wedged)
     admission = FairAdmission(
         manager.capacity, weights=parse_weights(args.tenant_weights),
         max_waiting=args.max_waiting,
@@ -216,10 +292,13 @@ def main(argv=None) -> int:
               if args.reqtrace != "off" else None)
     slo = SloWatcher(ttft_s=args.slo_ttft_s, e2e_s=args.slo_e2e_s,
                      dump_dir=run_dir, tracer=tracer)
+    hedge = HedgePolicy(enabled=args.hedge == "on",
+                        frac=args.hedge_frac,
+                        delay_ms=args.hedge_delay_ms)
     server = build_router(manager, admission, host=args.host,
                           port=args.port, allow_admin=args.admin,
                           read_timeout_s=args.read_timeout_s,
-                          tracer=tracer, slo=slo)
+                          tracer=tracer, slo=slo, hedge=hedge)
 
     draining = threading.Event()
 

@@ -90,6 +90,7 @@ import argparse
 import json
 import os
 import signal
+import socket
 import sys
 import threading
 import time
@@ -109,8 +110,10 @@ from pytorch_distributed_template_tpu.engine.continuous import (  # noqa: E402
     ContinuousBatchingService,
 )
 from pytorch_distributed_template_tpu.engine.serving import (  # noqa: E402
-    BatchedGenerationService, GenerationService, load_generation_stack,
+    BatchedGenerationService, DeadlineExceeded, GenerationService,
+    load_generation_stack,
 )
+from pytorch_distributed_template_tpu.resilience import faults  # noqa: E402
 from pytorch_distributed_template_tpu.observability.health import (  # noqa: E402
     health_counters,
 )
@@ -118,7 +121,8 @@ from pytorch_distributed_template_tpu.observability.profiler import (  # noqa: E
     OnDemandProfiler,
 )
 from pytorch_distributed_template_tpu.observability.reqtrace import (  # noqa: E402
-    RequestTracer, SloWatcher, mint_request_id, sanitize_request_id,
+    DEADLINE_EXPIRED_HEADER, DEADLINE_HEADER, Deadline, RequestTracer,
+    SloWatcher, mint_request_id, sanitize_request_id,
 )
 from pytorch_distributed_template_tpu.observability.telemetry import (  # noqa: E402
     compile_cache_stats,
@@ -156,12 +160,13 @@ def supervisor_restart_stats() -> dict:
 
 def _run_request(service: GenerationService, req: dict,
                  on_tokens=None, cancel=None,
-                 request_id=None) -> dict:
+                 request_id=None, deadline=None) -> dict:
     """JSON request body -> GenerationService.generate kwargs. All
     encoding/validation/dispatch logic lives in the service (shared
     with generate.py); this only maps the wire format. ``request_id``
     is the trace id from the ``X-Request-Id`` header (minted here when
-    the client sent none) — it keys the request's spans end to end."""
+    the client sent none) — it keys the request's spans end to end.
+    ``deadline`` is the parsed ``X-Deadline-Ms`` budget (ISSUE 9)."""
     kwargs = dict(
         prompt=req.get("prompt"),
         prompt_ids=req.get("prompt_ids"),
@@ -173,6 +178,7 @@ def _run_request(service: GenerationService, req: dict,
         speculative=int(req.get("speculative", 0)),
         stop=req.get("stop"),
         request_id=request_id,
+        deadline=deadline,
     )
     if on_tokens is not None:
         kwargs["on_tokens"] = on_tokens
@@ -216,6 +222,28 @@ def service_metrics(service: GenerationService) -> dict:
               "batched_requests", "max_batch_size"):
         if k in stats:
             out[k] = int(stats[k])
+    # deadline + brownout counters (ISSUE 9); _total suffix = counter
+    # TYPE for the prometheus exposition
+    out["deadline_expired_total"] = int(
+        stats.get("deadline_expired", 0))
+    out["brownout_clamped_total"] = int(
+        stats.get("brownout_clamped", 0))
+    # ONE monotonic progress counter for the fleet poller's wedged-
+    # replica detection (ISSUE 9): any scheduler activity advances it,
+    # so "frozen progress + pending work + healthy /healthz" is the
+    # wedge signature. Summing the per-scheduler counters keeps it
+    # scheduler-agnostic (each term is itself monotonic).
+    out["scheduler_progress_total"] = (
+        int(stats.get("chunks", 0)) + int(stats.get("batches", 0))
+        + int(stats.get("admissions", 0))
+        + int(stats.get("completed", stats.get("requests", 0)))
+        + int(stats.get("tokens_generated", 0)))
+    # brownout ladder (ISSUE 9): level gauge + transition counters;
+    # schedulers without a controller read level 0
+    if hasattr(service, "brownout_stats"):
+        out.update(service.brownout_stats())
+    else:
+        out["brownout_level"] = 0
     if hasattr(service, "latency_percentiles"):
         out["latency"] = service.latency_percentiles()
     # paged prefix-cache counters (engine/kvcache): hit tokens are
@@ -329,18 +357,28 @@ class ActiveRequests:
 
 def make_handler(service: GenerationService, profiler=None,
                  active: ActiveRequests | None = None, tracer=None):
+    import itertools
+
     active = active or ActiveRequests()
+    # 1-based STREAMING-request ordinal for the req-unit serving
+    # faults (stall_stream@req:N targets THIS process's Nth SSE
+    # request — counting streams only keeps the target deterministic
+    # under mixed traffic)
+    stream_ordinal = itertools.count(1)
 
     class Handler(BaseHTTPRequestHandler):
         _rid = None   # set per /generate request; echoed on responses
 
-        def _send(self, code: int, payload: dict) -> None:
+        def _send(self, code: int, payload: dict,
+                  headers=()) -> None:
             body = json.dumps(payload).encode("utf-8")
             self.send_response(code)
             self.send_header("Content-Type", "application/json")
             self.send_header("Content-Length", str(len(body)))
             if self._rid:
                 self.send_header("X-Request-Id", self._rid)
+            for k, v in headers:
+                self.send_header(k, v)
             self.end_headers()
             self.wfile.write(body)
 
@@ -404,14 +442,43 @@ def make_handler(service: GenerationService, profiler=None,
             t0 = time.monotonic()
             stream = False
             try:
+                # deadline propagation (ISSUE 9): the RELATIVE budget
+                # from X-Deadline-Ms, anchored to this hop's receipt
+                # (monotonic — clock-skew-free by construction). A
+                # malformed value is a client error; an already-spent
+                # budget sheds NOW with 504 before any device work.
+                try:
+                    deadline = Deadline.from_header(
+                        self.headers.get(DEADLINE_HEADER), t0=t0)
+                except ValueError as e:
+                    return self._send(400, {"error": str(e),
+                                            "request_id": rid})
+                if deadline is not None and deadline.expired():
+                    service.stats["deadline_expired"] = (
+                        service.stats.get("deadline_expired", 0) + 1)
+                    return self._send(
+                        504, {"error": "deadline already expired",
+                              "request_id": rid},
+                        headers=[(DEADLINE_EXPIRED_HEADER, "1")])
                 n = int(self.headers.get("Content-Length", 0))
                 req = json.loads(self.rfile.read(n) or b"{}")
                 stream = bool(req.get("stream"))
                 if stream:
-                    return self._stream(req, rid)
-                out = _run_request(service, req, request_id=rid)
+                    return self._stream(req, rid, deadline=deadline)
+                out = _run_request(service, req, request_id=rid,
+                                   deadline=deadline)
                 out["request_id"] = rid
-                self._send(200, out)
+                # a deadline-truncated result is still a 200 (the
+                # budget bought these tokens), but the marker header
+                # lets the router classify it OUT of the served SLO
+                self._send(200, out, headers=(
+                    [(DEADLINE_EXPIRED_HEADER, "1")]
+                    if out.get("stop_reason") == "deadline" else []))
+            except DeadlineExceeded as e:
+                service.stats["deadline_expired"] = (
+                    service.stats.get("deadline_expired", 0) + 1)
+                self._send(504, {"error": str(e), "request_id": rid},
+                           headers=[(DEADLINE_EXPIRED_HEADER, "1")])
             except ValueError as e:
                 self._send(400, {"error": str(e), "request_id": rid})
             except Exception as e:  # surface, don't kill the server
@@ -473,7 +540,27 @@ def make_handler(service: GenerationService, profiler=None,
                     else 500 if "error" in out else 200)
             self._send(code, out)
 
-        def _stream(self, req: dict, rid=None) -> None:
+        def _stall_stream(self, spec) -> None:
+            """The ``stall_stream`` fault: hold the SSE connection
+            OPEN without emitting (the nasty middle ground between
+            slow and dead — a naive client waits forever). Ends when
+            the peer hangs up (the router's deadline-bounded read
+            doing its job) or after the spec's duration cap."""
+            import select
+
+            deadline = time.monotonic() + max(spec.duration_s, 1.0) \
+                * (30.0 if spec.arg is None else 1.0)
+            while time.monotonic() < deadline:
+                try:
+                    r, _, _ = select.select([self.connection], [], [],
+                                            0.25)
+                    if r and not self.connection.recv(1,
+                                                      socket.MSG_PEEK):
+                        return           # peer closed: stall is over
+                except OSError:
+                    return
+
+        def _stream(self, req: dict, rid=None, deadline=None) -> None:
             """Server-sent events: one ``data:`` line per absorbed
             token batch (``{"ids": [...]}``' deltas concatenate to the
             final ids), then a final ``data:`` carrying the complete
@@ -494,6 +581,10 @@ def make_handler(service: GenerationService, profiler=None,
             # balancers cannot see. Raises ValueError -> _post's
             # handler maps it to 400.
             service.validate_request(req)
+            # stall_stream fault (ISSUE 9): armed for this process's
+            # Nth streaming request — after the first delta the stream
+            # freezes WITHOUT closing
+            stall_spec = faults.on_serve_request(next(stream_ordinal))
 
             q: "queue_mod.Queue" = queue_mod.Queue()
             out: dict = {}
@@ -511,7 +602,8 @@ def make_handler(service: GenerationService, profiler=None,
                         service, req,
                         on_tokens=(lambda ids: q.put(("tokens", ids)))
                         if incremental else None,
-                        cancel=cancel_evt, request_id=rid)
+                        cancel=cancel_evt, request_id=rid,
+                        deadline=deadline)
                     if rid:
                         r["request_id"] = rid
                     out["r"] = r
@@ -546,6 +638,17 @@ def make_handler(service: GenerationService, profiler=None,
                     kind, payload = q.get()
                     if kind == "tokens":
                         emit({"ids": [int(t) for t in payload]})
+                        if stall_spec is not None:
+                            # the stream freezes here, connection
+                            # open: the router's deadline-bounded
+                            # upstream read is what frees the client.
+                            # Cancel the generation so the slot
+                            # recycles; the worker's queued events
+                            # are simply never read.
+                            self._stall_stream(stall_spec)
+                            if cancel_evt is not None:
+                                cancel_evt.set()
+                            return
                     elif kind == "error":
                         e = payload
                         emit({"error": f"{type(e).__name__}: {e}",
@@ -607,6 +710,24 @@ def main(args, config):
     if args.reqtrace != "off":
         tracer = RequestTracer(config.save_dir / "spans.jsonl",
                                process="serve")
+    # brownout ladder (ISSUE 9): ordered degradation under overload
+    # (disable spec -> short chunks -> clamp budgets), driven by queue
+    # depth / pool occupancy / SLO breach rate with hysteresis.
+    # Config serving.brownout block; --brownout on/off overrides; the
+    # threshold flags override the config's knobs. Off by default —
+    # level 3 clamps budgets, which an operator must opt into.
+    brownout_cfg = dict((config.get("serving") or {}).get(
+        "brownout") or {})
+    if args.brownout == "on":
+        brownout_cfg["enabled"] = True
+    elif args.brownout == "off":
+        brownout_cfg["enabled"] = False
+    if args.brownout_queue_norm > 0:
+        brownout_cfg["queue_norm"] = args.brownout_queue_norm
+    if args.brownout_dwell_s > 0:
+        brownout_cfg["dwell_s"] = args.brownout_dwell_s
+    if args.brownout_max_new > 0:
+        brownout_cfg["max_new_cap"] = args.brownout_max_new
     slo_cfg = dict((config.get("serving") or {}).get("slo") or {})
     slo = SloWatcher(
         ttft_s=(args.slo_ttft_s or slo_cfg.get("ttft_s")),
@@ -636,7 +757,7 @@ def main(args, config):
             chunk=args.decode_chunk, window_ms=args.batch_window_ms,
             warm_buckets=warm_buckets, prefix_cache=prefix_cfg,
             recorder=recorder, spec_draft_layers=spec_draft_layers,
-            tracer=tracer, slo=slo,
+            tracer=tracer, slo=slo, brownout=brownout_cfg,
         )
     elif want == "static":
         # the static micro-batch scheduler's shared-group prefill does
@@ -765,6 +886,26 @@ if __name__ == "__main__":
                         help="end-to-end latency SLO threshold in "
                              "seconds (0 = use config serving.slo, "
                              "else off)")
+    parser.add_argument("--brownout", default="auto",
+                        choices=("auto", "on", "off"),
+                        help="brownout ladder (ISSUE 9): ordered "
+                             "degradation under overload — disable "
+                             "speculative decode, cap chunk growth, "
+                             "clamp admitted budgets — with "
+                             "hysteresis. auto follows the config's "
+                             "serving.brownout block (off when "
+                             "absent); level is a /metrics gauge")
+    parser.add_argument("--brownout-queue-norm", default=0.0,
+                        type=float,
+                        help="queue depth equal to slots x this reads "
+                             "as pressure 1.0 (0 = config/default 1.0)")
+    parser.add_argument("--brownout-dwell-s", default=0.0, type=float,
+                        help="minimum seconds at a brownout level "
+                             "before it may step back down (0 = "
+                             "config/default 2.0)")
+    parser.add_argument("--brownout-max-new", default=0, type=int,
+                        help="level-3 cap on admitted max_new_tokens "
+                             "(0 = config/default 4x decode chunk)")
     parser.add_argument("--drain-grace-s", default=30.0, type=float,
                         help="SIGTERM drain: how long to wait for "
                              "in-flight requests to finish before "

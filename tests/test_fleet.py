@@ -39,8 +39,10 @@ from pytorch_distributed_template_tpu.fleet.replicas import (
     EJECTED, HEALTHY, FleetManager, Replica, http_json,
 )
 from pytorch_distributed_template_tpu.fleet.router import (
-    RouterStats, build_router, prometheus_text, router_metrics,
+    HedgePolicy, RouterStats, build_router, prometheus_text,
+    router_metrics,
 )
+from pytorch_distributed_template_tpu.resilience import faults
 
 REPO = Path(__file__).parent.parent
 
@@ -223,6 +225,11 @@ class FakeReplica:
         self.sse_die_after = sse_die_after    # RST after N SSE frames
         self.broken_pipes = 0
         self.queue_depth = 0
+        # ISSUE 9 gauges: the wedge detector reads progress + pending
+        # work, the fleet brownout gauge reads brownout_level
+        self.progress = 0
+        self.live_slots = 0
+        self.brownout_level = 0
         self.requests = []
         self.counters = {"requests_total": 0,
                          "prefix_hit_tokens_total": 0}
@@ -245,9 +252,12 @@ class FakeReplica:
                 if self.path.startswith("/metrics"):
                     with fake._lock:
                         payload = dict(fake.counters)
-                    payload.update(slots=fake.slots,
-                                   queue_depth=fake.queue_depth,
-                                   live_slots=0)
+                    payload.update(
+                        slots=fake.slots,
+                        queue_depth=fake.queue_depth,
+                        live_slots=fake.live_slots,
+                        scheduler_progress_total=fake.progress,
+                        brownout_level=fake.brownout_level)
                     return self._json(200, payload)
                 self._json(200, {"status": "ok"})
 
@@ -258,7 +268,9 @@ class FakeReplica:
                     fake.requests.append(
                         {"body": body,
                          "tenant": self.headers.get("X-Tenant"),
-                         "rid": self.headers.get("X-Request-Id")})
+                         "rid": self.headers.get("X-Request-Id"),
+                         "deadline_ms": self.headers.get(
+                             "X-Deadline-Ms")})
                     fake.counters["requests_total"] += 1
                 if fake.delay_s:
                     time.sleep(fake.delay_s)
@@ -1030,6 +1042,357 @@ def test_router_stamps_ttft_on_sse_and_loadgen_rids_join(tmp_path):
     finally:
         server.shutdown()
         tracer.close()
+        for f in fakes:
+            f.stop()
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 9: wedged-replica detection, deadlines, hedging, brownout
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture()
+def _clean_faults(monkeypatch):
+    monkeypatch.delenv(faults.ENV_PLAN, raising=False)
+    monkeypatch.delenv(faults.ENV_ATTEMPT, raising=False)
+    faults.reset()
+    yield
+    faults.reset()
+
+
+def test_wedged_replica_ejected_not_readmitted_until_it_moves(
+        tmp_path):
+    """The satellite regression: frozen scheduler progress + pending
+    work + a perfectly healthy /healthz must eject — and a still-
+    frozen process must NOT readmit on its next healthy-looking
+    scrape."""
+    fakes = [FakeReplica(), FakeReplica()]
+    manager = _mk_fleet(tmp_path, fakes, eject_after=2, wedge_after=2)
+    r0 = manager.replicas["r0"]
+    try:
+        fakes[0].progress = 5
+        manager.poll_once()              # progress=5 recorded, idle
+        fakes[0].progress = 6
+        manager.poll_once()              # advanced: liveness ARMS
+        assert r0.state == HEALTHY
+        fakes[0].queue_depth = 3         # work appears, progress frozen
+        manager.poll_once()              # stuck streak 1
+        assert r0.state == HEALTHY
+        manager.poll_once()              # stuck streak 2 -> WEDGED
+        assert r0.state == EJECTED and r0.wedged
+        assert manager.stats["wedged_ejections_total"] == 1
+        assert manager.stats["ejections_total"] == 1
+        # the OTHER idle replica (frozen progress, no work) is fine
+        assert manager.replicas["r1"].state == HEALTHY
+        # a healthy scrape of the SAME frozen process must not readmit
+        manager.poll_once()
+        manager.poll_once()
+        assert r0.state == EJECTED
+        # "restart": progress moves (counters reset) and queue drains
+        fakes[0].progress = 0
+        fakes[0].queue_depth = 0
+        manager.poll_once()              # readmit_after=1
+        assert r0.state == HEALTHY and not r0.wedged
+        assert manager.stats["readmissions_total"] == 1
+        assert manager.recoveries_s     # time-to-recovery recorded
+        ev = [json.loads(line) for line in
+              (tmp_path / "router.jsonl").read_text().splitlines()]
+        eject = next(e for e in ev if e.get("event") == "eject")
+        assert eject["reason"] == "wedged"
+        assert eject["stuck_polls"] == 2
+    finally:
+        for f in fakes:
+            f.stop()
+
+
+def test_idle_frozen_replica_stays_healthy(tmp_path):
+    fakes = [FakeReplica()]
+    manager = _mk_fleet(tmp_path, fakes)
+    try:
+        for _ in range(6):               # frozen progress, zero work
+            manager.poll_once()
+        assert manager.replicas["r0"].state == HEALTHY
+        assert manager.stats["wedged_ejections_total"] == 0
+    finally:
+        fakes[0].stop()
+
+
+def test_wedge_window_defaults_to_the_time_grace(tmp_path):
+    """Without an explicit wedge_after, the window derives from
+    wedge_grace_s / poll_s: mid-life XLA compiles (new bucket shapes)
+    freeze the progress counter for seconds and must never read as a
+    wedge at the default cadence."""
+    fakes = [FakeReplica()]
+    try:
+        m = _mk_fleet(tmp_path, fakes)            # poll_s 1.0
+        assert m.wedge_after == 60
+        m2 = FleetManager([Replica("x", url=fakes[0].url)],
+                          run_dir=tmp_path / "m2", poll_s=0.3,
+                          wedge_grace_s=6.0)
+        assert m2.wedge_after == 20
+        m2.events.close()
+    finally:
+        fakes[0].stop()
+
+
+def test_cold_start_compile_stall_is_not_a_wedge(tmp_path):
+    """Startup grace (k8s startupProbe semantics): a replica that has
+    NEVER advanced — its first arrival wave frozen behind cold XLA
+    compiles with requests already queued — must not be ejected;
+    liveness arms only after the first observed advance, and a
+    counter reset (restart) re-disarms it."""
+    fakes = [FakeReplica()]
+    manager = _mk_fleet(tmp_path, fakes, eject_after=2, wedge_after=2)
+    r0 = manager.replicas["r0"]
+    try:
+        fakes[0].queue_depth = 4         # traffic queued, progress 0
+        for _ in range(6):               # way past wedge_after
+            manager.poll_once()
+        assert r0.state == HEALTHY
+        assert manager.stats["wedged_ejections_total"] == 0
+        fakes[0].progress = 9            # compile done, work flows
+        manager.poll_once()
+        fakes[0].progress = 2            # counter RESET = restart
+        manager.poll_once()
+        fakes[0].queue_depth = 4         # post-restart compile stall
+        for _ in range(6):
+            manager.poll_once()
+        assert r0.state == HEALTHY
+        assert manager.stats["wedged_ejections_total"] == 0
+    finally:
+        fakes[0].stop()
+
+
+def test_router_deadline_forwarded_and_expiry_is_504(tmp_path):
+    """Deadline propagation e2e at the router: the remaining budget
+    is forwarded on the hop; a replica slower than the budget costs
+    the client its deadline (504 + marker), never the 600 s read
+    budget — and the dead request stays OUT of the served e2e
+    histogram."""
+    fakes = [FakeReplica(delay_s=1.2)]
+    manager = _mk_fleet(tmp_path, fakes)
+    server, _, url = _router(manager)
+    try:
+        t0 = time.monotonic()
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post(url, {"prompt_ids": [1] * 8, "max_new_tokens": 2},
+                  headers={"X-Deadline-Ms": "300"})
+        took = time.monotonic() - t0
+        assert e.value.code == 504
+        assert e.value.headers.get("X-Deadline-Expired") == "1"
+        assert took < 1.1                # deadline, not delay_s
+        # the hop carried the REMAINING budget
+        assert fakes[0].requests
+        fwd = int(fakes[0].requests[0]["deadline_ms"])
+        assert 0 < fwd <= 300
+        m = _get_json(url, "/metrics?format=json")
+        assert m["deadline_expired_total"] == 1
+        assert m["router_e2e_seconds"]["count"] == 0   # out of SLO
+        # malformed header is the client's error
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post(url, {"prompt_ids": [2] * 8, "max_new_tokens": 2},
+                  headers={"X-Deadline-Ms": "soon"})
+        assert e.value.code == 400
+    finally:
+        server.shutdown()
+        fakes[0].stop()
+
+
+def test_sse_drip_feed_cannot_outlive_the_deadline(tmp_path):
+    """The relay's deadline bound is WALL-CLOCK, not per-read: a
+    replica that keeps emitting deltas (each inside the socket
+    timeout) must still be truncated at the deadline — otherwise a
+    deadline-ignoring replica holds the client for deltas x budget."""
+    fakes = [FakeReplica(sse_deltas=16, sse_delay_s=0.25)]
+    manager = _mk_fleet(tmp_path, fakes)
+    server, _, url = _router(manager)
+    try:
+        t0 = time.monotonic()
+        req = urllib.request.Request(
+            url + "/generate",
+            data=json.dumps({"prompt_ids": [1] * 8,
+                             "max_new_tokens": 16,
+                             "stream": True}).encode(),
+            headers={"Content-Type": "application/json",
+                     "X-Deadline-Ms": "600"})
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            body = resp.read()      # truncated stream ends at close
+        took = time.monotonic() - t0
+        # 16 deltas x 0.25s = 4s of drip; the budget is 0.6s
+        assert took < 2.0, f"drip-feed outlived the deadline: {took}"
+        assert b"done" not in body   # truncated, not completed
+        m = _get_json(url, "/metrics?format=json")
+        assert m["deadline_expired_total"] == 1
+    finally:
+        server.shutdown()
+        fakes[0].stop()
+
+
+def test_retry_never_fires_into_an_expired_deadline(
+        tmp_path, _clean_faults):
+    """Satellite: the retry-once path checks the remaining budget. A
+    proxy_latency fault burns the deadline before the hop; the first
+    attempt's connect failure must answer 504-deadline instead of
+    spending another replica on a dead request."""
+    faults.configure("proxy_latency@req:1:300ms")
+    fakes = [FakeReplica()]
+    manager = _mk_fleet(tmp_path, fakes)
+    # r0 -> a dead port; r1 -> the live fake (would serve a retry)
+    dead = Replica("rdead", url="http://127.0.0.1:9")
+    dead.state = HEALTHY
+    manager.replicas["rdead"] = dead
+    manager.replicas["r0"].state = EJECTED   # force the dead pick
+    server, _, url = _router(manager)
+    try:
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post(url, {"prompt_ids": [1] * 8, "max_new_tokens": 2},
+                  headers={"X-Deadline-Ms": "150"})
+        assert e.value.code == 504
+        assert e.value.headers.get("X-Deadline-Expired") == "1"
+        m = _get_json(url, "/metrics?format=json")
+        assert m["proxy_retries_total"] == 0
+        assert len(fakes[0].requests) == 0
+    finally:
+        server.shutdown()
+        fakes[0].stop()
+
+
+def test_hedge_fires_after_delay_and_respects_budget(tmp_path):
+    fakes = [FakeReplica(delay_s=0.5), FakeReplica(delay_s=0.5)]
+    manager = _mk_fleet(tmp_path, fakes)
+    server, _, url = _router(
+        manager, hedge=HedgePolicy(enabled=True, frac=1.0,
+                                   delay_ms=60))
+    try:
+        code, body = _post(url, {"prompt_ids": [1] * 8,
+                                 "max_new_tokens": 2})
+        assert code == 200 and body["ids"]
+        m = _get_json(url, "/metrics?format=json")
+        assert m["hedge_fired_total"] == 1
+        # both replicas ran it (that IS hedging); exactly one response
+        # reached the client and the loser was cancelled
+        assert m["hedge_cancelled_total"] == 1
+        assert len(fakes[0].requests) + len(fakes[1].requests) == 2
+    finally:
+        server.shutdown()
+        for f in fakes:
+            f.stop()
+
+
+def test_hedge_budget_caps_fraction(tmp_path):
+    fakes = [FakeReplica(delay_s=0.3), FakeReplica(delay_s=0.3)]
+    manager = _mk_fleet(tmp_path, fakes)
+    server, _, url = _router(
+        manager, hedge=HedgePolicy(enabled=True, frac=0.05,
+                                   delay_ms=30))
+    try:
+        for i in range(4):
+            _post(url, {"prompt_ids": [i + 1] * 8,
+                        "max_new_tokens": 2})
+        m = _get_json(url, "/metrics?format=json")
+        # 5% of 4 requests -> the budget never allows a hedge
+        assert m["hedge_fired_total"] == 0
+    finally:
+        server.shutdown()
+        for f in fakes:
+            f.stop()
+
+
+def test_hedge_no_double_execution_under_proxy_blackhole(
+        tmp_path, _clean_faults):
+    """Satellite: the blackholed primary attempt reaches NO replica;
+    the hedge serves the request. Exactly ONE replica executed it —
+    the no-double-execution proof."""
+    faults.configure("proxy_blackhole@req:1")
+    fakes = [FakeReplica(), FakeReplica()]
+    manager = _mk_fleet(tmp_path, fakes)
+    server, _, url = _router(
+        manager, hedge=HedgePolicy(enabled=True, frac=1.0,
+                                   delay_ms=50))
+    try:
+        code, body = _post(url, {"prompt_ids": [1] * 8,
+                                 "max_new_tokens": 2})
+        assert code == 200 and body["ids"]
+        assert len(fakes[0].requests) + len(fakes[1].requests) == 1
+        m = _get_json(url, "/metrics?format=json")
+        assert m["hedge_fired_total"] == 1
+        assert m["hedge_won_total"] == 1
+    finally:
+        server.shutdown()
+        for f in fakes:
+            f.stop()
+
+
+def test_streaming_requests_never_hedge(tmp_path):
+    fakes = [FakeReplica(delay_s=0.3), FakeReplica(delay_s=0.3)]
+    manager = _mk_fleet(tmp_path, fakes)
+    server, _, url = _router(
+        manager, hedge=HedgePolicy(enabled=True, frac=1.0,
+                                   delay_ms=20))
+    try:
+        req = urllib.request.Request(
+            url + "/generate",
+            data=json.dumps({"prompt_ids": [1] * 8,
+                             "max_new_tokens": 4,
+                             "stream": True}).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            resp.read()
+        m = _get_json(url, "/metrics?format=json")
+        assert m["hedge_fired_total"] == 0
+        assert len(fakes[0].requests) + len(fakes[1].requests) == 1
+    finally:
+        server.shutdown()
+        for f in fakes:
+            f.stop()
+
+
+def test_hedge_auto_delay_needs_histogram_samples():
+    hp = HedgePolicy(enabled=True)       # delay_ms=0 -> p95-derived
+    from pytorch_distributed_template_tpu.utils.promtext import (
+        LatencyHistogram,
+    )
+
+    hist = LatencyHistogram()
+    assert hp.delay_s(hist) is None      # empty histogram: no hedging
+    for _ in range(30):
+        hist.observe(0.2)
+    d = hp.delay_s(hist)
+    assert d is not None and d >= 0.02   # p95-based once warmed
+    assert HedgePolicy(enabled=False).delay_s(hist) is None
+
+
+def test_admission_brownout_level4_tightens_tenant_slice():
+    adm = FairAdmission(lambda: 0, max_waiting=16,
+                        max_waiting_per_tenant=8,
+                        queue_timeout_s=0.2)
+    adm.set_brownout_level(4)            # slice: 8 -> 2
+    waiters = [threading.Thread(
+        target=lambda: adm.submit("heavy", timeout_s=1.0))
+        for _ in range(2)]
+    for w in waiters:
+        w.start()
+    time.sleep(0.2)                      # both queued (capacity 0)
+    assert adm.submit("heavy", timeout_s=0.0) == "shed_tenant"
+    assert adm.submit("light", timeout_s=0.0) == "shed_timeout"
+    s = adm.stats()
+    assert s["brownout_shed_total"] == 1
+    for w in waiters:
+        w.join(timeout=3)
+
+
+def test_fleet_brownout_gauge_tracks_worst_replica(tmp_path):
+    fakes = [FakeReplica(), FakeReplica()]
+    fakes[1].brownout_level = 3
+    manager = _mk_fleet(tmp_path, fakes)
+    server, admission, url = _router(manager)
+    try:
+        assert manager.brownout_level() == 3
+        m = _get_json(url, "/metrics?format=json")
+        assert m["brownout_level"] == 3
+        assert m["fleet_brownout_level"] == 3
+    finally:
+        server.shutdown()
         for f in fakes:
             f.stop()
 

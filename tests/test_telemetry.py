@@ -242,7 +242,12 @@ def test_prometheus_text_exposition():
     assert "# TYPE pdt_serve_queue_depth gauge" in text
     assert "pdt_serve_queue_depth 3" in text
     assert "pdt_serve_latency_p95_s 1.0" in text
-    assert "scheduler" not in text  # non-numeric fields stay out
+    # non-numeric fields stay out: the scheduler CLASS-NAME string is
+    # never exported (the numeric scheduler_progress_total counter —
+    # the fleet's wedge-detection signal, ISSUE 9 — legitimately is)
+    assert "pdt_serve_scheduler " not in text
+    assert "ContinuousBatchingService" not in text
+    assert "pdt_serve_scheduler_progress_total" in text
 
 
 def test_metrics_endpoint_http(tmp_path):
