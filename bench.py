@@ -1627,6 +1627,221 @@ def bench_decode_paged(n_requests: int = 8, prefix_len: int = 256,
     return out
 
 
+def bench_serve_tp(tp_degrees=(1, 2, 4), n_requests: int = 8,
+                   prefix_len: int = 96, suffix_len: int = 16,
+                   new_tokens: int = 24, slots: int = 4,
+                   block_tokens: int = 16, n_layer: int = 2,
+                   d_model: int = 64) -> dict:
+    """Tensor-parallel serving rung (ISSUE 10 tentpole): the SAME
+    continuous paged engine at tp ∈ {1, 2, 4} — weights sharded per the
+    model's megatron ``partition_rules()``, pool pages on the KV-head
+    axis, block tables replicated (parallel/tp.py) — under an identical
+    shared-prefix Poisson drive. Three gates, all backend-independent:
+
+    - **greedy token-identity** tp>1 == tp=1 == solo (the collectives
+      change the schedule, not the math);
+    - **warm-admit copy bytes == 0** on every arm (the paged pointer-
+      update contract survives sharding — a pool page id means the
+      same thing on every shard);
+    - **collective-byte accounting**: one 1-token decode step is
+      AOT-compiled per arm and its collectives counted from the
+      compiled HLO (the MULTICHIP dryrun technique) — measured
+      all-reduce payload must land within [1.0x, 1.5x] of the analytic
+      megatron floor (2 x n_layer x [B,1,d_model] per step; the
+      vocab-sharded embedding lookup is why measured sits above 1.0x).
+
+    Aggregate tok/s + TTFT p50 are REPORTED per arm, not gated: on the
+    forced-host-device CPU mesh (the only place CI can run this)
+    all-reduces are thread synchronization, so tp>1 is expected
+    slower — the number that matters there is that the SPMD program
+    exists, moves the promised bytes, and emits identical tokens. On
+    real ICI the same executables are the >1-chip serving path.
+
+    Skips (not fails) below 2 devices: run under
+    ``XLA_FLAGS=--xla_force_host_platform_device_count=8``.
+    """
+    import queue as queue_mod
+    import threading
+
+    import jax
+    import jax.numpy as jnp
+
+    import pytorch_distributed_template_tpu.models  # noqa: F401
+    from pytorch_distributed_template_tpu.config.registry import MODELS
+    from pytorch_distributed_template_tpu.engine.continuous import (
+        ContinuousBatchingService,
+    )
+    from pytorch_distributed_template_tpu.engine.serving import (
+        GenerationService,
+    )
+    from pytorch_distributed_template_tpu.parallel.tp import (
+        decode_step_collectives, serving_mesh, shard_serving_params,
+        validate_tp_geometry,
+    )
+
+    n_dev = jax.device_count()
+    degrees = [tp for tp in tp_degrees if tp <= n_dev]
+    if len(degrees) < 2:
+        return {"skipped": f"needs >= 2 devices for a tp>1 arm (found "
+                           f"{n_dev}; set XLA_FLAGS="
+                           "--xla_force_host_platform_device_count=8)"}
+
+    vocab = 4096
+    L = prefix_len + suffix_len
+    bucket = 16
+    while bucket < L:
+        bucket *= 2
+    max_len = bucket + 2 * new_tokens + 16
+    # n_kv_head == 4 so every arm in {1, 2, 4} divides the KV heads
+    kw = dict(vocab_size=vocab, n_layer=n_layer, n_head=4, n_kv_head=4,
+              d_model=d_model, max_len=max_len)
+    base = MODELS.get("Llama")(**kw)
+    params_host = base.init(
+        jax.random.key(0), jnp.zeros((1, 8), jnp.int32))["params"]
+    rng = np.random.default_rng(0)
+    pool_blocks = slots * (max_len // block_tokens + 2) + 8
+    pcfg = {"enabled": True, "block_tokens": block_tokens,
+            "pool_blocks": pool_blocks}
+
+    def prompt(prefix):
+        return list(prefix) + [int(x) for x in
+                               rng.integers(1, vocab, suffix_len)]
+
+    def fresh_prefixes(n):
+        return [[int(x) for x in rng.integers(1, vocab, prefix_len)]
+                for _ in range(n)]
+
+    solo = GenerationService.from_model(base, params_host)
+    eq_prompts = [prompt(p) for p in fresh_prefixes(2)]
+    ref = {}
+    for i, ids in enumerate(eq_prompts):
+        ref[("g", i)] = solo.generate(prompt_ids=ids, max_new_tokens=8,
+                                      seed=i)["ids"]
+        ref[("s", i)] = solo.generate(
+            prompt_ids=ids, max_new_tokens=8, temperature=0.8,
+            top_k=8, seed=i)["ids"]
+
+    arrivals = list(np.cumsum(rng.exponential(0.02, size=n_requests)))
+    out: dict = {"n_requests": n_requests, "new_tokens": new_tokens,
+                 "tp_degrees": degrees, "parity_ok": True,
+                 "warm_admit_copy_bytes": 0}
+
+    for tp in degrees:
+        mesh = serving_mesh(tp)
+        model = MODELS.get("Llama")(**kw, mesh=mesh)
+        if tp > 1:
+            validate_tp_geometry(model, tp)
+        params = shard_serving_params(model, params_host, mesh)
+        cont = ContinuousBatchingService.from_model(
+            model, params, slots=slots, chunk=4, window_ms=5.0,
+            prefix_cache=dict(pcfg))
+        if not cont._paged:
+            raise RuntimeError(
+                f"serve_tp tp={tp}: paged pool fell back to scatter")
+
+        # token-identity vs the tp=1 solo reference — greedy AND
+        # sampled, also warming the cold/warm admit executables
+        for i, ids in enumerate(eq_prompts):
+            g = cont.generate(prompt_ids=ids, max_new_tokens=8,
+                              seed=i)["ids"]
+            s = cont.generate(prompt_ids=ids, max_new_tokens=8,
+                              temperature=0.8, top_k=8, seed=i)["ids"]
+            if g != ref[("g", i)] or s != ref[("s", i)]:
+                raise RuntimeError(
+                    f"serve_tp tp={tp} not token-identical to tp=1: "
+                    f"{g} vs {ref[('g', i)]} / {s} vs {ref[('s', i)]}")
+
+        def drive(prefixes, svc):
+            done: "queue_mod.Queue" = queue_mod.Queue()
+
+            def call(ids, delay):
+                time.sleep(delay)
+                t0 = time.perf_counter()
+                first = []
+
+                def on_tokens(_):
+                    if not first:
+                        first.append(time.perf_counter() - t0)
+
+                try:
+                    svc.generate(prompt_ids=ids,
+                                 max_new_tokens=new_tokens,
+                                 temperature=0.0, on_tokens=on_tokens)
+                    done.put(first[0] if first else None)
+                except Exception as e:  # noqa: BLE001 — rung reports
+                    done.put(e)
+
+            threads = [
+                threading.Thread(
+                    target=call,
+                    args=(prompt(prefixes[i % len(prefixes)]), d))
+                for i, d in enumerate(arrivals)]
+            t0 = time.perf_counter()
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=600)
+            wall = time.perf_counter() - t0
+            ttfts = []
+            while not done.empty():
+                v = done.get()
+                if isinstance(v, Exception):
+                    raise RuntimeError(
+                        f"serve_tp tp={tp} drive failed: {v!r}") from v
+                if v is not None:
+                    ttfts.append(v)
+            if len(ttfts) < n_requests:
+                raise RuntimeError(
+                    f"serve_tp tp={tp}: "
+                    f"{n_requests - len(ttfts)} requests hung")
+            return sorted(ttfts), wall
+
+        # compile pass x2 on a throwaway prefix, then the shared
+        # prefix primed unmeasured (serve_prefix's discipline: nothing
+        # measured may pay XLA)
+        comp = fresh_prefixes(1)
+        drive(comp, cont)
+        drive(comp, cont)
+        shared = fresh_prefixes(1)
+        cont.generate(prompt_ids=prompt(shared[0]), max_new_tokens=1,
+                      temperature=0.0)
+        copy0 = cont.prefix_cache_stats()["warm_admit_copy_bytes"]
+        ttfts, wall = drive(shared, cont)
+        copy1 = cont.prefix_cache_stats()["warm_admit_copy_bytes"]
+        if copy1 != copy0:
+            raise RuntimeError(
+                f"serve_tp tp={tp}: warm admits copied "
+                f"{copy1 - copy0} device bytes (paged contract is 0)")
+
+        pick = lambda xs, q: xs[min(len(xs) - 1,      # noqa: E731
+                                    int(q * len(xs)))]
+        out[f"tokens_per_sec_tp{tp}"] = round(
+            n_requests * new_tokens / wall, 1)
+        out[f"ttft_p50_tp{tp}_s"] = round(pick(ttfts, 0.5), 4)
+
+        # collective-byte accounting vs the analytic megatron floor
+        # (the MULTICHIP phase1 technique, serving-side)
+        acct = decode_step_collectives(model, params)
+        out[f"collective_count_tp{tp}"] = acct[
+            "collective_count_per_step"]
+        out[f"collective_bytes_tp{tp}"] = acct[
+            "collective_bytes_per_step"]
+        out[f"collective_floor_tp{tp}"] = acct["analytic_floor_bytes"]
+        if tp > 1:
+            floor = acct["analytic_floor_bytes"]
+            moved = (acct["bytes"].get("all-reduce", 0)
+                     + acct["bytes"].get("reduce-scatter", 0))
+            ratio = moved / max(floor, 1)
+            out[f"collective_ratio_tp{tp}"] = round(ratio, 3)
+            if not (1.0 <= ratio <= 1.5):
+                raise RuntimeError(
+                    f"serve_tp tp={tp}: per-step reduction bytes "
+                    f"{moved} vs analytic floor {floor} (ratio "
+                    f"{ratio:.2f} outside [1.0, 1.5]) — the compiled "
+                    "program is not doing megatron TP's communication")
+    return out
+
+
 def bench_decode_stop(batch: int = 8, prompt_len: int = 512,
                       new_tokens: int = 256) -> dict:
     """Stop-token rung (VERDICT r4 missing #1's measured half): chip
@@ -3254,6 +3469,13 @@ _SUMMARY_KEYS = {
     "decode_paged": ("decode_ratio", "paged_warm_admit_copy_bytes",
                      "spec_pool_speedup",
                      "spec_pool_tokens_per_call"),
+    # tensor-parallel serving (ISSUE 10): aggregate tok/s per arm, the
+    # greedy-parity gate result, the zero-copy warm-admit gate, and the
+    # measured-vs-analytic collective ratio CI asserts
+    "serve_tp": ("tokens_per_sec_tp1", "tokens_per_sec_tp2",
+                 "tokens_per_sec_tp4", "collective_ratio_tp2",
+                 "collective_ratio_tp4", "parity_ok",
+                 "warm_admit_copy_bytes"),
     # fleet rung: cache-aware routing uplift + the recovery headline
     # (per-arm TTFT p99s and shed/kill counts live in the full ladder)
     "serve_fleet": ("prefix_uplift", "ca_hit_rate",
@@ -3616,6 +3838,17 @@ _LADDER = [
                               "new_tokens": 16, "n_layer": 2,
                               "d_model": 128, "n_requests": 4,
                               "slots": 2}),
+    ]),
+    # tensor-parallel serving (ISSUE 10): the paged engine sharded over
+    # a tensor mesh axis — token parity, zero-copy warm admits, and
+    # collective-byte floors gated in-rung; skips below 2 devices (the
+    # tp-smoke CI job forces an 8-device host mesh)
+    # ONE attempt, deliberately: the rung self-scales (degrees filter
+    # to the device count; <2 devices skips), and a smaller fallback
+    # would let _try_ladder silently swallow a real tp=4 gate failure
+    # (parity / zero-copy / collective-ratio) behind a passing retry
+    ("serve_tp", [
+        (bench_serve_tp, {}),
     ]),
     # fleet front door: cache-aware router + admission control over
     # real serve.py subprocess replicas, trace-replay load, mid-trace

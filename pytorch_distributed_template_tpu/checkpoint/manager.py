@@ -603,7 +603,36 @@ def load_serving_meta(path) -> Optional[dict]:
     return meta if meta and meta.get("params_only") else None
 
 
-def restore_serving_params(path, template_params, shardings=None):
+def check_artifact_tp_geometry(path, mesh) -> None:
+    """Refuse a TP layout the artifact's recorded geometry cannot
+    shard (ISSUE 10 satellite): ``save_serving_params`` meta may carry
+    ``tp_geometry`` (scripts/make_serving_artifact.py records it) —
+    every recorded dimension must divide the mesh's ``tensor`` axis,
+    or the restore fails HERE with the exact violation instead of deep
+    inside a jit with a shape error. Pre-TP artifacts (no recorded
+    geometry) pass through: the model-level validation in
+    parallel/tp.validate_tp_geometry still guards them."""
+    from ..parallel.tp import tp_degree
+
+    tp = tp_degree(mesh)
+    if tp <= 1:
+        return
+    meta = load_serving_meta(path) or {}
+    geom = meta.get("tp_geometry")
+    if not geom:
+        return
+    bad = [f"{k}={v}" for k, v in sorted(geom.items())
+           if isinstance(v, int) and v and v % tp]
+    if bad:
+        raise ValueError(
+            f"artifact {path} cannot serve at tensor_parallel={tp}: "
+            f"recorded geometry {', '.join(bad)} not divisible "
+            "(re-produce the artifact with a compatible shape, or "
+            "pick a tp dividing every recorded dimension)")
+
+
+def restore_serving_params(path, template_params, shardings=None,
+                           mesh=None):
     """Restore a params-only artifact into ``template_params``'s
     shapes/dtypes (accepts abstract leaves, e.g. ``jax.eval_shape`` of
     ``model.init`` — the int8/scale leaves of a quantized tree restore
@@ -614,12 +643,18 @@ def restore_serving_params(path, template_params, shardings=None):
     orbax materialize each leaf ALREADY sharded over the mesh — required
     on multi-host meshes, where a host-local restore + device_put cannot
     address other hosts' devices (same constraint as
-    engine/state.create_sharded_train_state)."""
+    engine/state.create_sharded_train_state).
+
+    ``mesh``: optional serving mesh — when it carries a ``tensor``
+    axis, the artifact's recorded ``tp_geometry`` manifest is checked
+    first and a non-dividing layout refuses loudly
+    (:func:`check_artifact_tp_geometry`)."""
     # integrity gate BEFORE the restore (ISSUE 9 satellite): an
     # artifact with a manifest must hash clean, or the load refuses
     # loudly — serving garbage weights is the one failure mode no
     # downstream detector catches
     verify_artifact_manifest(path)
+    check_artifact_tp_geometry(path, mesh)
     if shardings is None:
         abstract = jax.tree.map(
             lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype),

@@ -85,9 +85,11 @@ def _admit_fn(model, bucket: int, k: int, n_stop: int):
     import jax
     import jax.numpy as jnp
 
+    from ..parallel.tp import constrain_kv_tree
     from .generate import _sample_rows_traced
 
     total = int(model.max_len)
+    mesh = getattr(model, "mesh", None)
 
     @functools.partial(jax.jit, donate_argnums=(1, 2))
     def admit(params, shared, arrays, prompts, ints, floats,
@@ -109,7 +111,7 @@ def _admit_fn(model, bucket: int, k: int, n_stop: int):
         )[1]["cache"]
         cache = jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype),
                              shapes)
-        cache = dict(cache)
+        cache = dict(constrain_kv_tree(cache, mesh))  # TP head shard
         cache["pos_index"] = pos0.astype(jnp.int32)
         logits, vs = model.apply(
             {"params": params, "cache": cache}, prompts,
@@ -191,10 +193,12 @@ def _warm_admit_fn(model, feed: int, k: int, n_stop: int, nb: int,
     import jax
     import jax.numpy as jnp
 
+    from ..parallel.tp import constrain_kv_tree
     from .generate import _sample_rows_traced
     from .kvcache import scatter_blocks
 
     total = int(model.max_len)
+    mesh = getattr(model, "mesh", None)
 
     @functools.partial(jax.jit, donate_argnums=(1, 2))
     def admit(params, shared, arrays, prompts, ints, floats,
@@ -216,6 +220,7 @@ def _warm_admit_fn(model, feed: int, k: int, n_stop: int, nb: int,
         )[1]["cache"]
         cache = jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype),
                              shapes)
+        cache = constrain_kv_tree(cache, mesh)        # TP head shard
         cache = dict(scatter_blocks(
             dict(cache), pool, block_ids, pad_k, pos0, feed, block,
             rotary=rotary, rope_base=rope_base))
@@ -539,6 +544,11 @@ class ContinuousBatchingService(GenerationService):
                       "deferred_admissions": 0, "deadline_expired": 0,
                       "brownout_clamped": 0}
         self._warm_chunk_ladder()
+        if self.tp > 1:
+            # precompute the per-step collective accounting with the
+            # rest of the warmup (one AOT compile) so neither the
+            # scheduler thread nor a /metrics scrape pays it later
+            self.tp_stats()
         self._worker_thread = threading.Thread(
             target=self._worker, daemon=True, name="gen-continuous")
         self._worker_thread.start()
@@ -1438,6 +1448,21 @@ class ContinuousBatchingService(GenerationService):
                     self.stats.get("tokens_generated", 0),
                 "admissions_total": self.stats.get("admissions", 0),
             }
+            if self.tp > 1:
+                # TP serving telemetry (ISSUE 10): constant per-step
+                # accounting (precomputed at setup — tp_stats caches),
+                # recorded per chunk so the offline analyzer's
+                # "Tensor parallel (serving)" section reads it from the
+                # same JSONL as everything else
+                tps = self.tp_stats()
+                rec.update(
+                    tp_degree=tps["tp_degree"],
+                    tp_collective_count_per_step=tps[
+                        "collective_count_per_step"],
+                    tp_collective_bytes_per_step=tps[
+                        "collective_bytes_per_step"],
+                    tp_collective_floor_bytes=tps[
+                        "analytic_floor_bytes"])
             if self._prefix is not None:
                 snap = self._prefix.stats_snapshot()
                 chunks = max(self.stats.get("chunks", 0), 1)

@@ -118,7 +118,15 @@ def fresh_cache(model, params, batch: int, length: int):
     ``kv_quant`` — empty slots decode to zeros). The one allocation
     idiom shared by ``generate``, ``generate_speculative``, and the
     bench/serving callers.
+
+    Under a TP serving mesh (ISSUE 10, ``model.mesh`` carrying a
+    ``tensor`` axis) the K/V leaves come back COMMITTED sharded on the
+    head axis — warmup ladders built from this cache then compile the
+    exact signatures live dispatch hits (a committed/uncommitted
+    mismatch mints fresh XLA compiles mid-traffic).
     """
+    from ..parallel.tp import shard_kv_tree
+
     shapes = jax.eval_shape(
         lambda p: model.apply(
             {"params": p}, jnp.zeros((batch, length), jnp.int32),
@@ -126,9 +134,10 @@ def fresh_cache(model, params, batch: int, length: int):
         ),
         params,
     )
-    return jax.tree.map(
+    cache = jax.tree.map(
         lambda s: jnp.zeros(s.shape, s.dtype), shapes[1]["cache"]
     )
+    return shard_kv_tree(cache, getattr(model, "mesh", None))
 
 
 def generate(model, params, prompt: jnp.ndarray, max_new_tokens: int,
@@ -343,8 +352,11 @@ def _stop_loop(model, t0: int, max_new: int, n_stop: int, sampling,
     """
     from jax import lax
 
+    from ..parallel.tp import constrain_kv_tree
+
     total = t0 + max_new
     per_row = sampling == "per_row"
+    mesh = getattr(model, "mesh", None)
 
     @jax.jit
     def run(params, prompt, row_rngs, row_stops, row_budgets, samp,
@@ -359,6 +371,7 @@ def _stop_loop(model, t0: int, max_new: int, n_stop: int, sampling,
         )[1]["cache"]
         cache = jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype),
                              shapes)
+        cache = constrain_kv_tree(cache, mesh)   # TP head sharding
         extra = {"pad_lens": pad_lens} if padded else {}
         logits, vs = model.apply(
             {"params": params, "cache": cache}, prompt,
@@ -755,7 +768,10 @@ def _spec_loop(model, L: int, D: int, g: int, t0: int, max_new: int,
     shared prefix's prefill."""
     from jax import lax
 
+    from ..parallel.tp import constrain_kv_tree
+
     greedy = temperature <= 0
+    mesh = getattr(model, "mesh", None)
 
     @jax.jit
     def run(params, prompt, rng, pad_len, stops, ext=None):
@@ -779,6 +795,7 @@ def _spec_loop(model, L: int, D: int, g: int, t0: int, max_new: int,
             cache = jax.tree.map(
                 lambda s: jnp.zeros(s.shape, s.dtype), shapes
             )
+            cache = constrain_kv_tree(cache, mesh)  # TP head sharding
             # bucket padding (pad_to): pad slots masked from attention
             logits, vs = model.apply(
                 {"params": params, "cache": cache}, prompt,
@@ -956,6 +973,10 @@ def _prefill_fresh(model, total: int):
     was ~50 (the per-request serving hot path). Batch size
     specializes by trace like any other jit dimension."""
 
+    from ..parallel.tp import constrain_kv_tree
+
+    mesh = getattr(model, "mesh", None)
+
     @jax.jit
     def go(params, prompt, pad_lens=None):
         b = prompt.shape[0]
@@ -969,6 +990,10 @@ def _prefill_fresh(model, total: int):
         cache = jax.tree.map(
             lambda s: jnp.zeros(s.shape, s.dtype), shapes
         )
+        # TP serving: pin the fresh cache's K/V leaves to the head
+        # sharding before the prefill writes land (without this GSPMD
+        # may replicate the zeros and all-gather heads every step)
+        cache = constrain_kv_tree(cache, mesh)
         extra = {} if pad_lens is None else {"pad_lens": pad_lens}
         logits, vs = model.apply(
             {"params": params, "cache": cache}, prompt,

@@ -197,6 +197,10 @@ def _warm_prefill_fn(model, total: int, feed: int, nb: int, block: int,
     import jax
     import jax.numpy as jnp
 
+    from ..parallel.tp import constrain_kv_tree
+
+    mesh = getattr(model, "mesh", None)
+
     @jax.jit
     def run(params, suffix, pool, block_ids, pos0):
         shapes = jax.eval_shape(
@@ -208,6 +212,7 @@ def _warm_prefill_fn(model, total: int, feed: int, nb: int, block: int,
         )[1]["cache"]
         cache = jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype),
                              shapes)
+        cache = constrain_kv_tree(cache, mesh)   # TP head sharding
         cache = scatter_blocks(
             dict(cache), pool, block_ids, jnp.zeros((1,), jnp.int32),
             pos0, feed, block, rotary=False, rope_base=0.0)
@@ -439,6 +444,24 @@ class PrefixCache:
         self.pool_blocks = int(pool_blocks)
         self.rotary = bool(spec.get("rotary"))
         self.rope_base = float(spec.get("rope_base") or 0.0)
+        # TP serving (ISSUE 10): pool pages shard on the KV-HEAD axis
+        # over the model's serving mesh — each tensor shard owns its
+        # KVH/tp slice of every page, while block ids / the radix index
+        # stay replicated host metadata (a page id means the same thing
+        # on every shard). kv_cache_spec's kv_heads must divide tp —
+        # validated up front at load (parallel/tp.validate_tp_geometry)
+        # and defensively here.
+        from ..parallel.tp import tp_degree
+
+        self.mesh = getattr(model, "mesh", None)
+        self._tp = tp_degree(self.mesh)
+        if self._tp > 1:
+            kv_heads = int(spec.get("kv_heads", 0) or 0)
+            if kv_heads and kv_heads % self._tp:
+                raise ValueError(
+                    f"kv_heads={kv_heads} not divisible by the serving "
+                    f"mesh's tensor axis ({self._tp}): the pool cannot "
+                    "shard on the head axis")
         # device pool: one [P, block, H, D] leaf per poolable cache leaf,
         # discovered from a [1, block] eval_shape trace (no device work)
         shapes = jax.eval_shape(
@@ -453,7 +476,7 @@ class PrefixCache:
         for path, leaf in flat:
             ps = _path_str(path)
             if _leaf_kind(ps, leaf) is not None:
-                self.pool[ps] = jnp.zeros(
+                self.pool[ps] = self._alloc_pool_leaf(
                     (self.pool_blocks,) + tuple(leaf.shape[1:]),
                     leaf.dtype)
         if not self.pool:
@@ -515,6 +538,24 @@ class PrefixCache:
                 "fallback serves", self.pool_blocks, self.nb_max,
                 int(model.max_len), self.block)
             self.paged = False
+
+    def _alloc_pool_leaf(self, shape, dtype):
+        """One zeroed pool leaf, COMMITTED to the serving mesh's head
+        sharding when TP is on (so warmup and dispatch signatures
+        match); plain uncommitted zeros at tp=1 — byte-identical to the
+        pre-TP path."""
+        import jax
+        import jax.numpy as jnp
+
+        if self._tp <= 1:
+            return jnp.zeros(shape, dtype)
+        from jax.sharding import NamedSharding
+
+        from ..parallel.tp import kv_pool_pspec
+
+        return jax.device_put(
+            jnp.zeros(shape, dtype),
+            NamedSharding(self.mesh, kv_pool_pspec()))
 
     # ---- host bookkeeping -------------------------------------------------
 
@@ -712,11 +753,10 @@ class PrefixCache:
         counters survive; ``prefix_pool_resets`` records the event.
         Callers must drop any cache pytree that aliased the old
         pool."""
-        import jax.numpy as jnp
-
         with self._lock:
-            self.pool = {ps: jnp.zeros(leaf.shape, leaf.dtype)
-                         for ps, leaf in self.pool.items()}
+            self.pool = {
+                ps: self._alloc_pool_leaf(leaf.shape, leaf.dtype)
+                for ps, leaf in self.pool.items()}
             self.index = RadixIndex(self.block)
             self._free = list(range(1, self.pool_blocks))
             self._private = set()

@@ -49,9 +49,10 @@ class GenerationService:
     threaded HTTP server).
     """
 
-    def __init__(self, config, use_ema: bool = False, **kw):
+    def __init__(self, config, use_ema: bool = False,
+                 tensor_parallel: int = 0, **kw):
         model, params, tokenizer = load_generation_stack(
-            config, use_ema=use_ema
+            config, use_ema=use_ema, tensor_parallel=tensor_parallel
         )
         self._setup(model, params, tokenizer, **kw)
 
@@ -71,9 +72,18 @@ class GenerationService:
 
         from ..utils.promtext import LatencyHistogram
 
+        from ..parallel.tp import tp_degree
+
         self.model, self.params, self.tokenizer = model, params, tokenizer
         self.vocab = int(getattr(self.model, "vocab_size", 0))
         self.arch = type(self.model).__name__
+        # TP serving (ISSUE 10): the mesh rides on the model
+        # (load_generation_stack injects it); tp=1 keeps every path
+        # byte-identical to the single-chip stack
+        self._mesh = getattr(model, "mesh", None)
+        self.tp = tp_degree(self._mesh)
+        self._tp_stats = None
+        self._tp_stats_lock = threading.Lock()
         # pad-capable = the model supports per-row left-pad masking
         # (RoPE families, non-rolling cache): enables mixed-length
         # micro-batching and length-bucketed speculative executables
@@ -177,6 +187,37 @@ class GenerationService:
         None when no pool is attached."""
         return (self._prefix.stats_snapshot()
                 if self._prefix is not None else None)
+
+    def tp_stats(self) -> dict:
+        """Tensor-parallel serving telemetry for /metrics (ISSUE 10):
+        the ``tp_degree`` gauge plus the per-decode-step collective
+        byte/count accounting from the compiled HLO (the MULTICHIP
+        dryrun technique, parallel/tp.decode_step_collectives).
+        Computed ONCE on first success — the accounting compiles a
+        1-token decode step AOT, which must never ride the scrape path
+        twice, so concurrent scrapes serialize on a lock (the
+        continuous engine precomputes at setup; the plain/static
+        schedulers pay it on the first scrape). A transient failure is
+        NOT cached: the scrape reports zeros and the next one retries.
+        tp=1 short-circuits to zeros with no compile."""
+        with self._tp_stats_lock:
+            if self._tp_stats is not None:
+                return self._tp_stats
+            from ..parallel.tp import decode_step_collectives
+
+            try:
+                self._tp_stats = decode_step_collectives(
+                    self.model, self.params)
+                return self._tp_stats
+            except Exception as e:  # noqa: BLE001 — telemetry must
+                # never take the server down; the gauge still reports
+                logger.warning("tp collective accounting failed "
+                               "(will retry next scrape): %s", e)
+                return {"tp_degree": self.tp,
+                        "collective_count_per_step": 0,
+                        "collective_bytes_per_step": 0,
+                        "analytic_floor_bytes": 0,
+                        "counts": {}, "bytes": {}}
 
     def encode_prompt(self, prompt=None, prompt_ids=None) -> list:
         """Text or explicit ids -> validated id list (raises ValueError
@@ -981,16 +1022,37 @@ class BatchedGenerationService(GenerationService):
             r["event"].set()
 
 
-def load_generation_stack(config, use_ema: bool = False):
-    """``(model, params, tokenizer | None)`` for ``config.resume``."""
+def load_generation_stack(config, use_ema: bool = False,
+                          tensor_parallel: int = 0):
+    """``(model, params, tokenizer | None)`` for ``config.resume``.
+
+    ``tensor_parallel`` (ISSUE 10; CLI ``--tp`` wins over the config's
+    ``serving.tensor_parallel``, both default 1 = single-chip): shard
+    the serving model over a ``{"tensor": tp}`` mesh — weights per the
+    model's own megatron ``partition_rules()``, KV caches and the
+    paged pool on the head axis — so prefill/admit/decode run as ONE
+    SPMD program with all-reduce collectives instead of a single-chip
+    dispatch. Geometry that cannot shard (kv heads, d_ff, vocab not
+    divisible by tp) refuses loudly HERE, before any executable
+    builds."""
+    from ..parallel.tp import (
+        serving_mesh, shard_serving_params, validate_tp_geometry,
+    )
+
     assert config.resume is not None, "generation requires a checkpoint (-r)"
     dist.initialize()  # multi-host rendezvous parity with train.py/test.py
-    mesh = mesh_from_config(config)
+    tp = int(tensor_parallel or 0) or int(
+        (config.get("serving") or {}).get("tensor_parallel") or 1)
+    mesh = serving_mesh(tp) if tp > 1 else mesh_from_config(config)
     model = inject_mesh(config.init_obj("arch", MODELS), mesh)
     if not hasattr(model, "max_len"):
         raise SystemExit(
             f"arch {type(model).__name__} has no decode support"
         )
+    if tp > 1:
+        validate_tp_geometry(model, tp)
+        logger.info("tensor-parallel serving: tp=%d over %s", tp,
+                    [str(d) for d in mesh.devices.flat])
 
     serving_meta = load_serving_meta(config.resume)
     if serving_meta is not None:
@@ -1014,8 +1076,13 @@ def load_generation_stack(config, use_ema: bool = False):
         # + device_put would break on multi-host meshes.
         rules = (model.partition_rules()
                  if hasattr(model, "partition_rules") else [])
+        # mesh passed through: the artifact's recorded tp_geometry is
+        # validated against it BEFORE orbax touches a byte — a layout
+        # the artifact cannot shard refuses loudly instead of failing
+        # deep inside a jit (ISSUE 10 satellite)
         params = restore_serving_params(
-            config.resume, template, apply_rules(template, mesh, rules)
+            config.resume, template, apply_rules(template, mesh, rules),
+            mesh=mesh,
         )
     else:
         state, _ = restore_template_state(config, model, mesh)
@@ -1023,4 +1090,8 @@ def load_generation_stack(config, use_ema: bool = False):
             state.ema_params
             if use_ema and state.ema_params is not None else state.params
         )
+    if tp > 1:
+        # idempotent when the restore already materialized sharded
+        # leaves; covers template paths that fell through replicated
+        params = shard_serving_params(model, params, mesh)
     return model, params, tokenizer_from_config(config)

@@ -234,8 +234,12 @@ class LlamaAttention(nn.Module):
 
         cached_k.value = put(pool_k, k)
         cached_v.value = put(pool_v, v)
+        # TP serving (ISSUE 10): a mesh with a tensor axis routes the
+        # read through per-shard head ranges (each shard's kernel walks
+        # only its local KVH/tp pool slice); tables/starts replicate
         return paged_gqa_attention(q, cached_k.value, cached_v.value,
-                                   block_tables, row_starts, pad_lens)
+                                   block_tables, row_starts, pad_lens,
+                                   mesh=self.mesh)
 
     def _cached_attention(self, q, k, v, cur, groups: int,
                           prefill: bool = False, pad_lens=None,
@@ -739,13 +743,24 @@ class LlamaLM(nn.Module):
         path (``block_tables``/``row_starts`` call args — attention
         reads pool pages in place through the block table, ISSUE 7);
         layouts without it fall back to ``kvcache.scatter_blocks``
-        copies into a contiguous cache."""
+        copies into a contiguous cache.
+
+        ``kv_heads`` (ISSUE 10): the TP sharding annotation — pool
+        pages are ``[pool_blocks, block_tokens, KVH, D]`` and a
+        serving mesh shards the head axis (axis 2, the
+        parallel/tp.kv_pool_pspec contract) over its ``tensor`` axis;
+        ``kv_heads % tp == 0`` is enforced up front by
+        parallel/tp.validate_tp_geometry and defensively by the pool.
+        Block tables and the radix index stay replicated host
+        metadata."""
+        n_kv = int(self.n_kv_head or self.n_head)
         return {
             "rotary": True,
             "rope_base": float(self.rope_base),
             "window": int(self.window),
             "kv_quant": self.kv_quant,
             "paged": self.window == 0 and not self.kv_quant,
+            "kv_heads": n_kv,
         }
 
     def partition_rules(self):

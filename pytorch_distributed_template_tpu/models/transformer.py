@@ -452,6 +452,9 @@ class TransformerLM(nn.Module):
             # no block_tables decode path in this family: prefix reuse
             # rides the scatter_blocks fallback arm (engine/kvcache.py)
             "paged": False,
+            # TP sharding annotation (ISSUE 10): full MHA — cache
+            # leaves carry all n_head KV heads on the pool's head axis
+            "kv_heads": int(self.n_head),
         }
 
     def partition_rules(self):

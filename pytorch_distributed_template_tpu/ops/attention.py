@@ -125,7 +125,7 @@ def grouped_query_attention(q, k, v, mask=None):
 
 
 def paged_gqa_attention(q, k_pool, v_pool, tables, row_starts, pad_lens,
-                        impl: str = "auto"):
+                        impl: str = "auto", mesh=None):
     """Decode attention straight from the paged KV block pool
     (ops/flash.paged_attention): row ``b``'s keys/values are gathered
     through its block table instead of a contiguous per-row cache, so a
@@ -137,9 +137,32 @@ def paged_gqa_attention(q, k_pool, v_pool, tables, row_starts, pad_lens,
     ``impl="auto"`` runs the Pallas kernel on TPU and the plain-JAX
     gather oracle elsewhere (the oracle materializes the page gather —
     fine for CPU tests, the exact HBM traffic the kernel avoids on
-    TPU)."""
+    TPU).
+
+    ``mesh`` with a ``tensor`` axis > 1 (ISSUE 10, TP serving): the
+    call runs under ``shard_map`` with PER-SHARD HEAD RANGES — each
+    tensor shard's kernel instance sees only its local ``KVH/tp`` pool
+    slice and the matching ``Hq/tp`` q heads (the q-to-kv pairing
+    ``i // g`` is shard-local because both counts divide by the same
+    tp), while block tables / row starts / pad lens stay replicated.
+    Attention is embarrassingly parallel over heads, so the body needs
+    no collectives; on TPU each shard's Pallas kernel DMA-walks only
+    its own head slice of the pool."""
     from .flash import paged_attention
 
+    if mesh is not None and "tensor" in mesh.axis_names \
+            and mesh.shape["tensor"] > 1:
+        hs = P(None, None, "tensor", None)
+        rep = P(None)
+
+        def local(q_, k_, v_, t_, rs_, pl_):
+            return paged_attention(q_, k_, v_, t_, rs_, pl_, impl=impl)
+
+        return shard_map(
+            local, mesh=mesh,
+            in_specs=(hs, hs, hs, P(None, None), rep, rep),
+            out_specs=hs, check_vma=False,
+        )(q, k_pool, v_pool, tables, row_starts, pad_lens)
     return paged_attention(q, k_pool, v_pool, tables, row_starts,
                            pad_lens, impl=impl)
 

@@ -725,6 +725,13 @@ def paged_attention(q, k_pool, v_pool, tables, row_starts, pad_lens,
     table — the call's own tokens must already be written into the pool
     (models/llama.py writes before attending, same as the contiguous
     DUS path).
+
+    TP serving (ISSUE 10): this kernel is HEAD-RANGE OBLIVIOUS — every
+    shape it reads is local (``groups = hq // kvh`` holds per shard
+    because both counts divide by the same tp), so under a tensor mesh
+    it runs inside ``ops/attention.paged_gqa_attention``'s shard_map
+    with each shard's instance walking only its local ``KVH/tp`` slice
+    of the pool; nothing here needs to know the mesh exists.
     """
     if impl == "auto":
         impl = "pallas" if _on_tpu() else "ref"

@@ -16,3 +16,17 @@ from .sharding import (
     make_state_sharding,
     apply_rules,
 )
+from .tp import (
+    TP_AXIS,
+    serving_mesh,
+    tp_degree,
+    validate_tp_geometry,
+    model_geometry,
+    kv_pool_pspec,
+    shard_kv_tree,
+    constrain_kv_tree,
+    shard_serving_params,
+    decode_step_collectives,
+    analytic_decode_floor_bytes,
+    hlo_collectives,
+)

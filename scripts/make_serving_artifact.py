@@ -37,7 +37,8 @@ def make_artifact(out_dir, arch: str = "TinyLlama",
                   n_layer: int = 2, n_head: int = 4,
                   n_kv_head: int = 2, max_len: int = 256,
                   block_tokens: int = 16, pool_blocks: int = 96,
-                  compile_cache_dir=None, seed: int = 0) -> Path:
+                  compile_cache_dir=None, seed: int = 0,
+                  tensor_parallel: int = 0) -> Path:
     """Build + save the artifact; returns the ``-r``-able model path.
 
     Imports jax lazily so ``--help`` stays instant."""
@@ -49,6 +50,9 @@ def make_artifact(out_dir, arch: str = "TinyLlama",
         save_serving_params,
     )
     from pytorch_distributed_template_tpu.config.registry import MODELS
+    from pytorch_distributed_template_tpu.parallel.tp import (
+        model_geometry, validate_tp_geometry,
+    )
 
     out_dir = Path(out_dir)
     out_dir.mkdir(parents=True, exist_ok=True)
@@ -58,6 +62,10 @@ def make_artifact(out_dir, arch: str = "TinyLlama",
         "n_kv_head": int(n_kv_head), "max_len": int(max_len),
     }
     model = MODELS.get(arch)(**arch_args)
+    if int(tensor_parallel) > 1:
+        # refuse at PRODUCTION time too: baking an intended tp the
+        # geometry cannot shard would only move the failure to restore
+        validate_tp_geometry(model, int(tensor_parallel))
     params = model.init(jax.random.key(int(seed)),
                         jnp.zeros((1, 8), jnp.int32))["params"]
     cfg = copy.deepcopy(json.loads(
@@ -68,15 +76,27 @@ def make_artifact(out_dir, arch: str = "TinyLlama",
         "enabled": True, "block_tokens": int(block_tokens),
         "pool_blocks": int(pool_blocks), "eviction": "lru",
     }}
+    if int(tensor_parallel) > 1:
+        # the artifact's INTENDED mesh layout: serve.py picks it up
+        # without a --tp flag, and restore validates geometry against
+        # whatever tp is actually requested (ISSUE 10 satellite)
+        cfg["serving"]["tensor_parallel"] = int(tensor_parallel)
     if compile_cache_dir:
         cfg["compile_cache"] = {"dir": str(compile_cache_dir)}
     (out_dir / "config.json").write_text(json.dumps(cfg, indent=2))
     # save_serving_params also writes <model>.manifest.json — the
     # per-file sha256 manifest restore_serving_params verifies before
-    # serving (a corrupted artifact refuses LOUDLY; ISSUE 9)
+    # serving (a corrupted artifact refuses LOUDLY; ISSUE 9). The
+    # tp_geometry meta records every TP-divisibility-relevant dimension
+    # so a restore at an incompatible tensor_parallel refuses loudly
+    # (checkpoint/manager.check_artifact_tp_geometry) instead of
+    # failing deep inside a jit.
+    meta = {"arch": arch, "source": "random-init", "seed": int(seed),
+            "tp_geometry": model_geometry(model)}
+    if int(tensor_parallel) > 1:
+        meta["tensor_parallel"] = int(tensor_parallel)
     return save_serving_params(
-        out_dir / "model", jax.device_get(params),
-        meta={"arch": arch, "source": "random-init", "seed": int(seed)},
+        out_dir / "model", jax.device_get(params), meta=meta,
     )
 
 
@@ -101,6 +121,11 @@ def main(argv=None) -> int:
                    help="shared persistent XLA cache dir baked into "
                         "the config (fleet replicas warm each other)")
     p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--tp", type=int, default=0,
+                   help="intended tensor_parallel degree baked into "
+                        "the serving config + manifest (ISSUE 10); "
+                        "geometry is validated at production time and "
+                        "again at restore")
     args = p.parse_args(argv)
     path = make_artifact(
         args.out, arch=args.arch, vocab_size=args.vocab_size,
@@ -108,7 +133,8 @@ def main(argv=None) -> int:
         n_head=args.n_head, n_kv_head=args.n_kv_head,
         max_len=args.max_len, block_tokens=args.block_tokens,
         pool_blocks=args.pool_blocks,
-        compile_cache_dir=args.compile_cache_dir, seed=args.seed)
+        compile_cache_dir=args.compile_cache_dir, seed=args.seed,
+        tensor_parallel=args.tp)
     print(f"ARTIFACT {path}", flush=True)
     print(f"MANIFEST {path.parent / (path.name + '.manifest.json')}",
           flush=True)

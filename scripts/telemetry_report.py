@@ -218,6 +218,31 @@ def analyze_prefix(records: list) -> dict:
     return out
 
 
+def analyze_tp(records: list) -> dict:
+    """Tensor-parallel serving section (ISSUE 10) from the slot
+    engine's per-chunk ``serve_chunk`` records: the TP degree and the
+    per-decode-step collective accounting (compiled-HLO counted,
+    engine-side constant — the LAST record is authoritative), plus the
+    analytic floor it is gated against in the ``serve_tp`` bench rung.
+    Empty for single-chip runs (tp fields absent)."""
+    serve = [r for r in records if r.get("event") == "serve_chunk"
+             and r.get("tp_degree")]
+    if not serve:
+        return {}
+    last = serve[-1]
+    out = {"tp_degree": last["tp_degree"]}
+    for k in ("tp_collective_count_per_step",
+              "tp_collective_bytes_per_step",
+              "tp_collective_floor_bytes"):
+        if last.get(k) is not None:
+            out[k] = last[k]
+    floor = out.get("tp_collective_floor_bytes")
+    got = out.get("tp_collective_bytes_per_step")
+    if floor and got:
+        out["tp_bytes_vs_floor"] = round(got / floor, 3)
+    return out
+
+
 def analyze_trace(path, top: int = 8) -> dict:
     """Total host-span time by name from a Chrome trace-event file."""
     try:
@@ -443,6 +468,7 @@ def to_markdown(report: dict) -> str:
 
     table("Flight recorder", report.get("telemetry", {}))
     table("Prefix cache (serving)", report.get("prefix_cache", {}))
+    table("Tensor parallel (serving)", report.get("tensor_parallel", {}))
     table("Supervisor", report.get("supervisor", {}))
     table("Fleet (router)", report.get("fleet", {}))
     table("Request tracing (p99 attribution)",
@@ -551,6 +577,9 @@ def main(argv=None) -> int:
             prefix = analyze_prefix(records)
             if prefix:
                 report["prefix_cache"] = prefix
+            tp = analyze_tp(records)
+            if tp:
+                report["tensor_parallel"] = tp
         trace_path = args.trace
         if trace_path is None and run_dir is not None:
             cand = run_dir / "trace.json"

@@ -302,6 +302,26 @@ def service_metrics(service: GenerationService) -> dict:
     cache = compile_cache_stats()
     out["compile_cache_hits_total"] = int(cache["hits"])
     out["compile_cache_misses_total"] = int(cache["misses"])
+    # tensor-parallel serving (ISSUE 10): tp_degree gauge + per-decode-
+    # step collective accounting from the compiled HLO (computed once,
+    # zeros on single-chip deployments). Per-op byte/count series ride
+    # flat so the prometheus exposition stays numeric-only.
+    if hasattr(service, "tp_stats"):
+        tp = service.tp_stats()
+        out["tp_degree"] = int(tp.get("tp_degree", 1))
+        out["tp_collective_count_per_step"] = int(
+            tp.get("collective_count_per_step", 0))
+        out["tp_collective_bytes_per_step"] = int(
+            tp.get("collective_bytes_per_step", 0))
+        out["tp_collective_floor_bytes"] = int(
+            tp.get("analytic_floor_bytes", 0))
+        for op, n in (tp.get("counts") or {}).items():
+            key = op.replace("-", "_")
+            out[f"tp_{key}_count_per_step"] = int(n)
+            out[f"tp_{key}_bytes_per_step"] = int(
+                (tp.get("bytes") or {}).get(op, 0))
+    else:
+        out["tp_degree"] = 1
     # health-layer counters (observability/health): anomalies fired,
     # straggler windows flagged, on-demand profiler captures taken
     hc = health_counters()
@@ -682,7 +702,8 @@ def main(args, config):
     # persistent compile cache BEFORE any executable builds: a restarted
     # server re-reads its warmup ladder from disk instead of recompiling
     configure_compile_cache(config)
-    model, params, tok = load_generation_stack(config, use_ema=args.ema)
+    model, params, tok = load_generation_stack(
+        config, use_ema=args.ema, tensor_parallel=args.tp)
     probe = GenerationService.from_model(model, params, tok)
     # serving.prefix_cache config block (paged KV block pool + radix
     # prefix index, engine/kvcache.py) with CLI override: --prefix-cache
@@ -859,6 +880,16 @@ if __name__ == "__main__":
                              "empty disables (default). Pairs with "
                              "compile_cache: a restarted server reads "
                              "the whole ladder from disk")
+    parser.add_argument("--tp", default=0, type=int,
+                        help="tensor-parallel serving degree (ISSUE "
+                             "10): shard weights + the paged KV pool "
+                             "over a {'tensor': tp} mesh so decode "
+                             "runs as one SPMD program. 0 follows the "
+                             "config's serving.tensor_parallel "
+                             "(default 1 = single chip); geometry that "
+                             "cannot shard refuses at startup. On CPU "
+                             "dev boxes pair with XLA_FLAGS="
+                             "--xla_force_host_platform_device_count=N")
     parser.add_argument("--prefix-cache", default="auto",
                         choices=("auto", "on", "off"),
                         help="paged KV prefix cache (engine/kvcache.py)"
