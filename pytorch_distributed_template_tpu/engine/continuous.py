@@ -468,11 +468,11 @@ class ContinuousBatchingService(GenerationService):
                chunk: int = 8, window_ms: float = 5.0,
                warm_buckets=None, prefix_cache=None, recorder=None,
                spec_draft_layers: int = 0, tracer=None, slo=None,
-               brownout=None):
+               brownout=None, role: str = "both"):
         super()._setup(model, params, tokenizer,
                        prefix_cache=prefix_cache,
                        spec_draft_layers=spec_draft_layers,
-                       tracer=tracer, slo=slo)
+                       tracer=tracer, slo=slo, role=role)
         self._recorder = recorder
         # pool_exhaust fault window: until this monotonic instant the
         # prefix pool reports dry (paged admissions defer, scatter
@@ -542,7 +542,10 @@ class ContinuousBatchingService(GenerationService):
                       "tokens_generated": 0, "cancelled": 0,
                       "paged_chunks": 0, "paged_admissions": 0,
                       "deferred_admissions": 0, "deadline_expired": 0,
-                      "brownout_clamped": 0}
+                      "brownout_clamped": 0,
+                      # disaggregated serving (ISSUE 12): pages shipped
+                      # in from prefill-role replicas / exports served
+                      "remote_admits": 0, "prefill_exports": 0}
         self._warm_chunk_ladder()
         if self.tp > 1:
             # precompute the per-step collective accounting with the
@@ -928,6 +931,9 @@ class ContinuousBatchingService(GenerationService):
         ids = self.encode_prompt(prompt, prompt_ids)
         stops = self.encode_stop(stop)
         max_new = int(max_new_tokens)
+        # role gate (ISSUE 12): a prefill-role replica refuses decode-
+        # scale budgets before they ever take a slot
+        self._check_role(max_new)
         # ONE owner for the enqueue rules (shared with serve.py's
         # pre-SSE validate_request — a rule changed here cannot drift
         # from the 400 path): stop-set width, max_new >= 1, and the

@@ -52,7 +52,9 @@ every reuse). Models declare their layout via ``kv_cache_spec()``
 from __future__ import annotations
 
 import functools
+import json
 import logging
+import struct
 import threading
 
 import numpy as np
@@ -61,6 +63,11 @@ logger = logging.getLogger(__name__)
 
 #: reserved pool block: padded/unused kernel lanes read and write here
 SCRATCH_BLOCK = 0
+
+#: wire magic for serialized page payloads (disaggregated serving,
+#: ISSUE 12): version bumps change the suffix, never the prefix, so a
+#: receiver can refuse a foreign format with one 10-byte read
+PAGE_MAGIC = b"PDTPAGES1\n"
 
 
 def _path_str(path) -> str:
@@ -289,6 +296,105 @@ def _paged_decode_fns(model, nb: int, temperature: float, top_k: int,
     return step
 
 
+@functools.lru_cache(maxsize=4)
+def _import_scatter_fn():
+    """Compiled page-import scatter: write ``n`` shipped blocks of
+    content into the (donated) pool at ``ids``. One dispatch for every
+    leaf; donation lets XLA alias the update in place instead of
+    copying the whole pool per import. Under TP the donated input's
+    head sharding carries through to the output — block ids stay
+    replicated host metadata, exactly like every other pool write."""
+    import jax
+
+    @functools.partial(jax.jit, donate_argnums=0)
+    def imp(pool, ids, content):
+        return {ps: pool[ps].at[ids].set(
+            content[ps].astype(pool[ps].dtype)) for ps in pool}
+
+    return imp
+
+
+def serialize_pages(payload: dict) -> bytes:
+    """Page payload (``PrefixCache.export_pages``) -> self-contained
+    bytes: magic + 4-byte header length + header JSON + concatenated
+    raw leaf bytes (header order). The host-staged arm of page
+    shipping — what crosses the wire between a prefill-role and a
+    decode-role replica when they share no mesh (the CPU/CI arm)."""
+    leaves = payload["leaves"]
+    header = {
+        "version": int(payload.get("version", 1)),
+        "block_tokens": int(payload["block_tokens"]),
+        "n_blocks": int(payload["n_blocks"]),
+        "token_ids": [int(t) for t in payload["token_ids"]],
+        "tp_geometry": dict(payload.get("tp_geometry") or {}),
+        "leaves": [],
+    }
+    blobs = []
+    nb = int(payload["n_blocks"])
+    for ps in sorted(leaves):
+        # trim export padding host-side (export gathers power-of-two
+        # chains so device shapes never depend on the block count):
+        # only real pages cross the wire
+        arr = np.ascontiguousarray(np.asarray(leaves[ps])[:nb])
+        header["leaves"].append({"path": ps,
+                                 "shape": list(arr.shape),
+                                 "dtype": str(arr.dtype)})
+        blobs.append(arr.tobytes())
+    hj = json.dumps(header).encode("utf-8")
+    return PAGE_MAGIC + struct.pack(">I", len(hj)) + hj + b"".join(blobs)
+
+
+def deserialize_pages(data: bytes) -> dict:
+    """Inverse of :func:`serialize_pages`; raises ``ValueError`` on a
+    foreign/torn payload (the receiving server maps it to HTTP 400)."""
+    if not data.startswith(PAGE_MAGIC):
+        raise ValueError("not a serialized page payload (bad magic)")
+    off = len(PAGE_MAGIC)
+    if len(data) < off + 4:
+        raise ValueError("truncated page payload (no header length)")
+    (hlen,) = struct.unpack(">I", data[off:off + 4])
+    off += 4
+    try:
+        header = json.loads(data[off:off + hlen].decode("utf-8"))
+    except (UnicodeDecodeError, json.JSONDecodeError) as e:
+        raise ValueError(f"bad page payload header: {e}")
+    off += hlen
+    leaves = {}
+    for spec in header.get("leaves", ()):
+        shape = tuple(int(d) for d in spec["shape"])
+        dtype = np.dtype(spec["dtype"])
+        n = int(np.prod(shape)) * dtype.itemsize
+        if off + n > len(data):
+            raise ValueError("truncated page payload (leaf bytes)")
+        leaves[spec["path"]] = np.frombuffer(
+            data[off:off + n], dtype=dtype).reshape(shape)
+        off += n
+    return {
+        "version": int(header.get("version", 1)),
+        "block_tokens": int(header["block_tokens"]),
+        "n_blocks": int(header["n_blocks"]),
+        "token_ids": [int(t) for t in header["token_ids"]],
+        "tp_geometry": dict(header.get("tp_geometry") or {}),
+        "leaves": leaves,
+    }
+
+
+def ship_pages(src: "PrefixCache", dst: "PrefixCache", ids) -> dict:
+    """Move the cached block chain for ``ids`` from one pool to
+    another in-process — the device-to-device arm of page shipping.
+    When both pools live on the SAME mesh (or both are single-chip on
+    one process) the gathered pages stay device arrays end to end and
+    the copy rides the interconnect (ICI on real hardware); pools on
+    different meshes host-stage, byte-identical to the serialized
+    cross-process arm. Returns the import receipt (see
+    :meth:`PrefixCache.import_pages`)."""
+    device = src.mesh is dst.mesh
+    payload = src.export_pages(ids, device=device)
+    if payload is None:
+        return {"imported_blocks": 0, "cached_tokens": 0, "bytes": 0}
+    return dst.import_pages(payload)
+
+
 class RadixIndex:
     """Block-granular radix/trie over prompt token ids.
 
@@ -513,6 +619,20 @@ class PrefixCache:
             # paged-CAPABLE says nothing about what traffic got)
             "batch1_paged_requests": 0,
             "batch1_scatter_requests": 0,
+            # page shipping (disaggregated serving, ISSUE 12): blocks
+            # exported to / imported from another replica's pool, plus
+            # the raw page bytes that crossed. Imports ALSO count into
+            # warm_admit_copy_bytes — a shipped page is a genuine
+            # device copy the decode replica paid (the paged admit that
+            # later reads it stays a zero-copy pointer update), so on a
+            # decode-role replica warm_admit_copy_bytes_total equals
+            # exactly the page-transfer bytes (accounted like PR 10's
+            # collectives: observable, gated in the serve_disagg rung).
+            "pages_exported": 0,
+            "pages_imported": 0,
+            "page_ship_out_bytes": 0,
+            "page_ship_in_bytes": 0,
+            "page_ship_dropped": 0,
         }
         self.nb_max = -(-int(model.max_len) // self.block)
         # bytes of ONE pool block across every leaf — the unit of the
@@ -716,6 +836,164 @@ class PrefixCache:
             with self._lock:
                 self.stats["warm_admit_copy_bytes"] += (
                     int(n_blocks) * self.page_bytes)
+
+    # ---- page shipping (disaggregated serving, ISSUE 12) -----------------
+
+    def cached_block_count(self, ids) -> int:
+        """Full blocks of ``ids`` the pool currently holds (NO refs, no
+        proper-prefix cap — export ships every full block, and the
+        receiving side's own admission lookup re-applies the cap)."""
+        with self._lock:
+            _, blocks = self.index.match(list(ids))
+            return len(blocks)
+
+    def export_pages(self, ids, device: bool = False):
+        """Gather the cached full-block chain for ``ids`` out of the
+        pool -> a ship payload (``None`` when not even one full block
+        is pooled). ``device=True`` keeps the gathered pages as device
+        arrays (the same-mesh ICI arm — :func:`ship_pages`); the
+        default stages them to host numpy (the serialized arm).
+
+        Refs are held across the gather so a concurrent insert cannot
+        evict a block mid-export; the payload's ``token_ids`` cover
+        exactly the exported blocks, so import adopts them under the
+        same radix keys. ``tp_geometry`` records the exporter's shard
+        layout for the receipt — page CONTENT is the logical
+        ``[block, H, D]`` tensor either way (block ids and the radix
+        are replicated host metadata under TP, PR 10), so a tp=2
+        export imports into a tp=1 pool and vice versa."""
+        import jax.numpy as jnp
+
+        ids = list(ids)
+        with self._lock:
+            nodes, blocks = self.index.match(ids)
+            if not blocks:
+                return None
+            self.index.acquire(nodes)
+        try:
+            nb = len(blocks)
+            # pad the gather to the power-of-two ladder: chain lengths
+            # are traffic-dependent, and an unpadded gather mints a
+            # fresh executable per distinct count — a mid-traffic XLA
+            # compile on the handoff path (the same stall class every
+            # fixed-shape dispatch in this stack exists to kill).
+            # Extra lanes read the scratch block and are sliced away.
+            cap = 1
+            while cap < nb:
+                cap *= 2
+            padded = np.full((cap,), SCRATCH_BLOCK, np.int32)
+            padded[:nb] = blocks
+            idx = jnp.asarray(padded)
+            leaves = {}
+            for ps, leaf in self.pool.items():
+                # leaves stay PADDED [cap, block, H, D] — device
+                # shapes must never depend on nb. serialize_pages
+                # trims host-side; import_pages clamps to n_blocks.
+                arr = leaf[idx]
+                leaves[ps] = arr if device else np.asarray(arr)
+        finally:
+            self.release(nodes)
+        with self._lock:
+            self.stats["pages_exported"] += nb
+            self.stats["page_ship_out_bytes"] += nb * self.page_bytes
+        return {
+            "version": 1,
+            "block_tokens": self.block,
+            "n_blocks": nb,
+            "token_ids": ids[:nb * self.block],
+            "tp_geometry": {"tp": self._tp},
+            "leaves": leaves,
+        }
+
+    def import_pages(self, payload: dict) -> dict:
+        """Adopt a shipped page chain into THIS pool — the receiving
+        half of the prefill→decode handoff. Blocks the pool already
+        holds are skipped (a re-ship of a hot prefix costs nothing);
+        the rest land as PRIVATE pages first (private pages are never
+        evictable, so an in-flight import cannot lose a page to
+        pressure), get their content written by one donating scatter
+        dispatch, and only then adopt into the radix index — a request
+        admitted mid-import either misses (cold prefill, correct) or
+        hits fully-written pages, never a torn one.
+
+        Returns ``{"imported_blocks", "cached_tokens", "bytes",
+        "dropped"?}``; a pool that cannot supply the chain right now
+        drops the import (the decode replica simply cold-prefills —
+        shipping is an optimization, never a correctness dependency).
+        Raises ``ValueError`` on a payload whose geometry cannot land
+        here (block size / leaf shape mismatch)."""
+        import jax.numpy as jnp
+
+        if int(payload.get("block_tokens", 0)) != self.block:
+            raise ValueError(
+                f"page import: block_tokens "
+                f"{payload.get('block_tokens')} != pool's {self.block}")
+        leaves_in = payload.get("leaves") or {}
+        for ps, leaf in self.pool.items():
+            src = leaves_in.get(ps)
+            if src is None:
+                raise ValueError(f"page import: payload missing leaf "
+                                 f"{ps!r}")
+            if tuple(src.shape[1:]) != tuple(leaf.shape[1:]):
+                raise ValueError(
+                    f"page import: leaf {ps!r} shape "
+                    f"{tuple(src.shape[1:])} != pool's "
+                    f"{tuple(leaf.shape[1:])}")
+        ids = [int(t) for t in payload["token_ids"]]
+        nb = min(int(payload["n_blocks"]),
+                 *(int(a.shape[0]) for a in leaves_in.values()))
+        nb = min(nb, len(ids) // self.block)
+        if nb <= 0:
+            return {"imported_blocks": 0, "cached_tokens": 0,
+                    "bytes": 0}
+        with self._lock:
+            _, have = self.index.match(ids)
+            have_n = min(len(have), nb)
+        need = list(range(have_n, nb))
+        if not need:
+            return {"imported_blocks": 0,
+                    "cached_tokens": nb * self.block, "bytes": 0}
+        priv = self.alloc_chain(len(need))
+        if priv is None:
+            with self._lock:
+                self.stats["page_ship_dropped"] += 1
+            return {"imported_blocks": 0, "cached_tokens": 0,
+                    "bytes": 0, "dropped": True}
+        # pad the scatter to the power-of-two ladder (mirror of the
+        # export gather): extra lanes write the scratch block, so a
+        # varying chain length never mints a fresh executable on the
+        # handoff path
+        cap = 1
+        while cap < len(need):
+            cap *= 2
+        sel = np.zeros((cap,), np.int64)
+        sel[:len(need)] = need
+        ids_pad = np.full((cap,), SCRATCH_BLOCK, np.int32)
+        ids_pad[:len(need)] = priv
+        content = {}
+        for ps in self.pool:
+            arr = leaves_in[ps][sel]
+            content[ps] = (arr if hasattr(arr, "devices")
+                           else jnp.asarray(arr))
+        self.pool = _import_scatter_fn()(
+            self.pool, jnp.asarray(ids_pad), content)
+        owned = {have_n + i: bid for i, bid in enumerate(priv)}
+        adopted, _ = self.adopt(ids[:nb * self.block], owned)
+        taken = set(adopted)
+        self.free_blocks([b for b in priv if b not in taken])
+        n = len(adopted)
+        nbytes = n * self.page_bytes
+        with self._lock:
+            self.stats["pages_imported"] += n
+            self.stats["page_ship_in_bytes"] += nbytes
+            # the transfer IS the decode replica's only genuine warm-
+            # admit copy: the paged admit that reads these pages stays
+            # a pointer update, so this counter's value on a decode
+            # replica is exactly the bytes shipped in (rung-gated)
+            self.stats["warm_admit_copy_bytes"] += nbytes
+        return {"imported_blocks": n,
+                "cached_tokens": (have_n + n) * self.block,
+                "bytes": nbytes}
 
     def sync_pool_from_cache(self, cache) -> None:
         """Point ``self.pool`` at the pool leaves inside a paged cache

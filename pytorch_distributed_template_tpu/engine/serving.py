@@ -65,8 +65,21 @@ class GenerationService:
         obj._setup(model, params, tokenizer, **kw)
         return obj
 
+    #: serving roles (disaggregated prefill/decode, ISSUE 12): a
+    #: "prefill" replica computes prompt KV into its pool and SHIPS the
+    #: pages (``prefill_export``) — it refuses decode-scale budgets; a
+    #: "decode" replica ingests shipped pages (``import_remote_pages``)
+    #: and serves decode; "both" (default) is the classic colocated
+    #: replica, byte-identical to the pre-disaggregation stack.
+    ROLES = ("both", "prefill", "decode")
+    #: the largest budget a prefill-role replica serves on /generate:
+    #: 1 token = prefill + first sample (health pokes, manual tests);
+    #: anything longer is decode work the router mis-routed
+    PREFILL_MAX_NEW = 1
+
     def _setup(self, model, params, tokenizer=None, prefix_cache=None,
-               spec_draft_layers: int = 0, tracer=None, slo=None):
+               spec_draft_layers: int = 0, tracer=None, slo=None,
+               role: str = "both"):
         import inspect
         import threading
 
@@ -77,6 +90,10 @@ class GenerationService:
         self.model, self.params, self.tokenizer = model, params, tokenizer
         self.vocab = int(getattr(self.model, "vocab_size", 0))
         self.arch = type(self.model).__name__
+        if role not in self.ROLES:
+            raise ValueError(f"unknown serving role {role!r} "
+                             f"(one of {self.ROLES})")
+        self.role = role
         # TP serving (ISSUE 10): the mesh rides on the model
         # (load_generation_stack injects it); tp=1 keeps every path
         # byte-identical to the single-chip stack
@@ -116,6 +133,15 @@ class GenerationService:
                     )
                 except ValueError as e:
                     logger.warning("prefix cache disabled: %s", e)
+        if self.role != "both" and self._prefix is None:
+            # role-split serving IS page shipping: a prefill replica
+            # with no pool has nothing to export, and a decode replica
+            # with no pool has nowhere to land an import — refuse at
+            # startup, not at the first handoff
+            raise ValueError(
+                f"role={self.role!r} needs a prefix cache "
+                "(serving.prefix_cache.enabled / --prefix-cache on): "
+                "page shipping moves pool pages")
         # early-exit draft depth for speculative requests (ISSUE 7):
         # 0 keeps the n-gram prompt-lookup drafter; > 0 drafts with the
         # model's own first k blocks + head (engine/generate
@@ -308,6 +334,117 @@ class GenerationService:
             raise ValueError(f"stop id outside [0, {self.vocab})")
         return ids
 
+    def _check_role(self, max_new: int) -> None:
+        """The role gate (disaggregated serving, ISSUE 12): a
+        prefill-role replica refuses decode-scale budgets LOUDLY (the
+        router mis-routed — serving it would silently re-colocate the
+        workload the split exists to separate). Decode and colocated
+        roles serve everything: a decode replica must still be able to
+        cold-prefill a miss (shipping is an optimization, never a
+        correctness dependency)."""
+        if self.role == "prefill" and int(max_new) > self.PREFILL_MAX_NEW:
+            raise ValueError(
+                f"prefill-role replica serves max_new_tokens <= "
+                f"{self.PREFILL_MAX_NEW} (got {int(max_new)}): decode "
+                "work belongs on a decode-role replica (POST /prefill "
+                "ships this prompt's KV pages instead)")
+
+    def prefill_export(self, prompt=None, prompt_ids=None,
+                       request_id=None, deadline=None) -> dict:
+        """The prefill-role entry (ISSUE 12 tentpole): compute the
+        prompt's KV into this replica's pool — paged path when
+        supported, scatter-insert fallback otherwise — and export the
+        full-block chain as a ship payload for a decode replica.
+
+        NOTHING but pages + token ids ships: the decode replica's warm
+        admit recomputes the fed suffix window (which always includes
+        the final prompt token) exactly as a cold admit would, so its
+        first-token logits — and therefore greedy AND sampled output
+        under the request's own seed — are token-identical to a
+        colocated run with no sampling state crossing the wire. The
+        canonical-rotation contract (PR 5) is what makes the shipped
+        bytes position/era-independent: a page is just content + a
+        block-table splice on arrival.
+
+        Returns the payload dict (``engine/kvcache.serialize_pages``
+        turns it into wire bytes); a prompt too short to fill one block
+        returns a payload with ``n_blocks == 0`` — the caller sends
+        the decode replica straight to a cold prefill.
+
+        Concurrency: exports run batch-1 under the service lock (the
+        speculative-request contract), so one prefill replica
+        serializes its /prefill traffic — concurrent handoffs queue
+        inside the replica and surface as handoff latency, which the
+        router's ``handoff_seconds`` histogram reports honestly.
+        Prefill is compute-bound (the reason the role exists), so
+        batch-1 costs little throughput on a dedicated chip; a
+        batched prefill-export through the slot engine is the
+        follow-on if prefill replicas ever saturate."""
+        import time
+
+        from .kvcache import serialize_pages  # noqa: F401 (re-export)
+
+        t0 = time.monotonic()
+        if deadline is not None and deadline.expired(t0):
+            raise DeadlineExceeded("deadline expired before prefill")
+        if self._prefix is None:
+            raise ValueError("prefill_export needs a prefix cache "
+                             "(serving.prefix_cache.enabled)")
+        ids = self.encode_prompt(prompt, prompt_ids)
+        pf = self._prefix
+        empty = {"version": 1, "block_tokens": pf.block, "n_blocks": 0,
+                 "token_ids": [], "tp_geometry": {"tp": pf._tp},
+                 "leaves": {}}
+        if len(ids) // pf.block == 0:
+            return empty          # nothing exportable: sub-block prompt
+        with self._lock:
+            if pf.cached_block_count(ids) < len(ids) // pf.block:
+                # compute the missing blocks into the pool. Paged arm:
+                # a 1-token-budget reservation whose suffix prefill
+                # writes straight into private pages, finished
+                # immediately so the prompt's blocks adopt zero-copy;
+                # scatter arm: warm_prefill's plan_insert + capture.
+                done = False
+                if pf.paged:
+                    res = pf.paged_prefill(self.params, ids, 1)
+                    if res is not None:
+                        _, cache, _, plan = res
+                        pf.paged_finish(plan, [], 0)
+                        done = True
+                if not done:
+                    pf.warm_prefill(self.params, ids, len(ids) + 1)
+            payload = pf.export_pages(ids)
+        if payload is None:
+            payload = empty
+        self.stats["prefill_exports"] = (
+            self.stats.get("prefill_exports", 0) + 1)
+        if self._tracer is not None and request_id:
+            self._tracer.add(request_id, "prefill_export", t0,
+                             time.monotonic(),
+                             blocks=payload["n_blocks"])
+        return payload
+
+    def import_remote_pages(self, payload) -> dict:
+        """The decode-role entry: land a shipped page chain in this
+        replica's pool (``bytes`` payloads deserialize here), making
+        the prompt's prefix a radix HIT — the very next ``generate``
+        for it admits as a zero-recompute block-table pointer update.
+        Runs under the service lock (the scheduler's tick-start
+        ``refresh_cache_from_pool`` absorbs the import's pool
+        donation, same contract as batch-1 speculative requests)."""
+        from .kvcache import deserialize_pages
+
+        if self._prefix is None:
+            raise ValueError("import_remote_pages needs a prefix cache "
+                             "(serving.prefix_cache.enabled)")
+        if isinstance(payload, (bytes, bytearray, memoryview)):
+            payload = deserialize_pages(bytes(payload))
+        with self._lock:
+            receipt = self._prefix.import_pages(payload)
+        self.stats["remote_admits"] = (
+            self.stats.get("remote_admits", 0) + 1)
+        return receipt
+
     def validate_request(self, req: dict) -> None:
         """Cheap host-side validation of a wire-format request body
         (the dict serve.py reads off the socket): raises the same
@@ -324,6 +461,7 @@ class GenerationService:
         max_new = int(req.get("max_new_tokens", 64))
         if max_new < 1:
             raise ValueError("max_new_tokens must be >= 1")
+        self._check_role(max_new)
         float(req.get("temperature", 0.0))
         int(req.get("top_k", 0))
         float(req.get("top_p", 0.0))
@@ -396,6 +534,7 @@ class GenerationService:
         if deadline is not None and deadline.expired(t_req):
             raise DeadlineExceeded(
                 "deadline expired before dispatch")
+        self._check_role(max_new_tokens)
         ids = self.encode_prompt(prompt, prompt_ids)
         stops = self.encode_stop(stop)
         arr = jnp.asarray(np.asarray(ids, np.int32)[None, :])

@@ -279,3 +279,46 @@ class FairAdmission:
             out["brownout_shed_total"] = self._brownout_shed
         out["wait_seconds"] = self.wait_hist.snapshot()
         return out
+
+
+def staged_gates(decode_capacity_fn: Callable[[], int],
+                 prefill_capacity_fn: Optional[Callable[[], int]] = None,
+                 weights: Optional[Dict[str, float]] = None,
+                 default_weight: float = 1.0,
+                 max_waiting: int = 64,
+                 max_waiting_per_tenant: Optional[int] = None,
+                 queue_timeout_s: float = 30.0,
+                 prefill_max_waiting: Optional[int] = None,
+                 prefill_queue_timeout_s: Optional[float] = None):
+    """Two-stage admission for a disaggregated fleet (ISSUE 12):
+    ``(decode_gate, prefill_gate | None)``.
+
+    The DECODE gate is the fleet-wide front-door gate the router has
+    always run (capacity from the decode-capable replicas' slots) —
+    it bounds end-to-end concurrency and owns the 429/Retry-After
+    shed contract. The PREFILL gate is a second, fully independent
+    :class:`FairAdmission` — its OWN WFQ virtual clock, watermark,
+    and waiter timeout — wrapped around only the prefill hop of a
+    handoff, so a burst of long prefills queues against prefill
+    capacity without consuming decode admission slots (and a decode
+    flood cannot starve prefill admission: separate clocks, separate
+    heaps). ``prefill_capacity_fn=None`` (no prefill-role replicas)
+    returns no prefill gate and the fleet schedules exactly as
+    before."""
+    decode_gate = FairAdmission(
+        decode_capacity_fn, weights=weights,
+        default_weight=default_weight, max_waiting=max_waiting,
+        max_waiting_per_tenant=max_waiting_per_tenant,
+        queue_timeout_s=queue_timeout_s)
+    prefill_gate = None
+    if prefill_capacity_fn is not None:
+        prefill_gate = FairAdmission(
+            prefill_capacity_fn, weights=weights,
+            default_weight=default_weight,
+            max_waiting=(max_waiting if prefill_max_waiting is None
+                         else int(prefill_max_waiting)),
+            max_waiting_per_tenant=max_waiting_per_tenant,
+            queue_timeout_s=(queue_timeout_s
+                             if prefill_queue_timeout_s is None
+                             else float(prefill_queue_timeout_s)))
+    return decode_gate, prefill_gate

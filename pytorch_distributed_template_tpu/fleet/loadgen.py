@@ -54,16 +54,53 @@ def build_trace(n_requests: int, seed: int = 0,
                 deadline_ms: Optional[int] = None,
                 infeasible_frac: float = 0.0,
                 infeasible_ms: int = 1,
-                vocab: int = 256) -> List[dict]:
+                vocab: int = 256,
+                long_prefix_len: int = 0, long_groups: int = 0,
+                group_prompt_lens: Optional[List[int]] = None,
+                group_max_new: Optional[List[int]] = None,
+                group_weights: Optional[List[float]] = None,
+                group_stream: Optional[List[bool]] = None
+                ) -> List[dict]:
     """Deterministic request trace: same seed ⇒ same trace, byte for
     byte. ``group_tag`` namespaces the prefix groups — two arms with
-    different tags share NO prefixes, so each starts cold."""
+    different tags share NO prefixes, so each starts cold.
+
+    **Long-prefill mixture (ISSUE 12):** the disaggregation rung needs
+    traffic where a minority of LONG prefills contends with
+    decode-heavy requests — the workload that collapses a colocated
+    replica's TPOT p99. ``long_groups``/``long_prefix_len`` make the
+    prompt-length distribution bimodal (the FIRST ``long_groups``
+    groups draw ``long_prefix_len``-token prefixes, the rest keep
+    ``prefix_len``); ``group_prompt_lens`` pins an explicit per-group
+    TOTAL prompt length instead (prefix = entry − ``suffix_len``;
+    overrides both), and ``group_max_new`` pins
+    a per-group decode budget (long-prefill groups typically pair with
+    a small budget, decode-heavy groups with a large one).
+    ``group_weights`` biases which group each request draws from
+    (uniform when absent — zero-weight groups never draw, so one
+    trace shape yields a matched decode-only control arm);
+    ``group_stream`` pins per-group SSE transport (the TPOT signal
+    needs the decode-heavy groups streaming). All the knobs are
+    draw-order-neutral: each group's prefix comes from its OWN seeded
+    stream, per-request draws happen knobs-or-not, and overrides
+    apply after the draw — so a trace built with the knobs off is
+    byte-identical to one built before they existed (the seed
+    contract)."""
     rng = random.Random(f"loadgen:{seed}")
+
+    def _group_prefix_len(g: int) -> int:
+        if group_prompt_lens is not None:
+            return max(int(group_prompt_lens[g % len(
+                group_prompt_lens)]) - suffix_len, 0)
+        if long_prefix_len > 0 and g < int(long_groups):
+            return int(long_prefix_len)
+        return int(prefix_len)
+
     prefixes = []
     for g in range(prefix_groups):
         grng = random.Random(f"prefix:{seed}:{group_tag}:{g}")
         prefixes.append([grng.randrange(1, vocab)
-                         for _ in range(prefix_len)])
+                         for _ in range(_group_prefix_len(g))])
     tenants = list(tenants)
     weights = [float((tenant_weights or {}).get(t, 1.0))
                for t in tenants]
@@ -86,8 +123,13 @@ def build_trace(n_requests: int, seed: int = 0,
     trace = []
     for i, at in enumerate(times):
         g = rng.randrange(prefix_groups)
+        if group_weights is not None:
+            g = rng.choices(range(prefix_groups),
+                            weights=group_weights)[0]
         suffix = [rng.randrange(1, vocab) for _ in range(suffix_len)]
         stream = rng.random() < stream_frac
+        if group_stream is not None:
+            stream = bool(group_stream[g % len(group_stream)])
         cancel = (stream and cancel_frac > 0
                   and rng.random() < cancel_frac)
         # deadline mixture (ISSUE 9): every request carries the
@@ -111,7 +153,9 @@ def build_trace(n_requests: int, seed: int = 0,
             "tenant": rng.choices(tenants, weights=weights)[0],
             "group": f"{group_tag}{g}",
             "prompt_ids": prefixes[g] + suffix,
-            "max_new_tokens": int(max_new_tokens),
+            "max_new_tokens": int(
+                group_max_new[g % len(group_max_new)]
+                if group_max_new else max_new_tokens),
             "temperature": float(temperature),
             "stream": stream,
             "cancel_after_s": (float(cancel_after_s) if cancel
@@ -263,6 +307,14 @@ def _consume_sse(resp, conn, item: dict, rec: dict,
                 if t_first is None:
                     t_first = now
                     rec["ttft_s"] = round(now - t0, 4)
+                else:
+                    # per-TOKEN inter-delta gap (normalized by the
+                    # delta's token count): TPOT is a per-token
+                    # metric, and pooling these across streams is
+                    # what makes a single long-prefill stall visible
+                    # at p99 (the serve_disagg gate's signal)
+                    rec.setdefault("tpot_gaps", []).append(
+                        round((now - t_last) / len(ids), 5))
                 t_last = now
                 rec["tokens"] += len(ids)
     finally:
@@ -309,6 +361,11 @@ def summarize(replayed: dict, trace: Optional[List[dict]] = None
                    if r["ttft_s"] is not None)
     tpots = sorted(r["tpot_s"] for r in results
                    if r["tpot_s"] is not None)
+    # pooled per-token gaps across every stream (see _consume_sse):
+    # the per-TOKEN TPOT distribution, orders of magnitude more
+    # samples than the per-request means above
+    gaps = sorted(g for r in results
+                  for g in (r.get("tpot_gaps") or ()))
     totals = sorted(r["total_s"] for r in results
                     if r["ok"] and r["total_s"] is not None)
     n = len(results)
@@ -360,6 +417,8 @@ def summarize(replayed: dict, trace: Optional[List[dict]] = None
         "ttft_p99_s": _percentile(ttfts, 0.99),
         "tpot_p50_s": _percentile(tpots, 0.5),
         "tpot_p99_s": _percentile(tpots, 0.99),
+        "tpot_tok_p50_s": _percentile(gaps, 0.5),
+        "tpot_tok_p99_s": _percentile(gaps, 0.99),
         "latency_p50_s": _percentile(totals, 0.5),
         "latency_p99_s": _percentile(totals, 0.99),
         "per_tenant": per_tenant,
@@ -395,6 +454,12 @@ def main(argv=None) -> int:
     p.add_argument("--tenants", default="t0,t1,t2")
     p.add_argument("--prefix-groups", type=int, default=4)
     p.add_argument("--prefix-len", type=int, default=64)
+    p.add_argument("--long-prefix-len", type=int, default=0,
+                   help="bimodal prompt-length mixture (ISSUE 12): "
+                        "the first --long-groups prefix groups draw "
+                        "prefixes this long (0 = unimodal)")
+    p.add_argument("--long-groups", type=int, default=0,
+                   help="how many leading prefix groups are LONG")
     p.add_argument("--suffix-len", type=int, default=16)
     p.add_argument("--max-new-tokens", type=int, default=8)
     p.add_argument("--stream-frac", type=float, default=0.5)
@@ -412,7 +477,9 @@ def main(argv=None) -> int:
         prefix_len=args.prefix_len, suffix_len=args.suffix_len,
         max_new_tokens=args.max_new_tokens, arrival=args.arrival,
         rate_rps=args.rate, stream_frac=args.stream_frac,
-        cancel_frac=args.cancel_frac)
+        cancel_frac=args.cancel_frac,
+        long_prefix_len=args.long_prefix_len,
+        long_groups=args.long_groups)
     summary = summarize(replay(args.url, trace,
                                timeout_s=args.timeout_s,
                                policy=args.policy), trace)

@@ -43,6 +43,29 @@ REASON_ROUND_ROBIN = "round_robin"
 
 POLICIES = ("cache_aware", "least_loaded", "round_robin")
 
+#: replica roles (disaggregated prefill/decode serving, ISSUE 12):
+#: "prefill" replicas compute prompt KV and ship pool pages, "decode"
+#: replicas ingest pages and serve decode, "both" does everything (the
+#: classic colocated replica — every pre-disaggregation fleet is all
+#: "both" and routes exactly as before)
+ROLE_PREFILL = "prefill"
+ROLE_DECODE = "decode"
+ROLE_BOTH = "both"
+ROLES = (ROLE_BOTH, ROLE_PREFILL, ROLE_DECODE)
+
+
+def role_serves(replica_role: str, stage: Optional[str]) -> bool:
+    """Can a replica with ``replica_role`` serve ``stage``?
+    ``stage=None`` (no role constraint — the colocated path) matches
+    everything; ``"prefill"`` matches prefill/both; ``"decode"``
+    matches decode/both. One owner for the stage→role matrix — the
+    manager's role-filtered routing and the two-queue capacity split
+    both consult it."""
+    if stage is None:
+        return True
+    role = replica_role or ROLE_BOTH
+    return role == ROLE_BOTH or role == stage
+
 
 def affinity_ids(body: dict) -> list:
     """Wire request body -> the id sequence the radix keys on:

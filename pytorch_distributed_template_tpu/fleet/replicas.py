@@ -48,9 +48,10 @@ from typing import Dict, List, Optional
 
 from ..resilience.supervisor import EventLog, Supervisor, SupervisorConfig
 from ..utils.promtext import (
-    add_histograms, histogram_quantile, is_histogram, zero_histogram,
+    LatencyHistogram, add_histograms, histogram_quantile, is_histogram,
+    zero_histogram,
 )
-from .placement import FleetRadix, choose_replica
+from .placement import ROLE_BOTH, FleetRadix, choose_replica, role_serves
 
 STARTING = "starting"
 HEALTHY = "healthy"
@@ -89,7 +90,8 @@ class Replica:
     def __init__(self, rid: str, cmd: Optional[List[str]] = None,
                  url: Optional[str] = None,
                  run_dir: Optional[Path] = None,
-                 sup_cfg: Optional[SupervisorConfig] = None):
+                 sup_cfg: Optional[SupervisorConfig] = None,
+                 role: str = ROLE_BOTH):
         if (cmd is None) == (url is None):
             raise ValueError("a replica needs exactly one of cmd/url")
         self.rid = rid
@@ -97,6 +99,10 @@ class Replica:
         self.url = url
         self.managed = cmd is not None
         self.state = STARTING
+        # disaggregated serving (ISSUE 12): the replica's configured
+        # role; the poller overwrites it from the replica's own
+        # /metrics "role" field (attach mode discovers roles this way)
+        self.role = role or ROLE_BOTH
         self.inflight = 0              # router-accounted live requests
         self.fail_streak = 0
         self.ok_streak = 0
@@ -285,8 +291,19 @@ class FleetManager:
             "routed_prefix_total": 0, "routed_least_loaded_total": 0,
             "routed_round_robin_total": 0, "dispatch_errors_total": 0,
             "wedged_ejections_total": 0, "wedge_restarts_total": 0,
+            # disaggregated handoffs (ISSUE 12): prefill→decode page
+            # ships brokered by the router, the raw page bytes that
+            # crossed (accounted like PR 10's collective bytes —
+            # observable transfer cost, not an estimate), and how many
+            # eligible requests fell back to the colocated path
+            "handoffs_total": 0, "pages_shipped_total": 0,
+            "page_ship_bytes_total": 0, "handoff_fallbacks_total": 0,
         }
         self.recoveries_s: List[float] = []
+        #: prefill→decode handoff latency (stage-1 dispatch → decode
+        #: dispatch), histogram-bucketed so it aggregates across
+        #: routers like every other fleet latency (ISSUE 8 discipline)
+        self.handoff_hist = LatencyHistogram()
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -375,6 +392,12 @@ class FleetManager:
                     r.polled = polled
                     r.absorb_counters(polled)
                     r.fail_streak = 0
+                    # role discovery (ISSUE 12): the replica's own
+                    # /metrics role wins over the configured one
+                    # (attach mode has no configuration to consult)
+                    role = polled.get("role")
+                    if isinstance(role, str) and role:
+                        r.role = role
                     # wedged-replica detection (ISSUE 9): frozen
                     # scheduler progress WITH pending work, across
                     # wedge_after successful polls, is as unhealthy as
@@ -475,20 +498,71 @@ class FleetManager:
 
     # -- routing ------------------------------------------------------------
 
-    def capacity(self) -> int:
+    def capacity(self, role: Optional[str] = None) -> int:
         """Fleet-wide concurrency cap for admission control: healthy
         slots x oversubscription (a bounded per-replica queue keeps the
-        continuous engines inside the batching sweet spot)."""
+        continuous engines inside the batching sweet spot). ``role``
+        restricts the sum to replicas serving that stage — the
+        two-queue split's independent capacities (ISSUE 12)."""
         with self._lock:
             cap = sum(r.slots(self.slots_hint) * self.queue_factor
                       for r in self.replicas.values()
-                      if r.state == HEALTHY)
+                      if r.state == HEALTHY
+                      and role_serves(r.role, role))
         return int(cap)
 
-    def healthy(self) -> List[Replica]:
+    def healthy(self, role: Optional[str] = None) -> List[Replica]:
         with self._lock:
             return [r for r in self.replicas.values()
-                    if r.state == HEALTHY]
+                    if r.state == HEALTHY
+                    and role_serves(r.role, role)]
+
+    def disaggregated(self) -> bool:
+        """Is the prefill/decode split LIVE right now? True only with
+        at least one healthy DEDICATED prefill replica and one healthy
+        decode-capable replica — an all-"both" fleet (or one whose
+        prefill arm is down) routes colocated, so role loss degrades
+        to the classic path instead of failing requests."""
+        with self._lock:
+            has_prefill = any(
+                r.state == HEALTHY and r.role == "prefill"
+                for r in self.replicas.values())
+            has_decode = any(
+                r.state == HEALTHY and role_serves(r.role, "decode")
+                for r in self.replicas.values())
+        return has_prefill and has_decode
+
+    def warm_decode_tokens(self, ids) -> int:
+        """Deepest radix match among healthy decode-capable replicas:
+        how many of this prompt's tokens a decode replica ALREADY
+        holds (shipped earlier, or decoded there). The router skips
+        the prefill stage when this covers (nearly) the whole prompt
+        — re-shipping pages the receiver has is pure wire cost, and
+        the request admits as a warm pointer update there anyway."""
+        with self._lock:
+            matches = self.radix.match(ids)
+            best = 0
+            for rid, tok in matches.items():
+                r = self.replicas.get(rid)
+                if (r is not None and r.state == HEALTHY
+                        and role_serves(r.role, "decode")):
+                    best = max(best, tok)
+            return best
+
+    def note_handoff(self, pages: int, nbytes: int, dur_s: float,
+                     fallback: bool = False) -> None:
+        """Account one prefill→decode handoff (or a fallback to the
+        colocated path): page/byte counters + the handoff latency
+        histogram, all snapshot into router.jsonl for the offline
+        'Disaggregation (serving)' report."""
+        with self._lock:
+            if fallback:
+                self.stats["handoff_fallbacks_total"] += 1
+                return
+            self.stats["handoffs_total"] += 1
+            self.stats["pages_shipped_total"] += int(pages)
+            self.stats["page_ship_bytes_total"] += int(nbytes)
+        self.handoff_hist.observe(max(float(dur_s), 0.0))
 
     def _brownout_level_locked(self) -> int:
         """ONE owner for which replicas count as 'live' for the fleet
@@ -506,14 +580,27 @@ class FleetManager:
             return self._brownout_level_locked()
 
     def route(self, ids, policy: Optional[str] = None,
-              exclude=()) -> Optional[tuple]:
+              exclude=(), role: Optional[str] = None,
+              record: bool = True) -> Optional[tuple]:
         """Place one request -> ``(replica, reason)`` or None (no
         healthy replica). Records the placement in the radix so the
-        NEXT shared-prefix request finds it."""
+        NEXT shared-prefix request finds it. ``role`` restricts
+        candidates to replicas serving that stage (the disaggregated
+        router routes stage 1 with ``role="prefill"`` and stage 2 with
+        ``role="decode"``; both stages share ONE radix — a prefix is
+        hot on a prefill replica AND on the decode replica its pages
+        shipped to, and the role filter picks the right view).
+        ``record=False`` skips the radix record: the handoff's decode
+        hop records only AFTER its import lands
+        (:meth:`record_placement`) — recording at route time would
+        let a concurrent same-prefix request skip its handoff against
+        pages that have not arrived yet and pay a COLD long prefill
+        on the decode replica, the exact stall the split removes."""
         with self._lock:
             cands = [(r.rid, r.load_estimate())
                      for r in self.replicas.values()
-                     if r.state == HEALTHY and r.rid not in exclude]
+                     if r.state == HEALTHY and r.rid not in exclude
+                     and role_serves(r.role, role)]
             picked = choose_replica(
                 cands, self.radix.match(ids),
                 policy=policy or self.policy, rr_counter=self._rr,
@@ -524,8 +611,18 @@ class FleetManager:
             rid, reason = picked
             self._rr += 1
             self.stats[f"routed_{reason}_total"] += 1
-            self.radix.record(ids, rid)
+            if record:
+                self.radix.record(ids, rid)
             return self.replicas[rid], reason
+
+    def record_placement(self, ids, rid: str) -> None:
+        """Deferred radix record for a handoff's decode hop: called
+        once the shipped pages have actually landed (or there were
+        none to ship), so the prediction never runs ahead of the
+        pool's contents."""
+        with self._lock:
+            if rid in self.replicas:
+                self.radix.record(ids, rid)
 
     def begin(self, replica: Replica) -> None:
         with self._lock:
@@ -624,6 +721,25 @@ class FleetManager:
             out["replicas"] = len(self.replicas)
             out["replicas_healthy"] = sum(
                 1 for r in self.replicas.values() if r.state == HEALTHY)
+            # disaggregation gauges (ISSUE 12): per-role healthy
+            # counts + the handoff latency histogram (and quantile
+            # estimates for humans) — the offline analyzer's
+            # "Disaggregation (serving)" section reads these from the
+            # snapshot events
+            out["replicas_prefill_healthy"] = sum(
+                1 for r in self.replicas.values()
+                if r.state == HEALTHY and r.role == "prefill")
+            out["replicas_decode_healthy"] = sum(
+                1 for r in self.replicas.values()
+                if r.state == HEALTHY
+                and role_serves(r.role, "decode"))
+            hh = self.handoff_hist.snapshot()
+            if hh.get("count"):
+                out["handoff_seconds"] = hh
+                for q, tag in ((0.5, "p50"), (0.99, "p99")):
+                    est = histogram_quantile(hh, q)
+                    if est is not None:
+                        out[f"handoff_{tag}_s"] = est
             # worst live replica's brownout level (gauge, ISSUE 9)
             out["fleet_brownout_level"] = self._brownout_level_locked()
             out["inflight"] = sum(r.inflight
@@ -638,6 +754,7 @@ class FleetManager:
         with self._lock:
             reps = [{
                 "id": r.rid, "url": r.url, "state": r.state,
+                "role": r.role,
                 "inflight": r.inflight,
                 "queue_depth": int(r.polled.get("queue_depth", 0)),
                 "slots": r.slots(self.slots_hint),

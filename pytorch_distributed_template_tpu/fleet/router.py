@@ -165,9 +165,14 @@ def fleet_brownout_level(manager: FleetManager,
 
 
 def router_metrics(manager: FleetManager, admission: FairAdmission,
-                   stats: RouterStats, slo=None) -> dict:
+                   stats: RouterStats, slo=None,
+                   prefill_admission=None) -> dict:
     """The flat dict behind ``GET /metrics``: router counters, fleet
-    aggregates (reset-corrected replica counters), admission stats."""
+    aggregates (reset-corrected replica counters), admission stats.
+    With a prefill gate attached (disaggregated fleets, ISSUE 12) the
+    prefill queue's depths/shed/wait series ride alongside under a
+    ``prefill_`` prefix — the per-role queue-depth split the
+    two-stage scheduler is judged by."""
     out = dict(stats.snapshot())
     out["router_ttft_seconds"] = stats.ttft_hist.snapshot()
     out["router_e2e_seconds"] = stats.e2e_hist.snapshot()
@@ -195,6 +200,13 @@ def router_metrics(manager: FleetManager, admission: FairAdmission,
     out["admission_wait_seconds"] = adm["wait_seconds"]
     out.update(admission.depths())   # inflight/waiting/capacity gauges
     out["tenants"] = adm["tenants"]  # JSON-only (nested)
+    if prefill_admission is not None:
+        padm = prefill_admission.stats()
+        out["prefill_admitted_total"] = padm[ADMITTED]
+        out["prefill_shed_total"] = padm["shed_total"]
+        out["prefill_admission_wait_seconds"] = padm["wait_seconds"]
+        for k, v in prefill_admission.depths().items():
+            out[f"prefill_{k}"] = v
     return out
 
 
@@ -203,7 +215,9 @@ def make_fleet_handler(manager: FleetManager, admission: FairAdmission,
                        allow_admin: bool = False,
                        connect_timeout_s: float = 5.0,
                        read_timeout_s: float = 600.0,
-                       tracer=None, slo=None, hedge=None):
+                       tracer=None, slo=None, hedge=None,
+                       prefill_admission=None,
+                       disagg_min_ids: int = 32):
     stats = stats or RouterStats()
     hedge = hedge or HedgePolicy(enabled=False)
     # 1-based ordinal of requests reaching the proxy stage: the req
@@ -247,8 +261,9 @@ def make_fleet_handler(manager: FleetManager, admission: FairAdmission,
         def do_GET(self):  # noqa: N802 (http.server API)
             path, _, query = self.path.partition("?")
             if path == "/metrics":
-                metrics = router_metrics(manager, admission, stats,
-                                         slo=slo)
+                metrics = router_metrics(
+                    manager, admission, stats, slo=slo,
+                    prefill_admission=prefill_admission)
                 if "format=json" in query:
                     return self._send(200, metrics)
                 return self._send_raw(
@@ -346,7 +361,10 @@ def make_fleet_handler(manager: FleetManager, admission: FairAdmission,
                 # gate (level 4 tightens per-tenant slices) — cheap:
                 # two lock-protected reads per request
                 fleet_brownout_level(manager, admission)
-                if not manager.healthy():
+                if not manager.healthy(role="decode"):
+                    # decode-capable replicas are what serve a
+                    # generate; a fleet whose only survivor is a
+                    # dedicated prefill replica is down for clients
                     stats.bump("unavailable_total")
                     outcome = "unavailable"
                     return self._send(
@@ -402,7 +420,7 @@ def make_fleet_handler(manager: FleetManager, admission: FairAdmission,
                         # or breach an SLO (an outage would otherwise
                         # drag fleet p50 DOWN and dump never-served
                         # requests as slow)
-                        outcome = self._route_and_proxy(
+                        outcome = self._dispatch(
                             body, raw, policy, rid, tenant, holder,
                             deadline, stream)
                 finally:
@@ -427,6 +445,270 @@ def make_fleet_handler(manager: FleetManager, admission: FairAdmission,
                                tenant=tenant, outcome=outcome,
                                stream=stream)
                 self._rid = None
+
+        def _dispatch(self, body: dict, raw: bytes, policy, rid: str,
+                      tenant: str, holder: dict, deadline=None,
+                      stream: bool = False) -> str:
+            """Pick the dispatch shape: two-stage disaggregated
+            (prefill-role replica computes + ships KV pages, decode-
+            role replica adopts them and serves — ISSUE 12) when the
+            fleet has live dedicated roles and the request is worth
+            shipping, else the classic colocated path. ``round_robin``
+            (the bench control arm) and speculative requests always
+            colocate; prompts under ``disagg_min_ids`` affinity ids
+            ship nothing worth the hop. Disaggregated requests do not
+            hedge — the handoff already runs two replicas."""
+            ids = affinity_ids(body)
+            if (manager.disaggregated()
+                    and policy != "round_robin"
+                    and not int(body.get("speculative", 0) or 0)
+                    and len(ids) >= disagg_min_ids
+                    # a decode replica already holding (nearly) the
+                    # whole prompt makes the handoff pure wire cost:
+                    # route straight there — the admission is a warm
+                    # pointer update on pages shipped earlier
+                    and manager.warm_decode_tokens(ids)
+                    < len(ids) - 2 * manager.radix.block):
+                return self._disagg_proxy(ids, body, raw, policy, rid,
+                                          tenant, holder, deadline,
+                                          stream)
+            return self._route_and_proxy(body, raw, policy, rid,
+                                         tenant, holder, deadline,
+                                         stream)
+
+        def _post_buffered(self, replica, path: str, raw: bytes,
+                           rid: str, tenant: str, deadline,
+                           content_type: str = "application/json"
+                           ) -> dict:
+            """One buffered POST to a replica sidecar endpoint
+            (``/prefill``, ``/admit_pages``): same wire mechanics and
+            failure classes as ``_open_upstream``, response fully
+            read. Returns ``{"verdict": ...}`` with ``status`` /
+            ``body`` / ``headers`` on ``done``."""
+            verdict, conn, resp = self._open_upstream(
+                replica, raw, rid, tenant, deadline, path=path,
+                content_type=content_type)
+            try:
+                if verdict != "ok":
+                    return {"verdict": verdict}
+                try:
+                    data = resp.read()
+                except (http.client.HTTPException, OSError):
+                    return {"verdict": "failed"}
+                return {"verdict": "done", "status": resp.status,
+                        "body": data,
+                        "headers": dict(resp.getheaders())}
+            finally:
+                conn.close()
+
+        def _disagg_proxy(self, ids, body: dict, raw: bytes, policy,
+                          rid: str, tenant: str, holder: dict,
+                          deadline=None, stream: bool = False) -> str:
+            """The two-stage handoff (ISSUE 12 tentpole):
+
+            1. **prefill stage** — admit through the PREFILL gate (its
+               own WFQ clock: a long-prefill burst queues against
+               prefill capacity, never decode admission), route to a
+               prefill-role replica, ``POST /prefill`` → serialized
+               page payload;
+            2. **handoff** — route a decode-capable replica
+               (cache-aware on the same radix), land the pages with
+               ``POST /admit_pages`` (a failed import degrades to a
+               cold prefill there — never a failed request), account
+               pages/bytes/latency on the manager and record the
+               ``page_ship`` span (the 12th attribution segment);
+            3. **decode stage** — the original request proxies to that
+               same replica via the classic ``_proxy`` (SSE relay,
+               deadline classification, retry-once all inherited);
+               its radix lookup hits the just-shipped pages, so the
+               admit is a zero-recompute pointer update.
+
+            EVERY stage-1 failure falls back to the colocated path
+            (counted ``handoff_fallbacks_total``): disaggregation is
+            a performance geometry, never a correctness dependency —
+            the "zero failed requests across a handoff" CI gate leans
+            on exactly this. Deadlines span both stages: each hop
+            forwards the REMAINING budget, and an expired budget
+            between stages sheds 504 without burning a decode slot."""
+            gate = prefill_admission
+            admitted = False
+            payload = b""
+            ship_blocks = 0
+            prefill_rid = None
+
+            def fallback() -> str:
+                manager.note_handoff(0, 0, 0.0, fallback=True)
+                return self._route_and_proxy(body, raw, policy, rid,
+                                             tenant, holder, deadline,
+                                             stream)
+
+            # ---- stage 1: prefill -------------------------------------
+            if gate is not None:
+                sub = None
+                if deadline is not None:
+                    sub = max(min(gate.queue_timeout_s,
+                                  deadline.remaining_s()), 0.0)
+                t_pw = time.monotonic()
+                adm = gate.submit(tenant, timeout_s=sub)
+                if tracer is not None:
+                    tracer.add(rid, "prefill_admission_wait", t_pw,
+                               time.monotonic(), tenant=tenant,
+                               outcome=adm)
+                if adm != ADMITTED:
+                    # prefill queue saturated (or the wait ate the
+                    # budget): colocate instead of failing — unless
+                    # the deadline is already dead
+                    if deadline is not None and deadline.expired():
+                        self._send(
+                            504, {"error": "deadline expired in "
+                                           "prefill admission"},
+                            headers=[(DEADLINE_EXPIRED_HEADER, "1")])
+                        return "deadline"
+                    return fallback()
+                admitted = True
+            # the handoff clock starts AFTER prefill admission: the
+            # page_ship span / handoff histogram measure stage-1
+            # dispatch -> decode dispatch, and the queue wait is
+            # already its own span (prefill_admission_wait) — starting
+            # earlier would double-report the wait inside the ship
+            t_ship0 = time.monotonic()
+            try:
+                if deadline is not None and deadline.expired():
+                    self._send(
+                        504, {"error": "deadline expired before "
+                                       "prefill"},
+                        headers=[(DEADLINE_EXPIRED_HEADER, "1")])
+                    return "deadline"
+                picked = manager.route(ids, policy=policy,
+                                       role="prefill")
+                if picked is None:
+                    return fallback()
+                replica_p, reason_p = picked
+                prefill_rid = replica_p.rid
+                manager.begin(replica_p)
+                t_p0 = time.monotonic()
+                try:
+                    res = self._post_buffered(replica_p, "/prefill",
+                                              raw, rid, tenant,
+                                              deadline)
+                finally:
+                    manager.end(replica_p)
+                    if tracer is not None:
+                        tracer.add(rid, "proxy", t_p0,
+                                   time.monotonic(),
+                                   replica=replica_p.rid,
+                                   reason=reason_p, kind="prefill")
+                if res["verdict"] == "retry":
+                    manager.note_dispatch_error(replica_p)
+                if res["verdict"] != "done" or res.get("status") != 200:
+                    if deadline is not None and deadline.expired():
+                        self._send(
+                            504, {"error": "deadline expired during "
+                                           "prefill"},
+                            headers=[(DEADLINE_EXPIRED_HEADER, "1")])
+                        return "deadline"
+                    return fallback()
+                payload = res["body"]
+                hdrs = res.get("headers") or {}
+                try:
+                    ship_blocks = int(hdrs.get("X-Ship-Blocks", 0) or 0)
+                except ValueError:
+                    ship_blocks = 0
+            finally:
+                if admitted:
+                    gate.release()
+            # ---- stage 2: handoff + decode ----------------------------
+            if deadline is not None and deadline.expired():
+                self._send(
+                    504, {"error": "deadline expired across the "
+                                   "handoff"},
+                    headers=[(DEADLINE_EXPIRED_HEADER, "1")])
+                return "deadline"
+            excluded: set = set()
+            for attempt in range(2):
+                # record=False: the radix must not predict pages that
+                # have not landed yet — record_placement below runs
+                # AFTER a successful import (a concurrent same-prefix
+                # request skipping its handoff on a premature record
+                # would pay a cold long prefill on the decode replica)
+                picked = manager.route(ids, policy=policy,
+                                       exclude=excluded, role="decode",
+                                       record=False)
+                if picked is None:
+                    stats.bump("unavailable_total")
+                    self._send(
+                        503, {"error": "no healthy decode replicas"},
+                        headers=[("Retry-After",
+                                  str(admission.retry_after_s()))])
+                    return "unroutable"
+                replica_d, reason_d = picked
+                landed = ship_blocks == 0   # nothing to ship = landed
+                imported = 0
+                if ship_blocks > 0 and attempt == 0:
+                    res = self._post_buffered(
+                        replica_d, "/admit_pages", payload, rid,
+                        tenant, deadline,
+                        content_type="application/octet-stream")
+                    # a 200 alone is NOT a landed import: the replica
+                    # answers 200 with {imported_blocks: 0, dropped:
+                    # true} on a dry pool — recording THAT in the
+                    # radix would let later same-prefix requests skip
+                    # their handoff against pages that never landed
+                    # (the cold-prefill stall), and counting it as
+                    # shipped would fake the byte accounting
+                    if (res["verdict"] == "done"
+                            and res.get("status") == 200):
+                        try:
+                            receipt = json.loads(res["body"])
+                        except (ValueError, TypeError):
+                            receipt = {}
+                        imported = int(
+                            receipt.get("imported_blocks", 0) or 0)
+                        landed = (imported > 0
+                                  or int(receipt.get("cached_tokens",
+                                                     0) or 0) > 0)
+                if landed:
+                    manager.record_placement(ids, replica_d.rid)
+                t_ship1 = time.monotonic()
+                if attempt == 0:
+                    manager.note_handoff(
+                        imported, len(payload) if imported else 0,
+                        t_ship1 - t_ship0, fallback=not landed)
+                    if tracer is not None:
+                        tracer.add(rid, "page_ship", t_ship0, t_ship1,
+                                   bytes=(len(payload) if imported
+                                          else 0),
+                                   blocks=imported, landed=landed,
+                                   prefill_replica=prefill_rid,
+                                   decode_replica=replica_d.rid)
+                manager.begin(replica_d)
+                t_p0 = time.monotonic()
+                try:
+                    verdict = self._proxy(replica_d, raw, rid, tenant,
+                                          holder, deadline=deadline)
+                finally:
+                    manager.end(replica_d)
+                    if tracer is not None:
+                        tracer.add(rid, "proxy", t_p0,
+                                   time.monotonic(),
+                                   replica=replica_d.rid,
+                                   reason=reason_d, kind="decode")
+                if verdict != "retry":
+                    return {"done": "proxied",
+                            "failed": "proxy_failed"}.get(verdict,
+                                                          verdict)
+                if deadline is not None and deadline.expired():
+                    self._send(
+                        504, {"error": "deadline expired before "
+                                       "retry"},
+                        headers=[(DEADLINE_EXPIRED_HEADER, "1")])
+                    return "deadline"
+                excluded.add(replica_d.rid)
+                manager.note_dispatch_error(replica_d)
+                stats.bump("proxy_retries_total")
+            stats.bump("proxy_errors_total")
+            self._send(502, {"error": "no decode replica reachable"})
+            return "unreachable"
 
         def _route_and_proxy(self, body: dict, raw: bytes,
                              policy, rid: str, tenant: str,
@@ -463,8 +745,14 @@ def make_fleet_handler(manager: FleetManager, admission: FairAdmission,
                         blackhole, delay)
             excluded: set = set()
             for attempt in range(2):
+                # role="decode" excludes only DEDICATED prefill
+                # replicas (ISSUE 12) — they refuse decode budgets
+                # with a 400, so routing a generate there would fail
+                # requests a both/decode replica serves fine; an
+                # all-"both" fleet is unaffected (every replica
+                # matches)
                 picked = manager.route(ids, policy=policy,
-                                       exclude=excluded)
+                                       exclude=excluded, role="decode")
                 if picked is None:
                     stats.bump("unavailable_total")
                     self._send(
@@ -514,11 +802,15 @@ def make_fleet_handler(manager: FleetManager, admission: FairAdmission,
             return "unreachable"
 
         @staticmethod
-        def _proxy_headers(rid: str, tenant: str, deadline) -> dict:
+        def _proxy_headers(rid: str, tenant: str, deadline,
+                           content_type: str = "application/json"
+                           ) -> dict:
             """The propagated hop headers: request identity + tenant
             (ISSUE 8) and the REMAINING deadline budget (ISSUE 9 —
-            relative ms, so the hop is clock-skew-free)."""
-            headers = {"Content-Type": "application/json",
+            relative ms, so the hop is clock-skew-free; a handoff's
+            second hop re-derives the remainder, so the budget spans
+            BOTH stages)."""
+            headers = {"Content-Type": content_type,
                        "X-Request-Id": rid, "X-Tenant": tenant}
             if deadline is not None:
                 headers[DEADLINE_HEADER] = deadline.header_value()
@@ -537,7 +829,9 @@ def make_fleet_handler(manager: FleetManager, admission: FairAdmission,
                            deadline.remaining_s() + 0.25), 0.05)
 
         def _open_upstream(self, replica, raw: bytes, rid: str,
-                           tenant: str, deadline, state=None):
+                           tenant: str, deadline, state=None,
+                           path: str = "/generate",
+                           content_type: str = "application/json"):
             """Connect + send + await the status line for one
             upstream attempt — the ONE owner of the hop's wire
             mechanics (the live streaming path and the buffered
@@ -569,9 +863,9 @@ def make_fleet_handler(manager: FleetManager, admission: FairAdmission,
                 # propagate the request identity + tenant so the
                 # replica's spans key on the SAME rid the router's
                 # do — plus the remaining deadline budget (ISSUE 9)
-                conn.request("POST", "/generate", body=raw,
+                conn.request("POST", path, body=raw,
                              headers=self._proxy_headers(
-                                 rid, tenant, deadline))
+                                 rid, tenant, deadline, content_type))
             except OSError:
                 # send failed: the replica never got a complete
                 # request — still retry-safe
@@ -749,7 +1043,7 @@ def make_fleet_handler(manager: FleetManager, admission: FairAdmission,
 
             def launch(kind, bh):
                 picked = manager.route(ids, policy=policy,
-                                       exclude=excluded)
+                                       exclude=excluded, role="decode")
                 if picked is None:
                     return None
                 replica, reason = picked
@@ -992,15 +1286,21 @@ def build_router(manager: FleetManager, admission: FairAdmission,
                  allow_admin: bool = False,
                  read_timeout_s: float = 600.0,
                  tracer=None, slo=None,
-                 hedge: Optional[HedgePolicy] = None
-                 ) -> ThreadingHTTPServer:
+                 hedge: Optional[HedgePolicy] = None,
+                 prefill_admission=None,
+                 disagg_min_ids: int = 32) -> ThreadingHTTPServer:
     """Bind the front-door server (``port`` 0 picks a free one; the
     bound address is ``server.server_address``). ``tracer``/``slo``
     attach the request-scoped tracing + SLO layer
     (observability/reqtrace.py) — optional, None = off. ``hedge``
-    attaches the hedged-request policy (ISSUE 9) — None = no hedging."""
+    attaches the hedged-request policy (ISSUE 9) — None = no hedging.
+    ``prefill_admission`` attaches the prefill-stage gate (two-queue
+    disaggregated scheduling, ISSUE 12 — ``admission.staged_gates``);
+    ``disagg_min_ids`` is the smallest affinity-id count worth a
+    handoff."""
     handler = make_fleet_handler(
         manager, admission, stats=stats, allow_admin=allow_admin,
         read_timeout_s=read_timeout_s, tracer=tracer, slo=slo,
-        hedge=hedge)
+        hedge=hedge, prefill_admission=prefill_admission,
+        disagg_min_ids=disagg_min_ids)
     return ThreadingHTTPServer((host, port), handler)

@@ -23,7 +23,10 @@ layer on top:
   span can never start before the router dispatched it — skewed files
   are shifted by the median violation), decomposes each request into
   non-overlapping segments (router queue / WFQ admission wait / proxy
-  hop / replica queue / admit-to-first-token / decode / stream), and
+  hop / replica queue / admit-to-first-token / decode / stream — plus
+  ``page_ship`` on disaggregated fleets: the prefill-stage execution +
+  page transfer + decode-side import of a prefill→decode handoff,
+  ISSUE 12), and
   reports the residual instead of hiding it. :func:`to_perfetto`
   emits one merged Chrome/Perfetto trace with flow events linking the
   router's proxy span to the replica's handler span per request.
@@ -517,6 +520,7 @@ def _segments(recs: List[dict]) -> Dict[str, float]:
     req = _named(recs, "request", proc="router")
     aw = _named(recs, "admission_wait", proc="router")
     proxy = _last_named(recs, "proxy", proc="router")
+    ship = _named(recs, "page_ship", proc="router")
     http = _named(recs, "http")
     if http is not None and http.get("proc") == "router":
         http = None
@@ -534,7 +538,19 @@ def _segments(recs: List[dict]) -> Dict[str, float]:
         put("router_recv", float(aw["t"]) - float(req["t"]))
     if aw is not None:
         put("admission_wait", float(aw.get("dur_ms", 0.0)) / 1e3)
-    if proxy is not None and aw is not None:
+    if ship is not None:
+        # disaggregated handoff (ISSUE 12): the 12th segment. The
+        # router's page_ship span runs from the prefill-stage dispatch
+        # to the decode-stage dispatch — remote prefill execution +
+        # page transfer + decode-side import as one non-overlapping
+        # slice; ``route`` then covers only the routing ahead of it,
+        # and the decode proxy span (the LAST proxy — _last_named)
+        # starts where page_ship ends, so the decomposition stays
+        # gap-free and coverage holds.
+        put("page_ship", float(ship.get("dur_ms", 0.0)) / 1e3)
+        if aw is not None:
+            put("route", float(ship["t"]) - _t1(aw))
+    elif proxy is not None and aw is not None:
         put("route", float(proxy["t"]) - _t1(aw))
     if proxy is not None and http is not None:
         put("proxy_send", float(http["t"]) - float(proxy["t"]))

@@ -72,6 +72,59 @@ def serving_mesh(tp: int):
     return Mesh(np.asarray(devices[:tp]).reshape(tp), (TP_AXIS,))
 
 
+def validate_dp_geometry(dp: int, tp: int) -> None:
+    """Refuse a DP×TP replica geometry the host cannot place — LOUDLY,
+    before any executable builds (the ISSUE 12 follow-on to PR 10's
+    ``validate_tp_geometry``): ``dp`` independent tensor groups of
+    ``tp`` chips each need ``dp * tp`` local devices."""
+    import jax
+
+    dp, tp = int(dp), int(tp)
+    if dp < 1 or tp < 1:
+        raise ValueError(f"need dp >= 1 and tp >= 1 (got dp={dp}, "
+                         f"tp={tp})")
+    need = dp * tp
+    have = len(jax.devices())
+    if have < need:
+        raise ValueError(
+            f"dp={dp} x tp={tp} needs {need} devices, found {have} "
+            "(on CPU dev boxes: XLA_FLAGS="
+            f"--xla_force_host_platform_device_count={need})")
+
+
+def dp_group_devices(group: int, tp: int):
+    """The device slice owned by DP group ``group`` (groups tile the
+    local device list in order: group g owns ``[g*tp, (g+1)*tp)``)."""
+    import jax
+
+    tp = max(int(tp), 1)
+    devices = jax.devices()
+    lo = int(group) * tp
+    if lo + tp > len(devices):
+        raise ValueError(
+            f"dp group {group} needs devices [{lo}, {lo + tp}) but "
+            f"only {len(devices)} exist")
+    return devices[lo:lo + tp]
+
+
+def dp_group_mesh(group: int, tp: int):
+    """A group-local ``{"tensor": tp}`` mesh for DP group ``group``
+    (DP×TP serving, ISSUE 12: N independent tp groups tiling one host
+    mesh — a decode-role replica runs several small groups while a
+    prefill-role replica runs one wide one). ``tp <= 1`` returns None
+    — the group is a single chip, pinned by committing its params to
+    ``dp_group_devices(group, 1)[0]`` (uncommitted engine state
+    follows the committed params at first dispatch, then lives on the
+    group device as donated jit outputs)."""
+    from jax.sharding import Mesh
+
+    tp = int(tp)
+    devices = dp_group_devices(group, tp)
+    if tp <= 1:
+        return None
+    return Mesh(np.asarray(devices).reshape(tp), (TP_AXIS,))
+
+
 def tp_degree(mesh) -> int:
     """Size of the ``tensor`` axis (1 when no mesh / axis absent)."""
     if mesh is None or TP_AXIS not in mesh.axis_names:

@@ -43,7 +43,7 @@ REPO = Path(__file__).resolve().parent.parent
 sys.path.insert(0, str(REPO))
 
 from pytorch_distributed_template_tpu.fleet.admission import (  # noqa: E402
-    FairAdmission,
+    staged_gates,
 )
 from pytorch_distributed_template_tpu.fleet.replicas import (  # noqa: E402
     FleetManager, Replica,
@@ -107,6 +107,25 @@ def build_parser() -> argparse.ArgumentParser:
                    help="cache-aware: fall back to least-loaded when "
                         "the prefix-holding replica's queue estimate "
                         "exceeds the lightest one's by more than this")
+    # disaggregated prefill/decode (ISSUE 12)
+    p.add_argument("--roles", default="", metavar="ROLE[,ROLE...]",
+                   help="assign serving roles to spawned replicas "
+                        "cyclically, e.g. 'prefill,decode' gives r0 "
+                        "--role prefill and r1 --role decode (each "
+                        "also gets --prefix-cache on — role-split "
+                        "serving ships pool pages). With a dedicated "
+                        "prefill replica live, the router brokers "
+                        "prefill→decode page handoffs with a second "
+                        "independent admission queue; empty (default) "
+                        "keeps the classic colocated fleet")
+    p.add_argument("--disagg-min-ids", type=int, default=32,
+                   help="smallest affinity-id count (prompt_ids, or "
+                        "UTF-8 bytes of a text prompt) worth a page "
+                        "handoff; shorter prompts route colocated")
+    p.add_argument("--prefill-queue-timeout-s", type=float, default=0.0,
+                   help="prefill-stage waiters older than this fall "
+                        "back to the colocated path (0 = the decode "
+                        "gate's --queue-timeout-s)")
     # admission / backpressure
     p.add_argument("--queue-factor", type=float, default=2.0,
                    help="per-replica oversubscription: fleet capacity "
@@ -234,12 +253,24 @@ def main(argv=None) -> int:
                   file=sys.stderr)
             return 2
         serve_py = REPO / "serve.py"
+        roles = [r.strip() for r in (args.roles or "").split(",")
+                 if r.strip()]
+        for role in roles:
+            if role not in ("both", "prefill", "decode"):
+                print(f"serve_fleet: unknown role {role!r} in --roles",
+                      file=sys.stderr)
+                return 2
         replicas = []
         for i in range(max(args.replicas, 1)):
             rid = f"r{i}"
+            role = roles[i % len(roles)] if roles else "both"
             cmd = [sys.executable, str(serve_py), "-r", args.resume,
                    "--host", "127.0.0.1", "--port", "0",
                    "-s", str(run_dir / rid / "save")]
+            if role != "both":
+                # role-split serving IS the pool: force it on so the
+                # replica can export/import pages
+                cmd += ["--role", role, "--prefix-cache", "on"]
             if args.config:
                 cmd += ["-c", args.config]
             # replicas inherit the fleet's SLO/tracing posture (the
@@ -260,7 +291,7 @@ def main(argv=None) -> int:
             child_env = {"PDT_FAULTS": replica_faults.get(rid, "")} \
                 if replica_faults else None
             replicas.append(Replica(
-                rid, cmd=cmd, run_dir=run_dir,
+                rid, cmd=cmd, run_dir=run_dir, role=role,
                 sup_cfg=SupervisorConfig(
                     max_restarts=args.max_restarts,
                     restart_delay_s=args.restart_delay,
@@ -277,12 +308,29 @@ def main(argv=None) -> int:
         queue_factor=args.queue_factor,
         wedge_after=(args.wedge_after or None),
         restart_wedged=not args.no_restart_wedged)
-    admission = FairAdmission(
-        manager.capacity, weights=parse_weights(args.tenant_weights),
+    # two-stage admission (ISSUE 12): the front door's gate caps the
+    # DECODE stage and a second, clock-independent gate wraps only the
+    # prefill hop of each handoff. Both capacity fns are ROLE-FILTERED
+    # unconditionally: in an all-"both" fleet every replica serves
+    # both stages, so they equal the classic full capacity — while an
+    # attach-mode fleet whose roles are only DISCOVERED by the poller
+    # (the configured Replica objects all start "both") still gets the
+    # right split the moment /metrics reports real roles.
+    admission, prefill_admission = staged_gates(
+        lambda: manager.capacity(role="decode"),
+        prefill_capacity_fn=lambda: manager.capacity(role="prefill"),
+        weights=parse_weights(args.tenant_weights),
         max_waiting=args.max_waiting,
-        queue_timeout_s=args.queue_timeout_s)
+        queue_timeout_s=args.queue_timeout_s,
+        prefill_queue_timeout_s=(args.prefill_queue_timeout_s or None))
+
     # recoveries must re-open the gate for queued waiters immediately
-    manager.on_capacity_change = admission.kick
+    def _on_capacity():
+        admission.kick()
+        if prefill_admission is not None:
+            prefill_admission.kick()
+
+    manager.on_capacity_change = _on_capacity
     # request tracing + SLO plumbing (ISSUE 8): the router is the
     # first hop — it mints X-Request-Id, records admission-wait and
     # proxy-hop spans to <run-dir>/spans.jsonl, and checks TTFT/e2e
@@ -298,7 +346,9 @@ def main(argv=None) -> int:
     server = build_router(manager, admission, host=args.host,
                           port=args.port, allow_admin=args.admin,
                           read_timeout_s=args.read_timeout_s,
-                          tracer=tracer, slo=slo, hedge=hedge)
+                          tracer=tracer, slo=slo, hedge=hedge,
+                          prefill_admission=prefill_admission,
+                          disagg_min_ids=args.disagg_min_ids)
 
     draining = threading.Event()
 

@@ -54,6 +54,14 @@ sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 _BENCH_METRIC_FALLBACK = {
     "steps/s": ("summary", "quick", "steps_per_sec"),
     "tokens/s": ("summary", "quick", "tokens_per_sec"),
+    # serving rung gates (ISSUE 12 satellite): TP throughput, the
+    # disaggregated decode rate, and how well the role-split arm
+    # holds the decode-only tail (1.0 = perfectly flat) — all
+    # higher-is-better so the one-sided floor gate applies
+    "serve_tp_tok_s": ("summary", "serve_tp", "tokens_per_sec_tp1"),
+    "serve_disagg_decode_tok_s": ("summary", "serve_disagg",
+                                  "decode_tok_s_base"),
+    "serve_disagg_hold": ("summary", "serve_disagg", "disagg_hold"),
 }
 
 
@@ -339,6 +347,46 @@ def analyze_fleet(path) -> dict:
     return {k: v for k, v in out.items() if v is not None}
 
 
+def analyze_disagg(path) -> dict:
+    """Disaggregated-serving section (ISSUE 12) from the router's
+    ``router.jsonl`` counter snapshots: how many prefill→decode page
+    handoffs the router brokered, the page/byte volume that crossed
+    (PR 10's collective-accounting discipline: measured transfer, not
+    an estimate), the handoff latency p50/p99, the effective transfer
+    rate, per-role healthy-replica counts, and how often an eligible
+    request fell back to the colocated path. Empty on a fleet that
+    never disaggregated — the section only renders when the feature
+    ran."""
+    last_snapshot: dict = {}
+    first_t = last_t = None
+    for rec in load_jsonl(path):
+        if rec.get("event") == "snapshot":
+            last_snapshot = rec
+        t = rec.get("t")
+        if isinstance(t, (int, float)):
+            first_t = t if first_t is None else first_t
+            last_t = t
+    if not last_snapshot.get("handoffs_total") and not \
+            last_snapshot.get("handoff_fallbacks_total"):
+        return {}
+    out: dict = {}
+    for key in ("handoffs_total", "pages_shipped_total",
+                "page_ship_bytes_total", "handoff_fallbacks_total",
+                "replicas_prefill_healthy", "replicas_decode_healthy",
+                "handoff_p50_s", "handoff_p99_s"):
+        if key in last_snapshot:
+            out[key] = last_snapshot[key]
+    handoffs = out.get("handoffs_total", 0) or 0
+    attempts = handoffs + (out.get("handoff_fallbacks_total", 0) or 0)
+    if attempts:
+        out["handoff_success_frac"] = round(handoffs / attempts, 4)
+    if (first_t is not None and last_t is not None and last_t > first_t
+            and out.get("page_ship_bytes_total")):
+        out["transfer_bytes_per_s"] = round(
+            out["page_ship_bytes_total"] / (last_t - first_t), 1)
+    return out
+
+
 def analyze_reqtrace(run_dir=None, span_files=None) -> dict:
     """Request-scoped tracing section (ISSUE 8): stitch every
     ``spans.jsonl`` under the run dir (router + replicas) into
@@ -471,6 +519,7 @@ def to_markdown(report: dict) -> str:
     table("Tensor parallel (serving)", report.get("tensor_parallel", {}))
     table("Supervisor", report.get("supervisor", {}))
     table("Fleet (router)", report.get("fleet", {}))
+    table("Disaggregation (serving)", report.get("disagg", {}))
     table("Request tracing (p99 attribution)",
           report.get("reqtrace", {}))
     tr = report.get("trace") or {}
@@ -598,6 +647,9 @@ def main(argv=None) -> int:
             fleet_path = cand if cand.exists() else None
         if fleet_path is not None:
             report["fleet"] = analyze_fleet(fleet_path)
+            disagg = analyze_disagg(fleet_path)
+            if disagg:
+                report["disagg"] = disagg
         if args.spans or run_dir is not None:
             rt = analyze_reqtrace(run_dir=run_dir,
                                   span_files=args.spans)

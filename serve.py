@@ -109,6 +109,9 @@ import pytorch_distributed_template_tpu.models  # noqa: F401,E402
 from pytorch_distributed_template_tpu.engine.continuous import (  # noqa: E402
     ContinuousBatchingService,
 )
+from pytorch_distributed_template_tpu.engine.kvcache import (  # noqa: E402
+    serialize_pages,
+)
 from pytorch_distributed_template_tpu.engine.serving import (  # noqa: E402
     BatchedGenerationService, DeadlineExceeded, GenerationService,
     load_generation_stack,
@@ -222,6 +225,14 @@ def service_metrics(service: GenerationService) -> dict:
               "batched_requests", "max_batch_size"):
         if k in stats:
             out[k] = int(stats[k])
+    # disaggregated serving (ISSUE 12): the replica's role (string —
+    # JSON-only; prometheus_text emits numeric series), its DP group
+    # count, and the handoff counters: prefills exported for shipping
+    # and remote page chains ingested
+    out["role"] = str(getattr(service, "role", "both"))
+    out["dp_groups"] = int(stats.get("dp_groups", 1) or 1)
+    out["prefill_exports_total"] = int(stats.get("prefill_exports", 0))
+    out["remote_admits_total"] = int(stats.get("remote_admits", 0))
     # deadline + brownout counters (ISSUE 9); _total suffix = counter
     # TYPE for the prometheus exposition
     out["deadline_expired_total"] = int(
@@ -282,6 +293,20 @@ def service_metrics(service: GenerationService) -> dict:
         # and the fraction of decode chunks served by the paged path
         out["warm_admit_copy_bytes_total"] = int(
             prefix["warm_admit_copy_bytes"])
+        # page shipping (ISSUE 12): blocks exported to / imported from
+        # peer replicas' pools and the raw page bytes that crossed — a
+        # decode replica's warm_admit_copy_bytes_total above equals
+        # page_ship_in_bytes_total exactly (gated in serve_disagg)
+        out["pages_shipped_total"] = int(
+            prefix.get("pages_exported", 0))
+        out["pages_imported_total"] = int(
+            prefix.get("pages_imported", 0))
+        out["page_ship_out_bytes_total"] = int(
+            prefix.get("page_ship_out_bytes", 0))
+        out["page_ship_in_bytes_total"] = int(
+            prefix.get("page_ship_in_bytes", 0))
+        out["page_ship_dropped_total"] = int(
+            prefix.get("page_ship_dropped", 0))
         chunks = int(stats.get("chunks", 0) or 0)
         if chunks:
             out["paged_decode_frac"] = round(
@@ -450,6 +475,10 @@ def make_handler(service: GenerationService, profiler=None,
             path, _, query = self.path.partition("?")
             if path == "/profile":
                 return self._profile(query)
+            if path == "/prefill":
+                return self._prefill()
+            if path == "/admit_pages":
+                return self._admit_pages()
             if path != "/generate":
                 return self._send(404, {"error": "unknown path"})
             # request identity (ISSUE 8): honor a propagated
@@ -511,6 +540,98 @@ def make_handler(service: GenerationService, profiler=None,
                     # "replica" envelope for this request
                     tracer.add(rid, "http", t0, time.monotonic(),
                                stream=stream)
+                self._rid = None
+
+        def _prefill(self) -> None:
+            """``POST /prefill`` (disaggregated serving, ISSUE 12):
+            compute the prompt's KV into this replica's pool and ship
+            the full-block chain back as a serialized page payload
+            (``application/octet-stream`` — the fleet router relays
+            the bytes to a decode replica's ``/admit_pages``). Only
+            pages + token ids cross the wire: the decode replica's
+            warm admit recomputes the fed suffix window, so output is
+            token-identical to a colocated run with no sampling state
+            shipped. Prefill- and both-role replicas only."""
+            if getattr(service, "role", "both") == "decode":
+                return self._send(403, {
+                    "error": "decode-role replica: POST pages to "
+                             "/admit_pages, prompts to a prefill-role "
+                             "replica's /prefill"})
+            if not hasattr(service, "prefill_export"):
+                return self._send(503, {
+                    "error": "scheduler has no prefill export"})
+            rid = (sanitize_request_id(self.headers.get("X-Request-Id"))
+                   or mint_request_id())
+            self._rid = rid
+            t0 = time.monotonic()
+            try:
+                try:
+                    deadline = Deadline.from_header(
+                        self.headers.get(DEADLINE_HEADER), t0=t0)
+                except ValueError as e:
+                    return self._send(400, {"error": str(e),
+                                            "request_id": rid})
+                n = int(self.headers.get("Content-Length", 0))
+                req = json.loads(self.rfile.read(n) or b"{}")
+                payload = service.prefill_export(
+                    prompt=req.get("prompt"),
+                    prompt_ids=req.get("prompt_ids"),
+                    request_id=rid, deadline=deadline)
+                body = serialize_pages(payload)
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 "application/octet-stream")
+                self.send_header("Content-Length", str(len(body)))
+                self.send_header("X-Request-Id", rid)
+                self.send_header("X-Ship-Blocks",
+                                 str(int(payload["n_blocks"])))
+                self.end_headers()
+                self.wfile.write(body)
+            except DeadlineExceeded as e:
+                service.stats["deadline_expired"] = (
+                    service.stats.get("deadline_expired", 0) + 1)
+                self._send(504, {"error": str(e), "request_id": rid},
+                           headers=[(DEADLINE_EXPIRED_HEADER, "1")])
+            except ValueError as e:
+                self._send(400, {"error": str(e), "request_id": rid})
+            except Exception as e:  # surface, don't kill the server
+                self._send(500, {"error": f"{type(e).__name__}: {e}",
+                                 "request_id": rid})
+            finally:
+                if tracer is not None:
+                    tracer.add(rid, "prefill_http", t0,
+                               time.monotonic())
+                self._rid = None
+
+        def _admit_pages(self) -> None:
+            """``POST /admit_pages``: land a shipped page payload
+            (serialized ``/prefill`` bytes) in this replica's pool —
+            the next ``/generate`` for that prompt admits as a
+            zero-recompute block-table pointer update. Decode- and
+            both-role replicas only."""
+            if getattr(service, "role", "both") == "prefill":
+                return self._send(403, {
+                    "error": "prefill-role replica does not ingest "
+                             "pages (ship them to a decode-role "
+                             "replica)"})
+            if not hasattr(service, "import_remote_pages"):
+                return self._send(503, {
+                    "error": "scheduler has no page import"})
+            rid = (sanitize_request_id(self.headers.get("X-Request-Id"))
+                   or mint_request_id())
+            self._rid = rid
+            try:
+                n = int(self.headers.get("Content-Length", 0))
+                receipt = service.import_remote_pages(
+                    self.rfile.read(n))
+                receipt["request_id"] = rid
+                self._send(200, receipt)
+            except ValueError as e:
+                self._send(400, {"error": str(e), "request_id": rid})
+            except Exception as e:
+                self._send(500, {"error": f"{type(e).__name__}: {e}",
+                                 "request_id": rid})
+            finally:
                 self._rid = None
 
         def _profile(self, query: str) -> None:
@@ -702,9 +823,25 @@ def main(args, config):
     # persistent compile cache BEFORE any executable builds: a restarted
     # server re-reads its warmup ladder from disk instead of recompiling
     configure_compile_cache(config)
-    model, params, tok = load_generation_stack(
-        config, use_ema=args.ema, tensor_parallel=args.tp)
-    probe = GenerationService.from_model(model, params, tok)
+    # DP×TP geometry (ISSUE 12): --dp N runs N independent tp-chip
+    # engine groups in THIS process (engine/dp.py); validated before
+    # any load so a geometry typo fails in milliseconds
+    dp = max(int(args.dp), 1)
+    if dp > 1:
+        from pytorch_distributed_template_tpu.parallel.tp import (
+            validate_dp_geometry,
+        )
+
+        validate_dp_geometry(dp, max(int(args.tp), 1))
+        if args.scheduler not in ("auto", "continuous"):
+            raise SystemExit(
+                "--dp > 1 requires the continuous scheduler "
+                f"(got --scheduler {args.scheduler})")
+        model = params = tok = probe = None
+    else:
+        model, params, tok = load_generation_stack(
+            config, use_ema=args.ema, tensor_parallel=args.tp)
+        probe = GenerationService.from_model(model, params, tok)
     # serving.prefix_cache config block (paged KV block pool + radix
     # prefix index, engine/kvcache.py) with CLI override: --prefix-cache
     # on forces it even without a config block, off disables one
@@ -714,6 +851,13 @@ def main(args, config):
         prefix_cfg["enabled"] = True
     elif args.prefix_cache == "off":
         prefix_cfg["enabled"] = False
+    if args.role != "both" and not prefix_cfg.get("enabled"):
+        # role-split serving IS page shipping: refuse the geometry in
+        # milliseconds instead of deep in service construction
+        raise SystemExit(
+            f"--role {args.role} requires the prefix cache "
+            "(--prefix-cache on or a serving.prefix_cache config "
+            "block): page shipping moves pool pages")
     # early-exit draft depth for speculative requests (ISSUE 7): the
     # model's own first k blocks + head draft, sharing the target's
     # cache and the prefix pool's warm blocks (engine/generate
@@ -757,10 +901,38 @@ def main(args, config):
         max_dumps=int(slo_cfg.get("max_dumps", 8)),
         cooldown_s=float(slo_cfg.get("cooldown_s", 30.0)))
     want = args.scheduler
-    if want == "auto":
+    if dp > 1:
+        want = "dp"
+    elif want == "auto":
         want = ("continuous" if probe._pad_ok and args.max_batch > 1
                 else "static" if args.max_batch > 1 else "none")
-    if want == "continuous":
+    if want == "dp":
+        # DP×TP (ISSUE 12): N independent continuous engines, one per
+        # tp-chip group, behind one cache-aware facade (engine/dp.py).
+        # The recorder belongs to group 0 alone — the per-chunk JSONL's
+        # "last record wins" analyzer contract cannot survive N
+        # engines interleaving cumulative counters in one file.
+        from pytorch_distributed_template_tpu.engine.dp import (
+            DataParallelService,
+        )
+        from pytorch_distributed_template_tpu.observability.telemetry \
+            import FlightRecorder
+
+        recorder = FlightRecorder(run_dir=str(config.save_dir),
+                                  memory_every=0)
+        service = DataParallelService.build_from_config(
+            config, ContinuousBatchingService, use_ema=args.ema,
+            dp=dp, tp=max(int(args.tp), 1),
+            service_kw=dict(
+                slots=args.max_batch, chunk=args.decode_chunk,
+                window_ms=args.batch_window_ms,
+                warm_buckets=warm_buckets, prefix_cache=prefix_cfg,
+                spec_draft_layers=spec_draft_layers, tracer=tracer,
+                slo=slo, brownout=brownout_cfg, role=args.role),
+            service_kw_fn=lambda g: ({"recorder": recorder}
+                                     if g == 0 else {}),
+        )
+    elif want == "continuous":
         # slot scheduler: rows admit/free mid-flight, no group keys
         # (engine/continuous.py); RoPE + non-rolling-cache models only.
         # Per-chunk serving telemetry (FlightRecorder JSONL next to the
@@ -779,11 +951,17 @@ def main(args, config):
             warm_buckets=warm_buckets, prefix_cache=prefix_cfg,
             recorder=recorder, spec_draft_layers=spec_draft_layers,
             tracer=tracer, slo=slo, brownout=brownout_cfg,
+            role=args.role,
         )
     elif want == "static":
         # the static micro-batch scheduler's shared-group prefill does
         # not consult the pool (group members already share one
-        # prefill); prefix caching rides the continuous/plain paths
+        # prefill); prefix caching rides the continuous/plain paths —
+        # and role-split serving IS the pool, so it rides them too
+        if args.role != "both":
+            raise SystemExit(
+                "--role prefill|decode needs a prefix-cache-capable "
+                "scheduler (continuous or none), not static")
         service = BatchedGenerationService.from_model(
             model, params, tok, max_batch=args.max_batch,
             window_ms=args.batch_window_ms,
@@ -796,7 +974,7 @@ def main(args, config):
         service = GenerationService.from_model(
             model, params, tok, prefix_cache=prefix_cfg,
             spec_draft_layers=spec_draft_layers,
-            tracer=tracer, slo=slo)
+            tracer=tracer, slo=slo, role=args.role)
     logger.info("scheduler: %s", type(service).__name__)
     # on-demand profiling (POST /profile): captures land next to the
     # serving run's logs
@@ -890,6 +1068,23 @@ if __name__ == "__main__":
                              "cannot shard refuses at startup. On CPU "
                              "dev boxes pair with XLA_FLAGS="
                              "--xla_force_host_platform_device_count=N")
+    parser.add_argument("--role", default="both",
+                        choices=("both", "prefill", "decode"),
+                        help="disaggregated serving role (ISSUE 12): "
+                             "'prefill' computes prompt KV and SHIPS "
+                             "pool pages via POST /prefill (refuses "
+                             "decode-scale budgets); 'decode' ingests "
+                             "shipped pages via POST /admit_pages and "
+                             "serves decode; 'both' (default) is the "
+                             "classic colocated replica. Role-split "
+                             "replicas require the prefix cache")
+    parser.add_argument("--dp", default=1, type=int,
+                        help="data-parallel group count (ISSUE 12): "
+                             "run N independent --tp-chip engine "
+                             "groups in this process behind one "
+                             "cache-aware facade — needs dp x tp "
+                             "local devices; continuous scheduler "
+                             "only")
     parser.add_argument("--prefix-cache", default="auto",
                         choices=("auto", "on", "off"),
                         help="paged KV prefix cache (engine/kvcache.py)"
