@@ -2038,12 +2038,15 @@ class _DisaggFleet:
     every replica healthy, replay traces, scrape, drain."""
 
     def __init__(self, repo: str, tmp: str, artifact: str, tag: str,
-                 replicas: int, roles: str, slots: int):
+                 replicas: int, roles: str, slots: int,
+                 extra=(), replica_extra=(), env_extra=None):
         import subprocess
 
         self.run_dir = os.path.join(tmp, f"run_{tag}")
         env = dict(os.environ, JAX_PLATFORMS="cpu")
         env.pop("PDT_FAULTS", None)
+        if env_extra:
+            env.update(env_extra)
         cmd = [sys.executable,
                os.path.join(repo, "scripts", "serve_fleet.py"),
                "-r", os.path.join(artifact, "model"),
@@ -2052,7 +2055,9 @@ class _DisaggFleet:
                "--disagg-min-ids", "64", "--poll-s", "0.5"]
         if roles:
             cmd += ["--roles", roles]
+        cmd += list(extra)
         cmd += ["--", "--max-batch", str(slots), "--decode-chunk", "4"]
+        cmd += list(replica_extra)
         self.proc = subprocess.Popen(
             cmd, env=env, cwd=tmp, stdout=subprocess.PIPE,
             stderr=subprocess.STDOUT, text=True)
@@ -2290,6 +2295,365 @@ def _serve_disagg_fleet_arms(n_requests: int,
             src = os.path.join(disagg.run_dir, name)
             if os.path.exists(src):
                 shutil.copy(src, os.path.join(evid, name))
+        with open(os.path.join(evid, "summary.json"), "w") as f:
+            json_mod.dump(out, f, indent=1, default=repr)
+        return out
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+def bench_serve_kvtier(n_groups: int = 8, prompt_len: int = 96,
+                       decode_new: int = 8, block_tokens: int = 16,
+                       pool_blocks: int = 24, n_layer: int = 2,
+                       d_model: int = 64, fleet_arm: bool = True
+                       ) -> dict:
+    """Tiered KV pool rung (ISSUE 13 tentpole): memory pressure and
+    restarts must degrade GRACEFULLY, not to recompute cliffs.
+
+    Three arms, all token-parity-gated against a cache-less reference:
+
+    - **tier arm** — a working set of ``n_groups`` distinct prefixes
+      ~2-4x the HBM pool replays twice through a spill-tiered pool
+      (eviction demotes to a host tier; a repeat hit promotes back)
+      and through an infinite-pool ORACLE. Gates: the tiered warm hit
+      rate holds within 1.5x of the oracle's, outputs are
+      token-identical to the cache-less reference, and the tier
+      provably engaged (demotes AND promotes > 0).
+    - **chaos arm** — the same traffic under the tier fault grammar
+      (``corrupt_spill`` / ``slow_spill`` / ``tier_exhaust``). Gates:
+      zero wrong tokens (a corrupt spilled page fails its sha256 and
+      recomputes cold), checksum-failure and exhaust-drop counters
+      observed NONZERO — the degradation paths ran, not just parsed.
+    - **fleet re-warm arm** (``fleet_arm``) — two subprocess fleets
+      (identical but ``--rewarm on`` vs ``off``); in each, both
+      replicas are warmed on the same prefixes, one replica is
+      SIGKILLed, and after supervised restart + readmission the hot
+      prefixes are requested DIRECTLY on the restarted replica. The
+      re-warm fleet replays the dead pool's hottest prefixes from its
+      peer before readmission (``rewarm_pulls_total`` > 0), so its
+      post-restart latency beats the cold-restart control
+      (``rewarm_speedup`` > 1); an injected ``peer_pull_timeout``
+      must degrade one pull cold without failing anything, and a
+      post-recovery trace replay gates zero failed/stranded requests.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    import pytorch_distributed_template_tpu.models  # noqa: F401
+    from pytorch_distributed_template_tpu.config.registry import MODELS
+    from pytorch_distributed_template_tpu.engine.serving import (
+        GenerationService,
+    )
+    from pytorch_distributed_template_tpu.resilience import faults
+
+    vocab = 512
+    max_len = 256
+    model = MODELS.get("Llama")(
+        vocab_size=vocab, n_layer=n_layer, n_head=4, n_kv_head=4,
+        d_model=d_model, max_len=max_len)
+    params = model.init(
+        jax.random.key(0), jnp.zeros((1, 8), jnp.int32))["params"]
+    rng = np.random.default_rng(7)
+    groups = [[int(x) for x in rng.integers(1, vocab, prompt_len)]
+              for _ in range(n_groups)]
+    blocks_per_prompt = prompt_len // block_tokens
+    working_set = n_groups * blocks_per_prompt
+    out: dict = {
+        "n_groups": n_groups, "prompt_len": prompt_len,
+        "pool_blocks": pool_blocks,
+        "working_set_blocks": working_set,
+        "working_set_x_pool": round(
+            working_set / max(pool_blocks - 1, 1), 2),
+        "parity_ok": True,
+    }
+    if not 2.0 <= out["working_set_x_pool"] <= 4.5:
+        raise RuntimeError(
+            f"serve_kvtier: working set {working_set} blocks is "
+            f"{out['working_set_x_pool']}x the pool — the rung's "
+            "premise needs 2-4x (resize n_groups/pool_blocks)")
+    cold = GenerationService.from_model(model, params)
+    refs = [cold.generate(prompt_ids=g, max_new_tokens=decode_new,
+                          seed=0)["ids"] for g in groups]
+    # hit tokens the PROPER-prefix contract allows per warm repeat:
+    # every full block except the one holding the final prompt token
+    max_hit = sum((len(g) - 1) // block_tokens * block_tokens
+                  for g in groups)
+
+    def run_two_rounds(cfg: dict) -> tuple:
+        svc = GenerationService.from_model(model, params,
+                                           prefix_cache=cfg)
+        for g in groups:                      # round 1: populate
+            svc.generate(prompt_ids=g, max_new_tokens=decode_new,
+                         seed=0)
+        h0 = svc.prefix_cache_stats()["prefix_hit_tokens"]
+        outs = [svc.generate(prompt_ids=g, max_new_tokens=decode_new,
+                             seed=0)["ids"] for g in groups]
+        snap = svc.prefix_cache_stats()
+        rate = (snap["prefix_hit_tokens"] - h0) / max(max_hit, 1)
+        return outs, round(rate, 4), snap
+
+    # ---- tier arm ----------------------------------------------------
+    tiered_cfg = {"enabled": True, "block_tokens": block_tokens,
+                  "pool_blocks": pool_blocks,
+                  "host_spill_blocks": 4 * pool_blocks}
+    oracle_cfg = {"enabled": True, "block_tokens": block_tokens,
+                  "pool_blocks": working_set + pool_blocks + 16}
+    outs_t, rate_t, snap_t = run_two_rounds(tiered_cfg)
+    outs_o, rate_o, _ = run_two_rounds(oracle_cfg)
+    if outs_t != refs or outs_o != refs:
+        raise RuntimeError("serve_kvtier: tiered/oracle output "
+                           "diverged from the cache-less reference")
+    out["warm_hit_rate_tiered"] = rate_t
+    out["warm_hit_rate_oracle"] = rate_o
+    out["warm_hit_hold"] = round(rate_t / max(rate_o, 1e-9), 4)
+    out["tier_demoted_blocks"] = int(snap_t["tier_demoted_blocks"])
+    out["tier_promoted_blocks"] = int(snap_t["tier_promoted_blocks"])
+    if snap_t["tier_demoted_blocks"] <= 0 \
+            or snap_t["tier_promoted_blocks"] <= 0:
+        raise RuntimeError(
+            f"serve_kvtier: the tier never engaged (demoted="
+            f"{snap_t['tier_demoted_blocks']}, promoted="
+            f"{snap_t['tier_promoted_blocks']}) — the working set "
+            "failed to pressure the pool")
+    if out["warm_hit_hold"] < 1.0 / 1.5:
+        raise RuntimeError(
+            f"serve_kvtier: tiered warm hit rate {rate_t} is worse "
+            f"than 1.5x off the infinite-pool oracle {rate_o} "
+            f"(hold {out['warm_hit_hold']} < {1.0 / 1.5:.3f})")
+    if snap_t["tier_checksum_failures"]:
+        raise RuntimeError(
+            "serve_kvtier: checksum failures on the fault-free arm: "
+            f"{snap_t['tier_checksum_failures']}")
+
+    # ---- chaos arm ---------------------------------------------------
+    had_env = os.environ.pop(faults.ENV_PLAN, None)
+    faults.reset()
+    faults.configure("corrupt_spill@evt:2;slow_spill@evt:5:20ms;"
+                     "tier_exhaust@evt:8:300ms")
+    try:
+        outs_c, _, snap_c = run_two_rounds(dict(tiered_cfg))
+    finally:
+        faults.reset()
+        if had_env is not None:
+            os.environ[faults.ENV_PLAN] = had_env
+    if outs_c != refs:
+        raise RuntimeError("serve_kvtier: WRONG TOKENS under tier "
+                           "chaos — a corrupt/torn spill was served")
+    out["tier_checksum_failures"] = int(
+        snap_c["tier_checksum_failures"])
+    out["tier_exhaust_drops"] = int(snap_c["tier_exhaust_drops"])
+    if out["tier_checksum_failures"] < 1 \
+            or out["tier_exhaust_drops"] < 1:
+        raise RuntimeError(
+            "serve_kvtier: chaos arm fault counters stayed zero "
+            f"({out['tier_checksum_failures']} checksum failures, "
+            f"{out['tier_exhaust_drops']} exhaust drops) — the "
+            "injected faults never exercised the degradation paths")
+
+    # ---- fleet re-warm arm -------------------------------------------
+    if fleet_arm:
+        out.update(_serve_kvtier_fleet_arm())
+        if out["rewarm_speedup"] <= 1.05:
+            raise RuntimeError(
+                "serve_kvtier: re-warmed restart not measurably "
+                f"faster than the cold-restart control "
+                f"(rewarm {out['rewarm_e2e_p50_s']}s vs cold "
+                f"{out['cold_e2e_p50_s']}s = "
+                f"{out['rewarm_speedup']}x <= 1.05x)")
+    return out
+
+
+def _post_json(url: str, path: str, body: dict, timeout_s: float,
+               headers: dict = None) -> dict:
+    """POST JSON -> parsed JSON response (the kvtier fleet arm's one
+    wire helper)."""
+    import urllib.request
+
+    req = urllib.request.Request(
+        url + path, data=json.dumps(body).encode("utf-8"),
+        headers={"Content-Type": "application/json",
+                 **(headers or {})})
+    with urllib.request.urlopen(req, timeout=timeout_s) as resp:
+        return json.loads(resp.read().decode("utf-8"))
+
+
+def _serve_kvtier_fleet_arm(n_groups: int = 4, prompt_len: int = 448,
+                            replay_requests: int = 8) -> dict:
+    """The kill → restart → re-warm-from-peers arm, run as REAL
+    subprocess fleets (the restart path is a supervisor + process
+    lifecycle — in-process simulation would measure nothing real).
+    Two identical 2-replica fleets, ``--rewarm on`` vs ``off``: warm
+    both replicas on the same prefixes (round_robin placement), kill
+    replica 0, wait for supervised restart + readmission, then time
+    the hot prefixes DIRECTLY on the restarted replica. The re-warm
+    fleet also carries ``PDT_FAULTS=peer_pull_timeout@pull:1`` — its
+    first peer pull is injected to time out, gating the degrade-cold
+    path inside the measured run. Evidence (router.jsonl + summary)
+    lands in ``artifacts/serve_kvtier``."""
+    import json as json_mod
+    import shutil
+    import subprocess
+    import tempfile
+
+    from pytorch_distributed_template_tpu.fleet import loadgen
+    from pytorch_distributed_template_tpu.fleet.replicas import (
+        http_json,
+    )
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    tmp = tempfile.mkdtemp(prefix="bench_kvtier_")
+    art = os.path.join(tmp, "artifact")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("PDT_FAULTS", None)
+    subprocess.run(
+        [sys.executable, os.path.join(repo, "scripts",
+                                      "make_serving_artifact.py"),
+         "-o", art, "--vocab-size", "4096", "--d-model", "128",
+         "--n-layer", "2", "--n-head", "4", "--n-kv-head", "4",
+         "--max-len", "576", "--block-tokens", "16",
+         "--pool-blocks", "384"],
+        check=True, env=env, cwd=tmp, timeout=300,
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+    rng = np.random.default_rng(11)
+    groups = [[int(x) for x in rng.integers(1, 4096, prompt_len)]
+              for _ in range(n_groups)]
+    # same-length throwaway prefixes: pay the restarted replica's XLA
+    # (cold-prefill and warm-admit executables) before the measured
+    # requests, in BOTH arms identically
+    warmup_a = [int(x) for x in rng.integers(1, 4096, prompt_len)]
+    warmup_b = [int(x) for x in rng.integers(1, 4096, prompt_len)]
+
+    def measure_arm(tag: str, rewarm: bool) -> dict:
+        fleet = _DisaggFleet(
+            repo, tmp, art, tag, 2, "", 4,
+            extra=["--admin", "--peer-pull", "on",
+                   "--peer-pull-min-tokens", "32",
+                   "--rewarm", "on" if rewarm else "off",
+                   "--rewarm-top-k", str(n_groups + 2),
+                   "--eject-after", "2", "--readmit-after", "2"],
+            replica_extra=["--batch-window-ms", "5"],
+            env_extra=({"PDT_FAULTS": "peer_pull_timeout@pull:1"}
+                       if rewarm else None))
+        try:
+            fleet.wait_ready()
+            hz = http_json(fleet.url + "/healthz", 5.0)
+            rid0 = hz["replicas"][0]["id"]
+            # warm BOTH replicas on every group (round_robin
+            # alternates) so the survivor can serve re-warm pulls
+            for g in groups:
+                for _ in range(2):
+                    _post_json(fleet.url, "/generate",
+                               {"prompt_ids": g, "max_new_tokens": 2,
+                                "seed": 0}, 120.0,
+                               headers={"X-Fleet-Policy":
+                                        "round_robin"})
+            _post_json(fleet.url, f"/admin/kill?replica={rid0}",
+                       {}, 10.0)
+            # wait out the eject, then the supervised restart +
+            # (re-warm +) readmission
+            deadline = time.monotonic() + 300.0
+            seen_down = False
+            r0_url = None
+            while time.monotonic() < deadline:
+                try:
+                    hz = http_json(fleet.url + "/healthz", 5.0)
+                except (OSError, ValueError):
+                    time.sleep(0.5)
+                    continue
+                rep = next(r for r in hz["replicas"]
+                           if r["id"] == rid0)
+                if rep["state"] != "healthy":
+                    seen_down = True
+                elif seen_down:
+                    r0_url = rep["url"]
+                    break
+                time.sleep(0.5)
+            if r0_url is None:
+                raise RuntimeError(
+                    f"serve_kvtier fleet arm {tag!r}: replica never "
+                    "recovered from the kill")
+            # pay the fresh process's executables (cold path twice is
+            # enough: first request compiles admission + chunk ladder
+            # paths, second compiles the warm-admit feed bucket)
+            _post_json(r0_url, "/generate",
+                       {"prompt_ids": warmup_a, "max_new_tokens": 2,
+                        "seed": 0}, 240.0)
+            _post_json(r0_url, "/generate",
+                       {"prompt_ids": warmup_b, "max_new_tokens": 2,
+                        "seed": 0}, 240.0)
+            _post_json(r0_url, "/generate",
+                       {"prompt_ids": warmup_b, "max_new_tokens": 2,
+                        "seed": 0}, 240.0)
+            lat = []
+            for g in groups:
+                t0 = time.monotonic()
+                _post_json(r0_url, "/generate",
+                           {"prompt_ids": g, "max_new_tokens": 2,
+                            "seed": 0}, 240.0)
+                lat.append(time.monotonic() - t0)
+            lat.sort()
+            rmet = http_json(r0_url + "/metrics?format=json", 10.0)
+            fmet = fleet.metrics()
+            # zero failed requests across the whole event: a
+            # post-recovery replay through the router must resolve
+            # every request to a classified success
+            tr = loadgen.build_trace(
+                replay_requests, seed=5, group_tag=f"post{tag}",
+                prefix_groups=2, prefix_len=56, suffix_len=8,
+                max_new_tokens=8, rate_rps=4.0, stream_frac=0.0,
+                vocab=4096)
+            summary = loadgen.summarize(
+                loadgen.replay(fleet.url, tr, timeout_s=240), tr)
+            if summary["errors"] or summary["stranded"]:
+                raise RuntimeError(
+                    f"serve_kvtier fleet arm {tag!r}: failed "
+                    f"requests after recovery: {summary}")
+            return {"e2e_p50_s": round(lat[len(lat) // 2], 4),
+                    "e2e": [round(v, 4) for v in lat],
+                    "replica_hit_tokens": int(
+                        rmet.get("prefix_hit_tokens_total", 0)),
+                    "router": fmet, "run_dir": fleet.run_dir}
+        finally:
+            fleet.stop()
+
+    out: dict = {}
+    try:
+        warm = measure_arm("rewarm", rewarm=True)
+        ctrl = measure_arm("coldctl", rewarm=False)
+        rt = warm["router"]
+        out["rewarm_e2e_p50_s"] = warm["e2e_p50_s"]
+        out["cold_e2e_p50_s"] = ctrl["e2e_p50_s"]
+        out["rewarm_speedup"] = round(
+            ctrl["e2e_p50_s"] / max(warm["e2e_p50_s"], 1e-9), 3)
+        out["rewarm_pulls"] = int(rt.get("rewarm_pulls_total", 0))
+        out["rewarm_blocks"] = int(rt.get("rewarm_blocks_total", 0))
+        out["peer_pull_timeouts"] = int(
+            rt.get("peer_pull_timeouts_total", 0))
+        out["rewarm_hit_tokens"] = warm["replica_hit_tokens"]
+        if out["rewarm_pulls"] < 1 or out["rewarm_blocks"] < 1:
+            raise RuntimeError(
+                "serve_kvtier: the re-warm never pulled "
+                f"({out['rewarm_pulls']} pulls, "
+                f"{out['rewarm_blocks']} blocks) — the restarted "
+                "replica came back cold in the re-warm arm")
+        if out["peer_pull_timeouts"] < 1:
+            raise RuntimeError(
+                "serve_kvtier: the injected peer_pull_timeout never "
+                "fired — the chaos contract is unproven")
+        if warm["replica_hit_tokens"] <= 0:
+            raise RuntimeError(
+                "serve_kvtier: restarted replica served the hot "
+                "prefixes with zero pool hits despite the re-warm")
+        if int(rt.get("rewarm_failures_total", 0)) \
+                > out["peer_pull_timeouts"]:
+            raise RuntimeError(
+                "serve_kvtier: re-warm pulls failed beyond the one "
+                f"injected timeout: {rt.get('rewarm_failures_total')}")
+        evid = os.path.join(repo, "artifacts", "serve_kvtier")
+        os.makedirs(evid, exist_ok=True)
+        src = os.path.join(warm["run_dir"], "router.jsonl")
+        if os.path.exists(src):
+            shutil.copy(src, os.path.join(evid, "router.jsonl"))
         with open(os.path.join(evid, "summary.json"), "w") as f:
             json_mod.dump(out, f, indent=1, default=repr)
         return out
@@ -3948,6 +4312,14 @@ _SUMMARY_KEYS = {
                      "tpot_p99_base_s", "pages_shipped",
                      "decode_warm_admit_copy_bytes", "dp_tp_parity",
                      "parity_ok"),
+    # tiered KV pool (ISSUE 13): the warm-hit hold vs the infinite-
+    # pool oracle, the zero-divergence verdict, the chaos-arm fault
+    # counters (provably nonzero), and the re-warm-beats-cold headline
+    "serve_kvtier": ("warm_hit_hold", "warm_hit_rate_tiered",
+                     "warm_hit_rate_oracle", "parity_ok",
+                     "tier_checksum_failures", "tier_exhaust_drops",
+                     "rewarm_speedup", "rewarm_pulls",
+                     "peer_pull_timeouts"),
     "decode_spec": ("speedup", "speedup_natural", "tokens_per_call"),
     "flash_attention_8k": ("speedup",),
     # serving-path chaos (ISSUE 9): the zero-stranded contract, the
@@ -4321,6 +4693,13 @@ _LADDER = [
     ("serve_disagg", [
         (bench_serve_disagg, {}),
         (bench_serve_disagg, {"fleet_arm": False}),
+    ]),
+    # tiered KV pool (ISSUE 13): demote-on-evict + checksummed spill +
+    # peer re-warm. The fallback arm drops the subprocess fleets (the
+    # in-process tier/chaos gates still run) for thin budgets.
+    ("serve_kvtier", [
+        (bench_serve_kvtier, {}),
+        (bench_serve_kvtier, {"fleet_arm": False}),
     ]),
     # fleet front door: cache-aware router + admission control over
     # real serve.py subprocess replicas, trace-replay load, mid-trace

@@ -1066,7 +1066,11 @@ class ContinuousBatchingService(GenerationService):
                 # lookup misses — admissions pay the full prefill
                 matches = [([], [], 0) for _ in reqs]
             else:
-                matches = [self._prefix.lookup(r["ids"]) for r in reqs]
+                # promote=False: spilled chains were promoted at tick
+                # start — a donation here would kill the cache the
+                # admit dispatch below aliases
+                matches = [self._prefix.lookup(r["ids"], promote=False)
+                           for r in reqs]
             feed = self._bucket(max(
                 len(r["ids"]) - m[2] for r, m in zip(reqs, matches)))
         else:
@@ -1182,8 +1186,10 @@ class ContinuousBatchingService(GenerationService):
         first = not r.get("_page_retry")
         r["_page_retry"] = True
         r["_page_attempts"] = r.get("_page_attempts", 0) + 1
+        # promote=False: tick-start promotion already ran; a pool
+        # donation here would invalidate the live paged cache mid-tick
         return self._prefix.paged_plan(r["ids"], r["budget"],
-                                       record=first)
+                                       record=first, promote=False)
 
     def _admit_group_paged(self, reqs: list, slots: list):
         """Paged admission: ONE dispatch writes the group's block
@@ -1492,6 +1498,28 @@ class ContinuousBatchingService(GenerationService):
                     paged_decode_frac=round(
                         self.stats.get("paged_chunks", 0) / chunks, 4),
                 )
+                if snap.get("tier_enabled"):
+                    # KV tier telemetry (ISSUE 13): cumulative demote/
+                    # promote traffic + occupancy per tier, read by the
+                    # offline analyzer's "KV tiers (serving)" section
+                    rec.update(
+                        tier_demoted_blocks_total=snap[
+                            "tier_demoted_blocks"],
+                        tier_promoted_blocks_total=snap[
+                            "tier_promoted_blocks"],
+                        tier_demote_bytes_total=snap[
+                            "tier_demote_bytes"],
+                        tier_promote_bytes_total=snap[
+                            "tier_promote_bytes"],
+                        tier_checksum_failures_total=snap[
+                            "tier_checksum_failures"],
+                        tier_exhaust_drops_total=snap[
+                            "tier_exhaust_drops"],
+                        tier_host_blocks=snap["tier_host_blocks"],
+                        tier_host_bytes=snap["tier_host_bytes"],
+                        tier_disk_blocks=snap["tier_disk_blocks"],
+                        tier_disk_bytes=snap["tier_disk_bytes"],
+                    )
             self._recorder.record(self.stats["chunks"], **rec)
 
     def _insert_prefixes(self, reqs, slots, ints, matches):
@@ -1775,6 +1803,25 @@ class ContinuousBatchingService(GenerationService):
                 key = "cancelled" if dead else "deadline_expired"
                 self.stats[key] = self.stats.get(key, 0) + 1
                 self.stats["completed"] += 1
+        # tiered-spill promotion (ISSUE 13): pending requests whose
+        # prefix was demoted to the host/disk tier promote HERE — the
+        # one point in the tick where a pool donation is still safe
+        # (the refresh below re-adopts the swapped leaves before any
+        # dispatch). Mid-tick lookups all pass promote=False for
+        # exactly this reason. The pool_exhaust window also reads the
+        # tier dry — the fault drains the WHOLE hierarchy.
+        if (self._prefix is not None and self._prefix.spill is not None
+                and pending and not self._pool_dry()):
+            for r in pending[:self._slots]:
+                t_tier0 = time.monotonic()
+                n = self._prefix.promote_spilled(r["ids"])
+                if n and self._tracer is not None and r.get("rid"):
+                    # the "tier" attribution segment: time this
+                    # admission spent pulling its prefix back up the
+                    # hierarchy (reqtrace subtracts it from the
+                    # scheduler_queue segment it overlaps)
+                    self._tracer.add(r["rid"], "tier", t_tier0,
+                                     time.monotonic(), blocks=n)
         if self._paged and self._cache is not None:
             # a batch-1 speculative request between ticks (same lock)
             # may have reassigned the pool — its scatter insert's

@@ -227,6 +227,25 @@ class DataParallelService:
         return self._engines[g].prefill_export(
             prompt=prompt, prompt_ids=prompt_ids, **kw)
 
+    def export_cached_pages(self, prompt=None, prompt_ids=None,
+                            **kw) -> dict:
+        """Export-only peer migration (ISSUE 13): pick PURELY by who
+        holds the deepest chain — an export is a read, and generate's
+        load-gated pick would divert it to an idle group with an
+        empty pool (n_blocks 0 while the pages sit one group over)."""
+        try:
+            ids = self._engines[0].encode_prompt(prompt, prompt_ids)
+        except ValueError:
+            ids = None          # group 0 raises the real 400 below
+        g = 0
+        if ids is not None:
+            depths = [(e._prefix.cached_block_count(ids)
+                       if e._prefix is not None else 0)
+                      for e in self._engines]
+            g = max(range(len(depths)), key=lambda i: depths[i])
+        return self._engines[g].export_cached_pages(
+            prompt=prompt, prompt_ids=prompt_ids, **kw)
+
     def import_remote_pages(self, payload) -> dict:
         """Land shipped pages on the least-loaded group's pool; the
         follow-up ``generate`` finds them through the same radix probe

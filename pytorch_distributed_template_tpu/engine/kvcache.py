@@ -43,6 +43,18 @@ idea for THIS framework's cache layout:
   as the cold path would (its DUS write wins over the scattered copy),
   which keeps warm output equal to cold output.
 
+- **Tiered spill hierarchy** (``SpillTier``, ISSUE 13): eviction
+  DEMOTES instead of destroys — the LRU-evicted block's bytes move to
+  a bounded host-RAM tier (and overflow optionally to a disk tier),
+  sha256-checksummed at demote time. A radix miss that extends into a
+  spilled chain PROMOTES it back: checksum-verified, landed as private
+  pages through the same donating scatter as a page import, then
+  adopted — a torn or corrupt spilled page fails verification and is
+  recomputed cold, never served wrong. A full or faulted tier degrades
+  to the classic destroy-on-evict, counted, with zero correctness
+  impact; the whole hierarchy is chaos-tested via the ``slow_spill`` /
+  ``corrupt_spill`` / ``tier_exhaust`` fault kinds (resilience/faults).
+
 Scope: non-rolling caches only (``window == 0`` — ring eviction order
 is position-dependent) and full-precision KV (``kv_quant == ""`` —
 rotating through an int8 round-trip would add quantization error on
@@ -52,10 +64,13 @@ every reuse). Models declare their layout via ``kv_cache_spec()``
 from __future__ import annotations
 
 import functools
+import hashlib
 import json
 import logging
+import os
 import struct
 import threading
+import time
 
 import numpy as np
 
@@ -395,6 +410,197 @@ def ship_pages(src: "PrefixCache", dst: "PrefixCache", ids) -> dict:
     return dst.import_pages(payload)
 
 
+class SpillTier:
+    """Bounded demote-on-evict store under the device pool (ISSUE 13).
+
+    One entry per evicted pool block, keyed by the FULL token prefix
+    up to and including that block (the same key the radix would
+    match), holding the block's raw leaf bytes + a sha256 recorded at
+    demote time. Two levels: a host-RAM dict bounded at
+    ``host_blocks`` entries, whose own LRU overflow demotes further to
+    a disk directory (bounded at ``disk_blocks`` files) when one is
+    configured, else drops (the classic destroy). EVERY read verifies
+    the checksum before the bytes go anywhere near the device pool —
+    a failed verification removes the entry and reads as a miss, so a
+    corrupt or torn spilled page costs a cold recompute, never a
+    wrong token.
+
+    The tier is an optimization with a fault plan: ``tier_exhaust``
+    makes :meth:`put` refuse for a window (destroy-on-evict fallback),
+    ``corrupt_spill`` flips a byte of the most recent demote AFTER
+    checksumming, ``slow_spill`` stalls tier operations — all owned by
+    the caller (PrefixCache) via ``faults.on_tier_event``.
+
+    Thread-safety: one internal lock; entries are immutable after put.
+    """
+
+    def __init__(self, host_blocks: int = 0, disk_dir=None,
+                 disk_blocks: int = 0):
+        import threading as _threading
+
+        self.host_blocks = max(int(host_blocks), 0)
+        self.disk_dir = str(disk_dir) if disk_dir else None
+        self.disk_blocks = max(int(disk_blocks), 0) if self.disk_dir \
+            else 0
+        if self.disk_dir:
+            os.makedirs(self.disk_dir, exist_ok=True)
+        self._host: "dict" = {}       # key -> entry (insertion = LRU)
+        self._disk: "dict" = {}       # key -> {"path", "sha", "nbytes"}
+        self._seq = 0
+        self._lock = _threading.Lock()
+        #: tier_exhaust fault window: until this instant put() refuses
+        self.full_until = 0.0
+
+    @property
+    def enabled(self) -> bool:
+        return self.host_blocks > 0 or self.disk_blocks > 0
+
+    @staticmethod
+    def digest(leaves: dict) -> str:
+        """sha256 over the concatenated leaf bytes in sorted-path
+        order — the ONE checksum formula (demote and verify share it)."""
+        h = hashlib.sha256()
+        for ps in sorted(leaves):
+            h.update(leaves[ps])
+        return h.hexdigest()
+
+    def occupancy(self) -> dict:
+        with self._lock:
+            host_bytes = sum(e["nbytes"] for e in self._host.values())
+            disk_bytes = sum(e["nbytes"] for e in self._disk.values())
+            return {"tier_host_blocks": len(self._host),
+                    "tier_host_bytes": int(host_bytes),
+                    "tier_disk_blocks": len(self._disk),
+                    "tier_disk_bytes": int(disk_bytes)}
+
+    def __contains__(self, key) -> bool:
+        with self._lock:
+            return key in self._host or key in self._disk
+
+    def put(self, key, leaves: dict, sha: str) -> str | None:
+        """Store one demoted block's bytes. Returns the tier it landed
+        in (``"host"``) or None (tier full/faulted — the caller counts
+        a destroy-on-evict). Host overflow demotes the LRU host entry
+        to disk (when configured) or drops it."""
+        import time as _time
+
+        if not self.enabled or _time.monotonic() < self.full_until:
+            return None
+        nbytes = sum(len(b) for b in leaves.values())
+        with self._lock:
+            self._host.pop(key, None)       # re-demote refreshes LRU
+            self._disk.pop(key, None)
+            self._host[key] = {"leaves": dict(leaves), "sha": sha,
+                               "nbytes": int(nbytes)}
+            while len(self._host) > self.host_blocks:
+                old_key = next(iter(self._host))
+                entry = self._host.pop(old_key)
+                self._spill_to_disk_locked(old_key, entry)
+        return "host"
+
+    def _spill_to_disk_locked(self, key, entry) -> None:
+        """Move one host entry to the disk tier (caller holds the
+        lock); no disk tier (or a write failure) drops it — degrade,
+        never raise into the eviction path."""
+        if not self.disk_blocks:
+            return
+        self._seq += 1
+        path = os.path.join(self.disk_dir,
+                            f"{entry['sha'][:12]}-{self._seq}.kvblk")
+        try:
+            with open(path, "wb") as f:
+                for ps in sorted(entry["leaves"]):
+                    blob = entry["leaves"][ps]
+                    f.write(struct.pack(">I", len(ps)))
+                    f.write(ps.encode("utf-8"))
+                    f.write(struct.pack(">Q", len(blob)))
+                    f.write(blob)
+        except OSError:
+            return
+        self._disk[key] = {"path": path, "sha": entry["sha"],
+                           "nbytes": entry["nbytes"]}
+        while len(self._disk) > self.disk_blocks:
+            old = self._disk.pop(next(iter(self._disk)))
+            try:
+                os.unlink(old["path"])
+            except OSError:
+                pass
+
+    @staticmethod
+    def _read_disk(path) -> dict:
+        leaves = {}
+        with open(path, "rb") as f:
+            while True:
+                head = f.read(4)
+                if not head:
+                    break
+                (n,) = struct.unpack(">I", head)
+                ps = f.read(n).decode("utf-8")
+                (m,) = struct.unpack(">Q", f.read(8))
+                leaves[ps] = f.read(m)
+        return leaves
+
+    def get(self, key):
+        """Checksum-verified read -> ``(leaves_bytes, "verified")`` or
+        ``(None, "miss"|"corrupt")``. A corrupt entry is REMOVED (the
+        caller recomputes cold and the tier never serves it again)."""
+        with self._lock:
+            entry = self._host.get(key)
+            disk = None if entry is not None else self._disk.get(key)
+        if entry is not None:
+            leaves = entry["leaves"]
+            sha = entry["sha"]
+        elif disk is not None:
+            try:
+                leaves = self._read_disk(disk["path"])
+            except Exception:  # noqa: BLE001 — a torn/bit-rotted file
+                # can raise ANYTHING out of the length-prefixed parse
+                # (UnicodeDecodeError from the path string, struct
+                # errors, OSError...); every parse failure IS the
+                # corruption the checksum contract covers — degrade to
+                # "corrupt" (cold recompute), never raise into serving
+                leaves = {}
+            sha = disk["sha"]
+        else:
+            return None, "miss"
+        if not leaves or self.digest(leaves) != sha:
+            self.drop(key)
+            return None, "corrupt"
+        # touch for LRU (host entries only; move-to-end via re-insert)
+        with self._lock:
+            if key in self._host:
+                self._host[key] = self._host.pop(key)
+        return leaves, "verified"
+
+    def drop(self, key) -> None:
+        with self._lock:
+            self._host.pop(key, None)
+            disk = self._disk.pop(key, None)
+        if disk is not None:
+            try:
+                os.unlink(disk["path"])
+            except OSError:
+                pass
+
+    def corrupt_latest(self) -> bool:
+        """The ``corrupt_spill`` fault's effect: flip one byte of the
+        most recently demoted HOST entry (after its checksum was
+        recorded, so the next read fails verification). Returns
+        whether an entry was corrupted."""
+        with self._lock:
+            if not self._host:
+                return False
+            key = next(reversed(self._host))
+            entry = self._host[key]
+            ps = sorted(entry["leaves"])[0]
+            blob = bytearray(entry["leaves"][ps])
+            if not blob:
+                return False
+            blob[0] ^= 0xFF
+            entry["leaves"][ps] = bytes(blob)
+            return True
+
+
 class RadixIndex:
     """Block-granular radix/trie over prompt token ids.
 
@@ -488,6 +694,15 @@ class RadixIndex:
     def evict_lru(self):
         """Detach the least-recently-used unreferenced LEAF node and
         return its block id (None when everything is pinned)."""
+        evicted = self.evict_lru_path()
+        return None if evicted is None else evicted[0]
+
+    def evict_lru_path(self):
+        """Like :meth:`evict_lru`, but returns ``(block_id,
+        token_path)`` where ``token_path`` is the full id prefix up to
+        and including the evicted block — the demote tier's key (the
+        chunks up the parent chain reconstruct it; the walk is
+        O(depth), paid only on eviction)."""
         best, best_key = None, None
         stack = [self.root]
         while stack:
@@ -502,10 +717,16 @@ class RadixIndex:
                     stack.append(child)
         if best is None:
             return None
+        chunks = []
+        node = best
+        while node is not None and node is not self.root:
+            chunks.append(node["chunk"])
+            node = node["parent"]
+        path = tuple(i for chunk in reversed(chunks) for i in chunk)
         del best["parent"]["children"][best["chunk"]]
         best["parent"] = None
         self.nodes -= 1
-        return best["block"]
+        return best["block"], path
 
 
 class PrefixCache:
@@ -520,7 +741,8 @@ class PrefixCache:
 
     def __init__(self, model, params, block_tokens: int = 32,
                  pool_blocks: int = 256, eviction: str = "lru",
-                 paged: bool = True):
+                 paged: bool = True, host_spill_blocks: int = 0,
+                 disk_spill_dir=None, disk_spill_blocks: int = 0):
         import jax
         import jax.numpy as jnp
 
@@ -633,7 +855,28 @@ class PrefixCache:
             "page_ship_out_bytes": 0,
             "page_ship_in_bytes": 0,
             "page_ship_dropped": 0,
+            # tiered spill hierarchy (ISSUE 13): demote-on-evict /
+            # promote-on-hit traffic, checksum verdicts, and the
+            # degradation counters (a full or faulted tier falls back
+            # to destroy-on-evict; a demote that cannot read its block
+            # — donation loss mid-flight — likewise)
+            "tier_demoted_blocks": 0,
+            "tier_demote_bytes": 0,
+            "tier_promoted_blocks": 0,
+            "tier_promote_bytes": 0,
+            "tier_checksum_failures": 0,
+            "tier_exhaust_drops": 0,
+            "tier_demote_errors": 0,
         }
+        # demote-on-evict spill tier (ISSUE 13): None keeps the
+        # classic destroy-on-evict byte-identical
+        self.spill = None
+        if int(host_spill_blocks) > 0 or (
+                disk_spill_dir and int(disk_spill_blocks) > 0):
+            self.spill = SpillTier(
+                host_blocks=int(host_spill_blocks),
+                disk_dir=disk_spill_dir,
+                disk_blocks=int(disk_spill_blocks))
         self.nb_max = -(-int(model.max_len) // self.block)
         # bytes of ONE pool block across every leaf — the unit of the
         # copy-bytes accounting above
@@ -684,17 +927,182 @@ class PrefixCache:
 
     def _alloc(self):
         """One free block id, evicting the LRU unreferenced leaf when
-        the free list is empty; None when everything is pinned."""
+        the free list is empty; None when everything is pinned. With a
+        spill tier attached the evicted block DEMOTES (its bytes +
+        checksum move to the tier) instead of being destroyed — the
+        read happens synchronously here, before the returned id can be
+        overwritten by the caller's (later, async) capture/scatter."""
         if self._free:
             return self._free.pop()
-        bid = self.index.evict_lru()
-        if bid is None:
+        if self.spill is None:
+            bid = self.index.evict_lru()
+            if bid is None:
+                self.stats["prefix_dropped_inserts"] += 1
+                return None
+            self.stats["prefix_evictions"] += 1
+            return bid
+        evicted = self.index.evict_lru_path()
+        if evicted is None:
             self.stats["prefix_dropped_inserts"] += 1
             return None
+        bid, path = evicted
         self.stats["prefix_evictions"] += 1
+        self._demote_block(bid, path)
         return bid
 
-    def lookup(self, ids, record: bool = True):
+    def _demote_block(self, bid: int, path) -> None:
+        """Move one evicted block's content into the spill tier
+        (caller holds the lock; ``path`` is the full token prefix up
+        to and including the block — the tier key a later promotion
+        matches). Every failure mode degrades to the classic
+        destroy-on-evict, counted, never raised: the eviction path
+        must stay infallible."""
+        from ..resilience import faults
+
+        fired = faults.on_tier_event()
+        if fired is not None and fired.get("exhaust") is not None:
+            self.spill.full_until = (
+                time.monotonic() + fired["exhaust"].duration_s)
+            logger.warning("fault tier_exhaust: spill tier reads full "
+                           "for %.2fs", fired["exhaust"].duration_s)
+        try:
+            # one D2H gather per leaf: the demote cost (a host sync on
+            # the eviction path — bounded at one block, and only under
+            # pool pressure; the promote direction is async like every
+            # other pool write)
+            leaves = {ps: np.asarray(leaf[bid]).tobytes()
+                      for ps, leaf in self.pool.items()}
+        except Exception:  # noqa: BLE001 — donated/dead leaf mid-error
+            self.stats["tier_demote_errors"] += 1
+            return
+        sha = SpillTier.digest(leaves)
+        landed = self.spill.put(path, leaves, sha)
+        if landed is None:
+            self.stats["tier_exhaust_drops"] += 1
+            return
+        self.stats["tier_demoted_blocks"] += 1
+        self.stats["tier_demote_bytes"] += sum(
+            len(b) for b in leaves.values())
+        if fired is not None and fired.get("corrupt") is not None:
+            if self.spill.corrupt_latest():
+                logger.warning("fault corrupt_spill: flipped a byte of "
+                               "the just-demoted spill entry")
+
+    def promote_spilled(self, ids) -> int:
+        """Extend the device radix with spilled blocks for ``ids``
+        (the promote half of the tier hierarchy): walk the spill tier
+        past the deepest resident block, checksum-verify each entry,
+        land the verified chain as private pages through the same
+        donating scatter as a page import, then adopt — a request
+        admitted mid-promotion either misses (cold, correct) or hits
+        fully-written pages. Returns blocks promoted (0 = nothing
+        spilled, tier disabled, or pool too dry to land them).
+
+        DONATES the pool on a nonzero promotion — callers follow the
+        import_pages contract (the continuous engine promotes at tick
+        start, before ``refresh_cache_from_pool``; batch-1 paths
+        promote inside ``lookup`` before they read ``self.pool``).
+        A checksum failure counts ``tier_checksum_failures``, drops
+        the entry, and stops the walk: everything past it recomputes
+        cold — the tier never serves an unverified byte."""
+        import jax.numpy as jnp
+
+        from ..resilience import faults
+
+        if self.spill is None:
+            return 0
+        ids = [int(t) for t in ids]
+        nfull = len(ids) // self.block
+        with self._lock:
+            _, have = self.index.match(ids)
+        start = len(have)
+        if start >= nfull:
+            return 0
+        # probe the tier BEFORE paying a fault hook / allocation: the
+        # common case (nothing spilled for this prompt) must stay a
+        # dict lookup
+        probe = tuple(ids[:(start + 1) * self.block])
+        if probe not in self.spill:
+            return 0
+        # slow_spill covers promotes too; a corrupt_spill/tier_exhaust
+        # landing on a promote ordinal applies all the same (the most
+        # recent demote corrupts / the put window closes) — the evt
+        # ordinal counts every tier operation, so a fired spec must
+        # never be silently swallowed
+        fired = faults.on_tier_event()
+        if fired is not None:
+            if fired.get("exhaust") is not None:
+                self.spill.full_until = (
+                    time.monotonic() + fired["exhaust"].duration_s)
+            if fired.get("corrupt") is not None:
+                self.spill.corrupt_latest()
+        chain = []                  # [(block_index, {ps: np_array})]
+        for i in range(start, nfull):
+            key = tuple(ids[:(i + 1) * self.block])
+            blob, verdict = self.spill.get(key)
+            if blob is None:
+                if verdict == "corrupt":
+                    with self._lock:
+                        self.stats["tier_checksum_failures"] += 1
+                    logger.warning(
+                        "spill tier checksum failure at block %d: "
+                        "entry dropped, recomputing cold", i)
+                break
+            content = {}
+            ok = True
+            for ps, leaf in self.pool.items():
+                raw = blob.get(ps)
+                shape = tuple(leaf.shape[1:])
+                n = int(np.prod(shape)) * leaf.dtype.itemsize
+                if raw is None or len(raw) != n:
+                    ok = False      # geometry changed under the tier
+                    break
+                content[ps] = np.frombuffer(
+                    raw, dtype=leaf.dtype).reshape(shape)
+            if not ok:
+                self.spill.drop(key)
+                break
+            chain.append((i, content))
+        if not chain:
+            return 0
+        priv = self.alloc_chain(len(chain))
+        if priv is None:
+            return 0                # dry pool: promotion waits its turn
+        # one donating scatter, padded to the power-of-two ladder like
+        # the import path (a varying chain length must not mint fresh
+        # executables on the admission path)
+        cap = 1
+        while cap < len(chain):
+            cap *= 2
+        ids_pad = np.full((cap,), SCRATCH_BLOCK, np.int32)
+        ids_pad[:len(chain)] = priv
+        stacked = {}
+        for ps, leaf in self.pool.items():
+            rows = np.zeros((cap,) + tuple(leaf.shape[1:]), leaf.dtype)
+            for j, (_, content) in enumerate(chain):
+                rows[j] = content[ps]
+            stacked[ps] = jnp.asarray(rows)
+        self.pool = _import_scatter_fn()(
+            self.pool, jnp.asarray(ids_pad), stacked)
+        owned = {i: bid for (i, _), bid in zip(chain, priv)}
+        adopted, _ = self.adopt(ids[:nfull * self.block], owned)
+        taken = set(adopted)
+        self.free_blocks([b for b in priv if b not in taken])
+        # entries whose block actually ADOPTED leave the tier (their
+        # content is resident again; a re-eviction re-demotes fresh
+        # bytes) — entries the adopt walk never reached (a concurrent
+        # eviction broke the resident prefix under us) KEEP their
+        # spilled bytes, or the chain would be lost from both tiers
+        for i, _ in chain:
+            if owned[i] in taken:
+                self.spill.drop(tuple(ids[:(i + 1) * self.block]))
+        n = len(adopted)
+        with self._lock:
+            self.stats["tier_promoted_blocks"] += n
+            self.stats["tier_promote_bytes"] += n * self.page_bytes
+        return n
+
+    def lookup(self, ids, record: bool = True, promote: bool = True):
         """Longest cached, fully-blocked, PROPER prefix of ``ids`` ->
         ``(nodes, block_ids, cached_tokens)``; refs acquired (callers
         MUST ``release(nodes)`` once the copy kernel is dispatched).
@@ -706,7 +1114,15 @@ class PrefixCache:
         SAME request (a deferred paged admission re-reserves every
         tick) and routing probes must not inflate
         ``prefix_hit_tokens`` — that counter feeds /metrics, the fleet
-        router, and the bench gates."""
+        router, and the bench gates.
+
+        ``promote=True`` (the batch-1 default) first promotes any
+        spilled extension of the match back into the pool — which may
+        DONATE the pool, so callers whose device state aliases it pass
+        ``promote=False`` and promote at their own safe point (the
+        continuous engine's tick start)."""
+        if promote and self.spill is not None:
+            self.promote_spilled(ids)
         with self._lock:
             if record:
                 self.stats["prefix_lookups"] += 1
@@ -1110,6 +1526,15 @@ class PrefixCache:
         out["prefix_pool_blocks_resident"] = resident
         out["prefix_pool_blocks_referenced"] = referenced
         out["prefix_paged"] = bool(self.paged)
+        # spill-tier occupancy gauges (ISSUE 13) ride the same split:
+        # spilled pages are neither resident nor referenced — they are
+        # the tier below, one promotion away from resident
+        out["tier_enabled"] = self.spill is not None
+        if self.spill is not None:
+            out.update(self.spill.occupancy())
+        else:
+            out.update({"tier_host_blocks": 0, "tier_host_bytes": 0,
+                        "tier_disk_blocks": 0, "tier_disk_bytes": 0})
         lk = out["prefix_lookups"]
         out["prefix_hit_rate"] = round(
             out["prefix_hit_requests"] / lk, 4) if lk else 0.0
@@ -1148,7 +1573,8 @@ class PrefixCache:
         )(self.pool, cache, jnp.asarray(np.asarray(slots, np.int32)),
           jnp.asarray(np.asarray(pads, np.int32)), jnp.asarray(ids))
 
-    def paged_plan(self, ids, budget: int, record: bool = True):
+    def paged_plan(self, ids, budget: int, record: bool = True,
+                   promote: bool = True):
         """Page reservation for one request: shared-prefix lookup
         (refs held for the request's lifetime — decode reads those
         pages in place) plus a private chain for the suffix and the
@@ -1158,7 +1584,8 @@ class PrefixCache:
         continuous engine defers the admission and retries with
         ``record=False``). ONE owner of the reservation math — the
         continuous engine's ``_reserve_pages`` wraps this."""
-        nodes, blocks, c = self.lookup(ids, record=record)
+        nodes, blocks, c = self.lookup(ids, record=record,
+                                       promote=promote)
         n_need = -(-(len(ids) + int(budget)) // self.block) - \
             c // self.block
         priv = self.alloc_chain(n_need)

@@ -110,6 +110,15 @@ class GenerationService:
             and int(getattr(self.model, "window", 0) or 0) == 0
         )
         self._lock = threading.Lock()
+        # batched prefill export (ISSUE 13 satellite — PR 12 documented
+        # its batch-1-under-the-lock contract as the honest follow-on):
+        # concurrent /prefill callers enqueue their chains and ONE
+        # leader thread drains the queue under a single service-lock
+        # acquisition, so a handoff burst queues behind one lock wait
+        # instead of N of them
+        self._export_mu = threading.Lock()       # guards the queue
+        self._export_leader = threading.Lock()   # one processor
+        self._export_q: list = []
         # paged KV prefix cache (engine/kvcache.py): either a prebuilt
         # PrefixCache or a ``serving.prefix_cache`` config dict. A
         # layout that cannot pool (rolling window, int8 KV, no
@@ -130,6 +139,13 @@ class GenerationService:
                         pool_blocks=int(cfg.get("pool_blocks", 256)),
                         eviction=cfg.get("eviction", "lru"),
                         paged=bool(cfg.get("paged", True)),
+                        # tiered spill hierarchy (ISSUE 13): 0 / None
+                        # keeps the classic destroy-on-evict pool
+                        host_spill_blocks=int(
+                            cfg.get("host_spill_blocks", 0)),
+                        disk_spill_dir=cfg.get("disk_spill_dir"),
+                        disk_spill_blocks=int(
+                            cfg.get("disk_spill_blocks", 0)),
                     )
                 except ValueError as e:
                     logger.warning("prefix cache disabled: %s", e)
@@ -371,15 +387,15 @@ class GenerationService:
         returns a payload with ``n_blocks == 0`` — the caller sends
         the decode replica straight to a cold prefill.
 
-        Concurrency: exports run batch-1 under the service lock (the
-        speculative-request contract), so one prefill replica
-        serializes its /prefill traffic — concurrent handoffs queue
-        inside the replica and surface as handoff latency, which the
-        router's ``handoff_seconds`` histogram reports honestly.
-        Prefill is compute-bound (the reason the role exists), so
-        batch-1 costs little throughput on a dedicated chip; a
-        batched prefill-export through the slot engine is the
-        follow-on if prefill replicas ever saturate."""
+        Concurrency (ISSUE 13 satellite): exports COALESCE. Each
+        caller enqueues its chain; the first thread to take the
+        export-leader lock drains the whole queue under ONE service-
+        lock acquisition (computing + exporting every queued chain),
+        so a burst of concurrent handoffs pays one lock wait instead
+        of N serialized ones — the ``handoff_seconds`` queueing
+        component this was measured to dominate. ``prefill_export_
+        batches`` / ``prefill_export_max_batch`` make the coalescing
+        observable."""
         import time
 
         from .kvcache import serialize_pages  # noqa: F401 (re-export)
@@ -397,31 +413,108 @@ class GenerationService:
                  "leaves": {}}
         if len(ids) // pf.block == 0:
             return empty          # nothing exportable: sub-block prompt
-        with self._lock:
-            if pf.cached_block_count(ids) < len(ids) // pf.block:
-                # compute the missing blocks into the pool. Paged arm:
-                # a 1-token-budget reservation whose suffix prefill
-                # writes straight into private pages, finished
-                # immediately so the prompt's blocks adopt zero-copy;
-                # scatter arm: warm_prefill's plan_insert + capture.
-                done = False
-                if pf.paged:
-                    res = pf.paged_prefill(self.params, ids, 1)
-                    if res is not None:
-                        _, cache, _, plan = res
-                        pf.paged_finish(plan, [], 0)
-                        done = True
-                if not done:
-                    pf.warm_prefill(self.params, ids, len(ids) + 1)
-            payload = pf.export_pages(ids)
-        if payload is None:
-            payload = empty
+        import threading
+
+        item = {"ids": ids, "evt": threading.Event(), "result": None,
+                "error": None}
+        with self._export_mu:
+            self._export_q.append(item)
+        while not item["evt"].is_set():
+            if self._export_leader.acquire(blocking=False):
+                try:
+                    self._drain_export_queue()
+                finally:
+                    self._export_leader.release()
+            else:
+                # a leader is processing; it drains until the queue is
+                # empty, so either it takes this item or the loop wins
+                # the leader lock on the next spin
+                item["evt"].wait(0.002)
+        if item["error"] is not None:
+            raise item["error"]
+        payload = item["result"] or empty
         self.stats["prefill_exports"] = (
             self.stats.get("prefill_exports", 0) + 1)
         if self._tracer is not None and request_id:
             self._tracer.add(request_id, "prefill_export", t0,
                              time.monotonic(),
                              blocks=payload["n_blocks"])
+        return payload
+
+    def _drain_export_queue(self) -> None:
+        """The export leader's loop: repeatedly drain EVERY queued
+        chain and process the batch under one service-lock
+        acquisition, until the queue stays empty (a caller enqueueing
+        after the final drain becomes the next leader itself). One
+        chain's failure is its own — it must not poison batchmates."""
+        while True:
+            with self._export_mu:
+                batch, self._export_q = self._export_q, []
+            if not batch:
+                return
+            with self._lock:
+                for it in batch:
+                    try:
+                        it["result"] = self._export_chain_locked(
+                            it["ids"])
+                    except Exception as e:  # noqa: BLE001 — per-chain
+                        it["error"] = e
+            self.stats["prefill_export_batches"] = (
+                self.stats.get("prefill_export_batches", 0) + 1)
+            self.stats["prefill_export_max_batch"] = max(
+                self.stats.get("prefill_export_max_batch", 0),
+                len(batch))
+            for it in batch:
+                it["evt"].set()
+
+    def _export_chain_locked(self, ids):
+        """Compute-if-needed + export ONE chain (the leader holds the
+        service lock). Paged arm: a 1-token-budget reservation whose
+        suffix prefill writes straight into private pages, finished
+        immediately so the prompt's blocks adopt zero-copy; scatter
+        arm: warm_prefill's plan_insert + capture. Spilled blocks
+        promote first — a demoted chain is as exportable as a
+        resident one."""
+        pf = self._prefix
+        if pf.spill is not None:
+            pf.promote_spilled(ids)
+        if pf.cached_block_count(ids) < len(ids) // pf.block:
+            done = False
+            if pf.paged:
+                res = pf.paged_prefill(self.params, ids, 1)
+                if res is not None:
+                    _, cache, _, plan = res
+                    pf.paged_finish(plan, [], 0)
+                    done = True
+            if not done:
+                pf.warm_prefill(self.params, ids, len(ids) + 1)
+        return pf.export_pages(ids)
+
+    def export_cached_pages(self, prompt=None, prompt_ids=None,
+                            request_id=None) -> dict:
+        """Peer page migration's EXPORT-ONLY entry (ISSUE 13): ship
+        whatever full-block chain this replica already holds for the
+        prompt — resident pages, plus spilled pages promoted (and
+        checksum-verified) on the way out — WITHOUT computing anything
+        missing. The fleet manager's miss-driven peer pulls and
+        restart re-warm both call this on the holder; a replica that
+        holds nothing answers ``n_blocks == 0`` and the puller falls
+        back cold. Any role with a pool serves it."""
+        if self._prefix is None:
+            raise ValueError("export_cached_pages needs a prefix cache "
+                             "(serving.prefix_cache.enabled)")
+        ids = self.encode_prompt(prompt, prompt_ids)
+        pf = self._prefix
+        with self._lock:
+            if pf.spill is not None:
+                pf.promote_spilled(ids)
+            payload = pf.export_pages(ids)
+        if payload is None:
+            payload = {"version": 1, "block_tokens": pf.block,
+                       "n_blocks": 0, "token_ids": [],
+                       "tp_geometry": {"tp": pf._tp}, "leaves": {}}
+        self.stats["peer_exports"] = (
+            self.stats.get("peer_exports", 0) + 1)
         return payload
 
     def import_remote_pages(self, payload) -> dict:

@@ -157,6 +157,34 @@ class FleetRadix:
             self._evict_batch(protect_from=now)
         return walked
 
+    def replica_prefixes(self, replica_id: str,
+                         top_k: int = 8) -> List[list]:
+        """The DEEPEST id-chains ``replica_id`` is recorded to hold,
+        hottest (most recently used) first, at most ``top_k`` — the
+        restart re-warm plan (ISSUE 13): captured at ejection time,
+        BEFORE :meth:`drop_replica` erases the dead replica's
+        entries, and replayed from peers once the replica comes back.
+        A chain is "deepest" when no child node also names the
+        replica (shallower prefixes ride along for free on a pull of
+        the deep one)."""
+        out: List[tuple] = []
+        # record() stamps a replica down the WHOLE path, so a node
+        # whose replicas lack the id has no claiming descendants —
+        # the walk prunes there
+        stack: List[tuple] = [(self.root, [])]
+        while stack:
+            node, ids = stack.pop()
+            deeper = False
+            for child in node["children"].values():
+                if replica_id in child["replicas"]:
+                    stack.append((child, ids + list(child["chunk"])))
+                    deeper = True
+            if (node is not self.root and not deeper
+                    and replica_id in node["replicas"]):
+                out.append((node["last_use"], ids))
+        out.sort(key=lambda t: -t[0])
+        return [ids for _, ids in out[:max(int(top_k), 0)]]
+
     def drop_replica(self, replica_id: str) -> int:
         """A replica died or restarted: its pool is empty, so every
         prediction naming it is stale. Removes it everywhere and prunes

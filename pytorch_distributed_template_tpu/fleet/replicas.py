@@ -83,6 +83,28 @@ def http_json(url: str, timeout_s: float = 5.0) -> dict:
         return json.loads(resp.read().decode("utf-8"))
 
 
+def http_post(url: str, path: str, body: bytes,
+              timeout_s: float = 5.0,
+              content_type: str = "application/json"):
+    """POST ``body`` to ``url + path`` -> ``(status, response_bytes)``.
+    The peer page-migration helper (export from one replica, admit
+    into another); wire failures raise (OSError / socket.timeout /
+    http.client.HTTPException) — the callers own the fallback."""
+    import http.client
+    from urllib.parse import urlsplit
+
+    u = urlsplit(url)
+    conn = http.client.HTTPConnection(u.hostname, u.port,
+                                      timeout=timeout_s)
+    try:
+        conn.request("POST", path, body=body,
+                     headers={"Content-Type": content_type})
+        resp = conn.getresponse()
+        return resp.status, resp.read()
+    finally:
+        conn.close()
+
+
 class Replica:
     """One fleet member: a supervised ``serve.py`` child (``cmd``) or
     an externally managed server (``url`` — attach mode, tests)."""
@@ -121,6 +143,12 @@ class Replica:
         self.stuck_streak = 0
         self.wedged = False
         self.wedge_progress: Optional[float] = None
+        # restart re-warm (ISSUE 13): the hottest prefixes this
+        # replica held, captured at ejection time BEFORE the radix
+        # drops its entries; replayed from peers once it comes back.
+        # state: None (no plan) / "pending" / "running" / "done"
+        self.rewarm_prefixes: list = []
+        self.rewarm_state = None
         self.polled: dict = {}         # last /metrics?format=json
         self.cum: Dict[str, float] = {k: 0 for k in AGGREGATED_COUNTERS}
         self._last_raw: Dict[str, float] = {}
@@ -242,7 +270,12 @@ class FleetManager:
                  on_capacity_change=None,
                  wedge_after: Optional[int] = None,
                  wedge_grace_s: float = 60.0,
-                 restart_wedged: bool = True):
+                 restart_wedged: bool = True,
+                 peer_pull: bool = False,
+                 peer_pull_min_tokens: int = 64,
+                 peer_pull_timeout_s: float = 5.0,
+                 rewarm: bool = False,
+                 rewarm_top_k: int = 8):
         self.replicas = {r.rid: r for r in replicas}
         self.policy = policy
         self.radix = FleetRadix(block_tokens=block_tokens,
@@ -298,7 +331,29 @@ class FleetManager:
             # eligible requests fell back to the colocated path
             "handoffs_total": 0, "pages_shipped_total": 0,
             "page_ship_bytes_total": 0, "handoff_fallbacks_total": 0,
+            # peer page migration (ISSUE 13): miss-driven pulls (a
+            # request routed to replica A whose prefix lives on B
+            # pulls B's pages instead of recomputing a long prefill)
+            # and restart re-warm pulls (a restarted replica replays
+            # its hottest prefixes from peers before readmission).
+            # Failures/timeouts degrade to a cold prefill, counted —
+            # migration is an optimization, never a dependency.
+            "peer_pulls_total": 0, "peer_pull_blocks_total": 0,
+            "peer_pull_bytes_total": 0, "peer_pull_failures_total": 0,
+            "peer_pull_timeouts_total": 0,
+            "rewarm_events_total": 0, "rewarm_pulls_total": 0,
+            "rewarm_blocks_total": 0, "rewarm_failures_total": 0,
         }
+        # peer page migration knobs (ISSUE 13); both off by default —
+        # a pre-tier fleet routes byte-identically
+        self.peer_pull = bool(peer_pull)
+        self.peer_pull_min_tokens = int(peer_pull_min_tokens)
+        self.peer_pull_timeout_s = float(peer_pull_timeout_s)
+        self.rewarm = bool(rewarm)
+        self.rewarm_top_k = int(rewarm_top_k)
+        #: miss-driven pull latency, histogram-bucketed like every
+        #: other fleet latency (ISSUE 8 discipline)
+        self.peer_pull_hist = LatencyHistogram()
         self.recoveries_s: List[float] = []
         #: prefill→decode handoff latency (stage-1 dispatch → decode
         #: dispatch), histogram-bucketed so it aggregates across
@@ -431,6 +486,7 @@ class FleetManager:
                         capacity_changed = True
                         self.stats["ejections_total"] += 1
                         self.stats["wedged_ejections_total"] += 1
+                        self._capture_rewarm_plan(r)
                         self.radix.drop_replica(r.rid)
                         self.events.log(
                             "eject", replica=r.rid, url=url,
@@ -457,12 +513,28 @@ class FleetManager:
                         r.ok_streak = 0
                     else:
                         r.ok_streak += 1
+                    if (self.rewarm and r.state == EJECTED
+                            and r.ok_streak >= self.readmit_after
+                            and r.rewarm_state == "pending"):
+                        # restart re-warm (ISSUE 13): replay the dead
+                        # pool's hottest prefixes from peers BEFORE
+                        # readmission — the replica rejoins warm, not
+                        # cold. Runs off-thread (pulls are HTTP);
+                        # readmission waits below until it finishes.
+                        r.rewarm_state = "running"
+                        threading.Thread(
+                            target=self._rewarm_worker, args=(r,),
+                            daemon=True,
+                            name=f"fleet-rewarm-{r.rid}").start()
                     if (r.state in (STARTING, EJECTED)
-                            and r.ok_streak >= self.readmit_after):
+                            and r.ok_streak >= self.readmit_after
+                            and r.rewarm_state != "running"):
                         was_ejected = r.state == EJECTED
                         r.state = HEALTHY
                         r.wedged = False
                         r.wedge_progress = None
+                        r.rewarm_prefixes = []
+                        r.rewarm_state = None
                         capacity_changed = True
                         recovery_s = None
                         if r.ejected_at is not None:
@@ -486,7 +558,10 @@ class FleetManager:
                         capacity_changed = True
                         self.stats["ejections_total"] += 1
                         # its pool restarts empty: predictions naming
-                        # it are stale the moment it comes back
+                        # it are stale the moment it comes back — but
+                        # the re-warm plan snapshots its hottest
+                        # prefixes first (ISSUE 13)
+                        self._capture_rewarm_plan(r)
                         self.radix.drop_replica(r.rid)
                         self.events.log("eject", replica=r.rid, url=url,
                                         fail_streak=r.fail_streak)
@@ -563,6 +638,155 @@ class FleetManager:
             self.stats["pages_shipped_total"] += int(pages)
             self.stats["page_ship_bytes_total"] += int(nbytes)
         self.handoff_hist.observe(max(float(dur_s), 0.0))
+
+    # -- peer page migration (ISSUE 13) -------------------------------------
+
+    def _capture_rewarm_plan(self, r: Replica) -> None:
+        """Snapshot the ejecting replica's hottest prefixes (caller
+        holds the lock, BEFORE ``radix.drop_replica`` erases them)."""
+        if not self.rewarm:
+            return
+        r.rewarm_prefixes = self.radix.replica_prefixes(
+            r.rid, self.rewarm_top_k)
+        r.rewarm_state = "pending" if r.rewarm_prefixes else None
+
+    def _pull_pages(self, src: Replica, dst: Replica, ids,
+                    timeout_s: float) -> Optional[dict]:
+        """One peer page pull: export the chain ``src`` holds, admit
+        it into ``dst``. Returns ``{"blocks", "bytes"}`` (landed) or
+        None — EVERY failure class (timeout, refused, bad payload, a
+        dry destination pool) degrades to None and the caller's cold
+        path; the ``peer_pull_timeout`` fault rides in here so chaos
+        runs exercise exactly that degradation."""
+        import http.client
+        import socket
+
+        from ..resilience import faults
+
+        spec = faults.on_peer_pull()
+        if spec is not None:
+            # injected timeout: stall like the real thing, then fail
+            time.sleep(min(spec.duration_s, timeout_s))
+            with self._lock:
+                self.stats["peer_pull_timeouts_total"] += 1
+            self.events.log("peer_pull_timeout", src=src.rid,
+                            dst=dst.rid, injected=True)
+            return None
+        try:
+            status, body = http_post(
+                src.url, "/export_pages",
+                json.dumps({"prompt_ids": [int(i) for i in ids]})
+                .encode("utf-8"), timeout_s=timeout_s)
+            if status != 200 or not body:
+                raise OSError(f"export answered {status}")
+            status, rbody = http_post(
+                dst.url, "/admit_pages", body, timeout_s=timeout_s,
+                content_type="application/octet-stream")
+            if status != 200:
+                raise OSError(f"admit answered {status}")
+            receipt = json.loads(rbody)
+        except socket.timeout:
+            with self._lock:
+                self.stats["peer_pull_timeouts_total"] += 1
+            self.events.log("peer_pull_timeout", src=src.rid,
+                            dst=dst.rid)
+            return None
+        except (OSError, http.client.HTTPException, ValueError):
+            with self._lock:
+                self.stats["peer_pull_failures_total"] += 1
+            return None
+        imported = int(receipt.get("imported_blocks", 0) or 0)
+        cached = int(receipt.get("cached_tokens", 0) or 0)
+        if imported <= 0 and cached <= 0:
+            return None          # dropped import (dry pool): stay cold
+        self.record_placement(ids, dst.rid)
+        return {"blocks": imported,
+                "bytes": int(receipt.get("bytes", 0) or 0)}
+
+    def maybe_peer_pull(self, ids, dst: Replica,
+                        budget_s=None) -> Optional[dict]:
+        """Miss-driven page migration (ISSUE 13 tentpole): when a
+        request lands on ``dst`` but ANOTHER healthy replica holds a
+        meaningfully deeper prefix (>= ``peer_pull_min_tokens`` more
+        than dst's own match), pull that replica's pages over the
+        export → admit path first — the admission then hits warm
+        pages instead of recomputing a long prefill. Returns the pull
+        receipt for the router's ``peer_pull`` trace span, or None
+        (nothing worth pulling / pull failed — the request proceeds
+        cold, which is always correct)."""
+        if not self.peer_pull:
+            return None
+        ids = [int(i) for i in ids]
+        if len(ids) < self.peer_pull_min_tokens:
+            return None
+        with self._lock:
+            matches = self.radix.match(ids)
+            dst_tok = matches.get(dst.rid, 0)
+            best, best_tok = None, dst_tok + self.peer_pull_min_tokens
+            for rid, tok in matches.items():
+                r = self.replicas.get(rid)
+                if (rid != dst.rid and r is not None
+                        and r.state == HEALTHY and tok >= best_tok):
+                    best, best_tok = r, tok
+        if best is None:
+            return None
+        timeout = self.peer_pull_timeout_s
+        if budget_s is not None:
+            timeout = max(min(timeout, float(budget_s)), 0.05)
+        t0 = time.monotonic()
+        res = self._pull_pages(best, dst, ids, timeout)
+        if res is None:
+            return None
+        dur = time.monotonic() - t0
+        self.peer_pull_hist.observe(dur)
+        with self._lock:
+            self.stats["peer_pulls_total"] += 1
+            self.stats["peer_pull_blocks_total"] += res["blocks"]
+            self.stats["peer_pull_bytes_total"] += res["bytes"]
+        self.events.log("peer_pull", src=best.rid, dst=dst.rid,
+                        blocks=res["blocks"], bytes=res["bytes"],
+                        dur_s=round(dur, 4))
+        return {"src": best.rid, **res, "dur_s": round(dur, 4)}
+
+    def _rewarm_worker(self, r: Replica) -> None:
+        """Replay a restarted replica's hottest prefixes from peers
+        (its readmission waits on this — the replica rejoins warm).
+        Every prefix pulls from the deepest healthy holder; failures
+        count and skip (the prefix simply comes back cold). Bounded:
+        at most ``rewarm_top_k`` pulls, each under the pull timeout."""
+        t0 = time.monotonic()
+        pulls = blocks = failures = 0
+        try:
+            for ids in r.rewarm_prefixes:
+                with self._lock:
+                    matches = self.radix.match(ids)
+                    best, best_tok = None, 0
+                    for rid, tok in matches.items():
+                        peer = self.replicas.get(rid)
+                        if (rid != r.rid and peer is not None
+                                and peer.state == HEALTHY
+                                and tok > best_tok):
+                            best, best_tok = peer, tok
+                if best is None:
+                    continue
+                res = self._pull_pages(best, r, ids,
+                                       self.peer_pull_timeout_s)
+                if res is None:
+                    failures += 1
+                    continue
+                pulls += 1
+                blocks += res["blocks"]
+        finally:
+            dur = round(time.monotonic() - t0, 4)
+            with self._lock:
+                self.stats["rewarm_events_total"] += 1
+                self.stats["rewarm_pulls_total"] += pulls
+                self.stats["rewarm_blocks_total"] += blocks
+                self.stats["rewarm_failures_total"] += failures
+                r.rewarm_state = "done"
+            self.events.log("rewarm", replica=r.rid, pulls=pulls,
+                            blocks=blocks, failures=failures,
+                            dur_s=dur)
 
     def _brownout_level_locked(self) -> int:
         """ONE owner for which replicas count as 'live' for the fleet
@@ -740,6 +964,15 @@ class FleetManager:
                     est = histogram_quantile(hh, q)
                     if est is not None:
                         out[f"handoff_{tag}_s"] = est
+            # peer page-pull latency (ISSUE 13): same histogram-first
+            # discipline as the handoff latency above
+            ph = self.peer_pull_hist.snapshot()
+            if ph.get("count"):
+                out["peer_pull_seconds"] = ph
+                for q, tag in ((0.5, "p50"), (0.99, "p99")):
+                    est = histogram_quantile(ph, q)
+                    if est is not None:
+                        out[f"peer_pull_{tag}_s"] = est
             # worst live replica's brownout level (gauge, ISSUE 9)
             out["fleet_brownout_level"] = self._brownout_level_locked()
             out["inflight"] = sum(r.inflight

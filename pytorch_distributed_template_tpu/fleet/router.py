@@ -761,6 +761,26 @@ def make_fleet_handler(manager: FleetManager, admission: FairAdmission,
                                   str(admission.retry_after_s()))])
                     return "unroutable"
                 replica, reason = picked
+                # miss-driven peer page migration (ISSUE 13): when
+                # another replica holds this prompt's prefix deeper
+                # than the chosen one, pull its pages over before
+                # dispatch — the admission becomes a warm pointer
+                # update instead of a long recompute. Fire-and-degrade:
+                # a failed/timed-out pull just proxies cold. First
+                # attempt only; never into a nearly-dead budget.
+                if attempt == 0 and manager.peer_pull:
+                    budget_s = (deadline.remaining_s() - 0.05
+                                if deadline is not None else None)
+                    if budget_s is None or budget_s > 0.05:
+                        t_pull = time.monotonic()
+                        pulled = manager.maybe_peer_pull(
+                            ids, replica, budget_s=budget_s)
+                        if pulled is not None and tracer is not None:
+                            tracer.add(rid, "peer_pull", t_pull,
+                                       time.monotonic(),
+                                       src=pulled["src"],
+                                       blocks=pulled["blocks"],
+                                       bytes=pulled["bytes"])
                 manager.begin(replica)
                 t_p0 = time.monotonic()
                 try:

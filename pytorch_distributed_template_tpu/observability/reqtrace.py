@@ -521,6 +521,8 @@ def _segments(recs: List[dict]) -> Dict[str, float]:
     aw = _named(recs, "admission_wait", proc="router")
     proxy = _last_named(recs, "proxy", proc="router")
     ship = _named(recs, "page_ship", proc="router")
+    pull = _named(recs, "peer_pull", proc="router")
+    tier = _named(recs, "tier")
     http = _named(recs, "http")
     if http is not None and http.get("proc") == "router":
         http = None
@@ -550,14 +552,29 @@ def _segments(recs: List[dict]) -> Dict[str, float]:
         put("page_ship", float(ship.get("dur_ms", 0.0)) / 1e3)
         if aw is not None:
             put("route", float(ship["t"]) - _t1(aw))
+    elif pull is not None and aw is not None:
+        # miss-driven peer page pull (ISSUE 13): the router pulled a
+        # peer's pages ahead of the proxy hop — its own slice, with
+        # "route" ending where the pull begins (the proxy span starts
+        # right after the pull, so the decomposition stays gap-free)
+        put("peer_pull", float(pull.get("dur_ms", 0.0)) / 1e3)
+        put("route", float(pull["t"]) - _t1(aw))
     elif proxy is not None and aw is not None:
         put("route", float(proxy["t"]) - _t1(aw))
     if proxy is not None and http is not None:
         put("proxy_send", float(http["t"]) - float(proxy["t"]))
     if http is not None and qw is not None:
         put("replica_recv", float(qw["t"]) - float(http["t"]))
+    if tier is not None:
+        # spill-tier promotion (ISSUE 13): runs at tick start while
+        # the request is still queued, INSIDE the queue_wait window —
+        # carved out below so the two stay non-overlapping
+        put("tier", float(tier.get("dur_ms", 0.0)) / 1e3)
     if qw is not None:
-        put("scheduler_queue", float(qw.get("dur_ms", 0.0)) / 1e3)
+        put("scheduler_queue",
+            float(qw.get("dur_ms", 0.0)) / 1e3
+            - (float(tier.get("dur_ms", 0.0)) / 1e3
+               if tier is not None else 0.0))
     if ft is not None and qw is not None:
         put("admit", float(ft["t"]) - _t1(qw))
     if done is not None and ft is not None:

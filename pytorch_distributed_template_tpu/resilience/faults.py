@@ -81,6 +81,34 @@ kind                unit  effect at the hook point
                           garbage weights
 ==================  ====  ==========================================
 
+KV-tier kinds (ISSUE 13) — the spill hierarchy's fault surface.
+``evt`` is the process-global tier-operation ordinal (every demote or
+promote the spill tier performs advances it); ``pull`` is the
+process-global peer-page-pull ordinal (the fleet manager's
+miss-driven pulls and restart re-warm pulls both count):
+
+===================  ====  =========================================
+kind                 unit  effect at the hook point
+===================  ====  =========================================
+``slow_spill``       evt   ``time.sleep(arg)`` before the tier
+                           operation (a slow host/disk tier; the
+                           admission simply takes longer — nothing
+                           may strand)
+``corrupt_spill``    evt   flip one byte of the most recently
+                           DEMOTED blob after its checksum was
+                           recorded: the next read of that entry
+                           must fail verification and recompute
+                           cold, never serve the torn page
+``tier_exhaust``     evt   the spill tier reports full for ``arg``
+                           (default 1s): eviction degrades to the
+                           classic destroy-on-evict, counted, with
+                           zero correctness impact
+``peer_pull_timeout`` pull the ``at``-th peer page pull times out
+                           (sleeps ``arg``, then fails): the router
+                           falls back to a cold prefill — a pull is
+                           an optimization, never a dependency
+===================  ====  =========================================
+
 Attempt gating: each spec fires only on one supervisor attempt
 (default the first), so a ``kill@step:5`` chaos run dies once and the
 restarted attempt — the supervisor exports ``PDT_ATTEMPT=n`` — sails
@@ -126,11 +154,19 @@ KINDS = {
     "proxy_latency": "req",
     "proxy_blackhole": "req",
     "ckpt_corrupt": "load",
+    # KV-tier kinds (ISSUE 13): evt = the spill tier's operation
+    # ordinal (demotes + promotes), pull = the fleet manager's peer
+    # page-pull ordinal. Same attempt gating + once-per-process rules.
+    "slow_spill": "evt",
+    "corrupt_spill": "evt",
+    "tier_exhaust": "evt",
+    "peer_pull_timeout": "pull",
 }
 
 #: kinds whose optional arg is a duration (validated at parse time)
 _DURATION_KINDS = ("slow_host", "slow_decode", "pool_exhaust",
-                   "stall_stream", "proxy_latency")
+                   "stall_stream", "proxy_latency", "slow_spill",
+                   "tier_exhaust", "peer_pull_timeout")
 
 ENV_PLAN = "PDT_FAULTS"
 ENV_ATTEMPT = "PDT_ATTEMPT"
@@ -284,8 +320,11 @@ def configure(text: Optional[str] = None,
 def reset() -> None:
     """Drop the plan entirely (tests)."""
     global _plan, _attempt, _active, _watched_loader_id, _load_ordinal
+    global _tier_ordinal, _pull_ordinal
     _plan, _attempt, _active, _watched_loader_id = None, 1, [], None
     _load_ordinal = 0
+    _tier_ordinal = 0
+    _pull_ordinal = 0
 
 
 def watch_loader(loader) -> None:
@@ -470,6 +509,50 @@ def on_artifact_load():
     if not _active:
         return None
     return _take("ckpt_corrupt", _load_ordinal)
+
+
+#: spill-tier operation ordinal (1-based) for the ``evt`` unit —
+#: every demote or promote the tier performs advances it
+_tier_ordinal = 0
+
+#: peer page-pull ordinal (1-based) for the ``pull`` unit
+_pull_ordinal = 0
+
+
+def on_tier_event():
+    """Spill-tier hook (engine/kvcache.SpillTier, ISSUE 13): each
+    call advances the tier-operation ordinal. ``slow_spill`` sleeps
+    in place (the tier is just slow; the caller proceeds); returns
+    ``{"corrupt": spec|None, "exhaust": spec|None}`` — the tier owns
+    the byte flip and the full-window — or None with no plan active."""
+    global _tier_ordinal
+    if _plan is None:
+        _ensure_configured()
+    _tier_ordinal += 1
+    if not _active:
+        return None
+    s = _take("slow_spill", _tier_ordinal)
+    if s is not None:
+        logger.warning("fault slow_spill: sleeping %.3fs at tier op %d",
+                       s.duration_s, _tier_ordinal)
+        time.sleep(s.duration_s)
+    return {"corrupt": _take("corrupt_spill", _tier_ordinal),
+            "exhaust": _take("tier_exhaust", _tier_ordinal)}
+
+
+def on_peer_pull():
+    """Peer page-pull hook (fleet/replicas.FleetManager, ISSUE 13):
+    each call advances the pull ordinal; returns the fired
+    ``peer_pull_timeout`` spec (the caller sleeps its duration and
+    then treats the pull as timed out — cold-prefill fallback) or
+    None."""
+    global _pull_ordinal
+    if _plan is None:
+        _ensure_configured()
+    _pull_ordinal += 1
+    if not _active:
+        return None
+    return _take("peer_pull_timeout", _pull_ordinal)
 
 
 def install_from_env_or_config(config_text: Optional[str]) -> None:

@@ -140,6 +140,27 @@ def build_parser() -> argparse.ArgumentParser:
                    help="weighted fair queueing weights per X-Tenant "
                         "value (default 1.0 each)")
     # health
+    p.add_argument("--peer-pull", default="off",
+                   choices=("on", "off"),
+                   help="miss-driven peer page migration (ISSUE 13): "
+                        "a request routed to a replica whose prefix "
+                        "lives on a peer pulls the peer's pool pages "
+                        "(/export_pages -> /admit_pages) before "
+                        "dispatch instead of recomputing the prefill; "
+                        "failures/timeouts degrade to a cold prefill")
+    p.add_argument("--peer-pull-min-tokens", type=int, default=64,
+                   help="smallest extra cached-token depth on a peer "
+                        "worth a pull")
+    p.add_argument("--peer-pull-timeout-s", type=float, default=5.0,
+                   help="per-hop timeout for peer page pulls")
+    p.add_argument("--rewarm", default="off", choices=("on", "off"),
+                   help="restart re-warm (ISSUE 13): a killed/ejected "
+                        "replica's hottest prefixes (snapshotted from "
+                        "the placement radix at ejection) replay from "
+                        "peers BEFORE readmission, so it rejoins warm "
+                        "instead of cold")
+    p.add_argument("--rewarm-top-k", type=int, default=8,
+                   help="how many hot prefixes the re-warm replays")
     p.add_argument("--poll-s", type=float, default=1.0)
     p.add_argument("--eject-after", type=int, default=2,
                    help="consecutive failed health polls before a "
@@ -307,7 +328,12 @@ def main(argv=None) -> int:
         readmit_after=args.readmit_after,
         queue_factor=args.queue_factor,
         wedge_after=(args.wedge_after or None),
-        restart_wedged=not args.no_restart_wedged)
+        restart_wedged=not args.no_restart_wedged,
+        peer_pull=args.peer_pull == "on",
+        peer_pull_min_tokens=args.peer_pull_min_tokens,
+        peer_pull_timeout_s=args.peer_pull_timeout_s,
+        rewarm=args.rewarm == "on",
+        rewarm_top_k=args.rewarm_top_k)
     # two-stage admission (ISSUE 12): the front door's gate caps the
     # DECODE stage and a second, clock-independent gate wraps only the
     # prefill hop of each handoff. Both capacity fns are ROLE-FILTERED

@@ -62,6 +62,12 @@ _BENCH_METRIC_FALLBACK = {
     "serve_disagg_decode_tok_s": ("summary", "serve_disagg",
                                   "decode_tok_s_base"),
     "serve_disagg_hold": ("summary", "serve_disagg", "disagg_hold"),
+    # tiered KV pool gates (ISSUE 13): warm-hit hold vs the
+    # infinite-pool oracle and the re-warm-beats-cold ratio — both
+    # higher-is-better for the one-sided floor gate
+    "serve_kvtier_hold": ("summary", "serve_kvtier", "warm_hit_hold"),
+    "serve_kvtier_rewarm": ("summary", "serve_kvtier",
+                            "rewarm_speedup"),
 }
 
 
@@ -387,6 +393,51 @@ def analyze_disagg(path) -> dict:
     return out
 
 
+def analyze_kvtier(records: list, fleet_path=None) -> dict:
+    """KV tiers (serving) section (ISSUE 13). Engine side, from the
+    slot engine's per-chunk ``serve_chunk`` records: demote/promote
+    traffic (cumulative — last record wins), checksum failures,
+    destroy-on-evict degradations, and the per-tier occupancy high
+    water. Fleet side, from the router's ``router.jsonl`` counter
+    snapshots: miss-driven peer page pulls (volume + p50/p99 latency)
+    and restart re-warm events. Empty when neither the tier nor peer
+    migration ever engaged — the section renders only when the
+    feature ran."""
+    out: dict = {}
+    serve = [r for r in records or ()
+             if r.get("event") == "serve_chunk"
+             and r.get("tier_demoted_blocks_total") is not None]
+    if serve:
+        last = serve[-1]
+        for k in ("tier_demoted_blocks_total",
+                  "tier_promoted_blocks_total",
+                  "tier_demote_bytes_total", "tier_promote_bytes_total",
+                  "tier_checksum_failures_total",
+                  "tier_exhaust_drops_total",
+                  "tier_host_blocks", "tier_disk_blocks"):
+            if last.get(k) is not None:
+                out[k] = last[k]
+        host_hw = [r["tier_host_bytes"] for r in serve
+                   if r.get("tier_host_bytes") is not None]
+        if host_hw:
+            out["tier_host_bytes_max"] = max(host_hw)
+    if fleet_path is not None:
+        last_snapshot: dict = {}
+        for rec in load_jsonl(fleet_path):
+            if rec.get("event") == "snapshot":
+                last_snapshot = rec
+        for k in ("peer_pulls_total", "peer_pull_blocks_total",
+                  "peer_pull_bytes_total", "peer_pull_failures_total",
+                  "peer_pull_timeouts_total", "peer_pull_p50_s",
+                  "peer_pull_p99_s", "rewarm_events_total",
+                  "rewarm_pulls_total", "rewarm_blocks_total",
+                  "rewarm_failures_total"):
+            v = last_snapshot.get(k)
+            if v:
+                out[k] = v
+    return out
+
+
 def analyze_reqtrace(run_dir=None, span_files=None) -> dict:
     """Request-scoped tracing section (ISSUE 8): stitch every
     ``spans.jsonl`` under the run dir (router + replicas) into
@@ -520,6 +571,7 @@ def to_markdown(report: dict) -> str:
     table("Supervisor", report.get("supervisor", {}))
     table("Fleet (router)", report.get("fleet", {}))
     table("Disaggregation (serving)", report.get("disagg", {}))
+    table("KV tiers (serving)", report.get("kvtier", {}))
     table("Request tracing (p99 attribution)",
           report.get("reqtrace", {}))
     tr = report.get("trace") or {}
@@ -615,6 +667,7 @@ def main(argv=None) -> int:
 
     report: dict = {}
     try:
+        records: list = []
         tel_path = args.telemetry
         run_dir = Path(args.run_dir) if args.run_dir else None
         if tel_path is None and run_dir is not None:
@@ -650,6 +703,9 @@ def main(argv=None) -> int:
             disagg = analyze_disagg(fleet_path)
             if disagg:
                 report["disagg"] = disagg
+        kvtier = analyze_kvtier(records, fleet_path=fleet_path)
+        if kvtier:
+            report["kvtier"] = kvtier
         if args.spans or run_dir is not None:
             rt = analyze_reqtrace(run_dir=run_dir,
                                   span_files=args.spans)

@@ -307,6 +307,37 @@ def service_metrics(service: GenerationService) -> dict:
             prefix.get("page_ship_in_bytes", 0))
         out["page_ship_dropped_total"] = int(
             prefix.get("page_ship_dropped", 0))
+        # tiered KV spill hierarchy (ISSUE 13): demote/promote
+        # traffic, checksum verdicts, degradation counters, and the
+        # per-tier occupancy gauges (no _total suffix) riding the
+        # resident/referenced split above
+        out["tier_demoted_blocks_total"] = int(
+            prefix.get("tier_demoted_blocks", 0))
+        out["tier_promoted_blocks_total"] = int(
+            prefix.get("tier_promoted_blocks", 0))
+        out["tier_demote_bytes_total"] = int(
+            prefix.get("tier_demote_bytes", 0))
+        out["tier_promote_bytes_total"] = int(
+            prefix.get("tier_promote_bytes", 0))
+        out["tier_checksum_failures_total"] = int(
+            prefix.get("tier_checksum_failures", 0))
+        out["tier_exhaust_drops_total"] = int(
+            prefix.get("tier_exhaust_drops", 0))
+        out["tier_demote_errors_total"] = int(
+            prefix.get("tier_demote_errors", 0))
+        out["tier_host_blocks"] = int(
+            prefix.get("tier_host_blocks", 0))
+        out["tier_host_bytes"] = int(prefix.get("tier_host_bytes", 0))
+        out["tier_disk_blocks"] = int(
+            prefix.get("tier_disk_blocks", 0))
+        out["tier_disk_bytes"] = int(prefix.get("tier_disk_bytes", 0))
+        out["peer_exports_total"] = int(stats.get("peer_exports", 0))
+        # batched prefill export (ISSUE 13 satellite): lock
+        # acquisitions amortized over export bursts
+        out["prefill_export_batches_total"] = int(
+            stats.get("prefill_export_batches", 0))
+        out["prefill_export_max_batch"] = int(
+            stats.get("prefill_export_max_batch", 0))
         chunks = int(stats.get("chunks", 0) or 0)
         if chunks:
             out["paged_decode_frac"] = round(
@@ -477,6 +508,8 @@ def make_handler(service: GenerationService, profiler=None,
                 return self._profile(query)
             if path == "/prefill":
                 return self._prefill()
+            if path == "/export_pages":
+                return self._export_pages()
             if path == "/admit_pages":
                 return self._admit_pages()
             if path != "/generate":
@@ -600,6 +633,50 @@ def make_handler(service: GenerationService, profiler=None,
             finally:
                 if tracer is not None:
                     tracer.add(rid, "prefill_http", t0,
+                               time.monotonic())
+                self._rid = None
+
+        def _export_pages(self) -> None:
+            """``POST /export_pages`` (peer page migration, ISSUE
+            13): ship whatever full-block chain THIS replica already
+            holds for the prompt — resident pages plus checksum-
+            verified spilled pages — WITHOUT computing anything
+            (contrast ``/prefill``, which computes missing blocks).
+            The fleet manager's miss-driven pulls and restart re-warm
+            consume it; a replica holding nothing answers
+            ``X-Ship-Blocks: 0`` and the puller falls back cold. Any
+            role with a pool serves it."""
+            if not hasattr(service, "export_cached_pages"):
+                return self._send(503, {
+                    "error": "scheduler has no page export"})
+            rid = (sanitize_request_id(self.headers.get("X-Request-Id"))
+                   or mint_request_id())
+            self._rid = rid
+            t0 = time.monotonic()
+            try:
+                n = int(self.headers.get("Content-Length", 0))
+                req = json.loads(self.rfile.read(n) or b"{}")
+                payload = service.export_cached_pages(
+                    prompt=req.get("prompt"),
+                    prompt_ids=req.get("prompt_ids"), request_id=rid)
+                body = serialize_pages(payload)
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 "application/octet-stream")
+                self.send_header("Content-Length", str(len(body)))
+                self.send_header("X-Request-Id", rid)
+                self.send_header("X-Ship-Blocks",
+                                 str(int(payload["n_blocks"])))
+                self.end_headers()
+                self.wfile.write(body)
+            except ValueError as e:
+                self._send(400, {"error": str(e), "request_id": rid})
+            except Exception as e:  # surface, don't kill the server
+                self._send(500, {"error": f"{type(e).__name__}: {e}",
+                                 "request_id": rid})
+            finally:
+                if tracer is not None:
+                    tracer.add(rid, "export_http", t0,
                                time.monotonic())
                 self._rid = None
 
@@ -851,6 +928,14 @@ def main(args, config):
         prefix_cfg["enabled"] = True
     elif args.prefix_cache == "off":
         prefix_cfg["enabled"] = False
+    # tiered spill hierarchy (ISSUE 13): CLI wins over the config
+    # block; 0 / empty keeps destroy-on-evict
+    if args.spill_blocks > 0:
+        prefix_cfg["host_spill_blocks"] = args.spill_blocks
+    if args.spill_dir:
+        prefix_cfg["disk_spill_dir"] = args.spill_dir
+        if args.spill_disk_blocks > 0:
+            prefix_cfg["disk_spill_blocks"] = args.spill_disk_blocks
     if args.role != "both" and not prefix_cfg.get("enabled"):
         # role-split serving IS page shipping: refuse the geometry in
         # milliseconds instead of deep in service construction
@@ -1094,6 +1179,23 @@ if __name__ == "__main__":
                              "(system / few-shot preambles) admit as "
                              "an HBM block copy + suffix-only prefill "
                              "instead of a full recompute")
+    parser.add_argument("--spill-blocks", default=0, type=int,
+                        help="host-RAM KV spill tier size in blocks "
+                             "(ISSUE 13): eviction DEMOTES page bytes "
+                             "(sha256-checksummed) instead of "
+                             "destroying them, and a radix hit on a "
+                             "spilled chain promotes it back. 0 (or "
+                             "no serving.prefix_cache."
+                             "host_spill_blocks) keeps classic "
+                             "destroy-on-evict")
+    parser.add_argument("--spill-dir", default="", type=str,
+                        help="disk KV spill tier directory: host-tier "
+                             "overflow demotes here instead of being "
+                             "dropped (checksums verified on every "
+                             "read); empty disables the disk tier")
+    parser.add_argument("--spill-disk-blocks", default=256, type=int,
+                        help="disk spill tier size in blocks "
+                             "(with --spill-dir)")
     parser.add_argument("--reqtrace", default="on",
                         choices=("on", "off"),
                         help="request-scoped span tracing "
