@@ -3315,6 +3315,14 @@ def bench_serve_fleet(replicas: int = 3, n_requests: int = 24,
       sub-latency (1 ms) so ``slo_breach_total`` provably counts on
       the router — the merged Perfetto trace + attribution land in
       ``artifacts/fleet_{trace,stitch}_latest.json``.
+    - **measurement substrate (ISSUE 14)**: ``GET /dashboard`` must
+      answer well-formed HTML MID-TRAFFIC; the router's goodput
+      ledger must hold ``goodput <= served <= raw`` with served > 0;
+      the poller's ``timeseries.jsonl`` must carry points; and the
+      stitched spans must export a ``service_model.json`` whose
+      segments cover >= 0.9 of stitched wall time, self-drift-clean
+      at tolerance 0 while a perturbed copy is rejected
+      (``artifacts/service_model_latest.json`` is the CI handle).
 
     CPU children like chaos/warm_start (the parent may hold the
     accelerator lock; routing mechanics are platform-independent).
@@ -3460,8 +3468,23 @@ def bench_serve_fleet(replicas: int = 3, n_requests: int = 24,
                     f"{ca['hit_rate']} vs round_robin "
                     f"{rr['hit_rate']} (x{uplift:.2f} < 1.5)")
 
+            def check_dashboard() -> bool:
+                """GET /dashboard must answer 200 with a parseable
+                HTML document (ISSUE 14 — the obs-smoke contract:
+                reachable mid-traffic, not just on an idle router)."""
+                resp = urllib.request.urlopen(url + "/dashboard",
+                                              timeout=15)
+                doc = resp.read().decode("utf-8")
+                if resp.status != 200 or "<html" not in doc \
+                        or "Replicas" not in doc:
+                    raise RuntimeError(
+                        f"dashboard malformed (status "
+                        f"{resp.status}): {doc[:400]}")
+                return True
+
             recovery_s = None
             kill_errors = 0
+            dashboard_ok = False
             if kill:
                 # kill r1 mid-trace: ONLY its in-flight may fail
                 trace = loadgen.build_trace(
@@ -3475,6 +3498,9 @@ def bench_serve_fleet(replicas: int = 3, n_requests: int = 24,
                     target=lambda: out.update(loadgen.replay(
                         url, trace, timeout_s=300)))
                 th.start()
+                # mid-traffic dashboard probe (ISSUE 14): the replay
+                # is live on other threads right now
+                dashboard_ok = check_dashboard()
                 time.sleep(trace[-1]["t"] * 0.3)
                 req = urllib.request.Request(
                     url + "/admin/kill?replica=r1", data=b"",
@@ -3513,12 +3539,33 @@ def bench_serve_fleet(replicas: int = 3, n_requests: int = 24,
                     raise RuntimeError(
                         f"post-recovery probe failed: {probe}")
 
+            if not dashboard_ok:      # kill=False fallback arm
+                dashboard_ok = check_dashboard()
+
             # SLO plumbing check (ISSUE 8): the 1 ms threshold is
             # sub-latency by construction, so a zero counter here
             # means the breach path is broken, not that the fleet is
             # fast — scraped while the router is still alive
-            slo_breaches = int(get_json(
-                url, "/metrics?format=json").get("slo_breach_total", 0))
+            router_metrics = get_json(url, "/metrics?format=json")
+            slo_breaches = int(router_metrics.get(
+                "slo_breach_total", 0))
+            # goodput ledger check (ISSUE 14): raw >= served > 0 and
+            # goodput <= served by construction — gated here so the
+            # counters provably count. (The rung's 1 ms SLO is
+            # deliberately absurd, so the SLO-compliant tier reads ~0;
+            # SERVED is the threshold-free tier that must be nonzero.)
+            raw_tokens = int(router_metrics.get(
+                "raw_tokens_total", 0))
+            served_tokens = int(router_metrics.get(
+                "served_tokens_total", 0))
+            goodput_tokens = int(router_metrics.get(
+                "goodput_tokens_total", 0))
+            if not (raw_tokens >= served_tokens > 0
+                    and goodput_tokens <= served_tokens):
+                raise RuntimeError(
+                    f"goodput ledger violated: raw={raw_tokens} "
+                    f"served={served_tokens} "
+                    f"goodput={goodput_tokens}")
 
             # drain contract: SIGTERM -> rc 0, preemption-path exits,
             # no orphans
@@ -3569,6 +3616,57 @@ def bench_serve_fleet(replicas: int = 3, n_requests: int = 24,
                 raise RuntimeError(
                     "slo_breach_total stayed 0 under a 1 ms e2e "
                     "threshold — the SLO path is broken")
+
+            # service-time model export (ISSUE 14 tentpole): the
+            # versioned per-(segment x route class) distribution file
+            # the simulator consumes. Gates: per-segment coverage of
+            # stitched wall time >= 0.9, drift self-compare clean at
+            # tolerance 0, a perturbed copy REJECTED — the
+            # distribution-level regression gate provably cuts both
+            # ways before CI relies on it.
+            from pytorch_distributed_template_tpu.observability import (
+                servicedist,
+            )
+            model = servicedist.build_service_model(
+                spans, client_e2e_by_rid=client_e2e)
+            model_cov = model["coverage"]["frac"] or 0.0
+            if model_cov < 0.9:
+                raise RuntimeError(
+                    f"service model coverage {model_cov} < 0.9 "
+                    f"(segments do not explain stitched wall time): "
+                    f"{model['counts']}")
+            if not model["segments"]:
+                raise RuntimeError("service model has no segments")
+            servicedist.write_service_model(
+                model, os.path.join(run_dir,
+                                    "service_model.json"))
+            # the poller's fleet timeline (ISSUE 14): the run must
+            # have left rate/gauge points behind, not just snapshots
+            from pytorch_distributed_template_tpu.observability.timeseries \
+                import load_timeseries
+            ts_points = len(load_timeseries(
+                os.path.join(run_dir, "timeseries.jsonl")))
+            if ts_points <= 0:
+                raise RuntimeError(
+                    "fleet timeseries.jsonl is empty — the poller "
+                    "never fed the timeline store")
+
+            drift = servicedist.drift_report(model, model,
+                                             tolerance=0.0)
+            if drift["shifts"]:
+                raise RuntimeError(
+                    f"service-model self-drift not clean at "
+                    f"tolerance 0: {drift['shifts']}")
+            import copy as copy_mod
+            perturbed = copy_mod.deepcopy(model)
+            seg0 = next(iter(perturbed["segments"].values()))
+            seg0["p99_s"] = round(seg0["p99_s"] * 3.0 + 1.0, 6)
+            if not servicedist.drift_report(
+                    perturbed, model, tolerance=0.25)["shifts"]:
+                raise RuntimeError(
+                    "drift gate failed to reject a 3x-perturbed "
+                    "service model")
+
             try:    # the merged trace + attribution, for humans/CI
                 os.makedirs("artifacts", exist_ok=True)
                 with open("artifacts/fleet_trace_latest.json",
@@ -3579,6 +3677,8 @@ def bench_serve_fleet(replicas: int = 3, n_requests: int = 24,
                     json.dump({"counts": stitch["counts"],
                                "attribution": att}, f, indent=2,
                               default=repr)
+                servicedist.write_service_model(
+                    model, "artifacts/service_model_latest.json")
             except OSError:
                 pass
         finally:
@@ -3604,6 +3704,16 @@ def bench_serve_fleet(replicas: int = 3, n_requests: int = 24,
         "trace_coverage_p50": round(cov_p50, 4),
         "trace_residual_p99_s": att.get("residual_p99_s"),
         "slo_breach_total": slo_breaches,
+        # ISSUE 14: measurement substrate — the obs-smoke CI
+        # contract fields (all hard-gated in-rung above)
+        "service_model_coverage": round(model_cov, 4),
+        "service_model_segments": len(model["segments"]),
+        "fleet_timeline_points": ts_points,
+        "raw_tokens_total": raw_tokens,
+        "served_tokens_total": served_tokens,
+        "goodput_tok_s": router_metrics.get("goodput_tok_s"),
+        "slo_compliant_tok_s": ca.get("slo_compliant_tok_s"),
+        "dashboard_ok": dashboard_ok,
         "platform": platform,
     }
 
@@ -4242,6 +4352,103 @@ def bench_quick_reqtrace(steps: int = 30, batch: int = 8,
     return out
 
 
+def bench_quick_timeseries(steps: int = 30, batch: int = 8,
+                           seq: int = 128) -> dict:
+    """Time-series recorder overhead rung (ISSUE 14 satellite: the
+    scrape/record cost must stay < 2%): the quick rung's TinyLM step
+    loop with and without a live observability/timeseries
+    .TimeSeriesStore absorbing ONE fleet-scrape-shaped observation
+    per step — six counters delta'd through reset correction plus
+    four gauges, against a real line-buffered ``timeseries.jsonl``
+    (interval boundaries emit points mid-run). That is strictly MORE
+    store traffic per unit work than production (the poller observes
+    once per second, the scheduler once per multi-step chunk), so the
+    estimate upper-bounds the serving-path cost.
+
+    Estimator + gate: the quick_reqtrace discipline verbatim — one
+    settling window, paired alternating-order windows, geometric-mean
+    ratio, and BOTH the gmean and the median pair must cross 2% to
+    fail (one noisy window on a shared host must not fail the
+    build)."""
+    import tempfile
+
+    from pytorch_distributed_template_tpu.observability.telemetry import (
+        FlightRecorder,
+    )
+    from pytorch_distributed_template_tpu.observability.timeseries import (
+        TimeSeriesStore,
+    )
+
+    state, step_fn, batch_arrays = _tiny_lm_step(seq=seq, batch=batch)
+    state, m = step_fn(state, batch_arrays)   # compile + warm
+    float(m["loss_sum"])
+    tmp = tempfile.mkdtemp(prefix="bench-timeseries-")
+    store = TimeSeriesStore(os.path.join(tmp, "timeseries.jsonl"),
+                            interval_s=0.25, process="bench")
+    win = max(steps // 3, 5)
+    n = [0]
+
+    def recorded_step(s, b):
+        out = step_fn(s, b)
+        n[0] += 1
+        store.observe(
+            counters={"tokens_generated_total": n[0] * 17,
+                      "admissions_total": n[0],
+                      "chunks_total": n[0],
+                      "completed_total": n[0] // 2,
+                      "cancelled_total": 0,
+                      "prefix_hit_tokens_total": n[0] * 5},
+            gauges={"queue_depth": n[0] % 7, "live_slots": 4,
+                    "brownout_level": 0,
+                    "prefix_pool_blocks_used": 100 + n[0] % 11})
+        return out
+
+    holder = {"state": state}
+
+    def run(fn):
+        rec = FlightRecorder(run_dir=None, capacity=win + 8,
+                             memory_every=0)
+        holder["state"], a = _recorder_timed_loop(
+            holder["state"], fn, batch_arrays, rec, win, batch, seq)
+        return a["steps_per_sec"]
+
+    run(step_fn)                  # unmeasured settling window
+    pair_logs = []
+    n_pairs = 6
+    for r in range(n_pairs):
+        if r % 2 == 0:
+            p = run(step_fn)
+            t = run(recorded_step)
+        else:
+            t = run(recorded_step)
+            p = run(step_fn)
+        pair_logs.append(math.log(p / t))
+
+    overhead_pct = round(
+        100.0 * (math.exp(sum(pair_logs) / n_pairs) - 1.0), 2)
+    median_pct = round(
+        100.0 * (math.exp(sorted(pair_logs)[n_pairs // 2]) - 1.0), 2)
+    points = store.points_written
+    store.close()
+    out = {
+        "timeseries_overhead_pct": overhead_pct,
+        "timeseries_overhead_median_pct": median_pct,
+        "timeseries_points": points,
+        "pairs": n_pairs,
+        "window_steps": win,
+        "batch": batch,
+        "seq": seq,
+    }
+    if points <= 0:
+        raise RuntimeError(
+            f"timeseries store emitted no points under load: {out}")
+    if overhead_pct >= 2.0 and median_pct >= 2.0:
+        raise RuntimeError(
+            f"time-series recorder overhead {overhead_pct}% >= 2% "
+            f"(gate): {out}")
+    return out
+
+
 # Which fields make a rung's one-line headline (VERDICT r4 #1: the
 # driver keeps only the TAIL of stdout, and round 4's full ladder line
 # overflowed it — BENCH_r04.json arrived truncated with parsed=null, so
@@ -4255,6 +4462,8 @@ _SUMMARY_KEYS = {
     "quick_health": ("health_overhead_pct", "health_anomalies"),
     # the request-tracing overhead A/B (gated in-rung at < 2%)
     "quick_reqtrace": ("reqtrace_overhead_pct",),
+    # the time-series recorder overhead A/B (gated in-rung at < 2%)
+    "quick_timeseries": ("timeseries_overhead_pct",),
     # compile_speedup stays full-ladder-only: derivable from the pair
     "warm_start": ("cold_compile_s", "warm_compile_s",
                    "warm_new_compiles"),
@@ -4302,7 +4511,13 @@ _SUMMARY_KEYS = {
                     # ISSUE 8: cross-process stitch + SLO contract —
                     # CI asserts these from the final-line summary
                     "trace_stitched", "trace_coverage_p50",
-                    "slo_breach_total"),
+                    "slo_breach_total",
+                    # ISSUE 14: measurement-substrate contract — the
+                    # obs-smoke CI job asserts these
+                    "service_model_coverage",
+                    "service_model_segments", "goodput_tok_s",
+                    "served_tokens_total", "dashboard_ok",
+                    "fleet_timeline_points"),
     # disaggregated serving (ISSUE 12): the tail-latency gate pair
     # (colocated collapses >= 2x, disaggregated holds <= 1.25x), the
     # ship volume, the copy-bytes honesty value, and the DP×TP parity
@@ -4560,6 +4775,15 @@ _LADDER = [
     ("quick_reqtrace", [
         (bench_quick_reqtrace, {}),
         (bench_quick_reqtrace, {"steps": 15, "batch": 4, "seq": 64}),
+    ]),
+    # time-series recorder overhead A/B (ISSUE 14 acceptance < 2%):
+    # the store absorbs one scrape-shaped observation per step —
+    # strictly MORE feed traffic per unit work than the per-chunk
+    # serving path — under the same paired-window gmean discipline
+    ("quick_timeseries", [
+        (bench_quick_timeseries, {}),
+        (bench_quick_timeseries, {"steps": 15, "batch": 4,
+                                  "seq": 64}),
     ]),
     # persistent-compile-cache cold/warm pair: EARLY among the heavy
     # rungs (two short child processes) so even small --budget-s runs

@@ -468,12 +468,17 @@ class ContinuousBatchingService(GenerationService):
                chunk: int = 8, window_ms: float = 5.0,
                warm_buckets=None, prefix_cache=None, recorder=None,
                spec_draft_layers: int = 0, tracer=None, slo=None,
-               brownout=None, role: str = "both"):
+               brownout=None, role: str = "both", tsdb=None):
         super()._setup(model, params, tokenizer,
                        prefix_cache=prefix_cache,
                        spec_draft_layers=spec_draft_layers,
                        tracer=tracer, slo=slo, role=role)
         self._recorder = recorder
+        # fleet timeline store (ISSUE 14): each absorbed chunk feeds
+        # one observation — counters become interval rates, queue/slot
+        # occupancy sample as gauges (observability/timeseries.py);
+        # the quick_timeseries bench rung gates the per-chunk cost
+        self._tsdb = tsdb
         # pool_exhaust fault window: until this monotonic instant the
         # prefix pool reports dry (paged admissions defer, scatter
         # lookups miss) — 0 = no window active
@@ -1289,6 +1294,7 @@ class ContinuousBatchingService(GenerationService):
                                  bucket=self._bucket(len(r["ids"])))
                 self._tracer.add(
                     rid, "admit", t_admit0, t_admit1, mode="paged",
+                    bucket=self._bucket(len(r["ids"])),
                     feed=feed, group=n,
                     prefix_hit_tokens=plan["c"],
                     # the paged contract: warm admits are pointer
@@ -1521,6 +1527,30 @@ class ContinuousBatchingService(GenerationService):
                         tier_disk_bytes=snap["tier_disk_bytes"],
                     )
             self._recorder.record(self.stats["chunks"], **rec)
+        if self._tsdb is not None:
+            counters = {
+                "tokens_generated_total":
+                    self.stats.get("tokens_generated", 0),
+                "admissions_total": self.stats.get("admissions", 0),
+                "chunks_total": self.stats.get("chunks", 0),
+                "completed_total": self.stats.get("completed", 0),
+                "cancelled_total": self.stats.get("cancelled", 0),
+                "deadline_expired_total":
+                    self.stats.get("deadline_expired", 0),
+            }
+            gauges = {
+                "queue_depth": self._queue.qsize(),
+                "live_slots": sum(mm is not None
+                                  for mm in self._meta),
+                "brownout_level": self.brownout_level,
+            }
+            if self._prefix is not None:
+                snap = self._prefix.stats_snapshot()
+                counters["prefix_hit_tokens_total"] = snap[
+                    "prefix_hit_tokens"]
+                gauges["prefix_pool_blocks_used"] = snap[
+                    "prefix_pool_blocks_used"]
+            self._tsdb.observe(counters=counters, gauges=gauges)
 
     def _insert_prefixes(self, reqs, slots, ints, matches):
         """Put the admitted prompts' own full blocks back into the pool:

@@ -349,12 +349,21 @@ def replay(base_url: str, trace: List[dict], timeout_s: float = 120.0,
     return {"results": results, "wall_s": round(wall_s, 3)}
 
 
-def summarize(replayed: dict, trace: Optional[List[dict]] = None
-              ) -> dict:
+def summarize(replayed: dict, trace: Optional[List[dict]] = None,
+              slo_ttft_s: Optional[float] = None,
+              slo_e2e_s: Optional[float] = None) -> dict:
     """Fold a replay into the rung's numbers. TTFT/TPOT percentiles
     come from the streaming subset (the only honest first-token
     signal); aggregate tok/s counts every generated token over the
-    replay wall clock."""
+    replay wall clock.
+
+    **Goodput (ISSUE 14):** ``slo_compliant_tok_s`` counts only the
+    tokens of requests that completed normally — deadline-truncated,
+    cancelled, and errored tokens are EXCLUDED — and (when
+    ``slo_ttft_s``/``slo_e2e_s`` are given) also met the SLO; the
+    per-tenant ``compliance_frac`` is each tenant's share of its own
+    tokens that qualified. Percentile math stays on the one package
+    convention (utils/promtext.percentile) — no new implementations."""
     results = replayed["results"]
     wall_s = max(replayed["wall_s"], 1e-9)
     ttfts = sorted(r["ttft_s"] for r in results
@@ -372,15 +381,38 @@ def summarize(replayed: dict, trace: Optional[List[dict]] = None
     shed = sum(r["shed"] for r in results)
     errors = sum(1 for r in results if r["error"])
     tokens = sum(r["tokens"] for r in results)
+
+    def _compliant(r) -> bool:
+        # goodput classification: served normally (no error, no
+        # deliberate cancel, not deadline-truncated) AND inside the
+        # SLO thresholds when armed
+        if not r["ok"] or r["error"] or r["cancelled"] \
+                or r["deadline"]:
+            return False
+        if (slo_ttft_s is not None and r["ttft_s"] is not None
+                and r["ttft_s"] > slo_ttft_s):
+            return False
+        if (slo_e2e_s is not None and r["total_s"] is not None
+                and r["total_s"] > slo_e2e_s):
+            return False
+        return True
+
+    compliant_tokens = sum(r["tokens"] for r in results
+                           if _compliant(r))
     per_tenant: Dict[str, dict] = {}
     for r in results:
         t = per_tenant.setdefault(
             r["tenant"], {"requests": 0, "ok": 0, "shed": 0,
-                          "tokens": 0})
+                          "tokens": 0, "compliant_tokens": 0})
         t["requests"] += 1
         t["ok"] += int(r["ok"])
         t["shed"] += int(r["shed"])
         t["tokens"] += r["tokens"]
+        if _compliant(r):
+            t["compliant_tokens"] += r["tokens"]
+    for t in per_tenant.values():
+        t["compliance_frac"] = round(
+            t["compliant_tokens"] / max(t["tokens"], 1), 4)
     # terminal-outcome accounting (ISSUE 9): a request is STRANDED
     # when it never reached ANY classified outcome — no HTTP status,
     # no deliberate cancel (client-side timeouts and connect failures
@@ -412,6 +444,12 @@ def summarize(replayed: dict, trace: Optional[List[dict]] = None
         "error_rate": round(errors / n, 4) if n else 0.0,
         "tokens_out": tokens,
         "agg_tok_s": round(tokens / wall_s, 2),
+        # goodput (ISSUE 14): the useful-work rate — compliant tokens
+        # only, over the same wall clock as agg_tok_s (so goodput <=
+        # raw by construction)
+        "slo_compliant_tokens": compliant_tokens,
+        "slo_compliant_tok_s": round(compliant_tokens / wall_s, 2),
+        "goodput_frac": round(compliant_tokens / max(tokens, 1), 4),
         "wall_s": round(wall_s, 3),
         "ttft_p50_s": _percentile(ttfts, 0.5),
         "ttft_p99_s": _percentile(ttfts, 0.99),

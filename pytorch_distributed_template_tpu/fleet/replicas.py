@@ -275,7 +275,8 @@ class FleetManager:
                  peer_pull_min_tokens: int = 64,
                  peer_pull_timeout_s: float = 5.0,
                  rewarm: bool = False,
-                 rewarm_top_k: int = 8):
+                 rewarm_top_k: int = 8,
+                 tsdb=None, tsdb_extra_fn=None):
         self.replicas = {r.rid: r for r in replicas}
         self.policy = policy
         self.radix = FleetRadix(block_tokens=block_tokens,
@@ -359,6 +360,13 @@ class FleetManager:
         #: dispatch), histogram-bucketed so it aggregates across
         #: routers like every other fleet latency (ISSUE 8 discipline)
         self.handoff_hist = LatencyHistogram()
+        # fleet timeline store (ISSUE 14): the poller feeds one point
+        # per sweep — fleet counter rates + queue/health gauges —
+        # instead of discarding everything but the latest snapshot.
+        # ``tsdb_extra_fn`` lets the CLI merge router-side series
+        # (admission depths, goodput) the manager cannot see.
+        self.tsdb = tsdb
+        self.tsdb_extra_fn = tsdb_extra_fn
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -400,6 +408,13 @@ class FleetManager:
             1 for r in self.replicas.values()
             if r.thread is not None and r.thread.is_alive()))
         self.events.close()
+        if self.tsdb is not None:
+            # flush the partial interval so a short run's trend is on
+            # disk before the process exits
+            try:
+                self.tsdb.close()
+            except Exception:  # noqa: BLE001
+                pass
 
     # -- health polling -----------------------------------------------------
 
@@ -568,8 +583,34 @@ class FleetManager:
         self._polls += 1
         if self.snapshot_every and self._polls % self.snapshot_every == 0:
             self.events.log("snapshot", **self.snapshot_counters())
+        if self.tsdb is not None:
+            self._feed_tsdb()
         if capacity_changed and self.on_capacity_change is not None:
             self.on_capacity_change()
+
+    def _feed_tsdb(self) -> None:
+        """One time-series point per sweep (ISSUE 14): the fleet
+        counter aggregates become rates, the health/queue picture
+        becomes gauges, plus whatever router-side metrics the CLI's
+        ``tsdb_extra_fn`` contributes. Never raises — the poller's
+        health sweep must not die to a telemetry hiccup."""
+        try:
+            flat = dict(self.snapshot_counters())
+            # replica-reported queue depth is a gauge the aggregates
+            # miss (it lives in polled state, not the counter fold)
+            with self._lock:
+                flat["queue_depth"] = sum(
+                    float(r.polled.get("queue_depth", 0) or 0)
+                    for r in self.replicas.values()
+                    if r.state in (HEALTHY, DRAINING))
+            if self.tsdb_extra_fn is not None:
+                try:
+                    flat.update(self.tsdb_extra_fn() or {})
+                except Exception:  # noqa: BLE001
+                    pass
+            self.tsdb.observe_flat(flat)
+        except Exception:  # noqa: BLE001
+            pass
 
     # -- routing ------------------------------------------------------------
 

@@ -25,9 +25,13 @@ Request path (``POST /generate``):
 ``GET /healthz`` reports per-replica state (the bench and the drain
 tooling read it); ``GET /metrics`` exposes the router's own counters
 plus reset-corrected fleet aggregates of the replicas' counters
-(Prometheus text, ``?format=json`` for JSON). Flag-gated ``POST
-/admin/kill`` / ``/admin/drain`` drive chaos tests and rolling
-restarts. Stdlib-only, like everything in this package.
+(Prometheus text, ``?format=json`` for JSON) and the goodput ledger
+(raw vs served vs SLO-compliant tokens — ISSUE 14); ``GET
+/dashboard`` renders the self-contained operator page
+(fleet/dashboard.py: per-replica state, counter board, time-series
+sparklines, p99 attribution). Flag-gated ``POST /admin/kill`` /
+``/admin/drain`` drive chaos tests and rolling restarts. Stdlib-only,
+like everything in this package.
 """
 from __future__ import annotations
 
@@ -46,10 +50,12 @@ from ..observability.reqtrace import (
     DEADLINE_EXPIRED_HEADER, DEADLINE_HEADER, Deadline,
     mint_request_id, sanitize_request_id,
 )
+from ..observability.servicedist import GoodputMeter
 from ..resilience import faults
 from ..utils.promtext import LatencyHistogram, histogram_quantile
 from ..utils.promtext import prometheus_text  # noqa: F401 (re-export)
 from .admission import ADMITTED, FairAdmission
+from .dashboard import render_dashboard
 from .placement import POLICIES, affinity_ids
 from .replicas import FleetManager
 
@@ -73,6 +79,10 @@ class RouterStats:
         self._c = {f: 0 for f in self.FIELDS}
         self.ttft_hist = LatencyHistogram()
         self.e2e_hist = LatencyHistogram()
+        # fleet-wide goodput ledger (ISSUE 14): raw vs served vs
+        # SLO-compliant tokens — make_fleet_handler arms the SLO
+        # thresholds when a watcher is attached
+        self.goodput = GoodputMeter()
 
     def bump(self, field: str, n: int = 1) -> None:
         with self._lock:
@@ -149,6 +159,18 @@ class HedgePolicy:
         return fired_total + 1 <= self.frac * max(requests_total, 1)
 
 
+def _response_tokens(body) -> int:
+    """Generated-token count from a ``/generate`` response body (or
+    one SSE ``done`` event payload) — the goodput ledger's unit. A
+    body without an ``ids`` list (errors, sheds) counts 0."""
+    try:
+        data = json.loads(body)
+    except (ValueError, TypeError):
+        return 0
+    ids = data.get("ids") if isinstance(data, dict) else None
+    return len(ids) if isinstance(ids, list) else 0
+
+
 def fleet_brownout_level(manager: FleetManager,
                          admission: FairAdmission) -> int:
     """The fleet-wide brownout gauge (ISSUE 9): the worst replica's
@@ -207,6 +229,16 @@ def router_metrics(manager: FleetManager, admission: FairAdmission,
         out["prefill_admission_wait_seconds"] = padm["wait_seconds"]
         for k, v in prefill_admission.depths().items():
             out[f"prefill_{k}"] = v
+    # goodput accounting (ISSUE 14): raw vs served vs SLO-compliant
+    # token counters + lifetime rates; the nested per-tenant shares
+    # ride JSON-only like every other nested dict
+    goodput = getattr(stats, "goodput", None)
+    if goodput is not None:
+        gp = goodput.stats()
+        tenants = gp.pop("goodput_tenants", None)
+        out.update(gp)
+        if tenants:
+            out["goodput_tenants"] = tenants
     return out
 
 
@@ -217,9 +249,13 @@ def make_fleet_handler(manager: FleetManager, admission: FairAdmission,
                        read_timeout_s: float = 600.0,
                        tracer=None, slo=None, hedge=None,
                        prefill_admission=None,
-                       disagg_min_ids: int = 32):
+                       disagg_min_ids: int = 32, tsdb=None):
     stats = stats or RouterStats()
     hedge = hedge or HedgePolicy(enabled=False)
+    if slo is not None:
+        # goodput's SLO-compliant tier uses the SAME thresholds the
+        # breach counters do — one SLO definition fleet-wide
+        stats.goodput.set_slo(slo.ttft_s, slo.e2e_s)
     # 1-based ordinal of requests reaching the proxy stage: the req
     # unit of the router-side fault kinds (proxy_latency@req:N /
     # proxy_blackhole@req:N)
@@ -271,6 +307,22 @@ def make_fleet_handler(manager: FleetManager, admission: FairAdmission,
                     prometheus_text(metrics, prefix="pdt_fleet")
                     .encode("utf-8"),
                     "text/plain; version=0.0.4")
+            if path == "/dashboard":
+                # the operator page (ISSUE 14): rendered from data
+                # already in memory / on disk — never touches a
+                # replica, safe to refresh mid-incident
+                try:
+                    doc = render_dashboard(
+                        manager, admission, stats, slo=slo,
+                        tsdb=tsdb,
+                        run_dir=getattr(manager, "run_dir", None))
+                except Exception as e:  # noqa: BLE001 — the page
+                    # must degrade, not 500 the front door's handler
+                    return self._send(500, {
+                        "error": f"dashboard: {type(e).__name__}: "
+                                 f"{e}"})
+                return self._send_raw(200, doc.encode("utf-8"),
+                                      "text/html; charset=utf-8")
             if path != "/healthz":
                 return self._send(404, {"error": "unknown path"})
             payload = manager.snapshot()
@@ -354,6 +406,10 @@ def make_fleet_handler(manager: FleetManager, admission: FairAdmission,
                 except ValueError as e:
                     outcome = "bad_request"
                     return self._send(400, {"error": str(e)})
+                if deadline is not None:
+                    # the goodput ledger's deadline-feasible tier: a
+                    # SERVED deadline-carrying request met its budget
+                    holder["had_deadline"] = True
                 stream = bool(body.get("stream"))
                 if stream:
                     stats.bump("stream_requests_total")
@@ -440,6 +496,14 @@ def make_fleet_handler(manager: FleetManager, admission: FairAdmission,
                                     ttft_s=holder.get("ttft_s"),
                                     e2e_s=t_end - t_req,
                                     tenant=tenant, stream=stream)
+                # goodput: EVERY terminal outcome feeds the ledger —
+                # served tokens split from truncated/cancelled/error
+                # tokens happens inside the meter (ISSUE 14)
+                stats.goodput.observe(
+                    holder.get("tokens", 0), outcome=outcome,
+                    e2e_s=t_end - t_req,
+                    ttft_s=holder.get("ttft_s"), tenant=tenant,
+                    had_deadline=holder.get("had_deadline", False))
                 if tracer is not None:
                     tracer.add(rid, "request", t_req, t_end,
                                tenant=tenant, outcome=outcome,
@@ -742,7 +806,7 @@ def make_fleet_handler(manager: FleetManager, admission: FairAdmission,
                         > delay + hedge.margin_s):
                     return self._hedged_proxy(
                         ids, raw, policy, rid, tenant, deadline,
-                        blackhole, delay)
+                        blackhole, delay, holder)
             excluded: set = set()
             for attempt in range(2):
                 # role="decode" excludes only DEDICATED prefill
@@ -977,6 +1041,11 @@ def make_fleet_handler(manager: FleetManager, admission: FairAdmission,
                     self._send(502, {
                         "error": "replica failed mid-response"})
                     return "failed"
+                if resp.status == 200:
+                    # raw-token accounting for the goodput ledger
+                    # (deadline-truncated 200s count raw, the meter
+                    # keeps them out of goodput via the outcome)
+                    holder["tokens"] = _response_tokens(data)
                 self._send_raw(resp.status, data, ct)
                 # a replica-marked deadline response (200 + partial
                 # tokens, or its own 504) relays verbatim but is
@@ -1045,7 +1114,7 @@ def make_fleet_handler(manager: FleetManager, admission: FairAdmission,
 
         def _hedged_proxy(self, ids, raw: bytes, policy, rid: str,
                           tenant: str, deadline, blackhole,
-                          delay_s: float) -> str:
+                          delay_s: float, holder: dict) -> str:
             """Hedged dispatch for a non-streaming request: start the
             primary attempt, wait ``delay_s``; if it has not answered
             and the hedge budget + remaining deadline allow, fire the
@@ -1163,6 +1232,9 @@ def make_fleet_handler(manager: FleetManager, admission: FairAdmission,
                     cancel_losers(state)
                     if state["kind"] == "hedge":
                         stats.bump("hedge_won_total")
+                    if res.get("status") == 200:
+                        holder["tokens"] = _response_tokens(
+                            res["body"])
                     self._send_raw(res["status"], res["body"],
                                    res["ct"])
                     if res.get("deadline_marked"):
@@ -1230,6 +1302,7 @@ def make_fleet_handler(manager: FleetManager, admission: FairAdmission,
                 self.send_header("X-Request-Id", self._rid)
             self.end_headers()
             deadline_marked = False
+            done_payload = False   # the final SSE event reached the wire
             try:
                 while True:
                     if deadline is not None:
@@ -1283,15 +1356,36 @@ def make_fleet_handler(manager: FleetManager, admission: FairAdmission,
                     if (line.startswith(b"data:")
                             and b'"stop_reason": "deadline"' in line):
                         deadline_marked = True
+                    is_done_line = (line.startswith(b"data:")
+                                    and b'"done": true' in line)
                     if ("ttft_s" not in holder
                             and line.startswith(b"data:")):
                         ttft = time.monotonic() - holder["t0"]
                         holder["ttft_s"] = ttft
                         stats.ttft_hist.observe(ttft)
                     self.wfile.write(line)
+                    if is_done_line:
+                        # ONLY after the write returned: a client
+                        # that hung up before receiving the final
+                        # event never got its answer — the flag must
+                        # not classify that as served. The final
+                        # event carries the COMPLETE ids — the
+                        # stream's raw-token count for goodput.
+                        done_payload = True
+                        holder["tokens"] = _response_tokens(
+                            line.split(b"data:", 1)[1])
                     if line == b"\n":
                         self.wfile.flush()
             except (BrokenPipeError, ConnectionError, OSError):
+                if done_payload:
+                    # the final "done" event was already written: the
+                    # client got its complete answer and hung up in
+                    # the gap before the trailing separator / upstream
+                    # EOF — that is a SERVED stream, not a mid-flight
+                    # cancel (classifying it cancelled made the e2e
+                    # histogram undercount under load)
+                    return ("deadline" if deadline_marked
+                            else "done")
                 stats.bump("client_disconnects_total")
                 # closing the upstream socket (finally in _proxy) is
                 # the cancellation signal to the replica
@@ -1308,7 +1402,8 @@ def build_router(manager: FleetManager, admission: FairAdmission,
                  tracer=None, slo=None,
                  hedge: Optional[HedgePolicy] = None,
                  prefill_admission=None,
-                 disagg_min_ids: int = 32) -> ThreadingHTTPServer:
+                 disagg_min_ids: int = 32,
+                 tsdb=None) -> ThreadingHTTPServer:
     """Bind the front-door server (``port`` 0 picks a free one; the
     bound address is ``server.server_address``). ``tracer``/``slo``
     attach the request-scoped tracing + SLO layer
@@ -1322,5 +1417,5 @@ def build_router(manager: FleetManager, admission: FairAdmission,
         manager, admission, stats=stats, allow_admin=allow_admin,
         read_timeout_s=read_timeout_s, tracer=tracer, slo=slo,
         hedge=hedge, prefill_admission=prefill_admission,
-        disagg_min_ids=disagg_min_ids)
+        disagg_min_ids=disagg_min_ids, tsdb=tsdb)
     return ThreadingHTTPServer((host, port), handler)

@@ -4,7 +4,9 @@ from .logging import setup_logging
 from .reqtrace import (
     RequestTracer, SloWatcher, mint_request_id, sanitize_request_id,
 )
+from .servicedist import GoodputMeter, build_service_model
 from .tb import TensorboardWriter
+from .timeseries import TimeSeriesStore, load_timeseries
 from .telemetry import FlightRecorder, read_jsonl
 from .trace import SpanRecorder, get_recorder, span
 from .tracker import MetricTracker

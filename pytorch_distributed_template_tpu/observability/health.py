@@ -417,6 +417,19 @@ class HealthMonitor:
                 )
             except Exception:  # noqa: BLE001
                 pass
+        # the process's time-series window (ISSUE 14): the anomaly
+        # bundle carries the trailing trend, not just the offending
+        # instant — present only when a store is registered (the
+        # serving paths register one; bare training runs don't)
+        try:
+            from .timeseries import default_store
+
+            ts = default_store()
+            if ts is not None:
+                anomaly["timeseries_window"] = ts.points(
+                    last_n=self.dump_last_n)
+        except Exception:  # noqa: BLE001
+            pass
         self._write_dump(step, anomaly)
         # timeline note + tail fsync rate-limited by the SAME cooldown
         # as the dumps: a persistent NaN streak under skip_nonfinite

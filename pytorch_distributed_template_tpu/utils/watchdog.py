@@ -194,6 +194,19 @@ class StepWatchdog:
                 )
             except Exception:
                 pass
+        # the process's time-series window (ISSUE 14): a stall dump
+        # then carries the TREND into the incident (was the queue
+        # growing for a minute, or did the world stop cold?) — only
+        # when a store was registered (serving paths register one)
+        try:
+            from ..observability.timeseries import default_store
+
+            ts = default_store()
+            if ts is not None:
+                report["timeseries_window"] = ts.points(
+                    last_n=self.dump_last_n)
+        except Exception:
+            pass
         # memory probes: HBM high-water marks make OOM-adjacent stalls
         # (allocator thrashing, a leak crossing bytes_limit) diagnosable
         # post-mortem. Probes run on the monitor thread and never block

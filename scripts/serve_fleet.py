@@ -49,10 +49,13 @@ from pytorch_distributed_template_tpu.fleet.replicas import (  # noqa: E402
     FleetManager, Replica,
 )
 from pytorch_distributed_template_tpu.fleet.router import (  # noqa: E402
-    HedgePolicy, build_router,
+    HedgePolicy, RouterStats, build_router,
 )
 from pytorch_distributed_template_tpu.observability.reqtrace import (  # noqa: E402
     RequestTracer, SloWatcher,
+)
+from pytorch_distributed_template_tpu.observability.timeseries import (  # noqa: E402
+    TimeSeriesStore, set_default_store,
 )
 from pytorch_distributed_template_tpu.resilience import faults  # noqa: E402
 from pytorch_distributed_template_tpu.resilience.supervisor import (  # noqa: E402
@@ -225,6 +228,15 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--slo-e2e-s", type=float, default=0.0,
                    help="router-observed end-to-end SLO threshold "
                         "(0 = off)")
+    # fleet timeline store (ISSUE 14)
+    p.add_argument("--timeline", default="on", choices=("on", "off"),
+                   help="fleet time-series store: the poller folds "
+                        "each sweep's counters into rate points "
+                        "(<run-dir>/timeseries.jsonl), feeding the "
+                        "/dashboard sparklines and the autoscaling "
+                        "measurement substrate")
+    p.add_argument("--timeline-interval-s", type=float, default=0.0,
+                   help="time-series point width (0 = --poll-s)")
     return p
 
 
@@ -319,6 +331,34 @@ def main(argv=None) -> int:
                     max_delay_s=30.0, poll_s=0.2,
                     stable_runtime_s=120.0,
                     child_env=child_env)))
+    # fleet timeline store (ISSUE 14): one rate/gauge point per poll
+    # sweep into <run-dir>/timeseries.jsonl — the /dashboard
+    # sparklines and the autoscaling substrate read it. Registered as
+    # the process default so forensic dumps carry the trend window.
+    tsdb = None
+    stats = RouterStats()
+    if args.timeline != "off":
+        tsdb = TimeSeriesStore(
+            run_dir / "timeseries.jsonl",
+            interval_s=(args.timeline_interval_s
+                        or max(args.poll_s, 0.25)),
+            process="router")
+        set_default_store(tsdb)
+
+    def _tsdb_extra() -> dict:
+        # router-side series the manager cannot see: admission
+        # depths, shed counters, and the goodput ledger
+        flat = dict(stats.snapshot())
+        flat.update(admission.depths())
+        adm = admission.stats()
+        flat["admitted_total"] = adm["admitted"]
+        flat["shed_total"] = adm["shed_total"]
+        flat["brownout_shed_total"] = adm["brownout_shed_total"]
+        gp = stats.goodput.stats()
+        gp.pop("goodput_tenants", None)
+        flat.update(gp)
+        return flat
+
     manager = FleetManager(
         replicas, run_dir=run_dir, policy=args.policy,
         block_tokens=args.block_tokens,
@@ -333,7 +373,9 @@ def main(argv=None) -> int:
         peer_pull_min_tokens=args.peer_pull_min_tokens,
         peer_pull_timeout_s=args.peer_pull_timeout_s,
         rewarm=args.rewarm == "on",
-        rewarm_top_k=args.rewarm_top_k)
+        rewarm_top_k=args.rewarm_top_k,
+        tsdb=tsdb,
+        tsdb_extra_fn=(_tsdb_extra if tsdb is not None else None))
     # two-stage admission (ISSUE 12): the front door's gate caps the
     # DECODE stage and a second, clock-independent gate wraps only the
     # prefill hop of each handoff. Both capacity fns are ROLE-FILTERED
@@ -370,11 +412,13 @@ def main(argv=None) -> int:
                         frac=args.hedge_frac,
                         delay_ms=args.hedge_delay_ms)
     server = build_router(manager, admission, host=args.host,
-                          port=args.port, allow_admin=args.admin,
+                          port=args.port, stats=stats,
+                          allow_admin=args.admin,
                           read_timeout_s=args.read_timeout_s,
                           tracer=tracer, slo=slo, hedge=hedge,
                           prefill_admission=prefill_admission,
-                          disagg_min_ids=args.disagg_min_ids)
+                          disagg_min_ids=args.disagg_min_ids,
+                          tsdb=tsdb)
 
     draining = threading.Event()
 

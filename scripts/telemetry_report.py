@@ -40,6 +40,13 @@ gate fails (exit 1) when ``current < baseline * (1 - tolerance)``.
 Improvements and same-or-better runs pass; metrics missing from either
 side are reported and skipped. Exit codes: 0 ok, 1 regression, 2 usage
 or unreadable input.
+
+``--drift CURRENT BASELINE`` (ISSUE 14) is the DISTRIBUTION-level
+gate: two ``service_model.json`` files (observability/servicedist.py)
+compared per segment on p50/p99 with a relative
+``--drift-tolerance`` — exit 1 on any shift in EITHER direction, so a
+p99 regression in ``admit`` fails CI even when aggregate tok/s held.
+A model self-compares clean at tolerance 0.
 """
 from __future__ import annotations
 
@@ -438,6 +445,33 @@ def analyze_kvtier(records: list, fleet_path=None) -> dict:
     return out
 
 
+def analyze_timeseries(path, last_n: int = 600) -> dict:
+    """Fleet timeline section (ISSUE 14) from a ``timeseries.jsonl``
+    (observability/timeseries.py): per-series p50/p99/max over the
+    trailing window — the trend picture a single /metrics snapshot
+    cannot give. Empty when the file holds no points."""
+    from pytorch_distributed_template_tpu.observability.timeseries \
+        import load_timeseries
+    from pytorch_distributed_template_tpu.utils.promtext import (
+        percentile,
+    )
+
+    points = load_timeseries(path)[-last_n:]
+    if not points:
+        return {}
+    out: dict = {"points": len(points)}
+    names = sorted({k for p in points for k in p
+                    if k not in ("t", "span_s")})
+    for name in names:
+        vals = sorted(p[name] for p in points if name in p)
+        if not vals:
+            continue
+        out[f"{name}_p50"] = round(percentile(vals, 0.5), 4)
+        out[f"{name}_p99"] = round(percentile(vals, 0.99), 4)
+        out[f"{name}_max"] = round(vals[-1], 4)
+    return out
+
+
 def analyze_reqtrace(run_dir=None, span_files=None) -> dict:
     """Request-scoped tracing section (ISSUE 8): stitch every
     ``spans.jsonl`` under the run dir (router + replicas) into
@@ -572,8 +606,31 @@ def to_markdown(report: dict) -> str:
     table("Fleet (router)", report.get("fleet", {}))
     table("Disaggregation (serving)", report.get("disagg", {}))
     table("KV tiers (serving)", report.get("kvtier", {}))
+    table("Fleet timeline (time series)",
+          report.get("timeseries", {}))
     table("Request tracing (p99 attribution)",
           report.get("reqtrace", {}))
+    drift = report.get("drift") or {}
+    if drift:
+        lines.append("## Service-model drift gate")
+        lines.append("")
+        lines.append("| segment | quantile | current | baseline | "
+                     "rel shift | verdict |")
+        lines.append("|---|---|---|---|---|---|")
+        shifted = {(s.get("segment"), s.get("quantile"))
+                   for s in drift.get("shifts", [])}
+        for row in drift.get("compared", []):
+            verdict = ("**SHIFT**" if (row["segment"],
+                                       row["quantile"]) in shifted
+                       else "ok")
+            lines.append(
+                f"| {row['segment']} | {row['quantile']} | "
+                f"{row['current']} | {row['baseline']} | "
+                f"{row['rel_shift']} | {verdict} |")
+        for s in drift.get("shifts", []):
+            if s.get("kind") != "shift":
+                lines.append(f"- **SHIFT** ({s.get('kind')}): {s}")
+        lines.append("")
     tr = report.get("trace") or {}
     if tr.get("top_spans"):
         lines.append("## Host spans (top by total time)")
@@ -659,6 +716,19 @@ def main(argv=None) -> int:
                         "(0.1 = fail below 90%% of baseline)")
     p.add_argument("--metrics", type=str, default="steps/s,tokens/s",
                    help="comma-separated bench metrics to gate on")
+    p.add_argument("--drift", type=str, nargs=2, default=None,
+                   metavar=("CURRENT", "BASELINE"),
+                   help="distribution-level regression gate (ISSUE "
+                        "14): compare two service_model.json files "
+                        "per segment (p50/p99, both directions); "
+                        "exit 1 on any shift past --drift-tolerance")
+    p.add_argument("--drift-tolerance", type=float, default=0.25,
+                   help="allowed RELATIVE per-quantile shift between "
+                        "the two service models (0 = exact match "
+                        "required; a self-compare passes at 0)")
+    p.add_argument("--timeseries", type=str, default=None,
+                   help="explicit timeseries.jsonl path (--run-dir "
+                        "also auto-discovers one)")
     p.add_argument("--json", action="store_true",
                    help="emit the report as JSON instead of markdown")
     p.add_argument("--out", type=str, default=None,
@@ -706,6 +776,17 @@ def main(argv=None) -> int:
         kvtier = analyze_kvtier(records, fleet_path=fleet_path)
         if kvtier:
             report["kvtier"] = kvtier
+        ts_path = args.timeseries
+        if ts_path is None and run_dir is not None:
+            # a fleet run leaves one at the top (the poller's) and
+            # one per replica save dir — the top-level one is the
+            # fleet view; explicit --timeseries picks any other
+            cand = run_dir / "timeseries.jsonl"
+            ts_path = cand if cand.exists() else None
+        if ts_path is not None:
+            ts = analyze_timeseries(ts_path)
+            if ts:
+                report["timeseries"] = ts
         if args.spans or run_dir is not None:
             rt = analyze_reqtrace(run_dir=run_dir,
                                   span_files=args.spans)
@@ -720,13 +801,30 @@ def main(argv=None) -> int:
     except (OSError, ValueError) as e:
         print(f"telemetry_report: {e}", file=sys.stderr)
         return 2
-    if not report and args.compare is None:
+    if not report and args.compare is None and args.drift is None:
         p.print_usage(sys.stderr)
         print("telemetry_report: nothing to analyze (pass --run-dir, "
-              "--telemetry and/or --bench)", file=sys.stderr)
+              "--telemetry, --bench and/or --drift)", file=sys.stderr)
         return 2
 
     rc = 0
+    if args.drift is not None:
+        from pytorch_distributed_template_tpu.observability.servicedist \
+            import drift_report, load_service_model
+
+        try:
+            cur = load_service_model(args.drift[0])
+            base = load_service_model(args.drift[1])
+        except (OSError, ValueError) as e:
+            print(f"telemetry_report: --drift: {e}", file=sys.stderr)
+            return 2
+        result = drift_report(cur, base,
+                              tolerance=args.drift_tolerance)
+        report["drift"] = result
+        if result["shifts"]:
+            rc = 1
+            for s in result["shifts"]:
+                print(f"DRIFT: {json.dumps(s)}", file=sys.stderr)
     if args.compare is not None:
         if bench is None:
             print("telemetry_report: --compare requires --bench",

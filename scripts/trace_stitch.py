@@ -138,6 +138,14 @@ def main(argv=None) -> int:
     p.add_argument("--perfetto", default=None, metavar="OUT.json",
                    help="write the merged Chrome/Perfetto trace "
                         "(flow events link processes per request)")
+    p.add_argument("--service-model", default=None,
+                   metavar="OUT.json",
+                   help="export the versioned per-segment "
+                        "service-time model (ISSUE 14, "
+                        "observability/servicedist.py) — log-spaced "
+                        "histograms + quantiles per (segment x route "
+                        "class), the simulator's input contract; "
+                        "telemetry_report --drift gates two of these")
     p.add_argument("--json", action="store_true",
                    help="emit the stitch report as JSON (default: "
                         "markdown tables)")
@@ -181,6 +189,25 @@ def main(argv=None) -> int:
         except OSError as e:
             print(f"trace_stitch: --perfetto: {e}", file=sys.stderr)
             return 2
+
+    if args.service_model:
+        from pytorch_distributed_template_tpu.observability import (
+            servicedist,
+        )
+
+        model = servicedist.build_service_model(
+            spans, client_e2e_by_rid=client)
+        try:
+            servicedist.write_service_model(model,
+                                            args.service_model)
+        except OSError as e:
+            print(f"trace_stitch: --service-model: {e}",
+                  file=sys.stderr)
+            return 2
+        print(f"service model: {len(model['segments'])} segment(s), "
+              f"coverage {model['coverage']['frac']} over "
+              f"{model['counts']['modeled']} request(s) -> "
+              f"{args.service_model}", file=sys.stderr)
 
     rendered = (json.dumps(report, indent=2) if args.json
                 else to_markdown(report))

@@ -130,6 +130,9 @@ from pytorch_distributed_template_tpu.observability.reqtrace import (  # noqa: E
 from pytorch_distributed_template_tpu.observability.telemetry import (  # noqa: E402
     compile_cache_stats,
 )
+from pytorch_distributed_template_tpu.observability.timeseries import (  # noqa: E402
+    TimeSeriesStore, set_default_store,
+)
 from pytorch_distributed_template_tpu.resilience.supervisor import (  # noqa: E402
     ENV_EVENTS, EXIT_PREEMPTED, read_supervisor_stats,
 )
@@ -904,6 +907,7 @@ def main(args, config):
     # engine groups in THIS process (engine/dp.py); validated before
     # any load so a geometry typo fails in milliseconds
     dp = max(int(args.dp), 1)
+    tsdb = None      # set by the schedulers that feed one (below)
     if dp > 1:
         from pytorch_distributed_template_tpu.parallel.tp import (
             validate_dp_geometry,
@@ -1005,6 +1009,11 @@ def main(args, config):
 
         recorder = FlightRecorder(run_dir=str(config.save_dir),
                                   memory_every=0)
+        # fleet timeline store (ISSUE 14): group 0 alone feeds it,
+        # same single-writer contract as the recorder's JSONL
+        tsdb = TimeSeriesStore(config.save_dir / "timeseries.jsonl",
+                               process="serve")
+        set_default_store(tsdb)
         service = DataParallelService.build_from_config(
             config, ContinuousBatchingService, use_ema=args.ema,
             dp=dp, tp=max(int(args.tp), 1),
@@ -1014,7 +1023,8 @@ def main(args, config):
                 warm_buckets=warm_buckets, prefix_cache=prefix_cfg,
                 spec_draft_layers=spec_draft_layers, tracer=tracer,
                 slo=slo, brownout=brownout_cfg, role=args.role),
-            service_kw_fn=lambda g: ({"recorder": recorder}
+            service_kw_fn=lambda g: ({"recorder": recorder,
+                                      "tsdb": tsdb}
                                      if g == 0 else {}),
         )
     elif want == "continuous":
@@ -1030,13 +1040,20 @@ def main(args, config):
 
         recorder = FlightRecorder(run_dir=str(config.save_dir),
                                   memory_every=0)
+        # fleet timeline store (ISSUE 14): per-chunk counters fold
+        # into fixed-interval rate points in timeseries.jsonl; also
+        # the process default, so watchdog/anomaly dumps carry the
+        # trend window
+        tsdb = TimeSeriesStore(config.save_dir / "timeseries.jsonl",
+                               process="serve")
+        set_default_store(tsdb)
         service = ContinuousBatchingService.from_model(
             model, params, tok, slots=args.max_batch,
             chunk=args.decode_chunk, window_ms=args.batch_window_ms,
             warm_buckets=warm_buckets, prefix_cache=prefix_cfg,
             recorder=recorder, spec_draft_layers=spec_draft_layers,
             tracer=tracer, slo=slo, brownout=brownout_cfg,
-            role=args.role,
+            role=args.role, tsdb=tsdb,
         )
     elif want == "static":
         # the static micro-batch scheduler's shared-group prefill does
@@ -1107,9 +1124,15 @@ def main(args, config):
         while active.count and time.monotonic() < deadline:
             time.sleep(0.05)
         server.server_close()
+        if tsdb is not None:
+            # emit the open interval before exit: a short-lived
+            # replica's trend must not evaporate with the drain
+            tsdb.close()
         logger.info("drained (%d request(s) still open); exiting via "
                     "the preemption path", active.count)
         sys.exit(EXIT_PREEMPTED)
+    if tsdb is not None:
+        tsdb.close()      # Ctrl-C / embedded exit path, same contract
 
 
 if __name__ == "__main__":
