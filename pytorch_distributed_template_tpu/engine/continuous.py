@@ -165,7 +165,8 @@ def _admit_fn(model, bucket: int, k: int, n_stop: int):
 
 @functools.lru_cache(maxsize=64)
 def _warm_admit_fn(model, feed: int, k: int, n_stop: int, nb: int,
-                   block: int, rotary: bool, rope_base: float):
+                   block: int, rotary: bool, rope_base: float,
+                   kv_quant: str = ""):
     """Prefix-cache-aware admission: ``_admit_fn`` with the paged KV
     pool spliced in (engine/kvcache.py). The fed token window is only
     ``feed`` wide — the group's largest UNCACHED suffix snapped to the
@@ -223,7 +224,7 @@ def _warm_admit_fn(model, feed: int, k: int, n_stop: int, nb: int,
         cache = constrain_kv_tree(cache, mesh)        # TP head shard
         cache = dict(scatter_blocks(
             dict(cache), pool, block_ids, pad_k, pos0, feed, block,
-            rotary=rotary, rope_base=rope_base))
+            rotary=rotary, rope_base=rope_base, kv_quant=kv_quant))
         cache["pos_index"] = pos0.astype(jnp.int32)
         logits, vs = model.apply(
             {"params": params, "cache": cache}, prompts,
@@ -468,7 +469,8 @@ class ContinuousBatchingService(GenerationService):
                chunk: int = 8, window_ms: float = 5.0,
                warm_buckets=None, prefix_cache=None, recorder=None,
                spec_draft_layers: int = 0, tracer=None, slo=None,
-               brownout=None, role: str = "both", tsdb=None):
+               brownout=None, role: str = "both", tsdb=None,
+               prefill_chunk_tokens: int = 0):
         super()._setup(model, params, tokenizer,
                        prefix_cache=prefix_cache,
                        spec_draft_layers=spec_draft_layers,
@@ -483,11 +485,18 @@ class ContinuousBatchingService(GenerationService):
         # prefix pool reports dry (paged admissions defer, scatter
         # lookups miss) — 0 = no window active
         self._pool_dry_until = 0.0
-        if not self._pad_ok:
+        # sliding-window models (ISSUE 15): the rolling contiguous
+        # cache disqualifies the scatter engine (_pad_ok is False),
+        # but the paged RING layout serves them — positions are
+        # row-local and pad masking is the paged path's own
+        ring_ok = (self._prefix is not None and self._prefix.paged
+                   and getattr(self._prefix, "window", 0) > 0)
+        if not self._pad_ok and not ring_ok:
             raise ValueError(
                 f"{type(model).__name__} is not pad-capable (RoPE "
                 "positions + non-rolling cache needed): use the static "
-                "BatchedGenerationService")
+                "BatchedGenerationService, or attach a paged prefix "
+                "cache for the sliding-window ring layout")
         import jax
 
         self._slots = int(slots)
@@ -501,6 +510,30 @@ class ContinuousBatchingService(GenerationService):
         # capture kernel. Unsupported layouts keep the round-5 scatter
         # fallback below, unchanged.
         self._paged = self._prefix is not None and self._prefix.paged
+        # chunked streaming prefill (ISSUE 15 tentpole): prompts whose
+        # uncached suffix exceeds this stream through fixed-size
+        # prefill chunks across scheduler ticks instead of minting one
+        # giant admit-bucket executable that stalls the decode batch.
+        # Power-of-two so bucketed feeds stay inside the warmed
+        # ladder; MANDATORY (and capped at the ring slack) for window
+        # models, whose single-dispatch feeds are bounded by the ring
+        # geometry contract.
+        chunk_tok = int(prefill_chunk_tokens or 0)
+        if chunk_tok and (chunk_tok & (chunk_tok - 1)):
+            raise ValueError(
+                f"serving.prefill_chunk_tokens={chunk_tok} must be a "
+                "power of two (admission feeds snap to the bucket "
+                "ladder)")
+        if self._paged and getattr(self._prefix, "window", 0) > 0:
+            cap = int(self._prefix.ring_slack_tokens)
+            chunk_tok = min(chunk_tok or cap, cap)
+        elif chunk_tok and not self._paged:
+            logger.warning(
+                "prefill_chunk_tokens=%d ignored: chunked streaming "
+                "prefill needs the paged pool (scatter/no-pool serves "
+                "monolithically)", chunk_tok)
+            chunk_tok = 0
+        self._prefill_chunk = chunk_tok
         self._tables = None          # [slots, nb_max] device block table
         self._starts = None          # [slots] row-local next-fed position
         # host-side key derivation: the default threefry impl's key
@@ -550,7 +583,12 @@ class ContinuousBatchingService(GenerationService):
                       "brownout_clamped": 0,
                       # disaggregated serving (ISSUE 12): pages shipped
                       # in from prefill-role replicas / exports served
-                      "remote_admits": 0, "prefill_exports": 0}
+                      "remote_admits": 0, "prefill_exports": 0,
+                      # chunked streaming prefill (ISSUE 15): chunks
+                      # dispatched, prompt tokens streamed through
+                      # them, and requests that streamed at all
+                      "prefill_chunks": 0, "streamed_prefill_tokens": 0,
+                      "streamed_requests": 0}
         self._warm_chunk_ladder()
         if self.tp > 1:
             # precompute the per-step collective accounting with the
@@ -868,7 +906,8 @@ class ContinuousBatchingService(GenerationService):
                 nb = self._prefix.nb_max
                 cache, arrays, _ = _warm_admit_fn(
                     self.model, bucket, k, W, nb, self._prefix.block,
-                    self._prefix.rotary, self._prefix.rope_base)(
+                    self._prefix.rotary, self._prefix.rope_base,
+                    self._prefix.kv_quant)(
                     self.params, cache, arrays,
                     jnp.zeros((k, bucket), jnp.int32),
                     jnp.asarray(ints), jnp.zeros((k, 2), jnp.float32),
@@ -990,6 +1029,17 @@ class ContinuousBatchingService(GenerationService):
         if max_new < 1:
             raise ValueError("max_new_tokens must be >= 1")
         max_len = int(self.model.max_len)
+        if getattr(self, "_paged", False):
+            # paged admissions are position-free (row-local positions,
+            # pages reserved up front): the raw prompt length is the
+            # budget constraint, NOT its admission bucket — a long
+            # prompt admits through chunked streaming prefill without
+            # rounding itself out of the model (ISSUE 15)
+            if len(ids) + max_new > max_len:
+                raise ValueError(
+                    f"prompt ({len(ids)} tokens) + max_new_tokens "
+                    f"({max_new}) exceeds model.max_len {max_len}")
+            return
         if self._bucket(len(ids)) + max_new > max_len:
             raise ValueError(
                 f"prompt ({len(ids)} tokens, admission bucket "
@@ -1114,7 +1164,8 @@ class ContinuousBatchingService(GenerationService):
             try:
                 self._cache, self._arrays, tok0 = _warm_admit_fn(
                     self.model, feed, k, W, nb, self._prefix.block,
-                    self._prefix.rotary, self._prefix.rope_base)(
+                    self._prefix.rotary, self._prefix.rope_base,
+                    self._prefix.kv_quant)(
                     self.params, self._cache, self._arrays,
                     jnp.asarray(prompts), jnp.asarray(ints),
                     jnp.asarray(floats), keys_data, jnp.asarray(topks),
@@ -1196,6 +1247,101 @@ class ContinuousBatchingService(GenerationService):
         return self._prefix.paged_plan(r["ids"], r["budget"],
                                        record=first, promote=False)
 
+    def _needs_streaming(self, r) -> bool:
+        """True while a reserved request's remaining uncached suffix
+        is wider than one prefill chunk — it streams instead of
+        admitting (ISSUE 15)."""
+        plan = r.get("_pages")
+        if plan is None or not self._prefill_chunk:
+            return False
+        done = plan.get("done", plan["c"])
+        return len(r["ids"]) - done > self._prefill_chunk
+
+    def _stream_prefill_step(self, r) -> str:
+        """One chunk of streaming prefill for a pending long request
+        (ISSUE 15 tentpole). Returns ``"chunked"`` when a chunk
+        dispatched — the tick's single streaming slot is consumed, so
+        decode rows get the engine back between chunks and TPOT holds
+        flat under a long arrival — ``"deferred"`` when the pool
+        cannot supply the reservation (the caller STOPS walking
+        pending: reserving for a later request instead would starve
+        this one, the same FIFO contract as the admission loop; the
+        admission loop owns the deferred_admissions count), and
+        ``"skip"`` when the request needs no streaming.
+
+        The full page plan (shared prefix + private chain covering
+        prompt AND budget) reserves up front on first sight — a dry
+        pool defers the whole request, never a mid-stream chunk. Each
+        chunk feeds ``prefill_chunk`` prompt tokens through the SAME
+        batch-1 paged prefill executable (one shape for the stream's
+        lifetime — no giant admit buckets), writes straight into the
+        plan's private pages, and zero-copy ADOPTS the completed full
+        blocks into the radix — a same-document request arriving
+        mid-prefill warm-hits the chunks already landed. Runs before
+        the tick's cache refresh (the dispatch donates the pool the
+        engine cache aliases)."""
+        import jax.numpy as jnp
+
+        from .kvcache import _paged_prefill_fn
+
+        ids = r["ids"]
+        chunk = self._prefill_chunk
+        plan = r.get("_pages")
+        if plan is None:
+            if len(ids) <= chunk:
+                return "skip"
+            plan = self._reserve_pages(r)
+            if plan is None:
+                return "deferred"       # dry pool: retried next tick
+            r["_pages"] = plan
+            plan["done"] = plan["c"]
+            if len(ids) - plan["c"] > chunk:
+                self.stats["streamed_requests"] += 1
+        done = plan.get("done", plan["c"])
+        if len(ids) - done <= chunk:
+            return "skip"               # ready for normal admission
+        pf = self._prefix
+        t0 = time.monotonic()
+        row = np.full((1, pf.nb_max), -1, np.int32)
+        for i, b in enumerate(plan["blocks"]):
+            row[0, i] = b
+        for idx, bid in (plan.get("shared") or {}).items():
+            row[0, idx] = bid
+        for idx, bid in plan["private"].items():
+            row[0, idx] = bid
+        suffix = jnp.asarray(
+            np.asarray(ids[done:done + chunk], np.int32)[None, :])
+        _, cache = _paged_prefill_fn(self.model, chunk, pf.nb_max)(
+            self.params, pf.paged_cache(), suffix, jnp.asarray(row),
+            jnp.asarray([done], jnp.int32))
+        pf.sync_pool_from_cache(cache)
+        plan["done"] = done + chunk
+        self.stats["prefill_chunks"] += 1
+        self.stats["streamed_prefill_tokens"] += chunk
+        if not plan.get("ring_wrap"):
+            # mid-prefill sharing: completed full blocks adopt now,
+            # ref-pinned (this request keeps reading them); pinned
+            # nodes release with the plan at paged_finish. Adopted
+            # pages move private -> "shared" so the row's block table
+            # KEEPS pointing at them (they are the prompt's history —
+            # later chunks and the final admit read through them).
+            adopted, anodes = pf.adopt(
+                ids[:plan["done"]], dict(plan["private"]), acquire=True)
+            if adopted:
+                taken = set(adopted)
+                shared = dict(plan.get("shared") or {})
+                for idx in [i for i, b in plan["private"].items()
+                            if b in taken]:
+                    shared[idx] = plan["private"].pop(idx)
+                plan["shared"] = shared
+                plan["adopt_nodes"] = (
+                    list(plan.get("adopt_nodes") or []) + anodes)
+        if self._tracer is not None and r.get("rid"):
+            self._tracer.add(
+                r["rid"], "prefill_chunk", t0, time.monotonic(),
+                tokens=chunk, done=plan["done"], total=len(ids))
+        return "chunked"
+
     def _admit_group_paged(self, reqs: list, slots: list):
         """Paged admission: ONE dispatch writes the group's block
         tables (the whole warm-prefix "copy" — a pointer update),
@@ -1220,8 +1366,13 @@ class ContinuousBatchingService(GenerationService):
         nb = pf.nb_max
         pad_reqs = reqs + [reqs[-1]] * (k - n)
         pad_slots = list(slots) + [slots[-1]] * (k - n)
+        # "done" covers both the radix-cached prefix AND any chunks a
+        # streamed prefill already landed (ISSUE 15): the admit feeds
+        # only what remains, so a streamed long prompt admits through
+        # the same small-bucket executable as a short one
         feed = self._bucket(max(
-            len(r["ids"]) - r["_pages"]["c"] for r in reqs))
+            len(r["ids"]) - r["_pages"].get("done", r["_pages"]["c"])
+            for r in reqs))
         prompts = np.zeros((k, feed), np.int32)
         ints = np.zeros((k, 4 + W), np.int32)
         floats = np.zeros((k, 2), np.float32)
@@ -1229,9 +1380,12 @@ class ContinuousBatchingService(GenerationService):
         tables_k = np.full((k, nb), -1, np.int32)
         for j, r in enumerate(pad_reqs):
             plan = r["_pages"]
-            ids, c = plan["ids"], plan["c"]
-            s = len(ids) - c               # uncached suffix (>= 1: the
-            # radix lookup never serves the final prompt token)
+            ids = plan["ids"]
+            c = plan.get("done", plan["c"])
+            s = len(ids) - c               # unfed suffix (>= 1: the
+            # radix lookup never serves the final prompt token, and a
+            # streamed prefill always leaves the final chunk to the
+            # admit)
             prompts[j, feed - s:] = ids[c:]
             ints[j, 0] = pad_slots[j]
             ints[j, 1] = r["budget"]
@@ -1244,6 +1398,10 @@ class ContinuousBatchingService(GenerationService):
             topks[j] = r["top_k"]
             for i, b in enumerate(plan["blocks"]):
                 tables_k[j, i] = b
+            for idx, bid in (plan.get("shared") or {}).items():
+                # pages this request streamed and adopted mid-prefill
+                # (ISSUE 15): index-owned now, still its history
+                tables_k[j, idx] = bid
             for idx, bid in plan["private"].items():
                 tables_k[j, idx] = bid
         keys_data = jnp.asarray(
@@ -1256,10 +1414,13 @@ class ContinuousBatchingService(GenerationService):
                 jnp.asarray(floats), keys_data, jnp.asarray(topks),
                 jnp.asarray(tables_k))
         except Exception:
-            # a failed dispatch must not strand refs or leak pages
+            # a failed dispatch must not strand refs or leak pages —
+            # including the ref-pins a streamed prefill's per-chunk
+            # adoptions accumulated in adopt_nodes (ISSUE 15)
             for r in reqs:
                 plan = r.pop("_pages")
                 pf.release(plan["nodes"])
+                pf.release(plan.get("adopt_nodes") or [])
                 pf.free_blocks(list(plan["private"].values()))
             raise
         pf.sync_pool_from_cache(self._cache)
@@ -1267,14 +1428,24 @@ class ContinuousBatchingService(GenerationService):
             plan = r.pop("_pages")
             # zero-copy insert of the prompt's own full blocks: the
             # pages just written in place become sharable immediately
-            # (ref-pinned — this slot keeps reading them)
-            adopted, anodes = pf.adopt(
-                plan["ids"], dict(plan["private"]), acquire=True)
-            for bid in adopted:
-                for idx in [i for i, b in plan["private"].items()
-                            if b == bid]:
-                    del plan["private"][idx]
-            plan["adopt_nodes"] = anodes
+            # (ref-pinned — this slot keeps reading them). NEVER for a
+            # ring_wrap plan (ISSUE 15): its decode will RECYCLE these
+            # very slots, so adopting them would hand the radix pages
+            # whose content a later wrap overwrites under other
+            # readers — the same guard paged_finish and the streaming
+            # path apply.
+            if not plan.get("ring_wrap"):
+                adopted, anodes = pf.adopt(
+                    plan["ids"], dict(plan["private"]), acquire=True)
+                for bid in adopted:
+                    for idx in [i for i, b in plan["private"].items()
+                                if b == bid]:
+                        del plan["private"][idx]
+                # EXTEND, never overwrite: a streamed prefill already
+                # pinned its per-chunk adoptions here (ISSUE 15) —
+                # clobbering them leaks the pins forever
+                plan["adopt_nodes"] = (
+                    list(plan.get("adopt_nodes") or []) + anodes)
             self._meta[slot] = {
                 "req": r, "emitted": 1, "out": [],
                 "tok0_ref": (tok0, j),
@@ -1297,6 +1468,11 @@ class ContinuousBatchingService(GenerationService):
                     bucket=self._bucket(len(r["ids"])),
                     feed=feed, group=n,
                     prefix_hit_tokens=plan["c"],
+                    # streamed = prompt tokens landed by chunked
+                    # prefill before this admit (ISSUE 15) — honest
+                    # split from genuine radix hits
+                    streamed_tokens=(
+                        plan.get("done", plan["c"]) - plan["c"]),
                     # the paged contract: warm admits are pointer
                     # updates — copy bytes are zero by construction
                     copy_blocks=0,
@@ -1503,6 +1679,15 @@ class ContinuousBatchingService(GenerationService):
                         "warm_admit_copy_bytes"],
                     paged_decode_frac=round(
                         self.stats.get("paged_chunks", 0) / chunks, 4),
+                    # long-context serving (ISSUE 15): chunked-prefill
+                    # progress + the pool-fallback family for the
+                    # analyzer's prefix-cache section
+                    prefill_chunks_total=self.stats.get(
+                        "prefill_chunks", 0),
+                    streamed_prefill_tokens_total=self.stats.get(
+                        "streamed_prefill_tokens", 0),
+                    pool_fallback_total=snap.get(
+                        "pool_fallback_total", 0),
                 )
                 if snap.get("tier_enabled"):
                     # KV tier telemetry (ISSUE 13): cumulative demote/
@@ -1825,6 +2010,16 @@ class ContinuousBatchingService(GenerationService):
             expired = (not dead and dl is not None and dl.expired())
             if dead or expired:
                 pending.remove(r)
+                plan = r.pop("_pages", None)
+                if plan is not None:
+                    # a cancel/expiry BETWEEN streaming-prefill chunks
+                    # (ISSUE 15): the plan's remaining private pages
+                    # free through the existing paged bookkeeping;
+                    # chunks already adopted stay in the radix (valid
+                    # content — a same-prefix request still warm-hits
+                    # them) with their pins released here
+                    self._prefix.paged_finish(
+                        plan, [], 0, written=plan.get("done", 0))
                 resp = self._response([], stops=r["stop"], emitted=0)
                 resp["stop_reason"] = ("cancelled" if dead
                                        else "deadline")
@@ -1852,6 +2047,24 @@ class ContinuousBatchingService(GenerationService):
                     # scheduler_queue segment it overlaps)
                     self._tracer.add(r["rid"], "tier", t_tier0,
                                      time.monotonic(), blocks=n)
+        # chunked streaming prefill (ISSUE 15 tentpole): ONE chunk of
+        # ONE long pending prompt per tick — decode rows interleave
+        # between chunks, so a 32k arrival never stalls the decode
+        # batch for its whole prefill. Runs BEFORE the cache refresh
+        # below: the chunk dispatch donates the pool the engine cache
+        # aliases, and the refresh re-adopts the swapped leaves.
+        if (self._paged and self._prefill_chunk and pending
+                and not self._pool_dry()):
+            for r in pending:
+                if (len(r["ids"]) > self._prefill_chunk
+                        or r.get("_pages") is not None):
+                    verdict = self._stream_prefill_step(r)
+                    if verdict != "skip":
+                        # "chunked": this tick's streaming slot is
+                        # spent; "deferred": a dry pool must not
+                        # reserve for LATER requests over this one
+                        # (FIFO, same as the admission loop)
+                        break
         if self._paged and self._cache is not None:
             # a batch-1 speculative request between ticks (same lock)
             # may have reassigned the pool — its scatter insert's
@@ -1921,16 +2134,26 @@ class ContinuousBatchingService(GenerationService):
                 self.stats["brownout_clamped"] = (
                     self.stats.get("brownout_clamped", 0) + 1)
             if self._paged:
+                if self._needs_streaming(r):
+                    # still streaming its prompt in chunks (ISSUE 15):
+                    # not admissible yet, but LATER pending requests
+                    # may admit around it — that interleaving is the
+                    # whole point of chunked prefill
+                    continue
                 # position-free admission: reserve pool pages (shared
                 # prefix refs + a private chain for suffix AND budget).
                 # A dry pool DEFERS the request — completions free
                 # pages; FIFO order holds (we stop at the first
                 # un-reservable request instead of skipping it)
-                plan = self._reserve_pages(r)
+                plan = r.get("_pages") or self._reserve_pages(r)
                 if plan is None:
                     self.stats["deferred_admissions"] += 1
                     break
                 r["_pages"] = plan
+                if self._needs_streaming(r):
+                    # freshly reserved long prompt: its first chunk
+                    # streams next tick (or already streamed this one)
+                    continue
                 pending.remove(r)
                 slot = free.pop(0)
                 # this slot's admit dispatch (this tick) neutralizes

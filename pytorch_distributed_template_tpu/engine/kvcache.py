@@ -55,11 +55,35 @@ idea for THIS framework's cache layout:
   impact; the whole hierarchy is chaos-tested via the ``slow_spill`` /
   ``corrupt_spill`` / ``tier_exhaust`` fault kinds (resilience/faults).
 
-Scope: non-rolling caches only (``window == 0`` — ring eviction order
-is position-dependent) and full-precision KV (``kv_quant == ""`` —
-rotating through an int8 round-trip would add quantization error on
-every reuse). Models declare their layout via ``kv_cache_spec()``
-(models/llama.py, models/transformer.py).
+- **int8-KV pool layout** (ISSUE 15, ``kv_quant == "int8"``): pool
+  K/V leaves store int8 pages with f32 scale leaves alongside
+  (``[P, bt, KVH]``, one scale per token x kv-head — models/quant
+  ``quantize_kv``). The paged path quantizes at the model's page
+  write and dequantizes in the paged kernel's tile fetch
+  (ops/flash.py dequant epilogue) — half the KV bytes cross HBM on
+  decode, the binding constraint per BASELINE.md — and ship/spill/
+  export move the quantized bytes (halving wire and tier traffic for
+  free; the sha256 spill checksums cover the int8 bytes unchanged).
+  Capture de-rotates in f32 then re-quantizes; the scatter fallback
+  dequantizes on gather. Parity contract: quantized-vs-f32 agrees to
+  the documented int8 tolerance, while warm-vs-cold stays
+  token-identical ON THE PAGED PATH (hits replay the exact bytes the
+  writer attended to).
+- **Sliding-window ring layout** (ISSUE 15, ``window > 0``): per-row
+  block tables become RINGS — logical block ``j`` lives in table slot
+  ``j % nb_ring`` with ``nb_ring ≈ window/block_tokens + 1 + slack``
+  — so decode reads O(window) pages regardless of sequence length.
+  The +1 covers band/tile misalignment; the slack pages guarantee a
+  multi-token prefill feed (bounded by ``ring_slack_tokens``) never
+  clobbers in-band history before its own queries read it. Radix
+  caching applies only to requests that never wrap
+  (``prompt + budget <= nb_ring * block_tokens`` — the loud
+  documented cap); a wrapping request runs fully private and adopts
+  nothing. The scatter fallback still refuses ``window > 0`` (a
+  rolling contiguous cache's eviction order is position-dependent).
+
+Models declare their layout via ``kv_cache_spec()`` (models/llama.py,
+models/transformer.py).
 """
 from __future__ import annotations
 
@@ -95,11 +119,16 @@ def _path_str(path) -> str:
 
 
 def _leaf_kind(path_s: str, leaf) -> str | None:
-    """'key' / 'value' for poolable K/V cache leaves, None for
-    everything else (pos_index, slot_pos, int8 scales)."""
+    """'key' / 'value' for poolable K/V cache leaves, 'scale' for the
+    int8-KV layout's per-(token, head) scale leaves (ISSUE 15 — they
+    pool alongside the pages they rescale), None for everything else
+    (pos_index, slot_pos)."""
+    name = path_s.rsplit("/", 1)[-1]
+    if getattr(leaf, "ndim", 0) == 3 and name in (
+            "cached_key_scale", "cached_value_scale"):
+        return "scale"
     if getattr(leaf, "ndim", 0) != 4:
         return None
-    name = path_s.rsplit("/", 1)[-1]
     if name == "cached_key":
         return "key"
     if name == "cached_value":
@@ -127,7 +156,8 @@ def rotate_rows(x, deltas, rope_base: float):
 
 
 def scatter_blocks(cache, pool, block_ids, pads, pos0, feed: int,
-                   block: int, rotary: bool, rope_base: float):
+                   block: int, rotary: bool, rope_base: float,
+                   kv_quant: str = ""):
     """Scatter pool block chains into a (fresh) per-row cache pytree.
 
     ``cache``: the group cache (leaves ``[k, total, H, D]``).
@@ -138,6 +168,14 @@ def scatter_blocks(cache, pool, block_ids, pads, pos0, feed: int,
     redirected into ``[pos0, pos0 + feed)``, which the suffix prefill's
     own DUS writes overwrite at every layer before any read, so their
     garbage is dead by construction. Traced; shapes are static.
+
+    ``kv_quant == "int8"`` (ISSUE 15): the pool holds int8 pages +
+    ``*_scale`` leaves. V (and non-rotated K at delta 0) copies the
+    int8 bytes and scales STRAIGHT across — exact; rotated K
+    dequantizes on the gather, re-rotates in f32, and re-quantizes
+    (the per-reuse rounding this layout's documented tolerance
+    covers). The generic path below already lands 3-dim scale leaves
+    (``dest`` indexes the token axis of any trailing shape).
     """
     import jax
     import jax.numpy as jnp
@@ -149,14 +187,35 @@ def scatter_blocks(cache, pool, block_ids, pads, pos0, feed: int,
                      pos0 + (tok % feed)[None, :])
     safe_ids = jnp.clip(block_ids, 0, None)                  # -1 -> scratch
 
+    updates = {}
+    if kv_quant and rotary:
+        from ..models.quant import quantize_kv
+
+        # K pages must re-rotate to the rows' absolute-slot angles:
+        # dequant -> rotate -> requant, jointly producing the int8 page
+        # AND its fresh scale leaf (the tree walk below consumes both)
+        for ps in pool:
+            if not ps.endswith("cached_key") or ps + "_scale" not in pool:
+                continue
+            sq = pool[ps][safe_ids]              # [k, nb, block, H, D]
+            ss = pool[ps + "_scale"][safe_ids]   # [k, nb, block, H]
+            deq = sq.astype(jnp.float32) * ss[..., None]
+            deq = deq.reshape(k, nb * block, *sq.shape[3:])
+            q2, s2 = quantize_kv(rotate_rows(deq, pads, rope_base))
+            updates[ps] = q2
+            updates[ps + "_scale"] = s2
+
     def put(path, leaf):
         ps = _path_str(path)
-        if ps not in pool:
+        if ps in updates:
+            src = updates[ps]
+        elif ps in pool:
+            src = pool[ps][safe_ids]             # [k, nb, block, ...]
+            src = src.reshape(k, nb * block, *src.shape[3:])
+            if rotary and ps.endswith("cached_key"):
+                src = rotate_rows(src, pads, rope_base)
+        else:
             return leaf
-        src = pool[ps][safe_ids]                 # [k, nb, block, H, D]
-        src = src.reshape(k, nb * block, *src.shape[3:])
-        if rotary and ps.endswith("cached_key"):
-            src = rotate_rows(src, pads, rope_base)
         src = src.astype(leaf.dtype)
         return jax.vmap(lambda row, d, s: row.at[d].set(s))(leaf, dest,
                                                             src)
@@ -166,17 +225,25 @@ def scatter_blocks(cache, pool, block_ids, pads, pos0, feed: int,
 
 @functools.lru_cache(maxsize=32)
 def _capture_fn(model, k: int, nb: int, block: int, rotary: bool,
-                rope_base: float):
+                rope_base: float, kv_quant: str = ""):
     """Compiled pool capture: gather ``nb`` blocks of each of ``k``
     cache rows (row ``slots[j]``, prompt starting at slot ``pads[j]``),
     de-rotate K to canonical space, and write them into the (donated)
     pool at ``block_ids``. Unused lanes (``-1``) read row 0 and write
-    the scratch block. One async dispatch; never forces a sync."""
+    the scratch block. One async dispatch; never forces a sync.
+
+    ``kv_quant == "int8"`` (ISSUE 15): cache rows are int8 + scale
+    leaves — dequantize, de-rotate (K) in f32, re-quantize, and write
+    page + scale leaf together. At delta 0 (batch-1 captures) the
+    round-trip is exact (quantize_kv maps each row's max back to ±127,
+    so requantizing a just-dequantized row reproduces its bytes)."""
     import jax
     import jax.numpy as jnp
 
     @functools.partial(jax.jit, donate_argnums=0)
     def capture(pool, cache, slots, pads, block_ids):
+        from ..models.quant import quantize_kv
+
         tok = jnp.arange(nb * block)
         used = jnp.repeat(block_ids >= 0, block, axis=1)
         src_idx = jnp.where(used, pads[:, None] + tok[None, :], 0)
@@ -184,15 +251,32 @@ def _capture_fn(model, k: int, nb: int, block: int, rotary: bool,
         flat = jax.tree_util.tree_flatten_with_path(dict(cache))[0]
         by_path = {_path_str(p): leaf for p, leaf in flat}
         out = {}
-        for ps, pool_leaf in pool.items():
-            rows = by_path[ps][slots]                       # [k, T, H, D]
-            content = jax.vmap(lambda r, i: r[i])(rows, src_idx)
-            if rotary and ps.endswith("cached_key"):
-                content = rotate_rows(content, -pads, rope_base)
+
+        def land(ps, content):
+            pool_leaf = pool[ps]
             content = content.astype(pool_leaf.dtype).reshape(
                 k, nb, block, *content.shape[2:])
             out[ps] = pool_leaf.at[ids.reshape(-1)].set(
                 content.reshape(k * nb, block, *content.shape[3:]))
+
+        for ps in sorted(pool):
+            if kv_quant and ps.endswith("_scale"):
+                continue                 # landed with its base leaf
+            rows = by_path[ps][slots]                       # [k, T, ...]
+            content = jax.vmap(lambda r, i: r[i])(rows, src_idx)
+            if kv_quant and ps + "_scale" in pool:
+                srows = by_path[ps + "_scale"][slots]       # [k, T, H]
+                scont = jax.vmap(lambda r, i: r[i])(srows, src_idx)
+                deq = content.astype(jnp.float32) * scont[..., None]
+                if rotary and ps.endswith("cached_key"):
+                    deq = rotate_rows(deq, -pads, rope_base)
+                q2, s2 = quantize_kv(deq)
+                land(ps, q2)
+                land(ps + "_scale", s2)
+                continue
+            if rotary and ps.endswith("cached_key"):
+                content = rotate_rows(content, -pads, rope_base)
+            land(ps, content)
         return out
 
     return capture
@@ -601,6 +685,19 @@ class SpillTier:
             return True
 
 
+class PoolUnsupported(ValueError):
+    """A KV layout the pool cannot serve (ISSUE 15 satellite): carries
+    the machine-readable ``reason`` (``window`` / ``kv_quant`` /
+    ``undersized`` / ``gpt2_layout``) that feeds the
+    ``pool_fallback_total{reason=...}`` counters on /metrics — today
+    the refusal string went to logs only and fleet-level fallback was
+    invisible."""
+
+    def __init__(self, reason: str, message: str):
+        super().__init__(message)
+        self.reason = reason
+
+
 class RadixIndex:
     """Block-granular radix/trie over prompt token ids.
 
@@ -742,25 +839,23 @@ class PrefixCache:
     def __init__(self, model, params, block_tokens: int = 32,
                  pool_blocks: int = 256, eviction: str = "lru",
                  paged: bool = True, host_spill_blocks: int = 0,
-                 disk_spill_dir=None, disk_spill_blocks: int = 0):
+                 disk_spill_dir=None, disk_spill_blocks: int = 0,
+                 ring_slack_tokens: int = 512):
         import jax
         import jax.numpy as jnp
 
         spec = getattr(model, "kv_cache_spec", None)
         if spec is None:
-            raise ValueError(
+            raise PoolUnsupported(
+                "gpt2_layout",
                 f"{type(model).__name__} declares no kv_cache_spec(): "
                 "prefix caching needs the decode-cache layout contract")
         spec = spec()
-        if spec.get("window", 0):
-            raise ValueError(
-                "prefix caching needs a non-rolling cache (window == 0):"
-                " ring eviction order is position-dependent")
-        if spec.get("kv_quant"):
-            raise ValueError(
-                "prefix caching supports full-precision KV only "
-                f"(kv_quant={spec['kv_quant']!r} would re-quantize on "
-                "every reuse)")
+        if spec.get("kv_quant") not in ("", None, "int8"):
+            raise PoolUnsupported(
+                "kv_quant",
+                f"unknown kv_quant {spec['kv_quant']!r} (the int8-KV "
+                "pool layout is the only quantized layout)")
         if eviction != "lru":
             raise ValueError(f"unknown eviction policy {eviction!r} "
                              "(only 'lru')")
@@ -772,6 +867,34 @@ class PrefixCache:
         self.pool_blocks = int(pool_blocks)
         self.rotary = bool(spec.get("rotary"))
         self.rope_base = float(spec.get("rope_base") or 0.0)
+        self.kv_quant = str(spec.get("kv_quant") or "")
+        # sliding-window ring layout (ISSUE 15): the pool can serve
+        # window models ONLY through the paged path (the scatter
+        # fallback's contiguous rolling cache has position-dependent
+        # eviction order — the original refusal, now scoped to that
+        # arm alone). Ring geometry lives here; the model's paged
+        # attention consumes it as `j % nb` table semantics.
+        self.window = int(spec.get("window", 0) or 0)
+        self.ring_slack_tokens = 0
+        if self.window:
+            if not (bool(paged) and spec.get("paged", False)):
+                raise PoolUnsupported(
+                    "window",
+                    f"window={self.window} needs the paged pool layout "
+                    "(the scatter fallback's rolling cache is "
+                    "position-dependent)")
+            if self.window % self.block or self.window < self.block:
+                raise PoolUnsupported(
+                    "window",
+                    f"window={self.window} must be a positive multiple "
+                    f"of block_tokens={self.block} for the ring layout")
+            # slack: the largest single prefill FEED the ring tolerates
+            # without a dispatch's writes clobbering its own queries'
+            # band (power-of-two so bucketed feeds stay inside it)
+            slack = 16
+            while slack < min(int(ring_slack_tokens), self.window):
+                slack *= 2
+            self.ring_slack_tokens = slack
         # TP serving (ISSUE 10): pool pages shard on the KV-HEAD axis
         # over the model's serving mesh — each tensor shard owns its
         # KVH/tp slice of every page, while block ids / the radix index
@@ -867,6 +990,15 @@ class PrefixCache:
             "tier_checksum_failures": 0,
             "tier_exhaust_drops": 0,
             "tier_demote_errors": 0,
+            # pool-fallback observability (ISSUE 15 satellite): WHY a
+            # request degraded to the scatter/no-pool arm, counted per
+            # request — /metrics renders these as
+            # pool_fallback_total{reason=...}
+            "pool_fallback_window": 0,
+            "pool_fallback_kv_quant": 0,
+            "pool_fallback_undersized": 0,
+            "pool_fallback_gpt2_layout": 0,
+            "pool_fallback_dry_pool": 0,
         }
         # demote-on-evict spill tier (ISSUE 13): None keeps the
         # classic destroy-on-evict byte-identical
@@ -878,22 +1010,47 @@ class PrefixCache:
                 disk_dir=disk_spill_dir,
                 disk_blocks=int(disk_spill_blocks))
         self.nb_max = -(-int(model.max_len) // self.block)
+        if self.window:
+            # ring table width: the in-band pages + 1 (band/tile
+            # misalignment) + slack pages so a bounded prefill feed
+            # never recycles a slot its own queries still read
+            nb_ring = (self.window // self.block + 1
+                       + self.ring_slack_tokens // self.block)
+            self.nb_max = min(self.nb_max, nb_ring)
         # bytes of ONE pool block across every leaf — the unit of the
-        # copy-bytes accounting above
+        # copy-bytes accounting above (int8 layouts: the quantized
+        # bytes + their scale leaves — ~0.53x the f32 page, which is
+        # exactly the wire/tier/HBM saving the layout exists for)
         self.page_bytes = int(sum(
             int(np.prod(leaf.shape[1:])) * leaf.dtype.itemsize
             for leaf in self.pool.values()))
         # TRUE paged decode (ISSUE 7): the engines read pool pages in
         # place through per-row block tables — needs the model's paged
         # call path AND a pool that can hold at least one full-budget
-        # request's chain; otherwise the scatter fallback serves
+        # request's chain; otherwise the scatter fallback serves.
+        # fallback_reason is the STRUCTURAL reason requests will take
+        # the scatter arm ("" = fully paged) — per-request fallbacks
+        # count it into pool_fallback_* (ISSUE 15 satellite).
         self.paged = bool(paged) and bool(spec.get("paged", False))
-        if bool(paged) and not spec.get("paged", False):
-            logger.warning(
-                "paged decode unavailable for %s (kv_cache_spec paged="
-                "False): warm admits use the scatter fallback",
-                type(model).__name__)
+        self.fallback_reason = ""
+        if not spec.get("paged", False):
+            self.fallback_reason = "gpt2_layout"
+            if bool(paged):
+                logger.warning(
+                    "paged decode unavailable for %s (kv_cache_spec "
+                    "paged=False): warm admits use the scatter "
+                    "fallback", type(model).__name__)
         if self.paged and self.pool_blocks - 1 < self.nb_max:
+            if self.window:
+                # no scatter arm exists for a window model — refuse
+                # loudly instead of degrading to a layout that cannot
+                # serve
+                raise PoolUnsupported(
+                    "undersized",
+                    f"prefix_cache.pool_blocks={self.pool_blocks} "
+                    f"cannot hold one ring request ({self.nb_max} "
+                    f"blocks for window={self.window} + slack at "
+                    f"block_tokens={self.block})")
             logger.warning(
                 "prefix_cache.pool_blocks=%d cannot hold one full-"
                 "budget request (%d blocks for max_len=%d at "
@@ -901,6 +1058,7 @@ class PrefixCache:
                 "fallback serves", self.pool_blocks, self.nb_max,
                 int(model.max_len), self.block)
             self.paged = False
+            self.fallback_reason = "undersized"
 
     def _alloc_pool_leaf(self, shape, dtype):
         """One zeroed pool leaf, COMMITTED to the serving mesh's head
@@ -918,7 +1076,7 @@ class PrefixCache:
 
         return jax.device_put(
             jnp.zeros(shape, dtype),
-            NamedSharding(self.mesh, kv_pool_pspec()))
+            NamedSharding(self.mesh, kv_pool_pspec(len(shape))))
 
     # ---- host bookkeeping -------------------------------------------------
 
@@ -1136,6 +1294,21 @@ class PrefixCache:
                     self.stats["prefix_hit_tokens"] += c
                 self.index.acquire(nodes)
             return nodes, blocks, c
+
+    def count_fallback(self, reason: str = "") -> None:
+        """Count one request that degraded off the paged pool path
+        (ISSUE 15 satellite): ``reason`` defaults to the pool's
+        structural ``fallback_reason`` (gpt2_layout / undersized);
+        transient dry-pool falls pass ``"dry_pool"``. An empty reason
+        (operator turned paged off deliberately) is not counted — a
+        choice is not a degradation."""
+        reason = reason or self.fallback_reason
+        if not reason:
+            return
+        key = f"pool_fallback_{reason}"
+        with self._lock:
+            if key in self.stats:
+                self.stats[key] += 1
 
     def count_batch1(self, paged: bool) -> None:
         """Tally which arm served one batch-1 request (paged in-place
@@ -1538,6 +1711,15 @@ class PrefixCache:
         lk = out["prefix_lookups"]
         out["prefix_hit_rate"] = round(
             out["prefix_hit_requests"] / lk, 4) if lk else 0.0
+        # long-context layouts (ISSUE 15): the pool's geometry — page
+        # bytes make the int8 HBM saving observable (the serve_longctx
+        # high-water gate), window/ring expose the sliding layout
+        out["pool_fallback_total"] = sum(
+            v for k2, v in out.items()
+            if k2.startswith("pool_fallback_"))
+        out["prefix_page_bytes"] = int(self.page_bytes)
+        out["prefix_pool_window"] = int(self.window)
+        out["prefix_pool_kv_quant"] = 1 if self.kv_quant else 0
         return out
 
     def _count_referenced(self) -> int:
@@ -1570,6 +1752,7 @@ class PrefixCache:
             ids[j, :len(row)] = row
         self.pool = _capture_fn(
             self.model, k, nb, self.block, self.rotary, self.rope_base,
+            self.kv_quant,
         )(self.pool, cache, jnp.asarray(np.asarray(slots, np.int32)),
           jnp.asarray(np.asarray(pads, np.int32)), jnp.asarray(ids))
 
@@ -1583,11 +1766,29 @@ class PrefixCache:
         chain right now (batch-1 falls back to the scatter arm; the
         continuous engine defers the admission and retries with
         ``record=False``). ONE owner of the reservation math — the
-        continuous engine's ``_reserve_pages`` wraps this."""
-        nodes, blocks, c = self.lookup(ids, record=record,
-                                       promote=promote)
-        n_need = -(-(len(ids) + int(budget)) // self.block) - \
-            c // self.block
+        continuous engine's ``_reserve_pages`` wraps this.
+
+        Ring layout (``window > 0``, ISSUE 15): a request whose
+        ``prompt + budget`` exceeds the ring span WRAPS — its table
+        slots recycle, so shared radix pages must not sit in it (they
+        would be overwritten under other readers) and nothing it
+        writes is adoptable. Such requests run fully private on
+        exactly ``nb_max`` pages (the documented "radix caches up to
+        ~window deep" cap); non-wrapping requests share and adopt
+        exactly like the flat layout."""
+        ring_wrap = False
+        nfull_total = -(-(len(ids) + int(budget)) // self.block)
+        if self.window and nfull_total > self.nb_max:
+            ring_wrap = True
+            if record:
+                with self._lock:
+                    self.stats["prefix_lookups"] += 1
+            nodes, blocks, c = [], [], 0
+            n_need = self.nb_max
+        else:
+            nodes, blocks, c = self.lookup(ids, record=record,
+                                           promote=promote)
+            n_need = nfull_total - c // self.block
         priv = self.alloc_chain(n_need)
         if priv is None:
             self.release(nodes)
@@ -1596,6 +1797,7 @@ class PrefixCache:
             "ids": list(ids), "c": c, "nodes": nodes, "blocks": blocks,
             "private": {c // self.block + i: bid
                         for i, bid in enumerate(priv)},
+            "ring_wrap": ring_wrap,
             # extra shared nodes acquired AFTER reservation (the
             # continuous engine's group-admit dedup) — released by
             # ``paged_finish`` with the plan's own refs
@@ -1616,19 +1818,38 @@ class PrefixCache:
         if plan is None:
             return None
         c = plan["c"]
-        feed = len(ids) - c
+        L = len(ids)
         row = np.full((1, self.nb_max), -1, np.int32)
         for i, b in enumerate(plan["blocks"]):
             row[0, i] = b
         for idx, bid in plan["private"].items():
             row[0, idx] = bid
         tables = jnp.asarray(row)
-        suffix = jnp.asarray(np.asarray(ids[c:], np.int32)[None, :])
+        done = c
         try:
+            # ring layout (ISSUE 15): a single dispatch's feed is
+            # bounded by the slack contract (a wider feed could recycle
+            # a slot its own queries' band still reads), so a long
+            # uncached suffix streams in fixed ``ring_slack_tokens``
+            # chunks — every chunk reuses ONE executable shape, and
+            # each chunk's writes land before the next chunk reads them
+            while self.window and L - done > self.ring_slack_tokens:
+                f = self.ring_slack_tokens
+                suffix = jnp.asarray(
+                    np.asarray(ids[done:done + f], np.int32)[None, :])
+                _, cache = _paged_prefill_fn(
+                    self.model, f, self.nb_max)(
+                    params, self.paged_cache(), suffix, tables,
+                    jnp.asarray([done], jnp.int32))
+                self.sync_pool_from_cache(cache)
+                done += f
+            feed = L - done
+            suffix = jnp.asarray(
+                np.asarray(ids[done:], np.int32)[None, :])
             last_logits, cache = _paged_prefill_fn(
                 self.model, feed, self.nb_max)(
                 params, self.paged_cache(), suffix, tables,
-                jnp.asarray([c], jnp.int32))
+                jnp.asarray([done], jnp.int32))
         except Exception:
             # the prefill DONATES the pool — a dispatch that fails
             # after donation leaves dead leaves behind, and every
@@ -1647,16 +1868,29 @@ class PrefixCache:
         self.sync_pool_from_cache(cache)
         return last_logits, cache, tables, plan
 
-    def paged_finish(self, plan, out_ids, emitted: int) -> None:
+    def paged_finish(self, plan, out_ids, emitted: int,
+                     written=None) -> None:
         """End-of-request paged bookkeeping: zero-copy ADOPT the full
         (prompt + decoded) blocks into the radix index, free the
-        unadoptable tail, release the shared-prefix refs."""
+        unadoptable tail, release the shared-prefix refs.
+
+        ``written`` overrides the default written-token count (prompt
+        + fed decode tokens) — the chunked-streaming-prefill path
+        finishes a cancelled request mid-prompt, where only the
+        streamed chunks ever landed. A ``ring_wrap`` plan adopts
+        NOTHING: its recycled slots clobbered the early blocks, so no
+        prefix key describes the surviving content."""
         ids = plan["ids"]
         seq = list(ids) + [int(t) for t in out_ids]
-        # positions actually written: the prompt plus every fed decode
-        # token (the final sampled token is never fed back)
-        written = len(ids) + max(int(emitted) - 1, 0)
-        adopted, _ = self.adopt(seq[:written], dict(plan["private"]))
+        if written is None:
+            # positions actually written: the prompt plus every fed
+            # decode token (the final sampled token is never fed back)
+            written = len(ids) + max(int(emitted) - 1, 0)
+        if plan.get("ring_wrap"):
+            adopted = []
+        else:
+            adopted, _ = self.adopt(seq[:int(written)],
+                                    dict(plan["private"]))
         taken = set(adopted)
         self.free_blocks([b for b in plan["private"].values()
                           if b not in taken])
@@ -1684,6 +1918,14 @@ class PrefixCache:
 
         from .generate import _prefill_fresh
 
+        if self.window:
+            # belt-and-braces: the pool refuses to CONSTRUCT a window
+            # layout without the paged path, and the batch-1 caller
+            # falls back cold instead of here — scattering a ring into
+            # a contiguous rolling cache would be silently wrong
+            raise PoolUnsupported(
+                "window", "the scatter arm cannot serve a rolling-"
+                "window layout (paged ring only)")
         L = len(ids)
         nodes, blocks, c = self.lookup(ids, record=record)
         try:

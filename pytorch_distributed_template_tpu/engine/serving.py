@@ -125,6 +125,12 @@ class GenerationService:
         # kv_cache_spec) disables LOUDLY instead of failing the load —
         # the operator asked for a server, not a cache
         self._prefix = None
+        # pool-fallback observability (ISSUE 15 satellite): when the
+        # pool REFUSES to construct, the machine-readable reason
+        # (window / kv_quant / undersized / gpt2_layout) survives here
+        # so /metrics can count the degradation instead of burying the
+        # refusal string in logs
+        self.pool_refusal_reason = ""
         if prefix_cache is not None:
             from .kvcache import PrefixCache
 
@@ -146,9 +152,17 @@ class GenerationService:
                         disk_spill_dir=cfg.get("disk_spill_dir"),
                         disk_spill_blocks=int(
                             cfg.get("disk_spill_blocks", 0)),
+                        # sliding-window ring geometry (ISSUE 15): the
+                        # largest single prefill feed the ring must
+                        # tolerate; chunked prefill keeps feeds inside
+                        ring_slack_tokens=int(
+                            cfg.get("prefill_chunk_tokens", 0)
+                            or cfg.get("ring_slack_tokens", 512)),
                     )
                 except ValueError as e:
                     logger.warning("prefix cache disabled: %s", e)
+                    self.pool_refusal_reason = getattr(
+                        e, "reason", "unsupported")
         if self.role != "both" and self._prefix is None:
             # role-split serving IS page shipping: a prefill replica
             # with no pool has nothing to export, and a decode replica
@@ -486,7 +500,9 @@ class GenerationService:
                     _, cache, _, plan = res
                     pf.paged_finish(plan, [], 0)
                     done = True
-            if not done:
+            if not done and not getattr(pf, "window", 0):
+                # no scatter arm for ring layouts: a dry ring pool
+                # exports whatever chain is already resident
                 pf.warm_prefill(self.params, ids, len(ids) + 1)
         return pf.export_pages(ids)
 
@@ -671,12 +687,17 @@ class GenerationService:
                 # stays cold (its fused single-dispatch loop builds its
                 # own cache in-graph). Out-of-budget requests also fall
                 # through, so generate() raises the usual ValueError.
+                # None = the pool cannot serve this request at all
+                # (e.g. a ring layout's dry pool — no scatter arm
+                # exists for window models): the cold path below
+                # serves it, counted as a pool fallback.
                 new_ids = self._generate_prefix_cached(
                     ids, int(max_new_tokens), float(temperature),
                     int(top_k), float(top_p), row_rngs)
-                resp = self._response(new_ids, stops=stops)
-                self._observe_request(request_id, t_req, resp)
-                return resp
+                if new_ids is not None:
+                    resp = self._response(new_ids, stops=stops)
+                    self._observe_request(request_id, t_req, resp)
+                    return resp
             if stops:
                 out, lengths = generate(
                     self.model, self.params, arr,
@@ -772,6 +793,16 @@ class GenerationService:
                 self._prefix.count_batch1(paged=True)
                 return row
         self._prefix.count_batch1(paged=False)
+        # pool-fallback accounting (ISSUE 15): a healthy-but-dry paged
+        # pool degrades as "dry_pool"; a structurally unpaged pool
+        # counts its own reason (gpt2_layout / undersized)
+        self._prefix.count_fallback(
+            "dry_pool" if self._prefix.paged else "")
+        if getattr(self._prefix, "window", 0):
+            # ring layouts have NO scatter arm (a rolling contiguous
+            # cache is position-dependent): the caller's cold path
+            # serves this request instead
+            return None
         # a dry-pool fall-through from the paged arm already recorded
         # this request's lookup inside paged_plan — recording again
         # here would double-count prefix_hit_tokens for the SAME
@@ -839,7 +870,8 @@ class GenerationService:
         dl = getattr(self, "_spec_draft_layers", 0)
         prefix = getattr(self, "_prefix", None)
         L = t0 + int(budget) + 2 * (int(draft) + 1)
-        if prefix is not None and L <= int(self.model.max_len):
+        if (prefix is not None and L <= int(self.model.max_len)
+                and not getattr(prefix, "window", 0)):
             ids = [int(t) for t in np.asarray(arr)[0]]
             # route through the pool only on an actual prefix HIT:
             # the warm path's executables key on the EXACT (t0, L) —
@@ -993,6 +1025,14 @@ class GenerationService:
         if stats is not None:
             stats["tokens_generated"] = (
                 stats.get("tokens_generated", 0) + len(ids))
+            if (getattr(self, "pool_refusal_reason", "")
+                    and getattr(self, "_prefix", None) is None):
+                # pool-fallback observability (ISSUE 15): a REFUSED
+                # pool means every served request ran without it —
+                # counted here so even the plain scheduler's /metrics
+                # carries the degradation
+                stats["pool_refused_requests"] = (
+                    stats.get("pool_refused_requests", 0) + 1)
         return resp
 
 
@@ -1275,6 +1315,13 @@ def load_generation_stack(config, use_ema: bool = False,
     dist.initialize()  # multi-host rendezvous parity with train.py/test.py
     tp = int(tensor_parallel or 0) or int(
         (config.get("serving") or {}).get("tensor_parallel") or 1)
+    kvq = str((config.get("serving") or {}).get("kv_quant") or "")
+    if kvq:
+        # int8-KV decode cache (ISSUE 15): a SERVING mode — the scale
+        # leaves are cache variables, not params — so the serving
+        # section can switch it on over a full-precision training
+        # arch without touching the checkpoint
+        config["arch"].setdefault("args", {})["kv_quant"] = kvq
     mesh = serving_mesh(tp) if tp > 1 else mesh_from_config(config)
     model = inject_mesh(config.init_obj("arch", MODELS), mesh)
     if not hasattr(model, "max_len"):

@@ -166,6 +166,39 @@ def build_trace(n_requests: int, seed: int = 0,
     return trace
 
 
+def longctx_trace(n_requests: int, seed: int = 0,
+                  doc_len: int = 8192, n_docs: int = 2,
+                  question_len: int = 24, background_groups: int = 4,
+                  doc_frac: float = 0.4, answer_tokens: int = 16,
+                  background_new_tokens: int = 48, vocab: int = 256,
+                  group_tag: str = "lc", **kw) -> List[dict]:
+    """The ``serve_longctx`` trace preset (ISSUE 15 satellite): the
+    long-document QA mixture ROADMAP item 2 names — a minority of
+    requests share ``n_docs`` long document prefixes (``doc_len``
+    tokens, the PR 12 ``long_prefix_len`` knob) followed by a short
+    unique question, against a decode-heavy short-prompt background
+    (streaming, bigger budgets — the TPOT-p99 signal a monolithic long
+    prefill stalls). Pure parameterization of :func:`build_trace`
+    (same knobs, same seeded streams), so the draw-order-neutrality
+    contract holds by construction — pinned by
+    tests/test_longctx.py."""
+    groups = int(n_docs) + int(background_groups)
+    doc_w = float(doc_frac) / max(int(n_docs), 1)
+    bg_w = (1.0 - float(doc_frac)) / max(int(background_groups), 1)
+    return build_trace(
+        n_requests, seed=seed, prefix_groups=groups,
+        group_tag=group_tag, suffix_len=int(question_len),
+        long_prefix_len=int(doc_len), long_groups=int(n_docs),
+        group_max_new=([int(answer_tokens)] * int(n_docs)
+                       + [int(background_new_tokens)]
+                       * int(background_groups)),
+        group_weights=([doc_w] * int(n_docs)
+                       + [bg_w] * int(background_groups)),
+        group_stream=([False] * int(n_docs)
+                      + [True] * int(background_groups)),
+        vocab=vocab, **kw)
+
+
 def prompt_tokens(trace: List[dict]) -> int:
     return sum(len(item["prompt_ids"]) for item in trace)
 
@@ -507,17 +540,35 @@ def main(argv=None) -> int:
                    help="X-Fleet-Policy override (cache_aware|"
                         "least_loaded|round_robin)")
     p.add_argument("--timeout-s", type=float, default=120.0)
+    p.add_argument("--preset", default=None, choices=("longctx",),
+                   help="named trace preset: 'longctx' = the "
+                        "serve_longctx long-document QA mixture "
+                        "(shared --long-prefix-len document prefixes "
+                        "+ short questions vs a decode-heavy "
+                        "streaming background, ISSUE 15)")
+    p.add_argument("--doc-len", type=int, default=8192,
+                   help="longctx preset: shared document prefix "
+                        "length in tokens")
+    p.add_argument("--n-docs", type=int, default=2,
+                   help="longctx preset: distinct shared documents")
     args = p.parse_args(argv)
-    trace = build_trace(
-        args.n, seed=args.seed,
-        tenants=[t for t in args.tenants.split(",") if t],
-        prefix_groups=args.prefix_groups, group_tag=args.group_tag,
-        prefix_len=args.prefix_len, suffix_len=args.suffix_len,
-        max_new_tokens=args.max_new_tokens, arrival=args.arrival,
-        rate_rps=args.rate, stream_frac=args.stream_frac,
-        cancel_frac=args.cancel_frac,
-        long_prefix_len=args.long_prefix_len,
-        long_groups=args.long_groups)
+    if args.preset == "longctx":
+        trace = longctx_trace(
+            args.n, seed=args.seed, doc_len=args.doc_len,
+            n_docs=args.n_docs, group_tag=args.group_tag,
+            tenants=[t for t in args.tenants.split(",") if t],
+            arrival=args.arrival, rate_rps=args.rate)
+    else:
+        trace = build_trace(
+            args.n, seed=args.seed,
+            tenants=[t for t in args.tenants.split(",") if t],
+            prefix_groups=args.prefix_groups, group_tag=args.group_tag,
+            prefix_len=args.prefix_len, suffix_len=args.suffix_len,
+            max_new_tokens=args.max_new_tokens, arrival=args.arrival,
+            rate_rps=args.rate, stream_frac=args.stream_frac,
+            cancel_frac=args.cancel_frac,
+            long_prefix_len=args.long_prefix_len,
+            long_groups=args.long_groups)
     summary = summarize(replay(args.url, trace,
                                timeout_s=args.timeout_s,
                                policy=args.policy), trace)

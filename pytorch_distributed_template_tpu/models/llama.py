@@ -185,7 +185,8 @@ class LlamaAttention(nn.Module):
         return dense(self.d_model, "o_proj")(ctx)
 
     def _paged_attention(self, q, k, v, cached_k, cached_v,
-                         block_tables, row_starts, pad_lens):
+                         block_tables, row_starts, pad_lens,
+                         k_scale=None, v_scale=None):
         """Paged decode (ISSUE 7): the supplied cache leaves ARE the KV
         block pool's ``[pool_blocks, block_tokens, KVH, D]`` pages, and
         this row's token positions map to pages through its block table
@@ -202,7 +203,24 @@ class LlamaAttention(nn.Module):
         scratch page and their outputs are garbage the caller ignores.
         New K/V always lands in the row's PRIVATE tail pages — the
         engine never feeds a position covered by a shared radix page —
-        so a write can never corrupt a page another row is reading."""
+        so a write can never corrupt a page another row is reading.
+
+        int8-KV pool layout (ISSUE 15, ``kv_quant="int8"``): new rows
+        quantize per (token, kv-head) at the WRITE (models/quant
+        ``quantize_kv``) — pages store int8 K/V plus f32 scale leaves —
+        and attention reads dequantize in the kernel's tile fetch
+        (ops/flash paged dequant epilogue). The call's own tokens
+        round-trip through int8 too (unlike the contiguous kvq path),
+        which keeps the page content the single source of truth: a
+        radix hit replays EXACTLY the bytes the writer attended to, so
+        warm == cold token-identically on the quantized paged path.
+
+        Sliding-window ring layout (ISSUE 15, ``window > 0``): logical
+        block ``j`` maps to table slot ``j % NB`` (the table is a ring
+        over ~``window/block_tokens`` pages), the attention mask adds
+        the ``q_pos - k_pos < window`` band, and out-of-band remnant
+        content in recycled pages is masked by construction
+        (engine/kvcache.py owns the ring geometry + slack contract)."""
         from ..ops.attention import paged_gqa_attention
         from ..engine.kvcache import SCRATCH_BLOCK
 
@@ -212,7 +230,14 @@ class LlamaAttention(nn.Module):
         nb = block_tables.shape[1]
         lane = jnp.arange(t)
         pos = row_starts[:, None] + lane[None, :]            # [B, t]
-        safe_pos = jnp.clip(pos, 0, nb * bt - 1)
+        if self.window > 0:
+            # ring: positions may exceed the table span; the page for
+            # position p is tables[(p // bt) % NB], offset p % bt
+            safe_pos = jnp.maximum(pos, 0)
+            blk = (safe_pos // bt) % nb
+        else:
+            safe_pos = jnp.clip(pos, 0, nb * bt - 1)
+            blk = safe_pos // bt
         cos, sin = rope_tables(safe_pos.reshape(-1), d, self.rope_base)
         cos = cos.reshape(b, t, d)
         sin = sin.reshape(b, t, d)
@@ -221,7 +246,7 @@ class LlamaAttention(nn.Module):
         if pad_lens is None:
             pad_lens = jnp.zeros((b,), jnp.int32)
         valid = lane[None, :] >= pad_lens[:, None]
-        page = jnp.take_along_axis(block_tables, safe_pos // bt, axis=1)
+        page = jnp.take_along_axis(block_tables, blk, axis=1)
         ok = valid & (page >= 0)
         flat_idx = jnp.where(ok, page * bt + safe_pos % bt,
                              SCRATCH_BLOCK * bt + safe_pos % bt)
@@ -232,14 +257,27 @@ class LlamaAttention(nn.Module):
                 new.astype(pool.dtype).reshape(b * t, *new.shape[2:]))
             return flat.reshape(pool.shape)
 
-        cached_k.value = put(pool_k, k)
-        cached_v.value = put(pool_v, v)
+        ks = vs = None
+        if k_scale is not None:
+            from .quant import quantize_kv
+
+            kq, k_s = quantize_kv(k)      # int8 [B,t,H,D], f32 [B,t,H]
+            vq, v_s = quantize_kv(v)
+            cached_k.value = put(pool_k, kq)
+            cached_v.value = put(pool_v, vq)
+            k_scale.value = put(k_scale.value, k_s)
+            v_scale.value = put(v_scale.value, v_s)
+            ks, vs = k_scale.value, v_scale.value
+        else:
+            cached_k.value = put(pool_k, k)
+            cached_v.value = put(pool_v, v)
         # TP serving (ISSUE 10): a mesh with a tensor axis routes the
         # read through per-shard head ranges (each shard's kernel walks
         # only its local KVH/tp pool slice); tables/starts replicate
         return paged_gqa_attention(q, cached_k.value, cached_v.value,
                                    block_tables, row_starts, pad_lens,
-                                   mesh=self.mesh)
+                                   mesh=self.mesh, window=self.window,
+                                   k_scale=ks, v_scale=vs)
 
     def _cached_attention(self, q, k, v, cur, groups: int,
                           prefill: bool = False, pad_lens=None,
@@ -326,6 +364,16 @@ class LlamaAttention(nn.Module):
                 "cache", "cached_value_scale", jnp.zeros,
                 (b, alloc_len, v.shape[2]), jnp.float32,
             )
+        if is_init and block_tables is not None:
+            # paged decode (ISSUE 7/15): the supplied leaves are pool
+            # pages [P, bt, KVH, D] (+ [P, bt, KVH] scale leaves when
+            # int8); positions ride in ``row_starts``, not the
+            # contiguous-cache machinery below (``cur`` is unused, and
+            # the rolling ring buffer + slot_pos never materialize —
+            # window > 0 runs as a ring BLOCK TABLE instead)
+            return self._paged_attention(q, k, v, cached_k, cached_v,
+                                         block_tables, row_starts,
+                                         pad_lens, k_scale, v_scale)
         cache_len = cached_k.value.shape[1]
         rolling = self.window > 0 and cache_len == self.window
         if pad_lens is not None and rolling:
@@ -348,17 +396,6 @@ class LlamaAttention(nn.Module):
         if not is_init:
             # shape-setting pass: allocate the cache, no attention needed
             return jnp.zeros((b, t, hq, d), q.dtype)
-        if block_tables is not None:
-            # paged decode: the supplied leaves are pool pages
-            # [P, bt, KVH, D]; positions ride in ``row_starts``, not the
-            # contiguous-cache machinery below (``cur`` is unused)
-            if kvq or self.window > 0:
-                raise ValueError(
-                    "paged decode needs a full-precision, non-rolling "
-                    "cache (engine/kvcache.py enforces this upstream)")
-            return self._paged_attention(q, k, v, cached_k, cached_v,
-                                         block_tables, row_starts,
-                                         pad_lens)
         if not rolling and t > cache_len:
             raise ValueError(f"decode input {t} exceeds cache {cache_len}")
         pos = cur + jnp.arange(t)
@@ -735,15 +772,18 @@ class LlamaLM(nn.Module):
         (the paged prefix-cache pool). ``rotary=True``: cached K rows
         are RoPE-rotated at absolute cache-slot angles, so block
         capture/extraction must shift rotations by the row's start slot
-        (rotations compose additively — kvcache.rotate_rows); a rolling
-        window or int8 KV cache disqualifies the layout for pooling
-        (position-dependent eviction / re-quantization per reuse).
+        (rotations compose additively — kvcache.rotate_rows).
 
         ``paged=True``: the family implements the TRUE paged decode
         path (``block_tables``/``row_starts`` call args — attention
-        reads pool pages in place through the block table, ISSUE 7);
-        layouts without it fall back to ``kvcache.scatter_blocks``
-        copies into a contiguous cache.
+        reads pool pages in place through the block table, ISSUE 7) —
+        for ALL of the family's layouts since ISSUE 15: the int8-KV
+        pool stores quantized pages + scale leaves, and ``window > 0``
+        runs the table as a ring over ~``window/block_tokens`` pages.
+        Layouts without it fall back to ``kvcache.scatter_blocks``
+        copies into a contiguous cache (the scatter arm still refuses
+        ``window > 0`` — a rolling contiguous cache's eviction order is
+        position-dependent).
 
         ``kv_heads`` (ISSUE 10): the TP sharding annotation — pool
         pages are ``[pool_blocks, block_tokens, KVH, D]`` and a
@@ -759,7 +799,7 @@ class LlamaLM(nn.Module):
             "rope_base": float(self.rope_base),
             "window": int(self.window),
             "kv_quant": self.kv_quant,
-            "paged": self.window == 0 and not self.kv_quant,
+            "paged": True,
             "kv_heads": n_kv,
         }
 

@@ -125,7 +125,8 @@ def grouped_query_attention(q, k, v, mask=None):
 
 
 def paged_gqa_attention(q, k_pool, v_pool, tables, row_starts, pad_lens,
-                        impl: str = "auto", mesh=None):
+                        impl: str = "auto", mesh=None, window: int = 0,
+                        k_scale=None, v_scale=None):
     """Decode attention straight from the paged KV block pool
     (ops/flash.paged_attention): row ``b``'s keys/values are gathered
     through its block table instead of a contiguous per-row cache, so a
@@ -147,16 +148,35 @@ def paged_gqa_attention(q, k_pool, v_pool, tables, row_starts, pad_lens,
     tp), while block tables / row starts / pad lens stay replicated.
     Attention is embarrassingly parallel over heads, so the body needs
     no collectives; on TPU each shard's Pallas kernel DMA-walks only
-    its own head slice of the pool."""
+    its own head slice of the pool.
+
+    ``window``/``k_scale``/``v_scale`` (ISSUE 15): the sliding-window
+    ring-table mapping and the int8-pool dequant scales, passed through
+    to :func:`ops.flash.paged_attention`; scale leaves shard on their
+    own head axis (axis 2 of 3) under TP, like the pages they rescale."""
     from .flash import paged_attention
 
     if mesh is not None and "tensor" in mesh.axis_names \
             and mesh.shape["tensor"] > 1:
         hs = P(None, None, "tensor", None)
+        ss = P(None, None, "tensor")
         rep = P(None)
+        if k_scale is not None:
+            def local_q(q_, k_, v_, t_, rs_, pl_, ks_, vs_):
+                return paged_attention(q_, k_, v_, t_, rs_, pl_,
+                                       impl=impl, window=window,
+                                       k_scale=ks_, v_scale=vs_)
+
+            return shard_map(
+                local_q, mesh=mesh,
+                in_specs=(hs, hs, hs, P(None, None), rep, rep, ss, ss),
+                out_specs=hs, check_vma=False,
+            )(q, k_pool, v_pool, tables, row_starts, pad_lens,
+              k_scale, v_scale)
 
         def local(q_, k_, v_, t_, rs_, pl_):
-            return paged_attention(q_, k_, v_, t_, rs_, pl_, impl=impl)
+            return paged_attention(q_, k_, v_, t_, rs_, pl_, impl=impl,
+                                   window=window)
 
         return shard_map(
             local, mesh=mesh,
@@ -164,7 +184,8 @@ def paged_gqa_attention(q, k_pool, v_pool, tables, row_starts, pad_lens,
             out_specs=hs, check_vma=False,
         )(q, k_pool, v_pool, tables, row_starts, pad_lens)
     return paged_attention(q, k_pool, v_pool, tables, row_starts,
-                           pad_lens, impl=impl)
+                           pad_lens, impl=impl, window=window,
+                           k_scale=k_scale, v_scale=v_scale)
 
 
 def _online_update(m, l, o, scores, vb):

@@ -617,14 +617,35 @@ def _on_tpu() -> bool:
 PAGED_MIN_Q = 8      # q lanes padded up to this (Mosaic sublane tile)
 
 
-def _paged_kernel(tables_ref, starts_ref, pads_ref, q_ref, k_ref, v_ref,
-                  o_ref, m_scr, l_scr, acc_scr, *, scale: float, bt: int,
-                  nb: int):
+def _paged_kernel(tables_ref, starts_ref, pads_ref, *refs, scale: float,
+                  bt: int, nb: int, window: int = 0,
+                  quant: bool = False):
     # grid (B, Hq, NB), kv innermost. q_ref/o_ref: [1, T, 1, D];
     # k_ref/v_ref: [1, bt, 1, D] — the pool page ``tables[b, j]`` for
     # this row's j-th logical block (scalar-prefetched index map; -1
     # lanes clip to the scratch page and are predicated away here).
     # Scratch m/l: [T, 1] f32, acc: [T, D] f32.
+    #
+    # ``quant`` (int8-KV pool layout, ISSUE 15): k/v pages are int8 and
+    # two extra scale refs ``[1, bt, 1]`` f32 ride along — the DEQUANT
+    # EPILOGUE multiplies each fetched tile by its per-(token, head)
+    # scale right after the HBM->VMEM DMA, so only half the KV bytes
+    # ever cross HBM (decode's binding constraint, BASELINE.md).
+    #
+    # ``window > 0`` (sliding-window ring layout, ISSUE 15): the block
+    # table is a RING — table slot ``s`` holds the newest logical block
+    # ``j ≡ s (mod nb)`` the row has written. k positions are derived
+    # from the query's own block (``j_log = jq - (jq - s) mod nb``);
+    # slots holding content newer than the query's block resolve to an
+    # out-of-band j_log and are masked (see engine/kvcache.py ring
+    # geometry: the +1/slack pages guarantee in-band content is never
+    # clobbered mid-dispatch).
+    if quant:
+        (q_ref, k_ref, v_ref, ks_ref, vs_ref, o_ref,
+         m_scr, l_scr, acc_scr) = refs
+    else:
+        q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr = refs
+        ks_ref = vs_ref = None
     b = pl.program_id(0)
     j = pl.program_id(2)
     t = q_ref.shape[1]
@@ -643,15 +664,29 @@ def _paged_kernel(tables_ref, starts_ref, pads_ref, q_ref, k_ref, v_ref,
         q = q_ref[0, :, 0].astype(jnp.float32) * scale     # [T, D]
         k_blk = k_ref[0, :, 0].astype(jnp.float32)         # [bt, D]
         v_blk = v_ref[0, :, 0].astype(jnp.float32)
+        if quant:
+            k_blk = k_blk * ks_ref[0]                      # [bt, 1]
+            v_blk = v_blk * vs_ref[0]
         s = jax.lax.dot_general(
             q, k_blk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )                                                  # [T, bt]
         lane = lax.broadcasted_iota(jnp.int32, (t, bt), 0)
         q_pos = start + lane
-        k_pos = j * bt + lax.broadcasted_iota(jnp.int32, (t, bt), 1)
-        # causal over ROW-LOCAL positions + leading pad lanes invalid
-        ok = (k_pos <= q_pos) & (lane >= pad)
+        k_off = lax.broadcasted_iota(jnp.int32, (t, bt), 1)
+        if window > 0:
+            jq = q_pos // bt
+            j_log = jq - jnp.mod(jq - j, nb)
+            k_pos = j_log * bt + k_off
+            # causal band over ROW-LOCAL positions; k_pos < 0 marks a
+            # slot this row has not written yet
+            ok = ((k_pos >= 0) & (k_pos <= q_pos)
+                  & (q_pos - k_pos < window) & (lane >= pad))
+        else:
+            k_pos = j * bt + k_off
+            # causal over ROW-LOCAL positions + leading pad lanes
+            # invalid
+            ok = (k_pos <= q_pos) & (lane >= pad)
         s = jnp.where(ok, s, NEG_INF)
         m = m_scr[...]
         m_new = jnp.maximum(m, jnp.max(s, axis=1, keepdims=True))
@@ -665,8 +700,13 @@ def _paged_kernel(tables_ref, starts_ref, pads_ref, q_ref, k_ref, v_ref,
         m_scr[...] = m_new
 
     # unused table lanes (-1: past the row's allocation) and blocks
-    # entirely beyond the last query position contribute nothing
-    pl.when((page >= 0) & (j * bt <= start + t - 1))(_compute)
+    # entirely beyond the last query position contribute nothing. In
+    # ring mode any slot may hold in-band content, so only the
+    # unallocated-lane predicate applies.
+    pred = page >= 0
+    if window <= 0:
+        pred = pred & (j * bt <= start + t - 1)
+    pl.when(pred)(_compute)
 
     @pl.when(j == nb - 1)
     def _finalize():
@@ -674,35 +714,60 @@ def _paged_kernel(tables_ref, starts_ref, pads_ref, q_ref, k_ref, v_ref,
         o_ref[0, :, 0] = (acc_scr[...] / l_safe).astype(o_ref.dtype)
 
 
-def paged_attention_ref(q, k_pool, v_pool, tables, row_starts, pad_lens):
+def paged_attention_ref(q, k_pool, v_pool, tables, row_starts, pad_lens,
+                        window: int = 0, k_scale=None, v_scale=None):
     """Plain-JAX oracle for :func:`paged_attention` (same contract):
     gather every row's pages, mask, and run the grouped-query einsum.
     Materializes the ``[B, NB*bt, KVH, D]`` gather — the HBM cost the
     Pallas kernel exists to avoid — so it is the CPU/test path and the
-    allclose reference, not the TPU path."""
+    allclose reference, not the TPU path. ``k_scale``/``v_scale``
+    dequantize int8 pages on the gather; ``window > 0`` applies the
+    ring-table position mapping + sliding band (see ``_paged_kernel``).
+    """
     from .attention import grouped_query_attention
 
     b, t, hq, d = q.shape
     bt = k_pool.shape[1]
     nb = tables.shape[1]
     safe = jnp.maximum(tables, 0)
-    gather = lambda pool: pool[safe].reshape(          # noqa: E731
-        b, nb * bt, *pool.shape[2:])
-    k_all, v_all = gather(k_pool), gather(v_pool)
+
+    def gather(pool, pscale):
+        arr = pool[safe].reshape(b, nb * bt, *pool.shape[2:])
+        if pscale is not None:
+            s = pscale[safe].reshape(b, nb * bt, *pscale.shape[2:])
+            arr = (arr.astype(jnp.float32) * s[..., None]).astype(
+                q.dtype)
+        return arr
+
+    k_all, v_all = gather(k_pool, k_scale), gather(v_pool, v_scale)
     lane = jnp.arange(t)
     q_pos = row_starts[:, None] + lane[None, :]                 # [B, T]
-    k_pos = jnp.arange(nb * bt)
     used = jnp.repeat(tables >= 0, bt, axis=1)                  # [B, L]
-    ok = (
-        (k_pos[None, None, :] <= q_pos[:, :, None])
-        & (lane[None, :, None] >= pad_lens[:, None, None])
-        & used[:, None, :]
-    )                                                           # [B, T, L]
+    valid = lane[None, :, None] >= pad_lens[:, None, None]
+    if window > 0:
+        # ring layout: table slot s holds the newest logical block
+        # j ≡ s (mod nb) at or below the query's own block
+        jq = q_pos // bt                                        # [B, T]
+        slot = jnp.arange(nb)
+        j_log = jq[:, :, None] - jnp.mod(
+            jq[:, :, None] - slot[None, None, :], nb)       # [B, T, NB]
+        k_pos = (j_log[..., None] * bt
+                 + jnp.arange(bt)).reshape(b, t, nb * bt)
+        ok = ((k_pos >= 0) & (k_pos <= q_pos[:, :, None])
+              & (q_pos[:, :, None] - k_pos < window)
+              & valid & used[:, None, :])
+    else:
+        k_pos = jnp.arange(nb * bt)
+        ok = (
+            (k_pos[None, None, :] <= q_pos[:, :, None])
+            & valid & used[:, None, :]
+        )                                                       # [B, T, L]
     return grouped_query_attention(q, k_all, v_all, mask=ok[:, None])
 
 
 def paged_attention(q, k_pool, v_pool, tables, row_starts, pad_lens,
-                    impl: str = "auto", interpret: bool | None = None):
+                    impl: str = "auto", interpret: bool | None = None,
+                    window: int = 0, k_scale=None, v_scale=None):
     """Paged decode attention over the KV block pool.
 
     :param q: ``[B, T, Hq, D]`` query rows (RoPE already applied at
@@ -718,6 +783,15 @@ def paged_attention(q, k_pool, v_pool, tables, row_starts, pad_lens,
         (their output rows are garbage; callers ignore them).
     :param impl: ``"auto"`` (Pallas on TPU, oracle elsewhere),
         ``"pallas"``, or ``"ref"``.
+    :param window: sliding-window size (ISSUE 15). ``> 0`` switches the
+        block table to RING semantics — logical block ``j`` lives in
+        table slot ``j % NB`` — and masks keys outside the band
+        ``q_pos - k_pos < window``; the table width bounds decode reads
+        at O(window), independent of sequence length.
+    :param k_scale / v_scale: ``[P, bt, KVH]`` f32 per-(token, head)
+        scales for int8 pools (ISSUE 15): pages dequantize in the
+        kernel's tile fetch (the decode-bandwidth win — half the KV
+        bytes cross HBM), or on the gather in the oracle.
     :returns: ``[B, T, Hq, D]`` attention output.
 
     Query lane ``i`` of row ``b`` (valid iff ``i >= pad_lens[b]``)
@@ -737,13 +811,15 @@ def paged_attention(q, k_pool, v_pool, tables, row_starts, pad_lens,
         impl = "pallas" if _on_tpu() else "ref"
     if impl == "ref":
         return paged_attention_ref(q, k_pool, v_pool, tables, row_starts,
-                                   pad_lens)
+                                   pad_lens, window=window,
+                                   k_scale=k_scale, v_scale=v_scale)
     if interpret is None:
         interpret = not _on_tpu()
     b, t, hq, d = q.shape
     p, bt, kvh, _ = k_pool.shape
     nb = tables.shape[1]
     groups = hq // kvh
+    quant = k_scale is not None
     t_pad = max(t, PAGED_MIN_Q)
     if t_pad != t:
         # LEFT-pad the q window (the last lane must stay last): the new
@@ -751,21 +827,27 @@ def paged_attention(q, k_pool, v_pool, tables, row_starts, pad_lens,
         q = jnp.pad(q, ((0, 0), (t_pad - t, 0), (0, 0), (0, 0)))
         row_starts = row_starts - (t_pad - t)
         pad_lens = pad_lens + (t_pad - t)
+    page_index = lambda bb, h, j, tbl, st, pd: (       # noqa: E731
+        jnp.maximum(tbl[bb, j], 0), 0, h // groups, 0)
+    scale_index = lambda bb, h, j, tbl, st, pd: (      # noqa: E731
+        jnp.maximum(tbl[bb, j], 0), 0, h // groups)
+    in_specs = [
+        pl.BlockSpec((1, t_pad, 1, d),
+                     lambda bb, h, j, tbl, st, pd: (bb, 0, h, 0)),
+        pl.BlockSpec((1, bt, 1, d), page_index),
+        pl.BlockSpec((1, bt, 1, d), page_index),
+    ]
+    args = [q, k_pool, v_pool]
+    if quant:
+        # dequant epilogue inputs: per-(token, head) f32 scales, same
+        # page-table-driven DMA as the int8 tiles they rescale
+        in_specs += [pl.BlockSpec((1, bt, 1), scale_index),
+                     pl.BlockSpec((1, bt, 1), scale_index)]
+        args += [k_scale, v_scale]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=3,
         grid=(b, hq, nb),
-        in_specs=[
-            pl.BlockSpec((1, t_pad, 1, d),
-                         lambda bb, h, j, tbl, st, pd: (bb, 0, h, 0)),
-            pl.BlockSpec(
-                (1, bt, 1, d),
-                lambda bb, h, j, tbl, st, pd: (
-                    jnp.maximum(tbl[bb, j], 0), 0, h // groups, 0)),
-            pl.BlockSpec(
-                (1, bt, 1, d),
-                lambda bb, h, j, tbl, st, pd: (
-                    jnp.maximum(tbl[bb, j], 0), 0, h // groups, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, t_pad, 1, d),
                                lambda bb, h, j, tbl, st, pd: (bb, 0, h, 0)),
         scratch_shapes=[
@@ -775,12 +857,13 @@ def paged_attention(q, k_pool, v_pool, tables, row_starts, pad_lens,
         ],
     )
     out = pl.pallas_call(
-        functools.partial(_paged_kernel, scale=d ** -0.5, bt=bt, nb=nb),
+        functools.partial(_paged_kernel, scale=d ** -0.5, bt=bt, nb=nb,
+                          window=window, quant=quant),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((b, t_pad, hq, d), q.dtype),
         interpret=interpret,
     )(tables.astype(jnp.int32), row_starts.astype(jnp.int32),
-      pad_lens.astype(jnp.int32), q, k_pool, v_pool)
+      pad_lens.astype(jnp.int32), *args)
     return out[:, t_pad - t:]
 
 

@@ -186,18 +186,26 @@ def validate_tp_geometry(model, tp: int,
             "pick tp dividing every listed dimension)")
 
 
-def kv_pool_pspec():
+def kv_pool_pspec(ndim: int = 4):
     """PartitionSpec for pool pages ``[pool_blocks, block_tokens, KVH,
     D]`` and cache leaves ``[B, T, KVH, D]``: KV heads over ``tensor``,
-    everything else replicated."""
+    everything else replicated. ``ndim=3`` covers the int8-KV pool's
+    scale leaves ``[pool_blocks, block_tokens, KVH]`` (ISSUE 15) whose
+    head axis is last."""
     from jax.sharding import PartitionSpec as P
 
+    if ndim == 3:
+        return P(None, None, TP_AXIS)
     return P(None, None, TP_AXIS, None)
 
 
 def _is_kv_leaf(path, leaf) -> bool:
     last = path[-1]
     name = str(getattr(last, "key", getattr(last, "name", last)))
+    if (getattr(leaf, "ndim", 0) == 3
+            and name in ("cached_key_scale", "cached_value_scale")):
+        # int8-KV pool scale leaves (ISSUE 15): shard with their pages
+        return True
     return (getattr(leaf, "ndim", 0) == 4
             and name in ("cached_key", "cached_value"))
 
@@ -216,12 +224,13 @@ def shard_kv_tree(tree, mesh):
 
     if tp_degree(mesh) <= 1:
         return tree
-    kv = NamedSharding(mesh, kv_pool_pspec())
     rep = NamedSharding(mesh, P())
 
     def put(path, leaf):
-        return jax.device_put(leaf, kv if _is_kv_leaf(path, leaf)
-                              else rep)
+        if _is_kv_leaf(path, leaf):
+            return jax.device_put(leaf, NamedSharding(
+                mesh, kv_pool_pspec(getattr(leaf, "ndim", 4))))
+        return jax.device_put(leaf, rep)
 
     return jax.tree_util.tree_map_with_path(put, tree)
 
@@ -239,11 +248,12 @@ def constrain_kv_tree(tree, mesh):
 
     if tp_degree(mesh) <= 1:
         return tree
-    kv = NamedSharding(mesh, kv_pool_pspec())
 
     def put(path, leaf):
         if _is_kv_leaf(path, leaf):
-            return jax.lax.with_sharding_constraint(leaf, kv)
+            return jax.lax.with_sharding_constraint(
+                leaf, NamedSharding(
+                    mesh, kv_pool_pspec(getattr(leaf, "ndim", 4))))
         return leaf
 
     return jax.tree_util.tree_map_with_path(put, tree)
@@ -338,13 +348,14 @@ def _decode_step_hlo(model, params, batch: int):
         ),
         params,
     )[1]["cache"]
-    kv = NamedSharding(mesh, kv_pool_pspec())
     rep = NamedSharding(mesh, P())
 
     def abstract(path, s):
-        return jax.ShapeDtypeStruct(
-            s.shape, s.dtype,
-            sharding=kv if _is_kv_leaf(path, s) else rep)
+        if _is_kv_leaf(path, s):
+            return jax.ShapeDtypeStruct(
+                s.shape, s.dtype,
+                sharding=NamedSharding(mesh, kv_pool_pspec(len(s.shape))))
+        return jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=rep)
 
     cache = jax.tree_util.tree_map_with_path(abstract, cache_shapes)
     lowered = jax.jit(step).lower(

@@ -38,7 +38,9 @@ def make_artifact(out_dir, arch: str = "TinyLlama",
                   n_kv_head: int = 2, max_len: int = 256,
                   block_tokens: int = 16, pool_blocks: int = 96,
                   compile_cache_dir=None, seed: int = 0,
-                  tensor_parallel: int = 0) -> Path:
+                  tensor_parallel: int = 0, long: bool = False,
+                  window: int = 0, kv_quant: str = "",
+                  prefill_chunk_tokens: int = 0) -> Path:
     """Build + save the artifact; returns the ``-r``-able model path.
 
     Imports jax lazily so ``--help`` stays instant."""
@@ -56,11 +58,26 @@ def make_artifact(out_dir, arch: str = "TinyLlama",
 
     out_dir = Path(out_dir)
     out_dir.mkdir(parents=True, exist_ok=True)
+    if long:
+        # --long (ISSUE 15): the long-context bench/CI traffic target —
+        # a bigger position budget, a sliding window (paged ring
+        # layout), an int8-KV pool, and chunked streaming prefill, so
+        # the longctx-smoke job exercises every ISSUE 15 layer from one
+        # artifact. Explicit flags still win.
+        max_len = int(max_len) if int(max_len) != 256 else 4096
+        window = int(window) or 512
+        kv_quant = kv_quant or "int8"
+        prefill_chunk_tokens = int(prefill_chunk_tokens) or 256
+        pool_blocks = max(int(pool_blocks), 256)
     arch_args = {
         "vocab_size": int(vocab_size), "d_model": int(d_model),
         "n_layer": int(n_layer), "n_head": int(n_head),
         "n_kv_head": int(n_kv_head), "max_len": int(max_len),
     }
+    if int(window) > 0:
+        arch_args["window"] = int(window)
+    if kv_quant:
+        arch_args["kv_quant"] = str(kv_quant)
     model = MODELS.get(arch)(**arch_args)
     if int(tensor_parallel) > 1:
         # refuse at PRODUCTION time too: baking an intended tp the
@@ -76,6 +93,11 @@ def make_artifact(out_dir, arch: str = "TinyLlama",
         "enabled": True, "block_tokens": int(block_tokens),
         "pool_blocks": int(pool_blocks), "eviction": "lru",
     }}
+    if int(prefill_chunk_tokens) > 0:
+        cfg["serving"]["prefill_chunk_tokens"] = \
+            int(prefill_chunk_tokens)
+        cfg["serving"]["prefix_cache"]["prefill_chunk_tokens"] = \
+            int(prefill_chunk_tokens)
     if int(tensor_parallel) > 1:
         # the artifact's INTENDED mesh layout: serve.py picks it up
         # without a --tp flag, and restore validates geometry against
@@ -121,6 +143,20 @@ def main(argv=None) -> int:
                    help="shared persistent XLA cache dir baked into "
                         "the config (fleet replicas warm each other)")
     p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--long", action="store_true",
+                   help="long-context variant (ISSUE 15): 4k max_len, "
+                        "sliding window (paged ring), int8-KV pool, "
+                        "chunked streaming prefill — the longctx-"
+                        "smoke / serve_longctx traffic target")
+    p.add_argument("--window", type=int, default=0,
+                   help="sliding-window size baked into the arch "
+                        "(0 = full attention; --long defaults 512)")
+    p.add_argument("--kv-quant", default="",
+                   help="decode-cache quantization ('int8' = the "
+                        "int8-KV pool layout; --long defaults int8)")
+    p.add_argument("--prefill-chunk-tokens", type=int, default=0,
+                   help="serving.prefill_chunk_tokens baked into the "
+                        "config (--long defaults 256)")
     p.add_argument("--tp", type=int, default=0,
                    help="intended tensor_parallel degree baked into "
                         "the serving config + manifest (ISSUE 10); "
@@ -134,7 +170,9 @@ def main(argv=None) -> int:
         max_len=args.max_len, block_tokens=args.block_tokens,
         pool_blocks=args.pool_blocks,
         compile_cache_dir=args.compile_cache_dir, seed=args.seed,
-        tensor_parallel=args.tp)
+        tensor_parallel=args.tp, long=args.long, window=args.window,
+        kv_quant=args.kv_quant,
+        prefill_chunk_tokens=args.prefill_chunk_tokens)
     print(f"ARTIFACT {path}", flush=True)
     print(f"MANIFEST {path.parent / (path.name + '.manifest.json')}",
           flush=True)

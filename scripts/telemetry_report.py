@@ -75,6 +75,14 @@ _BENCH_METRIC_FALLBACK = {
     "serve_kvtier_hold": ("summary", "serve_kvtier", "warm_hit_hold"),
     "serve_kvtier_rewarm": ("summary", "serve_kvtier",
                             "rewarm_speedup"),
+    # long-context serving gates (ISSUE 15): the warm shared-document
+    # TTFT speedup and the chunked-vs-monolithic TPOT-p99 separation
+    # (monolithic_hold / chunked_hold) — both higher-is-better for the
+    # one-sided floor gate
+    "serve_longctx_ttft": ("summary", "serve_longctx",
+                           "warm_ttft_speedup"),
+    "serve_longctx_decode_hold": ("summary", "serve_longctx",
+                                  "chunk_separation"),
 }
 
 
@@ -225,7 +233,14 @@ def analyze_prefix(records: list) -> dict:
               "warm_admit_copy_bytes_total", "paged_decode_frac",
               "prefix_adopted_blocks_total",
               "prefix_pool_blocks_resident",
-              "prefix_pool_blocks_referenced"):
+              "prefix_pool_blocks_referenced",
+              # long-context serving (ISSUE 15): chunked streaming
+              # prefill progress and WHY traffic degraded off the
+              # paged pool (pool_fallback_total — the per-reason split
+              # lives on /metrics; the refusal string used to go to
+              # logs only)
+              "prefill_chunks_total", "streamed_prefill_tokens_total",
+              "pool_fallback_total"):
         if last.get(k) is not None:
             out[k] = last[k]
     lookups = out.get("prefix_lookups_total")

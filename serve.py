@@ -335,6 +335,29 @@ def service_metrics(service: GenerationService) -> dict:
             prefix.get("tier_disk_blocks", 0))
         out["tier_disk_bytes"] = int(prefix.get("tier_disk_bytes", 0))
         out["peer_exports_total"] = int(stats.get("peer_exports", 0))
+        # long-context serving (ISSUE 15): chunked-streaming-prefill
+        # counters, the pool's layout gauges (page bytes make the int8
+        # HBM saving scrapeable; window exposes the ring), and the
+        # per-reason pool-fallback counters — flat names, the repo's
+        # labeled-family convention (reason rides in the name)
+        out["prefill_chunks_total"] = int(
+            stats.get("prefill_chunks", 0))
+        out["streamed_prefill_tokens_total"] = int(
+            stats.get("streamed_prefill_tokens", 0))
+        out["streamed_requests_total"] = int(
+            stats.get("streamed_requests", 0))
+        out["prefix_page_bytes"] = int(
+            prefix.get("prefix_page_bytes", 0))
+        out["prefix_pool_window"] = int(
+            prefix.get("prefix_pool_window", 0))
+        out["prefix_pool_kv_quant"] = int(
+            prefix.get("prefix_pool_kv_quant", 0))
+        for reason in ("window", "kv_quant", "undersized",
+                       "gpt2_layout", "dry_pool"):
+            out[f"pool_fallback_{reason}_total"] = int(
+                prefix.get(f"pool_fallback_{reason}", 0))
+        out["pool_fallback_total"] = int(
+            prefix.get("pool_fallback_total", 0))
         # batched prefill export (ISSUE 13 satellite): lock
         # acquisitions amortized over export bursts
         out["prefill_export_batches_total"] = int(
@@ -355,6 +378,22 @@ def service_metrics(service: GenerationService) -> dict:
             out["paged_decode_frac"] = (
                 round(int(prefix.get("batch1_paged_requests", 0))
                       / served, 4) if served else 0.0)
+    if prefix is None and getattr(service, "pool_refusal_reason", ""):
+        # the pool REFUSED to construct (unsupported layout, ISSUE 15
+        # satellite): every served request ran without it — counted at
+        # the response funnel (engine/serving._response) and attributed
+        # to the machine-readable refusal reason so fleet-level
+        # fallback is visible, not a one-line log
+        reason = str(service.pool_refusal_reason)
+        refused = int(stats.get("pool_refused_requests", 0))
+        # "unsupported" = a refusal without a machine-readable reason
+        # (a plain ValueError) — still split out so the per-reason
+        # family always sums to the total
+        for r in ("window", "kv_quant", "undersized", "gpt2_layout",
+                  "unsupported"):
+            out[f"pool_fallback_{r}_total"] = (
+                refused if r == reason else 0)
+        out["pool_fallback_total"] = refused
     # persistent-compile-cache counters (utils/compile_cache): a miss is
     # a real XLA compile, a hit an executable read back from disk —
     # restart cost and mid-traffic recompile storms as scrapeable series
@@ -940,6 +979,14 @@ def main(args, config):
         prefix_cfg["disk_spill_dir"] = args.spill_dir
         if args.spill_disk_blocks > 0:
             prefix_cfg["disk_spill_blocks"] = args.spill_disk_blocks
+    # chunked streaming prefill (ISSUE 15): CLI wins over the config's
+    # serving.prefill_chunk_tokens; the knob also sizes the ring slack
+    # for sliding-window pools (the two must agree, so it rides the
+    # prefix_cfg dict the pool reads)
+    prefill_chunk = int(args.prefill_chunk_tokens or 0) or int(
+        (config.get("serving") or {}).get("prefill_chunk_tokens") or 0)
+    if prefill_chunk:
+        prefix_cfg["prefill_chunk_tokens"] = prefill_chunk
     if args.role != "both" and not prefix_cfg.get("enabled"):
         # role-split serving IS page shipping: refuse the geometry in
         # milliseconds instead of deep in service construction
@@ -993,7 +1040,13 @@ def main(args, config):
     if dp > 1:
         want = "dp"
     elif want == "auto":
-        want = ("continuous" if probe._pad_ok and args.max_batch > 1
+        # sliding-window models (ISSUE 15): not pad-capable (rolling
+        # contiguous cache), but the paged RING layout serves them on
+        # the continuous engine when a pool is configured
+        ring_ok = (int(getattr(model, "window", 0) or 0) > 0
+                   and bool(prefix_cfg.get("enabled")))
+        want = ("continuous"
+                if (probe._pad_ok or ring_ok) and args.max_batch > 1
                 else "static" if args.max_batch > 1 else "none")
     if want == "dp":
         # DP×TP (ISSUE 12): N independent continuous engines, one per
@@ -1022,7 +1075,8 @@ def main(args, config):
                 window_ms=args.batch_window_ms,
                 warm_buckets=warm_buckets, prefix_cache=prefix_cfg,
                 spec_draft_layers=spec_draft_layers, tracer=tracer,
-                slo=slo, brownout=brownout_cfg, role=args.role),
+                slo=slo, brownout=brownout_cfg, role=args.role,
+                prefill_chunk_tokens=prefill_chunk),
             service_kw_fn=lambda g: ({"recorder": recorder,
                                       "tsdb": tsdb}
                                      if g == 0 else {}),
@@ -1054,6 +1108,7 @@ def main(args, config):
             recorder=recorder, spec_draft_layers=spec_draft_layers,
             tracer=tracer, slo=slo, brownout=brownout_cfg,
             role=args.role, tsdb=tsdb,
+            prefill_chunk_tokens=prefill_chunk,
         )
     elif want == "static":
         # the static micro-batch scheduler's shared-group prefill does
@@ -1202,6 +1257,14 @@ if __name__ == "__main__":
                              "(system / few-shot preambles) admit as "
                              "an HBM block copy + suffix-only prefill "
                              "instead of a full recompute")
+    parser.add_argument("--prefill-chunk-tokens", default=0, type=int,
+                        help="chunked streaming prefill (ISSUE 15): "
+                             "prompts whose uncached suffix exceeds "
+                             "this many tokens admit incrementally "
+                             "across scheduler ticks (power of two; "
+                             "0 = config serving.prefill_chunk_tokens, "
+                             "else monolithic admits — window models "
+                             "default to the ring slack)")
     parser.add_argument("--spill-blocks", default=0, type=int,
                         help="host-RAM KV spill tier size in blocks "
                              "(ISSUE 13): eviction DEMOTES page bytes "

@@ -178,22 +178,52 @@ def test_rotation_composes_to_absolute_angles():
 
 
 def test_unsupported_layouts_raise(stack):
+    """ISSUE 15 rewrote the old window/kv_quant refusals into real
+    layouts (paged ring / int8 pool) — what REMAINS refused: the
+    scatter-only path for window models, ring geometry the block size
+    cannot tile, and unknown quant strings. Every refusal carries the
+    machine-readable reason the pool_fallback counters consume."""
+    from pytorch_distributed_template_tpu.engine.kvcache import (
+        PoolUnsupported,
+    )
+
     model, params, _ = stack
-    win = MODELS.get("Llama")(vocab_size=VOCAB, n_layer=1, n_head=2,
-                              n_kv_head=2, d_model=16, max_len=64,
+    win = MODELS.get("Llama")(vocab_size=VOCAB, n_layer=2, n_head=4,
+                              n_kv_head=2, d_model=32, max_len=128,
                               window=32)
-    with pytest.raises(ValueError, match="non-rolling"):
-        PrefixCache(win, params, block_tokens=8, pool_blocks=8)
-    kvq = MODELS.get("Llama")(vocab_size=VOCAB, n_layer=1, n_head=2,
-                              n_kv_head=2, d_model=16, max_len=64,
+    # the PAGED ring layout constructs for window models now...
+    pf = PrefixCache(win, params, block_tokens=8, pool_blocks=32)
+    assert pf.paged and pf.window == 32 and pf.nb_max >= 5
+    # ...but the scatter arm still cannot serve a rolling cache
+    with pytest.raises(PoolUnsupported, match="paged") as ei:
+        PrefixCache(win, params, block_tokens=8, pool_blocks=32,
+                    paged=False)
+    assert ei.value.reason == "window"
+    # ring geometry the block size cannot tile refuses loudly
+    with pytest.raises(PoolUnsupported, match="multiple") as ei:
+        PrefixCache(win, params, block_tokens=12, pool_blocks=32)
+    assert ei.value.reason == "window"
+    # an undersized pool has no scatter fallback under a window
+    with pytest.raises(PoolUnsupported, match="ring") as ei:
+        PrefixCache(win, params, block_tokens=8, pool_blocks=4)
+    assert ei.value.reason == "undersized"
+    # the int8-KV pool layout constructs (scale leaves alongside pages)
+    kvq = MODELS.get("Llama")(vocab_size=VOCAB, n_layer=2, n_head=4,
+                              n_kv_head=2, d_model=32, max_len=128,
                               kv_quant="int8")
-    with pytest.raises(ValueError, match="full-precision"):
-        PrefixCache(kvq, params, block_tokens=8, pool_blocks=8)
-    # a config asking for it on an unsupported layout degrades LOUDLY
-    # to no pool instead of failing the server load
+    pfq = PrefixCache(kvq, params, block_tokens=8, pool_blocks=32)
+    scales = [ps for ps in pfq.pool if ps.endswith("_scale")]
+    int8 = [ps for ps, leaf in pfq.pool.items()
+            if str(leaf.dtype) == "int8"]
+    assert len(scales) == 4 and len(int8) == 4    # 2 layers x K/V
+    # a config asking for a genuinely refused layout degrades LOUDLY
+    # to no pool instead of failing the server load, and the service
+    # remembers WHY for the fallback counters
     svc = GenerationService.from_model(
-        win, params, prefix_cache={"enabled": True})
+        win, params,
+        prefix_cache={"enabled": True, "block_tokens": 12})
     assert svc.prefix_cache_stats() is None
+    assert svc.pool_refusal_reason == "window"
 
 
 # ---------------------------------------------------------------------------
