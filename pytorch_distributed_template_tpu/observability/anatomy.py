@@ -1,0 +1,247 @@
+"""Step anatomy: kernel-class cost attribution married to measured time.
+
+``costmodel`` answers "what is IN this executable" (per-kernel-class
+FLOPs/bytes + roofline placement); the telemetry layer answers "how
+long did the step take". This module joins them into the **step
+anatomy** surfaced on ``/metrics?format=json``
+(``decode_step_anatomy`` / ``train_step_anatomy``), in flight-recorder
+records, in ``telemetry_report``'s "Step anatomy" section, and in the
+stitched Perfetto trace:
+
+- per class: attributed time (the class's share of the roofline-modeled
+  device time, scaled onto the measured wall EWMA), FLOPs, bytes, and
+  whether the class sits under the compute, HBM, or ICI ceiling;
+- ``dispatch_gap_frac``: the fraction of measured wall time the device
+  model can NOT account for — host dispatch, data waits, queue gaps
+  (the continuous engine's analog of the trainer's ``data_wait_ms``).
+
+The analysis itself is an AOT lower+compile of the executable's
+abstract signature, which is NOT free — so :class:`AnatomyStore` runs
+it once per (kind, signature) on a single daemon worker thread, and
+the hot path (``observe`` per chunk/step) is a dict update. The
+``quick_anatomy`` bench rung gates the end-to-end overhead < 2% with
+the paired-window discipline. ``PDT_ANATOMY=0`` disables the whole
+subsystem (every surface degrades to an absent section).
+"""
+from __future__ import annotations
+
+import os
+import queue
+import threading
+from typing import Dict, Optional
+
+from . import costmodel
+
+
+def anatomy_enabled(default: bool = True) -> bool:
+    """The one switch: ``PDT_ANATOMY=0`` turns every anatomy surface
+    off (registration, background compiles, /metrics sections)."""
+    raw = os.environ.get("PDT_ANATOMY")
+    if raw is None:
+        return default
+    return raw.strip().lower() not in ("0", "false", "off", "no", "")
+
+
+def analyze_step(jitted_fn, *args, **kwargs) -> Optional[dict]:
+    """One-shot synchronous anatomy of a jitted fn (AOT compile —
+    startup/bench use, not the hot loop): class costs + roofline.
+    None when lowering or the backend's cost analysis fails."""
+    try:
+        costs = costmodel.analyze_jitted(jitted_fn, *args, **kwargs)
+        return costmodel.roofline(costs)
+    except Exception:  # noqa: BLE001 — anatomy must never break a run
+        return None
+
+
+def analyze_compiled(compiled) -> Optional[dict]:
+    """Anatomy of an already-compiled executable (no extra compile)."""
+    try:
+        return costmodel.roofline(
+            costmodel.executable_class_costs(compiled))
+    except Exception:  # noqa: BLE001
+        return None
+
+
+def render_anatomy(analysis: dict, wall_ms: Optional[float] = None,
+                   observed: int = 0, top_n: int = 0) -> dict:
+    """Roofline analysis + measured wall time -> the JSON anatomy
+    section. Class time = ``frac_time`` of the modeled device time
+    scaled onto the measured wall minus the dispatch gap; without a
+    measured wall the modeled times stand on their own."""
+    classes = analysis.get("classes") or {}
+    est_s = float(analysis.get("est_step_time_s") or 0.0)
+    device_ms = None
+    gap_frac = None
+    if wall_ms and wall_ms > 0:
+        device_ms = min(est_s * 1e3, wall_ms)
+        gap_frac = max(0.0, 1.0 - est_s * 1e3 / wall_ms)
+    items = sorted(classes.items(),
+                   key=lambda kv: -kv[1].get("est_time_s", 0.0))
+    if top_n:
+        items = items[:top_n]
+    out_classes = {}
+    for cls, c in items:
+        if not c.get("count"):
+            continue
+        frac = float(c.get("frac_time") or 0.0)
+        row = {
+            "frac_time": round(frac, 4),
+            "flops": round(float(c.get("flops") or 0.0), 1),
+            "bytes": round(float(c.get("bytes") or 0.0), 1),
+            "bound": c.get("bound"),
+        }
+        if device_ms is not None:
+            row["time_ms"] = round(frac * device_ms, 4)
+        out_classes[cls] = row
+    out = {
+        "classes": out_classes,
+        "est_step_time_ms": round(est_s * 1e3, 4),
+        "total_flops": round(sum(float(c.get("flops", 0.0))
+                                 for c in classes.values()), 1),
+        "peak_flops": analysis.get("peak_flops"),
+        "hbm_bytes_s": analysis.get("hbm_bytes_s"),
+    }
+    if wall_ms is not None:
+        out["wall_ms"] = round(float(wall_ms), 4)
+    if gap_frac is not None:
+        out["dispatch_gap_frac"] = round(gap_frac, 4)
+    if observed:
+        out["observed_steps"] = int(observed)
+    return out
+
+
+class AnatomyStore:
+    """Per-kind anatomy with background analysis and a dict-update
+    hot path.
+
+    ``register(kind, jitted_fn, args)`` abstracts the args (shape/
+    dtype/sharding only — no live buffer refs cross the thread
+    boundary, the executables donate) and queues ONE analysis per
+    (kind, signature) on the shared worker thread; re-registrations of
+    a seen signature are a set lookup. ``observe(kind, wall_ms)`` is
+    the per-chunk/step hot call: a counter bump + EWMA. ``snapshot()``
+    renders the /metrics sections; ``version`` bumps when an analysis
+    lands, so callers attach anatomy to a flight record exactly when
+    it changes instead of every step."""
+
+    _EWMA_ALPHA = 0.1
+
+    def __init__(self, enabled: Optional[bool] = None):
+        self.enabled = (anatomy_enabled() if enabled is None
+                        else bool(enabled))
+        self.version = 0
+        self._lock = threading.Lock()
+        self._seen: set = set()
+        self._analyses: Dict[str, dict] = {}
+        self._sig_counts: Dict[str, int] = {}
+        self._walls: Dict[str, dict] = {}
+        self._queue: "queue.Queue" = queue.Queue()
+        self._worker: Optional[threading.Thread] = None
+
+    # -- registration / analysis (cold path) ---------------------------
+
+    def _ensure_worker(self) -> None:
+        if self._worker is None or not self._worker.is_alive():
+            self._worker = threading.Thread(
+                target=self._run, name="anatomy-worker", daemon=True)
+            self._worker.start()
+
+    def _run(self) -> None:
+        while True:
+            kind, fn, args, kwargs = self._queue.get()
+            analysis = analyze_step(fn, *args, **kwargs)
+            with self._lock:
+                if analysis is not None:
+                    self._analyses[kind] = analysis
+                    self.version += 1
+            self._queue.task_done()
+
+    def register(self, kind: str, jitted_fn, args, kwargs=None) -> bool:
+        """Queue one background analysis of ``jitted_fn`` at this
+        abstract signature, deduped — steady state is one frozenset
+        lookup. Returns True when a new analysis was queued."""
+        if not self.enabled:
+            return False
+        try:
+            abstract = costmodel.abstractify(tuple(args))
+        except Exception:  # noqa: BLE001
+            return False
+        sig = (kind, str([
+            (getattr(x, "shape", None), str(getattr(x, "dtype", None)))
+            for x in _flat_leaves(abstract)]))
+        sig = (sig[0], hash(sig[1]))
+        with self._lock:
+            if sig in self._seen:
+                return False
+            self._seen.add(sig)
+            self._sig_counts[kind] = self._sig_counts.get(kind, 0) + 1
+        self._ensure_worker()
+        self._queue.put((kind, jitted_fn, abstract, kwargs or {}))
+        return True
+
+    def put_analysis(self, kind: str, analysis: dict) -> None:
+        """Install an already-computed analysis (tests; one-shot
+        callers that compiled synchronously anyway)."""
+        with self._lock:
+            self._analyses[kind] = analysis
+            self.version += 1
+
+    def wait_idle(self, timeout_s: float = 30.0) -> bool:
+        """Block until queued analyses finish (tests/bench — never the
+        serving path)."""
+        import time
+
+        t0 = time.monotonic()
+        while not self._queue.empty():
+            if time.monotonic() - t0 > timeout_s:
+                return False
+            time.sleep(0.01)
+        # queue empty != task done; poll the join flag briefly
+        while self._queue.unfinished_tasks:
+            if time.monotonic() - t0 > timeout_s:
+                return False
+            time.sleep(0.01)
+        return True
+
+    # -- hot path ------------------------------------------------------
+
+    def observe(self, kind: str, wall_ms: float) -> None:
+        """Per-step/chunk measured wall time for ``kind`` — a dict
+        update, safe at serving chunk rate."""
+        if not self.enabled:
+            return
+        w = self._walls.get(kind)
+        if w is None:
+            self._walls[kind] = {"ewma_ms": float(wall_ms), "n": 1}
+        else:
+            w["ewma_ms"] += self._EWMA_ALPHA * (wall_ms - w["ewma_ms"])
+            w["n"] += 1
+
+    # -- surfaces ------------------------------------------------------
+
+    def snapshot(self, kind: Optional[str] = None, top_n: int = 0):
+        """The /metrics section: one rendered anatomy per kind (or the
+        single requested kind; None while analysis hasn't landed)."""
+        if not self.enabled:
+            return None if kind is not None else {}
+        with self._lock:
+            analyses = (dict(self._analyses) if kind is None
+                        else {kind: self._analyses.get(kind)})
+        out = {}
+        for k, analysis in analyses.items():
+            if analysis is None:
+                continue
+            w = self._walls.get(k) or {}
+            rendered = render_anatomy(
+                analysis, wall_ms=w.get("ewma_ms"),
+                observed=w.get("n", 0), top_n=top_n)
+            if self._sig_counts.get(k, 0) > 1:
+                rendered["signatures"] = self._sig_counts[k]
+            out[k] = rendered
+        return out.get(kind) if kind is not None else out
+
+
+def _flat_leaves(tree):
+    import jax
+
+    return jax.tree.leaves(tree)

@@ -1,0 +1,331 @@
+"""XLA cost-model attribution: kernel-class costs of compiled executables.
+
+The repo already reads two numbers off a compiled executable — total
+FLOPs (``profiler.compiled_flops``, feeding MFU) and collective bytes
+(``parallel.tp.hlo_collectives``, feeding the TP floor gate). Both are
+single scalars over a whole step; neither can answer "where does the
+step's time GO?" — the question ROADMAP items 4/5 gate on (the MoE
+rung's 52.4% routing overhead is one opaque number, and the int8
+dequant epilogue has no kernel-level attribution at all).
+
+This module is the missing middle layer. It walks the **compiled** HLO
+text (post-fusion — the instructions the hardware actually runs, each
+carrying the JAX scope in its ``metadata={op_name=...}``), classifies
+every instruction into a kernel class:
+
+- ``attention``   — dots/softmax under an attention/flash scope
+- ``dense_matmul``— every other dot/convolution (MLP, QKV/O projections,
+                    expert FFNs, the LM head)
+- ``moe_dispatch``— router matmul, top-k, one-hot/sort/gather under a
+                    MoE scope on the way INTO the experts
+- ``moe_combine`` — the weighted scatter/einsum back OUT of the experts
+- ``collective``  — all-reduce / all-gather / reduce-scatter /
+                    all-to-all / collective-permute (ICI traffic)
+- ``quant_dequant``— int8<->float converts + their scale multiplies
+- ``elementwise`` — everything else (LN, residuals, optimizer math)
+
+and estimates per-instruction FLOPs and bytes from the instruction
+shapes (the ``hlo_collectives`` technique, generalized). The per-class
+sums are then **rescaled so they agree with XLA's own
+``cost_analysis()`` totals** for the executable — the cost model
+supplies the authoritative magnitudes, the HLO walk supplies the
+attribution. :func:`roofline` converts class costs into estimated
+device time + a compute/HBM/ICI-bound placement against the BASELINE.md
+roofline constants (197 TFLOP/s bf16 peak, the measured ~260 GB/s HBM
+envelope) so a class's placement says WHICH ceiling it sits under.
+
+Everything here is AOT and side-effect-free: callers lower+compile
+abstract shapes (no device allocation, nothing executed), so analysis
+can run on a background thread off the serving hot path —
+``observability.anatomy`` does exactly that.
+"""
+from __future__ import annotations
+
+import os
+import re
+from typing import Dict, Optional
+
+#: classification targets, in display order
+KERNEL_CLASSES = (
+    "attention", "dense_matmul", "moe_dispatch", "moe_combine",
+    "collective", "quant_dequant", "elementwise",
+)
+
+# BASELINE.md roofline constants (v5e slice): bf16 peak per chip, the
+# MEASURED HBM envelope (~260 GB/s of the 819 GB/s spec — the number
+# the decode rung's total_bw_frac is normalized against), and one ICI
+# link direction. Env-overridable like profiler.PDT_TPU_PEAK_FLOPS so
+# a different slice reuses the machinery without a code edit.
+DEFAULT_PEAK_FLOPS = 197e12
+DEFAULT_HBM_BYTES_S = 260e9
+DEFAULT_ICI_BYTES_S = 45e9
+
+_DTYPE_BYTES = {
+    "f64": 8, "f32": 4, "f16": 2, "bf16": 2, "f8e4m3fn": 1,
+    "f8e5m2": 1, "s64": 8, "u64": 8, "s32": 4, "u32": 4, "s16": 2,
+    "u16": 2, "s8": 1, "u8": 1, "pred": 1,
+}
+
+_COLLECTIVE_OPS = (
+    "all-reduce", "all-gather", "reduce-scatter", "all-to-all",
+    "collective-permute",
+)
+
+# one HLO instruction: `%name = f32[8,128]{1,0} dot(f32[8,64] %a, ...)`
+# (tuple-typed results match on their first element, same as
+# parallel.tp.hlo_collectives — a weight, not an exact byte count)
+_INSTR_RE = re.compile(
+    r"=\s*\(?\s*(\w+)\[([0-9,]*)\][^=]*?\s"
+    r"([a-z][a-z0-9\-]*)(?:-start)?\(")
+_OPERAND_RE = re.compile(r"(\w+)\[([0-9,]*)\](?:\{[0-9,]*\})? %")
+_OPNAME_RE = re.compile(r'op_name="([^"]*)"')
+_CONTRACT_RE = re.compile(r"lhs_contracting_dims=\{([0-9,]*)\}")
+
+# scope keyword tables, matched case-insensitively against the
+# op_name metadata (the flax module path survives compilation):
+# moe wins over attention wins over the opcode fallback, and within a
+# moe scope the combine-side keywords are checked first (the combine
+# einsum's scope also contains the block name the dispatch shares)
+_ATTN_PAT = re.compile(r"attn|attention|flash|softmax", re.I)
+_MOE_PAT = re.compile(r"moe|expert|router|gshard", re.I)
+_MOE_COMBINE_PAT = re.compile(
+    r"combine|unsort|scatter_out|weighted_sum|sec,ecd", re.I)
+_MOE_EXPERT_MM_PAT = re.compile(
+    # param names (wi/wo), module names, and the expert einsum
+    # equations themselves — flax puts the equation in the scope
+    # (`moe/ecd,edf->ecf/dot_general`), and those [E,C,d]x[E,d,f]
+    # batched matmuls are the expert WORK, not routing
+    r"wi|wo|mlp|ffn|expert_m|ecd,edf|ecf,efd", re.I)
+_QUANT_PAT = re.compile(r"quant|dequant", re.I)
+
+
+def _numel(dims: str) -> int:
+    n = 1
+    for d in dims.split(","):
+        if d.strip():
+            n *= int(d)
+    return n
+
+
+def classify_instruction(opcode: str, op_name: str) -> str:
+    """Kernel class of one HLO instruction from its opcode + the JAX
+    scope carried in its ``op_name`` metadata."""
+    if opcode in _COLLECTIVE_OPS:
+        return "collective"
+    if _QUANT_PAT.search(op_name):
+        return "quant_dequant"
+    if _MOE_PAT.search(op_name):
+        if _MOE_COMBINE_PAT.search(op_name):
+            return "moe_combine"
+        if opcode in ("dot", "convolution") \
+                and _MOE_EXPERT_MM_PAT.search(op_name):
+            # the expert FFN matmuls are the WORK, not the routing —
+            # matched-active-FLOPs accounting keeps them dense_matmul
+            return "dense_matmul"
+        return "moe_dispatch"
+    if _ATTN_PAT.search(op_name):
+        return "attention"
+    if opcode in ("dot", "convolution"):
+        return "dense_matmul"
+    return "elementwise"
+
+
+def parse_hlo_classes(hlo: str) -> Dict[str, dict]:
+    """Walk compiled HLO text into per-class FLOP/byte/count estimates.
+
+    Per instruction: bytes = (operand + result elements) x dtype
+    width; FLOPs = 2 x result elements x contraction length for dots
+    (contraction parsed from ``lhs_contracting_dims`` against the first
+    operand's shape), result elements otherwise. These are WEIGHTS for
+    attribution — :func:`executable_class_costs` rescales them against
+    ``cost_analysis()`` so the totals are XLA's own."""
+    out: Dict[str, dict] = {
+        c: {"flops": 0.0, "bytes": 0.0, "count": 0}
+        for c in KERNEL_CLASSES
+    }
+    for line in hlo.splitlines():
+        m = _INSTR_RE.search(line)
+        if m is None:
+            continue
+        dtype, dims, opcode = m.groups()
+        if opcode in ("parameter", "constant", "tuple",
+                      "get-tuple-element", "bitcast",
+                      # container ops: their cost IS their body's cost,
+                      # and the body's instructions are walked too —
+                      # counting both would double-attribute
+                      "fusion", "call", "while", "conditional"):
+            continue
+        res_elems = _numel(dims)
+        nbytes = res_elems * _DTYPE_BYTES.get(dtype, 4)
+        operands = _OPERAND_RE.findall(line[m.end():])
+        for odt, odims in operands:
+            nbytes += _numel(odims) * _DTYPE_BYTES.get(odt, 4)
+        flops = float(res_elems)
+        if opcode in ("dot", "convolution"):
+            contract = 1
+            cm = _CONTRACT_RE.search(line)
+            if cm and operands:
+                lhs_dims = [int(d) for d in operands[0][1].split(",")
+                            if d.strip()]
+                for idx in cm.group(1).split(","):
+                    if idx.strip() and int(idx) < len(lhs_dims):
+                        contract *= lhs_dims[int(idx)]
+            flops = 2.0 * res_elems * max(contract, 1)
+        name_m = _OPNAME_RE.search(line)
+        cls = classify_instruction(
+            opcode, name_m.group(1) if name_m else "")
+        out[cls]["flops"] += flops
+        out[cls]["bytes"] += nbytes
+        out[cls]["count"] += 1
+    return out
+
+
+def cost_totals(compiled) -> dict:
+    """XLA ``cost_analysis()`` totals of a compiled executable,
+    tolerant of the list-of-dict shape older jax returns (the
+    ``profiler.executable_flops`` convention). Empty dict when the
+    backend doesn't report."""
+    try:
+        cost = compiled.cost_analysis()
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0] if cost else {}
+        out = {}
+        if cost.get("flops"):
+            out["flops"] = float(cost["flops"])
+        if cost.get("bytes accessed"):
+            out["bytes"] = float(cost["bytes accessed"])
+        return out
+    except Exception:  # noqa: BLE001 — absent on some backends
+        return {}
+
+
+def executable_class_costs(compiled) -> dict:
+    """Per-kernel-class FLOPs/bytes for one compiled executable:
+    the HLO-walk attribution of :func:`parse_hlo_classes`, rescaled
+    per dimension so the class sums equal the executable's own
+    ``cost_analysis()`` totals (when the backend reports them — the
+    raw HLO estimates stand otherwise). Returns::
+
+        {"classes": {cls: {"flops", "bytes", "count", "frac_flops"}},
+         "total_flops", "total_bytes", "collective_bytes",
+         "instructions"}
+    """
+    classes = parse_hlo_classes(compiled.as_text())
+    totals = cost_totals(compiled)
+    est_flops = sum(c["flops"] for c in classes.values())
+    est_bytes = sum(c["bytes"] for c in classes.values())
+    flops_scale = (totals["flops"] / est_flops
+                   if totals.get("flops") and est_flops > 0 else 1.0)
+    bytes_scale = (totals["bytes"] / est_bytes
+                   if totals.get("bytes") and est_bytes > 0 else 1.0)
+    out_classes = {}
+    for cls, c in classes.items():
+        out_classes[cls] = {
+            "flops": c["flops"] * flops_scale,
+            "bytes": c["bytes"] * bytes_scale,
+            "count": c["count"],
+        }
+    total_flops = sum(c["flops"] for c in out_classes.values())
+    for c in out_classes.values():
+        c["frac_flops"] = (c["flops"] / total_flops
+                           if total_flops > 0 else 0.0)
+    return {
+        "classes": out_classes,
+        "total_flops": total_flops,
+        "total_bytes": sum(c["bytes"] for c in out_classes.values()),
+        "collective_bytes": out_classes["collective"]["bytes"],
+        "instructions": sum(c["count"] for c in out_classes.values()),
+    }
+
+
+def analyze_jitted(jitted_fn, *args, **kwargs) -> dict:
+    """AOT lower+compile ``jitted_fn`` for the given (abstract or
+    concrete) args and return :func:`executable_class_costs`. Like
+    ``profiler.compiled_flops`` this is a one-shot startup/background
+    call, NOT a hot-loop call — it pays a compile."""
+    return executable_class_costs(
+        jitted_fn.lower(*args, **kwargs).compile())
+
+
+def abstractify(tree):
+    """Concrete arg tree -> ShapeDtypeStruct tree carrying shardings
+    (the ``parallel.tp._decode_step_hlo`` technique), so an analysis
+    thread never holds references to live (donatable) buffers."""
+    import jax
+
+    def leaf(x):
+        if isinstance(x, jax.ShapeDtypeStruct):
+            return x
+        shape = getattr(x, "shape", None)
+        dtype = getattr(x, "dtype", None)
+        if shape is None or dtype is None:
+            return x
+        sharding = getattr(x, "sharding", None)
+        try:
+            return jax.ShapeDtypeStruct(shape, dtype, sharding=sharding)
+        except (TypeError, ValueError):
+            return jax.ShapeDtypeStruct(shape, dtype)
+
+    return jax.tree.map(leaf, tree)
+
+
+def roofline_constants(peak_flops: Optional[float] = None,
+                       hbm_bytes_s: Optional[float] = None,
+                       ici_bytes_s: Optional[float] = None) -> dict:
+    """Resolve the roofline triple: explicit args > env overrides >
+    the detected chip's peak (profiler table) > BASELINE.md defaults."""
+    if peak_flops is None:
+        env = os.environ.get("PDT_TPU_PEAK_FLOPS")
+        if env:
+            peak_flops = float(env)
+        else:
+            try:
+                from .profiler import peak_flops_per_device
+                peak_flops = peak_flops_per_device()
+            except Exception:  # noqa: BLE001
+                peak_flops = None
+        if peak_flops is None:
+            peak_flops = DEFAULT_PEAK_FLOPS
+    if hbm_bytes_s is None:
+        hbm_bytes_s = float(
+            os.environ.get("PDT_HBM_BYTES_S", DEFAULT_HBM_BYTES_S))
+    if ici_bytes_s is None:
+        ici_bytes_s = float(
+            os.environ.get("PDT_ICI_BYTES_S", DEFAULT_ICI_BYTES_S))
+    return {"peak_flops": float(peak_flops),
+            "hbm_bytes_s": float(hbm_bytes_s),
+            "ici_bytes_s": float(ici_bytes_s)}
+
+
+def roofline(costs: dict, peak_flops: Optional[float] = None,
+             hbm_bytes_s: Optional[float] = None,
+             ici_bytes_s: Optional[float] = None) -> dict:
+    """Roofline placement per kernel class: estimated device time is
+    ``max(flops/peak, bytes/hbm)`` (``bytes/ici`` for the collective
+    class), and the class is bound by whichever ceiling wins. Returns
+    ``{"classes": {cls: {est_time_s, frac_time, bound, ...}},
+    "est_step_time_s", constants...}`` — fractions of the MODELED
+    time; ``anatomy`` marries them to measured wall time."""
+    k = roofline_constants(peak_flops, hbm_bytes_s, ici_bytes_s)
+    out_classes = {}
+    for cls, c in costs["classes"].items():
+        t_compute = c["flops"] / k["peak_flops"]
+        if cls == "collective":
+            t_mem = c["bytes"] / k["ici_bytes_s"]
+            bound = "ici" if t_mem >= t_compute else "compute"
+        else:
+            t_mem = c["bytes"] / k["hbm_bytes_s"]
+            bound = "hbm" if t_mem >= t_compute else "compute"
+        out_classes[cls] = {
+            **c,
+            "est_time_s": max(t_compute, t_mem),
+            "bound": bound,
+        }
+    total = sum(c["est_time_s"] for c in out_classes.values())
+    for c in out_classes.values():
+        c["frac_time"] = (c["est_time_s"] / total if total > 0 else 0.0)
+    return {
+        "classes": out_classes,
+        "est_step_time_s": total,
+        **k,
+    }

@@ -16,6 +16,7 @@ percentile gauges is not aggregation.
 """
 from __future__ import annotations
 
+import re
 import threading
 from typing import Dict, List, Optional
 
@@ -181,3 +182,113 @@ def prometheus_text(metrics: dict, prefix: str = "pdt_serve") -> str:
                 if isinstance(vv, (int, float)):
                     emit(f"{k}_{kk}", vv)
     return "\n".join(lines) + "\n"
+
+
+_METRIC_NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+
+
+def lint_exposition(text: str) -> List[str]:
+    """Self-lint a Prometheus exposition body (ISSUE 16).
+
+    Every ``/metrics`` producer in the repo (serve.py's
+    ``service_metrics``, the fleet router's ``router_metrics``) builds
+    its dict by MERGING several sources — engine stats, manager
+    counters, admission stats, goodput ledgers — so naming drift is a
+    merge away: a counter that forgot its ``_total`` suffix, a nested
+    dict flattening onto an existing top-level key (duplicate series),
+    a histogram snapshot whose child series collide with a scalar.
+    This walks the rendered text (the single choke point every
+    producer already routes through) and returns violation strings —
+    empty means clean. Checked:
+
+    - metric names are charset-legal and declared by exactly ONE
+      ``# TYPE`` line (a duplicate declaration IS the flatten
+      collision above);
+    - counter-typed series end ``_total``, and nothing typed gauge
+      ends ``_total`` (it would silently demote a counter);
+    - every histogram exposes ``_bucket`` series including
+      ``le="+Inf"``, ``_sum`` and ``_count``, bucket counts are
+      cumulative (non-decreasing by ``le``) and the ``+Inf`` bucket
+      equals ``_count``;
+    - histogram child names never collide with an independently
+      declared series;
+    - no sample line repeats the same series (name + labels).
+    """
+    violations: List[str] = []
+    types: Dict[str, str] = {}
+    samples: Dict[str, float] = {}
+    seen_lines: set = set()
+    for line in text.splitlines():
+        if not line.strip():
+            continue
+        if line.startswith("#"):
+            parts = line.split()
+            if len(parts) >= 4 and parts[1] == "TYPE":
+                name, kind = parts[2], parts[3]
+                if name in types:
+                    violations.append(
+                        f"duplicate TYPE declaration: {name}")
+                types[name] = kind
+            continue
+        token, _, value = line.partition(" ")
+        if token in seen_lines:
+            violations.append(f"duplicate sample: {token}")
+        seen_lines.add(token)
+        name = token.split("{", 1)[0]
+        if not _METRIC_NAME_RE.match(name):
+            violations.append(f"illegal metric name: {name}")
+        try:
+            samples[token] = float(value)
+        except ValueError:
+            violations.append(f"non-numeric sample: {line}")
+    for name, kind in types.items():
+        if kind == "counter" and not name.endswith("_total"):
+            violations.append(
+                f"counter without _total suffix: {name}")
+        if kind == "gauge" and name.endswith("_total"):
+            violations.append(
+                f"_total series typed gauge (demoted counter): "
+                f"{name}")
+        if kind != "histogram":
+            continue
+        for child in (f"{name}_bucket", f"{name}_sum",
+                      f"{name}_count"):
+            if child in types:
+                violations.append(
+                    f"histogram child collides with declared "
+                    f"series: {child}")
+        buckets = []
+        for token, v in samples.items():
+            if token.startswith(f"{name}_bucket{{"):
+                m = re.search(r'le="([^"]+)"', token)
+                if m:
+                    buckets.append((m.group(1), v))
+        count = samples.get(f"{name}_count")
+        if not buckets or f"{name}_sum" not in samples \
+                or count is None:
+            violations.append(
+                f"incomplete histogram (needs _bucket/_sum/_count): "
+                f"{name}")
+            continue
+        inf = dict(buckets).get("+Inf")
+        if inf is None:
+            violations.append(f'histogram missing le="+Inf": {name}')
+        elif inf != count:
+            violations.append(
+                f"histogram +Inf bucket ({inf}) != _count "
+                f"({count}): {name}")
+        finite = sorted(((float(le), v) for le, v in buckets
+                         if le != "+Inf"))
+        if any(b[1] > a[1] for b, a in zip(finite, finite[1:])):
+            violations.append(
+                f"histogram buckets not cumulative: {name}")
+    # samples referencing an undeclared family (typo'd child names)
+    declared: set = set(types)
+    for name, kind in types.items():
+        if kind == "histogram":
+            declared.update(
+                {f"{name}_bucket", f"{name}_sum", f"{name}_count"})
+    for token in samples:
+        if token.split("{", 1)[0] not in declared:
+            violations.append(f"sample without TYPE: {token}")
+    return violations

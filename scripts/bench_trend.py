@@ -1,0 +1,386 @@
+#!/usr/bin/env python
+"""Bench regression observatory (ISSUE 16).
+
+The repo's performance history lives in two places that nothing read
+until now: the committed ``BENCH_r*.json`` round artifacts (one per
+growth round — a final-line JSON when the round's capture survived
+whole, a front-truncated stdout tail when it did not, an rc=124
+timeout with no JSON at all when the ladder died) and the fresh
+artifacts a bench run leaves behind (the tee'd final line,
+``artifacts/bench_full_latest.json``). This script folds them into one
+per-rung trend table:
+
+    python scripts/bench_trend.py                      # history only
+    python scripts/bench_trend.py --current /tmp/bench.out
+    python scripts/bench_trend.py --current /tmp/bench.out --gate
+
+Salvage rules, in order, per round artifact:
+
+- ``parsed`` is a dict: its ``rungs`` (full-ladder) / ``summary``
+  (final-line) dict of per-rung dicts when present, plus the headline
+  ``metric``/``value`` pair;
+- the raw ``tail`` is ALWAYS regex-scanned for flat per-rung JSON
+  objects (``"rung": {...}``) — rounds 3 and 4 shipped ``parsed:
+  null`` with their entire ladder sitting in the truncated tail, and
+  those numbers are history too;
+- nonzero ``rc`` with nothing salvageable marks the round **failed**
+  in the table instead of silently absent.
+
+Each rung row tracks ONE headline metric (the bench summary-table
+convention); direction flags compare consecutive present values with
+the metric's own polarity (``overhead``/latency-like keys are
+lower-is-better). ``--gate`` exits nonzero when the current run
+regresses past ``--tolerance`` against the most recent historical
+value of any overlapping rung — CI's anatomy-smoke job runs it against
+the committed history, so the observatory is a gate, not a dashboard.
+"""
+from __future__ import annotations
+
+import argparse
+import glob as glob_mod
+import json
+import re
+import sys
+from pathlib import Path
+
+# rung -> headline metric, highest priority first. Falls back to the
+# first numeric key in the rung dict, so unmapped/new rungs still
+# trend (with whatever their arm reported first).
+_HEADLINE = {
+    "quick": "steps_per_sec",
+    "quick_health": "health_overhead_pct",
+    "quick_reqtrace": "reqtrace_overhead_pct",
+    "quick_timeseries": "timeseries_overhead_pct",
+    "quick_anatomy": "anatomy_overhead_pct",
+    "warm_start": "warm_compile_s",
+    "chaos": "time_to_recovery_s",
+    "resnet50": "images_per_sec",
+    "gpt2_small": "tokens_per_sec",
+    "vit_b16": "images_per_sec",
+    "llama_train": "tokens_per_sec",
+    "gpt2_long": "tokens_per_sec",
+    "decode": "decode_tokens_per_sec",
+    "decode_w8": "decode_tokens_per_sec",
+    "decode_kv8": "decode_tokens_per_sec",
+    "decode_w8kv8": "decode_tokens_per_sec",
+    "decode_stop": "saved_frac",
+    "decode_batch": "kv8_max_batch_tokens_per_sec",
+    "decode_paged": "decode_ratio",
+    "decode_spec": "speedup",
+    "moe": "routing_overhead_pct",
+    "serve_batch": "batching_speedup",
+    "serve_mixed": "mixed_tokens_per_sec",
+    "serve_prefix": "warm_prefill_speedup",
+    "serve_tp": "tokens_per_sec_tp1",
+    "serve_fleet": "goodput_tok_s",
+    "serve_disagg": "disagg_hold",
+    "serve_kvtier": "warm_hit_hold",
+    "serve_longctx": "chunk_separation",
+    "serve_chaos": "deadline_compliance",
+    "flash_attention_8k": "speedup",
+}
+
+# metric-name fragments whose polarity is lower-is-better; everything
+# else trends higher-is-better
+_LOWER_BETTER = ("overhead", "_ms", "_s", "gap", "ttft", "tpot",
+                 "degradation", "wall", "recovery")
+
+_RUNG_RE = re.compile(r'"(\w+)": (\{[^{}]*\})')
+
+
+def _lower_better(metric: str) -> bool:
+    m = metric.lower()
+    # explicit higher-is-better *_s exceptions (rates & ratios whose
+    # names end in suffixed units would be rare; keep the fragment
+    # test but let per-sec rates win)
+    if "per_sec" in m or "tok_s" in m:
+        return False
+    return any(f in m for f in _LOWER_BETTER)
+
+
+def _salvage_tail(tail: str) -> dict:
+    """Flat per-rung dicts regex-lifted out of a (possibly truncated)
+    stdout capture — the ONLY record rounds 3/4 left behind."""
+    rungs: dict = {}
+    for name, blob in _RUNG_RE.findall(tail or ""):
+        try:
+            v = json.loads(blob)
+        except json.JSONDecodeError:
+            continue
+        if isinstance(v, dict) and any(
+                isinstance(x, (int, float)) and not isinstance(x, bool)
+                for x in v.values()):
+            rungs[name] = v
+    return rungs
+
+
+def load_round(path) -> dict:
+    """One BENCH_r*.json -> {"label", "rc", "rungs": {rung: {...}},
+    "failed": bool}. Parsed final line wins over tail salvage per
+    rung; a nonzero rc with no salvageable rungs is a failed round."""
+    data = json.loads(Path(path).read_text())
+    label = Path(path).stem.replace("BENCH_", "")
+    rungs = _salvage_tail(data.get("tail") or "")
+    parsed = data.get("parsed")
+    if isinstance(parsed, dict):
+        for key in ("rungs", "summary"):
+            sub = parsed.get(key)
+            if isinstance(sub, dict):
+                for name, v in sub.items():
+                    if isinstance(v, dict):
+                        rungs[name] = v
+        # the final-line headline (metric/value) is sometimes the
+        # ONLY number a round preserved (r01) — trend it under its
+        # own row keyed by the full metric name
+        metric, value = parsed.get("metric"), parsed.get("value")
+        if (isinstance(metric, str)
+                and isinstance(value, (int, float))
+                and not isinstance(value, bool)):
+            rungs.setdefault(metric, {"value": float(value)})
+    rc = int(data.get("rc") or 0)
+    return {"label": label, "rc": rc, "rungs": rungs,
+            "failed": rc != 0 and not rungs}
+
+
+def load_current(path) -> dict:
+    """A fresh bench artifact: the tee'd stdout (last JSON line), a
+    plain final-line JSON, or a full-ladder artifact with "rungs"."""
+    text = Path(path).read_text()
+    data = None
+    try:
+        data = json.loads(text)
+    except json.JSONDecodeError:
+        for line in reversed(text.strip().splitlines()):
+            try:
+                data = json.loads(line.strip())
+                break
+            except json.JSONDecodeError:
+                continue
+    if not isinstance(data, dict):
+        raise ValueError(f"no parseable bench JSON in {path}")
+    rungs: dict = {}
+    for key in ("rungs", "summary"):
+        sub = data.get(key)
+        if isinstance(sub, dict):
+            for name, v in sub.items():
+                if isinstance(v, dict):
+                    rungs.setdefault(name, {}).update(v)
+    return {"label": "current", "rc": 0, "rungs": rungs,
+            "failed": False}
+
+
+def headline(rung: str, values: dict):
+    """(metric, value) for a rung dict — the mapped headline when the
+    rung reports it, else its first numeric field."""
+    key = _HEADLINE.get(rung)
+    v = values.get(key)
+    if isinstance(v, (int, float)) and not isinstance(v, bool):
+        return key, float(v)
+    for k, x in values.items():
+        if isinstance(x, (int, float)) and not isinstance(x, bool):
+            return k, float(x)
+    return None, None
+
+
+def build_trend(rounds: list) -> dict:
+    """Rounds (history order + optional current last) -> per-rung
+    series with direction flags."""
+    labels = [r["label"] for r in rounds]
+    rung_names: list = []
+    for r in rounds:
+        for name in r["rungs"]:
+            if name not in rung_names:
+                rung_names.append(name)
+    rows = []
+    for name in sorted(rung_names):
+        metric = None
+        series = []
+        for r in rounds:
+            v = r["rungs"].get(name)
+            if v is None:
+                series.append(None)
+                continue
+            m, val = headline(name, v)
+            if metric is None:
+                metric = m
+            elif m != metric:
+                val = (float(v[metric])
+                       if isinstance(v.get(metric), (int, float))
+                       and not isinstance(v.get(metric), bool)
+                       else None)
+            series.append(val)
+        present = [(i, v) for i, v in enumerate(series)
+                   if v is not None]
+        flags = [None] * len(series)
+        for (pi, pv), (ci, cv) in zip(present, present[1:]):
+            if pv == 0:
+                flags[ci] = "→"
+                continue
+            change = (cv - pv) / abs(pv)
+            better = (change < 0) if _lower_better(metric or "") \
+                else (change > 0)
+            if abs(change) < 0.02:
+                flags[ci] = "→"
+            else:
+                flags[ci] = ("↑" if cv > pv else "↓") \
+                    + (" ✓" if better else " ✗")
+        rows.append({"rung": name, "metric": metric,
+                     "series": series, "flags": flags})
+    return {
+        "labels": labels,
+        "rows": rows,
+        "failed_rounds": [
+            {"label": r["label"], "rc": r["rc"]}
+            for r in rounds if r["failed"]],
+    }
+
+
+def gate(trend: dict, tolerance: float) -> list:
+    """Regressions of the CURRENT run (last column) vs the most recent
+    historical value of the same rung, with per-metric polarity.
+    Returns the violation rows; empty when nothing overlapped (a gate
+    with no comparable data passes — CI says so on stderr)."""
+    if not trend["labels"] or trend["labels"][-1] != "current":
+        return []
+    violations = []
+    for row in trend["rows"]:
+        series = row["series"]
+        cur = series[-1]
+        prior = [v for v in series[:-1] if v is not None]
+        if cur is None or not prior:
+            continue
+        base = prior[-1]
+        if base == 0:
+            continue
+        if _lower_better(row["metric"] or ""):
+            bad = cur > base * (1.0 + tolerance)
+        else:
+            bad = cur < base * (1.0 - tolerance)
+        if bad:
+            violations.append({
+                "rung": row["rung"], "metric": row["metric"],
+                "current": cur, "baseline": base,
+                "tolerance": tolerance,
+            })
+    return violations
+
+
+def _fmt(v) -> str:
+    if v is None:
+        return "·"
+    if abs(v) >= 1000:
+        return f"{v:,.0f}"
+    return f"{v:g}"
+
+
+def to_markdown(trend: dict) -> str:
+    labels = trend["labels"]
+    lines = ["# Bench trend", ""]
+    if trend["failed_rounds"]:
+        for f in trend["failed_rounds"]:
+            lines.append(f"- **{f['label']}: FAILED round** "
+                         f"(rc={f['rc']}, no salvageable ladder)")
+        lines.append("")
+    lines.append("| rung | metric | " + " | ".join(labels) + " |")
+    lines.append("|---|---|" + "---|" * len(labels))
+    for row in trend["rows"]:
+        cells = []
+        for v, fl in zip(row["series"], row["flags"]):
+            cell = _fmt(v)
+            if fl and v is not None:
+                cell += f" {fl}"
+            cells.append(cell)
+        lines.append(f"| {row['rung']} | {row['metric']} | "
+                     + " | ".join(cells) + " |")
+    lines.append("")
+    lines.append("flags: vs previous present value; ✓ better / "
+                 "✗ worse by that metric's polarity; → within 2%")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        description="fold BENCH_r*.json history + fresh bench "
+                    "artifacts into a per-rung trend table "
+                    "(+ --gate regression exit)")
+    p.add_argument("--history", default=None, metavar="GLOB",
+                   help="round-artifact glob (default: BENCH_r*.json "
+                        "next to the repo root)")
+    p.add_argument("--current", nargs="*", default=None,
+                   help="fresh bench artifact(s): tee'd stdout, "
+                        "final-line JSON, or a full-ladder artifact "
+                        "with a rungs dict (later files win per rung)")
+    p.add_argument("--gate", action="store_true",
+                   help="exit 1 when the current run regresses past "
+                        "--tolerance vs the most recent historical "
+                        "value of any overlapping rung")
+    p.add_argument("--tolerance", type=float, default=0.1,
+                   help="allowed fractional regression for --gate "
+                        "(polarity-aware; default 0.1)")
+    p.add_argument("--json", action="store_true",
+                   help="emit the trend as JSON instead of markdown")
+    p.add_argument("--out", default=None,
+                   help="also write the rendered trend to this path")
+    args = p.parse_args(argv)
+
+    pattern = args.history or str(
+        Path(__file__).resolve().parent.parent / "BENCH_r*.json")
+    paths = sorted(glob_mod.glob(pattern))
+    if not paths and not args.current:
+        print(f"bench_trend: no round artifacts match {pattern} and "
+              "no --current given", file=sys.stderr)
+        return 2
+    rounds = []
+    for path in paths:
+        try:
+            rounds.append(load_round(path))
+        except (OSError, ValueError, json.JSONDecodeError) as e:
+            print(f"bench_trend: {path}: {e}", file=sys.stderr)
+            return 2
+    if args.current:
+        merged = {"label": "current", "rc": 0, "rungs": {},
+                  "failed": False}
+        for path in args.current:
+            try:
+                cur = load_current(path)
+            except (OSError, ValueError) as e:
+                print(f"bench_trend: --current: {e}", file=sys.stderr)
+                return 2
+            for name, v in cur["rungs"].items():
+                merged["rungs"].setdefault(name, {}).update(v)
+        rounds.append(merged)
+
+    trend = build_trend(rounds)
+    rendered = (json.dumps(trend, indent=2) if args.json
+                else to_markdown(trend))
+    print(rendered)
+    if args.out:
+        try:
+            Path(args.out).write_text(rendered + "\n")
+        except OSError as e:
+            print(f"bench_trend: --out: {e}", file=sys.stderr)
+            return 2
+
+    if args.gate:
+        violations = gate(trend, args.tolerance)
+        if violations:
+            for v in violations:
+                print(f"REGRESSION: {v['rung']}.{v['metric']} = "
+                      f"{v['current']} vs baseline {v['baseline']} "
+                      f"(tolerance {v['tolerance']})",
+                      file=sys.stderr)
+            return 1
+        overlap = any(
+            r["series"][-1] is not None
+            and any(v is not None for v in r["series"][:-1])
+            for r in trend["rows"]) if (
+                trend["labels"]
+                and trend["labels"][-1] == "current") else False
+        if not overlap:
+            print("bench_trend: gate passed vacuously (no rung "
+                  "overlaps history and current)", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -551,6 +551,35 @@ def bench_headline(bench: dict) -> dict:
 # ---------------------------------------------------------------------------
 
 
+def analyze_anatomy(records: list) -> dict:
+    """Step-anatomy section (ISSUE 16): the LAST flight record
+    carrying ``train_step_anatomy`` / ``decode_step_anatomy`` (the
+    engines attach the kernel-class breakdown when the background
+    analysis lands / per log window), re-shaped for the markdown
+    renderer. Empty when the run predates anatomy or PDT_ANATOMY=0."""
+    out: dict = {}
+    for field, label in (("train_step_anatomy", "train"),
+                         ("decode_step_anatomy", "decode")):
+        last = next((r[field] for r in reversed(records)
+                     if isinstance(r.get(field), dict)), None)
+        if not last:
+            continue
+        entry = {
+            k: last[k] for k in (
+                "est_step_time_ms", "wall_ms", "dispatch_gap_frac",
+                "total_flops", "observed_steps")
+            if last.get(k) is not None
+        }
+        classes = last.get("classes") or {}
+        entry["classes"] = [
+            {"class": cls, **c} for cls, c in sorted(
+                classes.items(),
+                key=lambda kv: -(kv[1].get("frac_time") or 0.0))
+        ]
+        out[label] = entry
+    return out
+
+
 def _bench_metric(bench: dict, key: str):
     v = bench.get(key)
     if isinstance(v, (int, float)):
@@ -568,11 +597,24 @@ def compare(current: dict, baseline: dict, tolerance: float,
     """Throughput gate: fail when current < baseline * (1 - tolerance).
 
     Returns ``{"compared": [...], "regressions": [...],
-    "skipped": [...]}``; callers exit nonzero on any regression."""
-    compared, regressions, skipped = [], [], []
+    "skipped": [...], "missing": [...]}``; callers exit nonzero on any
+    regression. ``missing`` is the loud arm of the skip logic (ISSUE
+    16 satellite): the BASELINE carries the metric but the current
+    run's artifacts lack its rung — a silently skipped gate there
+    means a bench rung stopped running and nothing would ever fail, so
+    callers must treat it as a usage error naming the rung."""
+    compared, regressions, skipped, missing = [], [], [], []
     for key in metrics:
         cur = _bench_metric(current, key)
         base = _bench_metric(baseline, key)
+        if cur is None and base is not None and base > 0:
+            path = _BENCH_METRIC_FALLBACK.get(key) or ()
+            missing.append({
+                "metric": key,
+                "rung": path[1] if len(path) > 1 else key,
+                "baseline": base,
+            })
+            continue
         if cur is None or base is None or base <= 0:
             skipped.append({"metric": key, "current": cur,
                             "baseline": base})
@@ -590,7 +632,7 @@ def compare(current: dict, baseline: dict, tolerance: float,
         if not row["ok"]:
             regressions.append(row)
     return {"compared": compared, "regressions": regressions,
-            "skipped": skipped}
+            "skipped": skipped, "missing": missing}
 
 
 # ---------------------------------------------------------------------------
@@ -617,6 +659,33 @@ def to_markdown(report: dict) -> str:
     table("Flight recorder", report.get("telemetry", {}))
     table("Prefix cache (serving)", report.get("prefix_cache", {}))
     table("Tensor parallel (serving)", report.get("tensor_parallel", {}))
+    anatomy = report.get("anatomy") or {}
+    for label in ("train", "decode"):
+        an = anatomy.get(label)
+        if not an:
+            continue
+        lines.append(f"## Step anatomy ({label})")
+        lines.append("")
+        head = [f"modeled {an.get('est_step_time_ms', '?')} ms"]
+        if an.get("wall_ms") is not None:
+            head.append(f"measured {an['wall_ms']} ms")
+        if an.get("dispatch_gap_frac") is not None:
+            head.append(
+                f"dispatch gap {an['dispatch_gap_frac']:.1%}")
+        lines.append("Step: " + ", ".join(head) + ".")
+        lines.append("")
+        lines.append("| kernel class | time frac | time ms | GFLOPs | "
+                     "MB | bound |")
+        lines.append("|---|---|---|---|---|---|")
+        for c in an.get("classes", [])[:8]:
+            time_ms = c.get("time_ms")
+            lines.append(
+                f"| {c['class']} | {c.get('frac_time', 0):.1%} | "
+                f"{time_ms if time_ms is not None else '-'} | "
+                f"{c.get('flops', 0) / 1e9:.3f} | "
+                f"{c.get('bytes', 0) / 2**20:.2f} | "
+                f"{c.get('bound', '-')} |")
+        lines.append("")
     table("Supervisor", report.get("supervisor", {}))
     table("Fleet (router)", report.get("fleet", {}))
     table("Disaggregation (serving)", report.get("disagg", {}))
@@ -669,7 +738,8 @@ def to_markdown(report: dict) -> str:
         lines.append("")
     table("Bench", report.get("bench", {}))
     cmp_ = report.get("compare") or {}
-    if cmp_.get("compared") or cmp_.get("skipped"):
+    if (cmp_.get("compared") or cmp_.get("skipped")
+            or cmp_.get("missing")):
         lines.append("## Regression gate")
         lines.append("")
         lines.append("| metric | current | baseline | floor | verdict |")
@@ -684,6 +754,11 @@ def to_markdown(report: dict) -> str:
             lines.append(
                 f"| {row['metric']} | {row['current']} | "
                 f"{row['baseline']} | - | skipped |"
+            )
+        for row in cmp_.get("missing", []):
+            lines.append(
+                f"| {row['metric']} | rung `{row['rung']}` absent | "
+                f"{row['baseline']} | - | **MISSING RUNG** |"
             )
         lines.append("")
     return "\n".join(lines)
@@ -767,6 +842,9 @@ def main(argv=None) -> int:
             tp = analyze_tp(records)
             if tp:
                 report["tensor_parallel"] = tp
+            anatomy = analyze_anatomy(records)
+            if anatomy:
+                report["anatomy"] = anatomy
         trace_path = args.trace
         if trace_path is None and run_dir is not None:
             cand = run_dir / "trace.json"
@@ -853,6 +931,22 @@ def main(argv=None) -> int:
         metrics = [m.strip() for m in args.metrics.split(",") if m.strip()]
         result = compare(bench, baseline, args.tolerance, metrics)
         report["compare"] = result
+        if result.get("missing"):
+            # LOUD failure, not a silent skip: the baseline gates a
+            # rung the current run never produced — most likely the
+            # bench rung stopped running (or its artifacts were not
+            # passed), and a skip here would let any regression in it
+            # ship forever
+            for row in result["missing"]:
+                print(
+                    f"telemetry_report: --compare: baseline metric "
+                    f"'{row['metric']}' references rung "
+                    f"'{row['rung']}' absent from the current run's "
+                    f"bench artifacts (baseline {row['baseline']}); "
+                    "run that rung or drop the metric from --metrics",
+                    file=sys.stderr,
+                )
+            return 2
         if result["regressions"]:
             rc = 1
             for row in result["regressions"]:

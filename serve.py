@@ -434,6 +434,16 @@ def service_metrics(service: GenerationService) -> dict:
     if hist:
         for k, h in hist.items():
             out[k] = h.snapshot()
+    # step anatomy (ISSUE 16): kernel-class breakdown of the decode
+    # chunk executable (XLA cost model x measured chunk wall EWMA).
+    # ?format=json carries the full nested section; the prometheus
+    # exposition keeps its top-level numeric leaves only (modeled step
+    # time, dispatch gap) — per-class drill-down is a JSON concern.
+    # Absent entirely when PDT_ANATOMY=0 or analysis hasn't landed.
+    if hasattr(service, "anatomy_snapshot"):
+        anatomy = service.anatomy_snapshot()
+        if anatomy:
+            out["decode_step_anatomy"] = anatomy
     if hasattr(service, "slo_stats"):
         out.update(service.slo_stats())
     # resilience-supervisor counters (when supervised / a log exists):

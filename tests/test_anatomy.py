@@ -1,0 +1,419 @@
+"""Step anatomy (ISSUE 16): XLA cost-model kernel-class attribution,
+roofline placement, the AnatomyStore hot path, the MoE routing
+decomposition, and the bench regression observatory.
+"""
+import json
+import sys
+from pathlib import Path
+
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from pytorch_distributed_template_tpu.observability import (  # noqa: E402
+    costmodel,
+)
+from pytorch_distributed_template_tpu.observability.anatomy import (  # noqa: E402
+    AnatomyStore, analyze_compiled, analyze_step, anatomy_enabled,
+    render_anatomy,
+)
+
+sys.path.insert(0, str(Path(__file__).parent.parent / "scripts"))
+import bench_trend  # noqa: E402
+
+
+# ---------------------------------------------------------------------------
+# classification
+# ---------------------------------------------------------------------------
+
+
+def test_classify_instruction_table():
+    cl = costmodel.classify_instruction
+    assert cl("all-reduce", "whatever") == "collective"
+    assert cl("all-to-all", "jit(f)/moe/a2a") == "collective"
+    assert cl("dot", "jit(f)/attn/qk") == "attention"
+    assert cl("exponential", "jit(f)/flash/softmax") == "attention"
+    assert cl("dot", "jit(f)/mlp/wi") == "dense_matmul"
+    assert cl("dot", "jit(f)/moe/router/dot_general") == "moe_dispatch"
+    assert cl("gather", "jit(f)/moe/gather") == "moe_dispatch"
+    # the expert FFN einsums are the WORK, not routing
+    assert cl("dot",
+              "jit(f)/moe/ecd,edf->ecf/dot_general") == "dense_matmul"
+    assert cl("dot",
+              "jit(f)/moe/ecf,efd->ecd/dot_general") == "dense_matmul"
+    # the GShard combine einsum
+    assert cl("dot",
+              "jit(f)/moe/sec,ecd->sd/dot_general") == "moe_combine"
+    assert cl("convert", "jit(f)/quant/dequant_w") == "quant_dequant"
+    assert cl("add", "jit(f)/ln/residual") == "elementwise"
+
+
+def test_parse_hlo_skips_container_ops():
+    hlo = """
+  %p0 = f32[8,64]{1,0} parameter(0)
+  %fused = f32[8,64]{1,0} fusion(f32[8,64] %p0), kind=kLoop
+  %d = f32[8,8]{1,0} dot(f32[8,64]{1,0} %p0, f32[64,8]{1,0} %p0), lhs_contracting_dims={1}, metadata={op_name="jit(f)/mlp/wi"}
+  %t = (f32[8,8]) tuple(f32[8,8] %d)
+"""
+    out = costmodel.parse_hlo_classes(hlo)
+    total = sum(c["count"] for c in out.values())
+    assert total == 1                       # only the dot counted
+    assert out["dense_matmul"]["count"] == 1
+    # dot flops: 2 * 8*8 result * 64 contraction
+    assert out["dense_matmul"]["flops"] == 2 * 8 * 8 * 64
+
+
+# ---------------------------------------------------------------------------
+# acceptance: dense per-class FLOPs within 10% of the analytic estimate
+# ---------------------------------------------------------------------------
+
+
+def test_dense_matmul_flops_within_10pct_of_analytic():
+    """The acceptance gate: cost_analysis-calibrated per-class FLOPs
+    for a dense program agree with hand math to 10%."""
+    d, h, o, b = 64, 128, 32, 8
+
+    @jax.jit
+    def f(x, w1, w2):
+        return jax.nn.relu(x @ w1) @ w2
+
+    x = jnp.ones((b, d), jnp.float32)
+    w1 = jnp.ones((d, h), jnp.float32)
+    w2 = jnp.ones((h, o), jnp.float32)
+    costs = costmodel.analyze_jitted(f, x, w1, w2)
+    analytic = 2 * b * d * h + 2 * b * h * o
+    got = costs["classes"]["dense_matmul"]["flops"]
+    assert abs(got - analytic) / analytic < 0.10, (got, analytic)
+    # and the relu landed outside the matmul class
+    assert costs["classes"]["attention"]["count"] == 0
+    assert costs["total_flops"] >= got
+
+
+def test_decode_step_anatomy_attention_dominates():
+    """A real KV-cached decode step: attention + dense classes carry
+    the program; analysis runs AOT off abstract shapes."""
+    import pytorch_distributed_template_tpu.models  # noqa: F401
+    from pytorch_distributed_template_tpu.config.registry import MODELS
+
+    model = MODELS.get("Llama")(vocab_size=64, n_layer=2, n_head=4,
+                                n_kv_head=2, d_model=32, max_len=64)
+    params = model.init(jax.random.key(0),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+
+    def fwd(p, tokens):
+        return model.apply({"params": p}, tokens, train=False)
+
+    analysis = analyze_step(jax.jit(fwd), params,
+                            jnp.zeros((2, 16), jnp.int32))
+    assert analysis is not None
+    cl = analysis["classes"]
+    assert cl["attention"]["count"] > 0
+    assert cl["dense_matmul"]["count"] > 0
+    fracs = sum(c["frac_time"] for c in cl.values())
+    assert abs(fracs - 1.0) < 1e-6
+    assert analysis["est_step_time_s"] > 0
+
+
+# ---------------------------------------------------------------------------
+# MoE: dispatch/combine attribution + the routing decomposition
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("impl,expect_combine", [
+    ("einsum", True),    # GShard einsums: sec,ecd->sd is attributable
+    ("gather", False),   # row-gather combine blends into dispatch
+])
+def test_moe_kernel_classes(impl, expect_combine):
+    from pytorch_distributed_template_tpu.models.moe import MoeMlp
+
+    m = MoeMlp(d_model=32, d_ff=64, num_experts=4, top_k=2,
+               capacity_factor=2.0, dispatch_impl=impl)
+    x = jnp.ones((2, 16, 32), jnp.float32)
+    params = m.init(jax.random.key(0), x, train=False)["params"]
+
+    def fwd(p, x):
+        return m.apply({"params": p}, x, train=False)
+
+    costs = costmodel.analyze_jitted(jax.jit(fwd), params, x)
+    cl = costs["classes"]
+    assert cl["moe_dispatch"]["count"] > 0
+    assert (cl["moe_combine"]["count"] > 0) == expect_combine
+    # the expert FFN matmuls stayed dense (matched-active-FLOPs
+    # accounting) and carry most of the FLOPs
+    assert cl["dense_matmul"]["flops"] > cl["moe_dispatch"]["flops"]
+
+
+def test_routing_decomposition_sums_exactly():
+    import bench
+
+    anatomy = {"classes": {
+        "moe_dispatch": {"est_time_s": 0.003},
+        "moe_combine": {"est_time_s": 0.002},
+        "collective": {"est_time_s": 0.001},
+    }}
+    out = bench._routing_decomposition(52.4, anatomy)
+    assert set(out) == {"routing_dispatch_pct", "routing_combine_pct",
+                        "routing_collective_pct"}
+    assert round(sum(out.values()), 10) == 52.4   # exact-sum contract
+    assert out["routing_dispatch_pct"] > out["routing_combine_pct"]
+    # absent / empty anatomy -> headline number stands alone
+    assert bench._routing_decomposition(52.4, None) == {}
+    assert bench._routing_decomposition(
+        52.4, {"classes": {"moe_dispatch": {"est_time_s": 0.0}}}) == {}
+
+
+# ---------------------------------------------------------------------------
+# roofline + rendering
+# ---------------------------------------------------------------------------
+
+
+def test_roofline_bound_placement(monkeypatch):
+    monkeypatch.setenv("PDT_TPU_PEAK_FLOPS", "100e12")
+    monkeypatch.setenv("PDT_HBM_BYTES_S", "100e9")
+    monkeypatch.setenv("PDT_ICI_BYTES_S", "10e9")
+    costs = {"classes": {
+        "dense_matmul": {"flops": 2e12, "bytes": 1e9, "count": 1},
+        "attention": {"flops": 1e9, "bytes": 1e10, "count": 1},
+        "collective": {"flops": 0.0, "bytes": 1e9, "count": 1},
+    }}
+    out = costmodel.roofline(costs)
+    cl = out["classes"]
+    assert cl["dense_matmul"]["bound"] == "compute"   # 20ms flops vs 10ms hbm
+    assert cl["attention"]["bound"] == "hbm"
+    assert cl["collective"]["bound"] == "ici"
+    assert abs(sum(c["frac_time"] for c in cl.values()) - 1.0) < 1e-9
+
+
+def test_render_anatomy_dispatch_gap():
+    analysis = {
+        "classes": {
+            "attention": {"flops": 2e9, "bytes": 1e8, "count": 3,
+                          "est_time_s": 0.00075, "frac_time": 0.75,
+                          "bound": "hbm"},
+            "dense_matmul": {"flops": 1e9, "bytes": 2e7, "count": 2,
+                             "est_time_s": 0.00025, "frac_time": 0.25,
+                             "bound": "compute"},
+        },
+        "est_step_time_s": 0.001,
+        "peak_flops": 197e12, "hbm_bytes_s": 260e9,
+    }
+    out = render_anatomy(analysis, wall_ms=4.0, observed=17)
+    assert out["est_step_time_ms"] == 1.0
+    assert out["wall_ms"] == 4.0
+    assert out["dispatch_gap_frac"] == 0.75   # 3 of 4 ms unaccounted
+    assert out["observed_steps"] == 17
+    # class times split the MODELED device ms, not the wall
+    assert out["classes"]["attention"]["time_ms"] == 0.75
+    assert out["classes"]["dense_matmul"]["time_ms"] == 0.25
+    # top_n trims to the biggest classes
+    top = render_anatomy(analysis, wall_ms=4.0, top_n=1)
+    assert list(top["classes"]) == ["attention"]
+
+
+# ---------------------------------------------------------------------------
+# AnatomyStore
+# ---------------------------------------------------------------------------
+
+
+def _mk_fn():
+    @jax.jit
+    def f(x):
+        return x @ x
+
+    return f
+
+
+def test_store_register_dedupes_and_lands():
+    store = AnatomyStore(enabled=True)
+    f = _mk_fn()
+    x = jnp.ones((16, 16), jnp.float32)
+    assert store.register("decode_chunk", f, (x,)) is True
+    # same signature -> deduped, not re-queued
+    assert store.register("decode_chunk", f, (x,)) is False
+    assert store.wait_idle(timeout_s=60.0)
+    assert store.version == 1
+    store.observe("decode_chunk", 2.0)
+    store.observe("decode_chunk", 4.0)
+    snap = store.snapshot("decode_chunk")
+    assert snap is not None
+    assert snap["observed_steps"] == 2
+    # EWMA(2.0, then 4.0, alpha .1) = 2.2
+    assert abs(snap["wall_ms"] - 2.2) < 1e-6
+    assert snap["classes"]
+    # a NEW signature (different shape) queues a second analysis and
+    # surfaces the signature count
+    y = jnp.ones((8, 8), jnp.float32)
+    assert store.register("decode_chunk", f, (y,)) is True
+    assert store.wait_idle(timeout_s=60.0)
+    assert store.version == 2
+    assert store.snapshot("decode_chunk")["signatures"] == 2
+
+
+def test_store_disabled_is_inert():
+    store = AnatomyStore(enabled=False)
+    f = _mk_fn()
+    assert store.register("k", f, (jnp.ones((4, 4)),)) is False
+    store.observe("k", 1.0)
+    assert store.snapshot("k") is None
+    assert store.snapshot() == {}
+    assert store.version == 0
+
+
+def test_anatomy_enabled_env_switch(monkeypatch):
+    monkeypatch.delenv("PDT_ANATOMY", raising=False)
+    assert anatomy_enabled() is True
+    monkeypatch.setenv("PDT_ANATOMY", "0")
+    assert anatomy_enabled() is False
+    assert AnatomyStore().enabled is False
+    monkeypatch.setenv("PDT_ANATOMY", "1")
+    assert anatomy_enabled() is True
+
+
+def test_analyze_compiled_no_extra_compile():
+    f = _mk_fn()
+    x = jnp.ones((32, 32), jnp.float32)
+    compiled = f.lower(x).compile()
+    analysis = analyze_compiled(compiled)
+    assert analysis is not None
+    assert analysis["classes"]["dense_matmul"]["count"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# engine integration: the continuous engine's snapshot reaches /metrics
+# ---------------------------------------------------------------------------
+
+
+def test_continuous_engine_anatomy_surfaces():
+    import numpy as np
+
+    import pytorch_distributed_template_tpu.models  # noqa: F401
+    import serve
+    from pytorch_distributed_template_tpu.config.registry import MODELS
+    from pytorch_distributed_template_tpu.engine.continuous import (
+        ContinuousBatchingService,
+    )
+
+    model = MODELS.get("Llama")(vocab_size=64, n_layer=2, n_head=4,
+                                n_kv_head=2, d_model=32, max_len=128)
+    params = model.init(jax.random.key(0),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    svc = ContinuousBatchingService.from_model(
+        model, params, slots=2, chunk=4, window_ms=10.0)
+    rs = np.random.RandomState(0)
+    out = svc.generate(
+        prompt_ids=[int(t) for t in rs.randint(1, 64, 6)],
+        max_new_tokens=4)
+    assert out["ids"]
+    assert svc._anatomy.wait_idle(timeout_s=120.0)
+    snap = svc.anatomy_snapshot()
+    assert snap and snap["classes"]
+    assert snap.get("observed_steps", 0) >= 1
+    assert 0.0 <= snap["dispatch_gap_frac"] <= 1.0
+    m = serve.service_metrics(svc)
+    assert m["decode_step_anatomy"]["classes"]
+
+
+# ---------------------------------------------------------------------------
+# bench_trend: salvage + gate (acceptance: pinned synthetic regression)
+# ---------------------------------------------------------------------------
+
+
+def _round_file(tmp_path, name, parsed=None, tail="", rc=0):
+    p = tmp_path / name
+    p.write_text(json.dumps(
+        {"cmd": "python bench.py", "n": 1, "parsed": parsed,
+         "rc": rc, "tail": tail}))
+    return p
+
+
+def test_bench_trend_salvages_history(tmp_path):
+    _round_file(tmp_path, "BENCH_r01.json",
+                parsed={"metric": "x_per_sec", "value": 10.0})
+    # a truncated round: ladder only in the tail
+    _round_file(
+        tmp_path, "BENCH_r02.json", parsed=None,
+        tail='... "decode": {"decode_tokens_per_sec": 5000.0} ...')
+    _round_file(tmp_path, "BENCH_r03.json", parsed=None, rc=124)
+    rounds = [bench_trend.load_round(p)
+              for p in sorted(tmp_path.glob("BENCH_r*.json"))]
+    trend = bench_trend.build_trend(rounds)
+    assert trend["labels"] == ["r01", "r02", "r03"]
+    by_rung = {r["rung"]: r for r in trend["rows"]}
+    assert by_rung["x_per_sec"]["series"][0] == 10.0
+    assert by_rung["decode"]["series"][1] == 5000.0
+    assert trend["failed_rounds"] == [{"label": "r03", "rc": 124}]
+    md = bench_trend.to_markdown(trend)
+    assert "FAILED round" in md and "rc=124" in md
+    assert "| decode |" in md
+
+
+def test_bench_trend_gate_rejects_synthetic_regression(tmp_path, capsys):
+    """The acceptance pin: --gate exits nonzero on an injected
+    regression against history, and passes on a healthy run."""
+    _round_file(
+        tmp_path, "BENCH_r01.json", parsed=None,
+        tail='"decode": {"decode_tokens_per_sec": 5000.0}')
+    hist = str(tmp_path / "BENCH_r*.json")
+    bad = tmp_path / "current_bad.json"
+    bad.write_text(json.dumps(
+        {"rungs": {"decode": {"decode_tokens_per_sec": 2500.0}}}))
+    rc = bench_trend.main(["--history", hist, "--current", str(bad),
+                           "--gate"])
+    assert rc == 1
+    assert "REGRESSION" in capsys.readouterr().err
+    good = tmp_path / "current_good.json"
+    good.write_text(json.dumps(
+        {"rungs": {"decode": {"decode_tokens_per_sec": 5100.0}}}))
+    assert bench_trend.main(["--history", hist, "--current",
+                             str(good), "--gate"]) == 0
+
+
+def test_bench_trend_gate_polarity_lower_is_better(tmp_path):
+    """overhead-style metrics regress UP: the gate must know."""
+    _round_file(
+        tmp_path, "BENCH_r01.json", parsed=None,
+        tail='"moe": {"routing_overhead_pct": 52.4}')
+    hist = str(tmp_path / "BENCH_r*.json")
+    worse = tmp_path / "worse.json"
+    worse.write_text(json.dumps(
+        {"rungs": {"moe": {"routing_overhead_pct": 80.0}}}))
+    assert bench_trend.main(["--history", hist, "--current",
+                             str(worse), "--gate"]) == 1
+    better = tmp_path / "better.json"
+    better.write_text(json.dumps(
+        {"rungs": {"moe": {"routing_overhead_pct": 30.0}}}))
+    assert bench_trend.main(["--history", hist, "--current",
+                             str(better), "--gate"]) == 0
+
+
+def test_bench_trend_over_committed_history():
+    """The real BENCH_r01..r05 artifacts render: every round column
+    present, the failed r05 flagged, salvaged rungs populated."""
+    repo = Path(__file__).parent.parent
+    if not list(repo.glob("BENCH_r*.json")):
+        pytest.skip("no committed BENCH history")
+    rounds = [bench_trend.load_round(p)
+              for p in sorted(repo.glob("BENCH_r*.json"))]
+    trend = bench_trend.build_trend(rounds)
+    md = bench_trend.to_markdown(trend)
+    for r in rounds:
+        assert f"| {r['label']} " in md or f" {r['label']} |" in md
+    assert any(row["series"] for row in trend["rows"])
+
+
+# ---------------------------------------------------------------------------
+# telemetry_report --compare: a missing rung fails loudly by name
+# ---------------------------------------------------------------------------
+
+
+def test_compare_missing_rung_named():
+    import telemetry_report
+
+    base = {"summary": {"quick": {"steps_per_sec": 8.0,
+                                  "tokens_per_sec": 8000.0}}}
+    cur = {"summary": {}}   # the quick rung silently stopped running
+    result = telemetry_report.compare(cur, base, tolerance=0.1)
+    assert len(result["missing"]) == 2, result
+    assert all(m["rung"] == "quick" for m in result["missing"])
+    assert not result["regressions"] and not result["compared"]

@@ -12,7 +12,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from pytorch_distributed_template_tpu.observability.profiler import (
-    ThroughputMeter, TraceCapture, compiled_flops, mfu, peak_flops_per_device,
+    OnDemandProfiler, ThroughputMeter, TraceCapture, compiled_flops,
+    install_sigusr2, mfu, peak_flops_per_device,
 )
 
 
@@ -79,6 +80,125 @@ def test_trace_capture_disabled(tmp_path):
     cap.after_step(0)
     cap.close()
     assert not (tmp_path / "profile").exists()
+
+
+def test_trace_capture_request_rearms_consumed_window(tmp_path):
+    """request() must re-arm even after the config-scheduled window
+    was consumed (or never existed): the SIGUSR2 path on a long-lived
+    run profiles on demand, not once."""
+    cap = TraceCapture(tmp_path, num_steps=0)   # nothing scheduled
+    x = jnp.ones((32, 32))
+    cap.before_step(0)
+    cap.after_step(0)
+    assert cap.captures == 0
+    cap.request(1)
+    cap.before_step(1)
+    assert cap._active
+    jax.block_until_ready(x @ x)
+    cap.after_step(1)
+    assert cap.captures == 1 and cap._done and not cap._active
+
+
+def test_trace_capture_request_coalesces_while_active(tmp_path):
+    """A second request() while a capture is in flight is DROPPED —
+    two SIGUSR2s during one slow capture must not latch a surprise
+    extra trace for after it closes."""
+    cap = TraceCapture(tmp_path, num_steps=0)
+    x = jnp.ones((32, 32))
+    cap.request(2)
+    cap.before_step(0)
+    assert cap._active
+    cap.request(5)                      # the second signal, mid-flight
+    assert cap._requested is None       # coalesced away, not queued
+    jax.block_until_ready(x @ x)
+    cap.after_step(0)
+    assert cap._active                  # window is 2 steps
+    cap.after_step(1)
+    assert not cap._active and cap.captures == 1
+    # and nothing re-arms on the next step
+    cap.before_step(2)
+    assert not cap._active
+    cap.after_step(2)
+    assert cap.captures == 1
+
+
+def test_install_sigusr2_requests_capture(tmp_path, monkeypatch):
+    """kill -USR2: the handler arms a capture sized by
+    PDT_PROFILE_STEPS (bad values fall back to the default)."""
+    import os
+    import signal
+
+    cap = TraceCapture(tmp_path, num_steps=0)
+    old = signal.getsignal(signal.SIGUSR2)
+    try:
+        assert install_sigusr2(cap, default_steps=5) is True
+        monkeypatch.setenv("PDT_PROFILE_STEPS", "3")
+        os.kill(os.getpid(), signal.SIGUSR2)
+        assert cap._requested == 3
+        cap._requested = None
+        monkeypatch.setenv("PDT_PROFILE_STEPS", "not-a-number")
+        os.kill(os.getpid(), signal.SIGUSR2)
+        assert cap._requested == 5      # default_steps fallback
+    finally:
+        signal.signal(signal.SIGUSR2, old)
+
+
+def test_install_sigusr2_refused_off_main_thread(tmp_path):
+    import threading
+
+    cap = TraceCapture(tmp_path, num_steps=0)
+    out = []
+    t = threading.Thread(
+        target=lambda: out.append(install_sigusr2(cap)))
+    t.start()
+    t.join(timeout=10)
+    assert out == [False]
+
+
+def test_on_demand_profiler_idle_timeout(tmp_path):
+    """An idle server (progress never advances) must release the
+    request thread at timeout_s and say so, not pin it forever."""
+    prof = OnDemandProfiler(tmp_path)
+    t0 = time.monotonic()
+    out = prof.capture(steps=5, progress_fn=lambda: 0,
+                       timeout_s=0.2, poll_s=0.01)
+    assert out["timed_out"] is True
+    assert out["steps_observed"] == 0
+    assert out["steps_requested"] == 5
+    assert 0.2 <= time.monotonic() - t0 < 10
+    assert out["captures_total"] == 1
+
+
+def test_on_demand_profiler_busy_second_caller(tmp_path):
+    """One capture at a time: a concurrent caller gets {'busy': True}
+    immediately instead of queueing behind the in-flight trace."""
+    import threading
+
+    prof = OnDemandProfiler(tmp_path)
+    started = threading.Event()
+    release = threading.Event()
+    first: dict = {}
+
+    def progress():
+        started.set()
+        return 1 if release.is_set() else 0
+
+    def run_first():
+        first.update(prof.capture(steps=1, progress_fn=progress,
+                                  timeout_s=30.0, poll_s=0.01))
+
+    t = threading.Thread(target=run_first)
+    t.start()
+    assert started.wait(timeout=10)
+    busy = prof.capture(steps=1)
+    assert busy.get("busy") is True and "error" in busy
+    release.set()
+    t.join(timeout=30)
+    assert not t.is_alive()
+    assert first.get("timed_out") is False
+    assert first.get("steps_observed", 0) >= 1
+    # the busy bounce did not count as a capture
+    assert prof.captures == 1
 
 
 def test_trainer_profiler_integration(tmp_path):
