@@ -4877,6 +4877,298 @@ def bench_quick_anatomy(steps: int = 30, batch: int = 8,
     return out
 
 
+def bench_serve_audit(n_requests: int = 18, prefix_len: int = 128,
+                      suffix_len: int = 16, new_tokens: int = 12,
+                      block_tokens: int = 32, n_layer: int = 2,
+                      d_model: int = 128,
+                      overhead_steps: int = 30) -> dict:
+    """Token-integrity observatory rung (ISSUE 18): the shadow-replay
+    auditor (observability/audit.py) against live churn traffic, in
+    three arms, each gated in-rung so the audit-smoke CI job fails
+    loudly:
+
+    - **churn arm**: a pooled batch-1 service serves mixed cold/warm
+      shared-prefix traffic (several serve-path fingerprints); every
+      completion is offered to a ShadowAuditor whose reference is a
+      second no-pool service over the SAME model/params (the layout
+      like-for-like discipline serve.py uses). Gates:
+      ``token_divergence_total == 0`` (warm==cold is the product
+      invariant), ``audit_sampled_total > 0``, and per-fingerprint
+      coverage — every fingerprint seen is audited at least
+      ``min(seen, floor)`` times, the stratified floor that keeps rare
+      paths covered.
+    - **overhead arm**: the provenance + offer machinery that rides
+      the serving hot path (build the path dict, fingerprint it, bump
+      the counter, ``offer()`` into the bounded queue) A/B'd with the
+      quick_reqtrace paired-window gmean discipline at one
+      completion's load per TinyLM step — strictly MORE offers per
+      unit work than production. The REPLAY cost is deliberately not
+      in this number: it runs on the auditor's worker thread, off the
+      scheduler hot path, bounded by the queue — that placement is
+      the design, and the <2% gate covers what the scheduler pays.
+    - **injected-divergence self-test**: arm the fault grammar's
+      ``corrupt_page@evt:1`` (resilience/faults.py), ship a page
+      chain into a fresh pool (export -> import, origin "ship" — the
+      adoption advances the evt ordinal and marks the block), serve
+      the warm request that consumes the corrupted page, and prove
+      the observatory end to end: the auditor fires
+      (``token_divergence_total >= 1``), ``healthy()`` flips (what
+      degrades /healthz), the ``divergence_<rid>.json`` bundle lands,
+      and the divergent fingerprint carries the ``ship`` flag the
+      attribution report would rank.
+
+    The model runs f32 like the warm==cold parity tier
+    (tests/test_kvcache.py), NOT the perf rungs' bf16: paged and
+    contiguous attention reduce over different padded extents, so at
+    bf16 a random-init near-tie can flip one greedy argmax in a few
+    hundred decode steps — a float hazard of the tiny model, not a
+    pool defect, and exactly the noise an exact-token gate must not
+    sit on."""
+    import tempfile
+    from pathlib import Path
+
+    import jax
+    import jax.numpy as jnp
+
+    import pytorch_distributed_template_tpu.models  # noqa: F401
+    from pytorch_distributed_template_tpu.config.registry import MODELS
+    from pytorch_distributed_template_tpu.engine.serving import (
+        GenerationService,
+    )
+    from pytorch_distributed_template_tpu.observability.audit import (
+        ShadowAuditor,
+    )
+    from pytorch_distributed_template_tpu.observability.reqtrace import (
+        fingerprint_features, path_fingerprint,
+    )
+    from pytorch_distributed_template_tpu.observability.telemetry import (
+        FlightRecorder,
+    )
+    from pytorch_distributed_template_tpu.resilience import faults
+
+    vocab = 8192
+    L = prefix_len + suffix_len
+    bucket = 16
+    while bucket < L:
+        bucket *= 2
+    model = MODELS.get("Llama")(
+        vocab_size=vocab, n_layer=n_layer, n_head=4, n_kv_head=2,
+        d_model=d_model, max_len=bucket + 2 * new_tokens + 16,
+    )
+    params = model.init(
+        jax.random.key(0), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+    pcfg = {"enabled": True, "block_tokens": block_tokens,
+            "pool_blocks": 6 * (L // block_tokens + 2)}
+    rng = np.random.default_rng(0)
+
+    def prompt(prefix):
+        return list(prefix) + [int(x) for x in
+                               rng.integers(1, vocab, suffix_len)]
+
+    # the cold no-pool reference shares model/params with the serving
+    # pool — same KV layout, so warm==cold is exact (audit.py's
+    # like-for-like discipline)
+    ref = GenerationService.from_model(model, params)
+    ref.generate(prompt_ids=[1] * L, max_new_tokens=new_tokens)  # compile
+
+    def reference_fn(rec):
+        resp = ref.generate(prompt_ids=rec["prompt_ids"],
+                            max_new_tokens=rec["max_new_tokens"],
+                            temperature=0.0)
+        return resp.get("ids") or []
+
+    # ---- arm 1: churn traffic, zero divergence + coverage floors ----
+    svc = GenerationService.from_model(model, params,
+                                       prefix_cache=dict(pcfg))
+    floor = 2
+    tmp = tempfile.mkdtemp(prefix="bench-audit-")
+    auditor = ShadowAuditor(reference_fn, sample_rate=0.5,
+                            floor=floor, queue_max=64, dump_dir=tmp)
+    comp = [int(x) for x in rng.integers(1, vocab, prefix_len)]
+    svc.generate(prompt_ids=prompt(comp), max_new_tokens=new_tokens)
+    svc.generate(prompt_ids=prompt(comp), max_new_tokens=new_tokens)
+    # ^ compile the (cold, warm) shapes unmeasured; nothing offered
+    groups = [[int(x) for x in rng.integers(1, vocab, prefix_len)]
+              for _ in range(3)]
+    for i in range(n_requests):
+        ids = prompt(groups[i % len(groups)])
+        resp = svc.generate(prompt_ids=ids, max_new_tokens=new_tokens)
+        auditor.offer({
+            "rid": f"bench-{i:04d}",
+            "serve_path": resp.get("serve_path"),
+            "ids": resp.get("ids"),
+            "stop_reason": resp.get("stop_reason", "length"),
+            "prompt_ids": ids,
+            "max_new_tokens": new_tokens,
+            "temperature": 0.0, "top_k": 0, "top_p": 0.0, "seed": 0,
+            "stop": None,
+        })
+    if not auditor.drain(timeout_s=300.0):
+        raise RuntimeError("serve_audit: replay queue never drained")
+    stats = auditor.stats()
+    coverage = auditor.coverage()
+    auditor.close()
+    served_paths = svc.path_counts_snapshot()
+    if stats["token_divergence_total"] != 0:
+        raise RuntimeError(
+            f"serve_audit: {stats['token_divergence_total']} token "
+            f"divergences on healthy churn (gate): {coverage}")
+    if stats["audit_sampled_total"] <= 0:
+        raise RuntimeError(
+            f"serve_audit: nothing audited (gate): {stats}")
+    if len(coverage) < 2:
+        raise RuntimeError(
+            f"serve_audit: churn produced {len(coverage)} "
+            f"fingerprint(s), expected cold+warm at least: {coverage}")
+    for fp, cov in coverage.items():
+        if cov["audited"] < min(cov["seen"], floor):
+            raise RuntimeError(
+                f"serve_audit: fingerprint {fp} audited "
+                f"{cov['audited']} < floor min({cov['seen']}, {floor})"
+                f" (stratification gate): {coverage}")
+
+    # ---- arm 2: hot-path overhead, paired-window gmean < 2% ---------
+    state, step_fn, batch_arrays = _tiny_lm_step(seq=128, batch=8)
+    state, m = step_fn(state, batch_arrays)   # compile + warm
+    float(m["loss_sum"])
+    # the A/B auditor replays through an identity reference (replay
+    # cost is off-hot-path by design; this arm prices what the
+    # SCHEDULER pays: path dict -> fingerprint -> counter -> offer)
+    ab = ShadowAuditor(lambda rec: rec["ids"], sample_rate=0.05,
+                       floor=4, queue_max=64, dump_dir=None)
+    counts: dict = {}
+    rid_n = [0]
+
+    def audited_step(s, b):
+        out = step_fn(s, b)
+        rid_n[0] += 1
+        path = {"mode": "warm", "adopt": True, "tp": 1, "dp": 1,
+                "brownout": 0}
+        fp = path_fingerprint(path)
+        counts[fp] = counts.get(fp, 0) + 1
+        ab.offer({"rid": f"ab-{rid_n[0]:06d}", "serve_path": fp,
+                  "ids": [1, 2, 3, 4], "stop_reason": "length",
+                  "prompt_ids": [1, 2, 3], "max_new_tokens": 4,
+                  "temperature": 0.0, "top_k": 0, "top_p": 0.0,
+                  "seed": 0, "stop": None})
+        return out
+
+    win = max(overhead_steps // 3, 5)
+    holder = {"state": state}
+
+    def run(fn):
+        rec = FlightRecorder(run_dir=None, capacity=win + 8,
+                             memory_every=0)
+        holder["state"], a = _recorder_timed_loop(
+            holder["state"], fn, batch_arrays, rec, win, 8, 128)
+        return a["steps_per_sec"]
+
+    run(step_fn)                  # unmeasured settling window
+    pair_logs = []
+    n_pairs = 6
+    for r in range(n_pairs):
+        if r % 2 == 0:
+            p = run(step_fn)
+            t = run(audited_step)
+        else:
+            t = run(audited_step)
+            p = run(step_fn)
+        pair_logs.append(math.log(p / t))
+    ab.drain(timeout_s=60.0)
+    ab.close()
+    overhead_pct = round(
+        100.0 * (math.exp(sum(pair_logs) / n_pairs) - 1.0), 2)
+    median_pct = round(
+        100.0 * (math.exp(sorted(pair_logs)[n_pairs // 2]) - 1.0), 2)
+
+    # ---- arm 3: injected corrupt_page must be CAUGHT ----------------
+    had_env = os.environ.pop(faults.ENV_PLAN, None)
+    faults.reset()
+    inj_tmp = tempfile.mkdtemp(prefix="bench-audit-inject-")
+    inj = ShadowAuditor(reference_fn, sample_rate=1.0, floor=4,
+                        queue_max=16, dump_dir=inj_tmp,
+                        cooldown_s=0.0)
+    try:
+        # exporter computes the prefix into ITS pool, ships the chain;
+        # the victim adopts it (origin "ship"). The fault plan arms
+        # AFTER the export: the exporter's own paged_finish adoption
+        # already advanced the page ordinal, and configure() activates
+        # a plan without zeroing ordinals — reset() right before
+        # arming is what makes the shipped import land on evt 1
+        chain = [int(x) for x in rng.integers(1, vocab, prefix_len)]
+        exporter = GenerationService.from_model(
+            model, params, prefix_cache=dict(pcfg))
+        exporter.generate(prompt_ids=prompt(chain), max_new_tokens=1)
+        payload = exporter.export_cached_pages(prompt_ids=chain)
+        if not payload.get("n_blocks"):
+            raise RuntimeError(
+                "serve_audit: exporter shipped no blocks "
+                f"({payload.get('n_blocks')}) — cannot inject")
+        victim = GenerationService.from_model(
+            model, params, prefix_cache=dict(pcfg))
+        faults.reset()
+        faults.configure("corrupt_page@evt:1")
+        victim.import_remote_pages(payload, origin="ship")
+        ids = prompt(chain)
+        resp = victim.generate(prompt_ids=ids,
+                               max_new_tokens=new_tokens)
+        inj_fp = str(resp.get("serve_path") or "")
+        inj.offer({
+            "rid": "bench-inject", "serve_path": inj_fp,
+            "ids": resp.get("ids"),
+            "stop_reason": resp.get("stop_reason", "length"),
+            "prompt_ids": ids, "max_new_tokens": new_tokens,
+            "temperature": 0.0, "top_k": 0, "top_p": 0.0, "seed": 0,
+            "stop": None,
+        })
+        if not inj.drain(timeout_s=300.0):
+            raise RuntimeError(
+                "serve_audit: injected-arm replay never drained")
+        inj_stats = inj.stats()
+        inj_healthy = inj.healthy()
+    finally:
+        faults.reset()
+        if had_env is not None:
+            os.environ[faults.ENV_PLAN] = had_env
+        inj.close()
+    bundles = sorted(p.name for p in
+                     Path(inj_tmp).glob("divergence_*.json"))
+    injected_detected = (inj_stats["token_divergence_total"] >= 1
+                         and not inj_healthy and bool(bundles))
+    out = {
+        "token_divergence_total": stats["token_divergence_total"],
+        "audit_sampled_total": stats["audit_sampled_total"],
+        "audit_matched_total": stats["audit_matched_total"],
+        "audit_dropped_total": stats["audit_dropped_total"],
+        "fingerprints_served": len(served_paths),
+        "fingerprints_audited": len(coverage),
+        "coverage": coverage,
+        "audit_overhead_pct": overhead_pct,
+        "audit_overhead_median_pct": median_pct,
+        "injected_detected": injected_detected,
+        "injected_divergences": inj_stats["token_divergence_total"],
+        "injected_fingerprint": inj_fp,
+        "injected_ship_flag": "ship" in fingerprint_features(inj_fp),
+        "injected_bundles": bundles,
+        "injected_healthy_after": inj_healthy,
+    }
+    # the ISSUE 18 acceptance gates, in-rung so audit-smoke CI fails
+    # loudly: the hot-path tax must stay noise (both estimators agree
+    # before failing, like quick_reqtrace), and the self-test must
+    # PROVE the auditor catches a real corruption end to end
+    if overhead_pct >= 2.0 and median_pct >= 2.0:
+        raise RuntimeError(
+            f"sampled-audit hot-path overhead {overhead_pct}% >= 2% "
+            f"(gate): {out}")
+    if not injected_detected:
+        raise RuntimeError(
+            "serve_audit: injected corrupt_page NOT caught (gate) — "
+            f"divergences={inj_stats['token_divergence_total']} "
+            f"healthy={inj_healthy} bundles={bundles}: {out}")
+    return out
+
+
 # Which fields make a rung's one-line headline (VERDICT r4 #1: the
 # driver keeps only the TAIL of stdout, and round 4's full ladder line
 # overflowed it — BENCH_r04.json arrived truncated with parsed=null, so
@@ -4979,6 +5271,14 @@ _SUMMARY_KEYS = {
                       "warm_admit_copy_bytes", "page_bytes_ratio",
                       "int8_decode_ratio",
                       "int8_vs_f32_greedy_overlap", "parity_ok"),
+    # token-integrity observatory (ISSUE 18): zero divergence on
+    # healthy churn, nonzero audited with stratified coverage, the
+    # hot-path overhead (gated < 2% in-rung), and the injected
+    # corrupt_page self-test verdict — the audit-smoke CI job asserts
+    # these from the final-line summary
+    "serve_audit": ("token_divergence_total", "audit_sampled_total",
+                    "fingerprints_audited", "audit_overhead_pct",
+                    "audit_overhead_median_pct", "injected_detected"),
     "decode_spec": ("speedup", "speedup_natural", "tokens_per_call"),
     "flash_attention_8k": ("speedup",),
     # serving-path chaos (ISSUE 9): the zero-stranded contract, the
@@ -5386,6 +5686,19 @@ _LADDER = [
         (bench_serve_longctx, {}),
         (bench_serve_longctx, {"long_prompt": 1024,
                                "n_background": 3, "bg_new": 200}),
+    ]),
+    # token-integrity observatory (ISSUE 18): shadow-replay auditor
+    # against churn traffic (zero divergence + stratified coverage
+    # floors), hot-path overhead < 2% (paired-window gmean), and the
+    # injected corrupt_page@evt self-test proving the auditor fires,
+    # the divergence bundle lands, and healthy() flips. In-process
+    # (no subprocess fleet), so it rides before the multi-minute rungs
+    ("serve_audit", [
+        (bench_serve_audit, {}),
+        # fallback arm: shorter churn + smaller overhead windows (the
+        # gates are identical — only the sample sizes shrink)
+        (bench_serve_audit, {"n_requests": 10, "prefix_len": 64,
+                             "new_tokens": 8, "overhead_steps": 15}),
     ]),
     # fleet front door: cache-aware router + admission control over
     # real serve.py subprocess replicas, trace-replay load, mid-trace

@@ -1197,11 +1197,22 @@ class ContinuousBatchingService(GenerationService):
             # inserts + the ref release ride one helper (its finally
             # owns the release from here on)
             self._insert_prefixes(reqs, slots, ints, matches)
+        from .kvcache import page_origin_flags
+
         for j, (r, slot) in enumerate(zip(reqs, slots)):
+            # serve-path provenance (ISSUE 18): admit mode + the pool
+            # events this request's cached blocks rode in on, finalized
+            # into the fingerprint at _complete
+            hit = matches[j][2] if matches is not None else 0
+            path = {"mode": "warm" if hit else "cold",
+                    "brownout": self.brownout_level}
+            if matches is not None and hit:
+                path.update(page_origin_flags(matches[j][0]))
             self._meta[slot] = {
                 "req": r, "emitted": 1, "out": [],
                 "tok0_ref": (tok0, j),
                 "pad_len": int(ints[j, 2]), "done": False,
+                "path": path,
             }
         self.stats["admissions"] += n
         if self._tracer is not None:
@@ -1458,10 +1469,22 @@ class ContinuousBatchingService(GenerationService):
                 # clobbering them leaks the pins forever
                 plan["adopt_nodes"] = (
                     list(plan.get("adopt_nodes") or []) + anodes)
+            # serve-path provenance (ISSUE 18): "stream" marks prompts
+            # whose prefill arrived via chunked streaming before this
+            # admit; node origins name the pool events behind the
+            # cached prefix (adopt/promote/pull/ship)
+            from .kvcache import page_origin_flags
+
+            streamed = plan.get("done", plan["c"]) > plan["c"]
+            path = {"mode": "stream" if streamed else "paged",
+                    "wrap": bool(plan.get("ring_wrap")),
+                    "brownout": self.brownout_level,
+                    **page_origin_flags(plan.get("nodes"))}
             self._meta[slot] = {
                 "req": r, "emitted": 1, "out": [],
                 "tok0_ref": (tok0, j),
                 "pad_len": 0, "done": False, "pages": plan,
+                "path": path,
             }
         self.stats["admissions"] += n
         self.stats["paged_admissions"] += n
@@ -1898,6 +1921,9 @@ class ContinuousBatchingService(GenerationService):
             resp["stop_reason"] = "deadline"
             self.stats["deadline_expired"] = (
                 self.stats.get("deadline_expired", 0) + 1)
+        path = self._base_path()
+        path.update(m.get("path") or {})
+        self._finalize_path(resp, path, req.get("rid"))
         req["result"] = resp
         req["event"].set()
         self._meta[slot] = None

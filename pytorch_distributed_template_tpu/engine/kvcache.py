@@ -494,6 +494,21 @@ def ship_pages(src: "PrefixCache", dst: "PrefixCache", ids) -> dict:
     return dst.import_pages(payload)
 
 
+def page_origin_flags(nodes) -> dict:
+    """Collapse the ``origin`` tags of the radix nodes a request
+    consumed into path-fingerprint flags (ISSUE 18). Locally captured
+    nodes ("capture") are the baseline warm case and add no flag; the
+    pool EVENTS that put content here some other way — a zero-copy
+    adoption, a tier promote, a peer pull, a shipped import — each
+    set their flag so the serve-path fingerprint names them."""
+    flags: dict = {}
+    for n in nodes or ():
+        o = n.get("origin")
+        if o in ("adopt", "promote", "pull", "ship"):
+            flags[o] = True
+    return flags
+
+
 class SpillTier:
     """Bounded demote-on-evict store under the device pool (ISSUE 13).
 
@@ -771,8 +786,12 @@ class RadixIndex:
                     bid = alloc()
                     if bid is None:
                         break
+                    # origin feeds per-request path provenance (ISSUE
+                    # 18): capture = the scatter arm's capture kernel
+                    # wrote this page from a live cache row
                     nxt = {"children": {}, "block": bid, "parent": node,
-                           "chunk": chunk, "refs": 0, "last_use": now}
+                           "chunk": chunk, "refs": 0, "last_use": now,
+                           "origin": "capture"}
                     node["children"][chunk] = nxt
                     self.nodes += 1
                     new_nodes.append(nxt)
@@ -1000,6 +1019,13 @@ class PrefixCache:
             "pool_fallback_gpt2_layout": 0,
             "pool_fallback_dry_pool": 0,
         }
+        # corrupt_page fault (ISSUE 18): block id marked for a
+        # deferred constant-pattern overwrite; applied at the next
+        # safe pool-donation point
+        self._corrupt_block = None
+        # path provenance (ISSUE 18): origin flags of the nodes the
+        # most recent warm_prefill consumed (scatter arm only)
+        self.last_warm_flags: dict = {}
         # demote-on-evict spill tier (ISSUE 13): None keeps the
         # classic destroy-on-evict byte-identical
         self.spill = None
@@ -1243,7 +1269,8 @@ class PrefixCache:
         self.pool = _import_scatter_fn()(
             self.pool, jnp.asarray(ids_pad), stacked)
         owned = {i: bid for (i, _), bid in zip(chain, priv)}
-        adopted, _ = self.adopt(ids[:nfull * self.block], owned)
+        adopted, _ = self.adopt(ids[:nfull * self.block], owned,
+                                origin="promote")
         taken = set(adopted)
         self.free_blocks([b for b in priv if b not in taken])
         # entries whose block actually ADOPTED leave the tier (their
@@ -1370,7 +1397,8 @@ class PrefixCache:
                 self._private.discard(bid)
             self._free.extend(ids)
 
-    def adopt(self, token_ids, owned: dict, acquire: bool = False):
+    def adopt(self, token_ids, owned: dict, acquire: bool = False,
+              origin: str = "adopt"):
         """ZERO-COPY radix insert: hand privately-written pool pages to
         the index so other requests share them — no capture kernel, no
         device work; the K/V is already canonical in place (ISSUE 7:
@@ -1384,11 +1412,20 @@ class PrefixCache:
         request adopted the same content first) the private duplicate
         stays private — the caller frees it after completion.
 
+        ``origin`` tags the created nodes for per-request path
+        provenance (ISSUE 18): ``adopt`` (a local request's zero-copy
+        pages), ``ship`` (a disaggregated prefill→decode import),
+        ``pull`` (a peer-pool pull), ``promote`` (a spill-tier
+        promotion). A later admission consuming the page surfaces the
+        tag in its serve-path fingerprint.
+
         Returns ``(adopted_ids, nodes)``: the block ids now owned by
         the index (no longer private) and, when ``acquire``, the
         CREATED nodes ref-pinned for the (still-reading) caller to
         release at completion (pre-existing duplicates need no pin —
         the caller keeps reading its own private copy)."""
+        from ..resilience import faults
+
         bt = self.block
         nfull = len(token_ids) // bt
         with self._lock:
@@ -1404,7 +1441,8 @@ class PrefixCache:
                         break
                     nxt = {"children": {}, "block": int(bid),
                            "parent": node, "chunk": chunk,
-                           "refs": 0, "last_use": now}
+                           "refs": 0, "last_use": now,
+                           "origin": str(origin)}
                     node["children"][chunk] = nxt
                     self.index.nodes += 1
                     self._private.discard(int(bid))
@@ -1415,6 +1453,16 @@ class PrefixCache:
                 nxt["last_use"] = now
                 node = nxt
             self.stats["prefix_adopted_blocks"] += len(adopted)
+            if adopted:
+                # corrupt_page fault (ISSUE 18): mark the first block
+                # this adoption landed; the overwrite itself is
+                # DEFERRED to the pool's next safe device point
+                # (_apply_pending_corruption) — corrupting here would
+                # donate the pool out from under a live engine cache
+                # mid-tick
+                spec = faults.on_page_adopt()
+                if spec is not None:
+                    self._corrupt_block = int(adopted[0])
             return adopted, nodes
 
     def record_copy_bytes(self, n_blocks: int) -> None:
@@ -1494,10 +1542,14 @@ class PrefixCache:
             "leaves": leaves,
         }
 
-    def import_pages(self, payload: dict) -> dict:
+    def import_pages(self, payload: dict, origin: str = "ship") -> dict:
         """Adopt a shipped page chain into THIS pool — the receiving
-        half of the prefill→decode handoff. Blocks the pool already
-        holds are skipped (a re-ship of a hot prefix costs nothing);
+        half of the prefill→decode handoff. ``origin`` tags the
+        adopted radix nodes for path provenance (ISSUE 18): "ship"
+        for the disagg prefill→decode handoff, "pull" when the fleet
+        poller dragged the chain here via peer pull. Blocks the pool
+        already holds are skipped (a re-ship of a hot prefix costs
+        nothing);
         the rest land as PRIVATE pages first (private pages are never
         evictable, so an in-flight import cannot lose a page to
         pressure), get their content written by one donating scatter
@@ -1567,7 +1619,8 @@ class PrefixCache:
         self.pool = _import_scatter_fn()(
             self.pool, jnp.asarray(ids_pad), content)
         owned = {have_n + i: bid for i, bid in enumerate(priv)}
-        adopted, _ = self.adopt(ids[:nb * self.block], owned)
+        adopted, _ = self.adopt(ids[:nb * self.block], owned,
+                                origin=origin)
         taken = set(adopted)
         self.free_blocks([b for b in priv if b not in taken])
         n = len(adopted)
@@ -1583,6 +1636,29 @@ class PrefixCache:
         return {"imported_blocks": n,
                 "cached_tokens": (have_n + n) * self.block,
                 "bytes": nbytes}
+
+    def _apply_pending_corruption(self) -> None:
+        """Apply a deferred ``corrupt_page`` fault (ISSUE 18):
+        overwrite the marked pool block with a constant pattern
+        through the donating import scatter. Called from the pool-
+        reading entry points (``refresh_cache_from_pool``,
+        ``paged_prefill``, ``warm_prefill``) — places where a pool
+        donation is already part of the caller's contract, so the
+        corruption can never strand a live cache mid-dispatch."""
+        import jax.numpy as jnp
+
+        with self._lock:
+            bid, self._corrupt_block = self._corrupt_block, None
+        if bid is None:
+            return
+        content = {
+            ps: jnp.ones((1,) + tuple(leaf.shape[1:]), leaf.dtype)
+            for ps, leaf in self.pool.items()}
+        self.pool = _import_scatter_fn()(
+            self.pool, jnp.asarray(np.asarray([bid], np.int32)),
+            content)
+        logger.warning("fault corrupt_page: overwrote pool block %d "
+                       "with a constant pattern", bid)
 
     def sync_pool_from_cache(self, cache) -> None:
         """Point ``self.pool`` at the pool leaves inside a paged cache
@@ -1627,6 +1703,7 @@ class PrefixCache:
             self.index = RadixIndex(self.block)
             self._free = list(range(1, self.pool_blocks))
             self._private = set()
+            self._corrupt_block = None
             self.stats["prefix_pool_resets"] = (
                 self.stats.get("prefix_pool_resets", 0) + 1)
         logger.warning(
@@ -1647,6 +1724,7 @@ class PrefixCache:
         ``cache`` unchanged when already current."""
         import jax
 
+        self._apply_pending_corruption()
         flat = jax.tree_util.tree_flatten_with_path(dict(cache))[0]
         by_path = {_path_str(p): leaf for p, leaf in flat}
         if all(by_path.get(ps) is leaf
@@ -1814,6 +1892,7 @@ class PrefixCache:
         MUST call ``paged_finish(plan, out_ids, emitted)`` when done."""
         import jax.numpy as jnp
 
+        self._apply_pending_corruption()
         plan = self.paged_plan(ids, budget)
         if plan is None:
             return None
@@ -1926,8 +2005,14 @@ class PrefixCache:
             raise PoolUnsupported(
                 "window", "the scatter arm cannot serve a rolling-"
                 "window layout (paged ring only)")
+        self._apply_pending_corruption()
         L = len(ids)
         nodes, blocks, c = self.lookup(ids, record=record)
+        # per-request path provenance (ISSUE 18): the scatter arm
+        # consumes its nodes internally, so the caller cannot read
+        # their origins from a plan — stash the flags for the batch-1
+        # service (single-threaded under the service lock) to pick up
+        self.last_warm_flags = page_origin_flags(nodes) if c else {}
         try:
             if c == 0:
                 prompt = jnp.asarray(np.asarray(ids, np.int32)[None, :])

@@ -206,6 +206,11 @@ class GenerationService:
         self.hist = {"ttft_seconds": LatencyHistogram(),
                      "tpot_seconds": LatencyHistogram(),
                      "e2e_seconds": LatencyHistogram()}
+        # per-request serve-path provenance (ISSUE 18): fingerprint ->
+        # served-request count, rendered by serve.py's /metrics as the
+        # serve_path_<fingerprint>_total counter family
+        self._path_counts: dict = {}
+        self._path_lock = threading.Lock()
         # scheduler subclasses overwrite this with richer dicts in
         # their own _setup (after this super() call); the plain
         # serialized service still exposes a token counter for /metrics
@@ -230,6 +235,42 @@ class GenerationService:
         if self._slo is not None and request_id:
             self._slo.observe(request_id, ttft_s=ttft_s, e2e_s=e2e,
                               tokens=tokens)
+
+    def _base_path(self, speculative: int = 0) -> dict:
+        """The request-independent half of a serve-path fingerprint
+        (ISSUE 18): kv layout + TP geometry + spec intent. Engines add
+        the admit mode and the pool events the request consumed before
+        :meth:`_finalize_path` renders it."""
+        pf = getattr(self, "_prefix", None)
+        kvq = str(getattr(pf, "kv_quant", "")
+                  or getattr(self.model, "kv_quant", "") or "")
+        window = int(getattr(pf, "window", 0)
+                     or getattr(self.model, "window", 0) or 0)
+        return {"mode": "cold", "tp": self.tp,
+                "int8": kvq == "int8", "ring": window > 0,
+                "spec": int(speculative) > 0}
+
+    def _finalize_path(self, resp: dict, path: dict,
+                       request_id=None) -> str:
+        """Render ``path`` to its fingerprint and attach it everywhere
+        a completed request is observable: the wire response
+        (``serve_path`` — serve.py echoes it as ``X-Serve-Path``), the
+        per-fingerprint request counters, and the request's trace."""
+        from ..observability.reqtrace import path_fingerprint
+
+        fp = path_fingerprint(path)
+        resp["serve_path"] = fp
+        with self._path_lock:
+            self._path_counts[fp] = self._path_counts.get(fp, 0) + 1
+        if self._tracer is not None and request_id:
+            self._tracer.event(request_id, "serve_path",
+                               fingerprint=fp)
+        return fp
+
+    def path_counts_snapshot(self) -> dict:
+        """fingerprint -> served-request count (for /metrics)."""
+        with self._path_lock:
+            return dict(self._path_counts)
 
     def slo_stats(self):
         """SLO breach counters for /metrics (zeros when no watcher)."""
@@ -533,12 +574,15 @@ class GenerationService:
             self.stats.get("peer_exports", 0) + 1)
         return payload
 
-    def import_remote_pages(self, payload) -> dict:
+    def import_remote_pages(self, payload, origin: str = "ship") -> dict:
         """The decode-role entry: land a shipped page chain in this
         replica's pool (``bytes`` payloads deserialize here), making
         the prompt's prefix a radix HIT — the very next ``generate``
         for it admits as a zero-recompute block-table pointer update.
-        Runs under the service lock (the scheduler's tick-start
+        ``origin`` tags the adopted nodes for path provenance (ISSUE
+        18): "ship" for the disagg handoff, "pull" when the fleet
+        manager dragged the chain here as a peer pull. Runs under the
+        service lock (the scheduler's tick-start
         ``refresh_cache_from_pool`` absorbs the import's pool
         donation, same contract as batch-1 speculative requests)."""
         from .kvcache import deserialize_pages
@@ -549,7 +593,7 @@ class GenerationService:
         if isinstance(payload, (bytes, bytearray, memoryview)):
             payload = deserialize_pages(bytes(payload))
         with self._lock:
-            receipt = self._prefix.import_pages(payload)
+            receipt = self._prefix.import_pages(payload, origin=origin)
         self.stats["remote_admits"] = (
             self.stats.get("remote_admits", 0) + 1)
         return receipt
@@ -646,6 +690,7 @@ class GenerationService:
         self._check_role(max_new_tokens)
         ids = self.encode_prompt(prompt, prompt_ids)
         stops = self.encode_stop(stop)
+        path = self._base_path(speculative)
         arr = jnp.asarray(np.asarray(ids, np.int32)[None, :])
         with self._lock:
             if deadline is not None and deadline.expired():
@@ -669,6 +714,15 @@ class GenerationService:
                         tokens_per_call=stats.get("tokens_per_call"),
                         model_calls=stats.get("model_calls"),
                         disabled=stats.get("speculation_disabled"))
+                if (self._prefix is not None
+                        and stats.get("prefix_hit_tokens")):
+                    # the pool-shared spec arm warm-prefilled through
+                    # the prefix cache — a warm admit, with the pool
+                    # events warm_prefill stashed
+                    path["mode"] = "warm"
+                    path.update(getattr(self._prefix,
+                                        "last_warm_flags", {}))
+                self._finalize_path(resp, path, request_id)
                 self._observe_request(request_id, t_req, resp)
                 return resp
             # row_rngs (not rng): the row stream is key(seed)
@@ -696,6 +750,8 @@ class GenerationService:
                     int(top_k), float(top_p), row_rngs)
                 if new_ids is not None:
                     resp = self._response(new_ids, stops=stops)
+                    path.update(getattr(self, "_last_path_info", {}))
+                    self._finalize_path(resp, path, request_id)
                     self._observe_request(request_id, t_req, resp)
                     return resp
             if stops:
@@ -718,6 +774,7 @@ class GenerationService:
                 )
         resp = self._response(np.asarray(out[0, arr.shape[1]:]),
                               stops=stops, emitted=emitted)
+        self._finalize_path(resp, path, request_id)
         self._observe_request(request_id, t_req, resp)
         return resp
 
@@ -745,8 +802,13 @@ class GenerationService:
         import numpy as np
 
         from .generate import _decode_fns, _fold_all_rows, _sample_rows
-        from .kvcache import _paged_decode_fns
+        from .kvcache import _paged_decode_fns, page_origin_flags
 
+        # path provenance stash (ISSUE 18): which arm served this
+        # request + the pool events it consumed; the caller merges it
+        # into the request's serve-path fingerprint. Safe as an
+        # instance attr — the caller holds the service lock.
+        self._last_path_info = {"mode": "cold"}
         if temperature <= 0:
             keys_at = lambda i: row_rngs                   # noqa: E731
         else:
@@ -791,6 +853,10 @@ class GenerationService:
                 self._prefix.paged_finish(
                     plan, [int(t) for t in row], max_new)
                 self._prefix.count_batch1(paged=True)
+                self._last_path_info = {
+                    "mode": "paged",
+                    "wrap": bool(plan.get("ring_wrap")),
+                    **page_origin_flags(plan.get("nodes"))}
                 return row
         self._prefix.count_batch1(paged=False)
         # pool-fallback accounting (ISSUE 15): a healthy-but-dry paged
@@ -810,6 +876,10 @@ class GenerationService:
         last_logits, cache, hit = self._prefix.warm_prefill(
             self.params, ids, len(ids) + max_new,
             record=not self._prefix.paged)
+        if hit:
+            self._last_path_info = {
+                "mode": "warm",
+                **getattr(self._prefix, "last_warm_flags", {})}
         _, step = _decode_fns(self.model, temperature, top_k, top_p)
         token = _sample_rows(keys_at(0), last_logits, temperature,
                              top_k, top_p)
@@ -1291,6 +1361,10 @@ class BatchedGenerationService(GenerationService):
                 new[i], stops=r["stop"],
                 emitted=None if lengths is None else int(lengths[i]),
             )
+            # micro-batched requests always run the cold full-prefill
+            # path (no pool on this scheduler) — fingerprint is the
+            # base layout/geometry
+            self._finalize_path(r["result"], self._base_path())
             r["event"].set()
 
 

@@ -178,6 +178,40 @@ def render_dashboard(manager, admission, stats, slo=None,
     parts += _table(rows or [("(no traffic yet)", "-")],
                     ("counter", "value"))
 
+    # -- token integrity (ISSUE 18) ----------------------------------------
+    # fleet-level shadow-audit verdict + per-replica coverage split by
+    # serve-path fingerprint, read from the poller's stored /metrics
+    # bodies (rep.polled) — a dashboard request never touches a replica
+    parts.append("<h2>Token integrity (shadow audit)</h2>")
+    audited = int(counters.get("fleet_audit_sampled_total", 0) or 0)
+    diverged = int(
+        counters.get("fleet_token_divergence_total", 0) or 0)
+    dropped = int(counters.get("fleet_audit_dropped_total", 0) or 0)
+    verdict = ("no auditing replicas"
+               if not audited and not diverged
+               else "DIVERGENT" if diverged else "clean")
+    parts.append(
+        f'<p class="muted">verdict: {html.escape(verdict)} · audited '
+        f"{audited} · divergent {diverged} · dropped {dropped}</p>")
+    cov_rows = []
+    for r in snap["replicas"]:
+        rep = manager.replicas.get(r["id"])
+        polled = (rep.polled or {}) if rep is not None else {}
+        for k in sorted(polled):
+            if not (k.startswith("audit_path_")
+                    and k.endswith("_audited_total")):
+                continue
+            fp = k[len("audit_path_"):-len("_audited_total")]
+            cov_rows.append((
+                r["id"], fp,
+                int(polled.get(f"serve_path_{fp}_total", 0) or 0),
+                int(polled.get(k, 0) or 0),
+                int(polled.get(f"audit_path_{fp}_divergent_total", 0)
+                    or 0)))
+    if cov_rows:
+        parts += _table(cov_rows, ("replica", "fingerprint", "served",
+                                   "audited", "divergent"))
+
     # -- sparklines --------------------------------------------------------
     parts.append("<h2>Timeline (poller window)</h2>")
     if tsdb is None or not tsdb.points():

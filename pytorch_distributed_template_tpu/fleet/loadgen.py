@@ -215,7 +215,11 @@ def _run_one(base: str, item: dict, t_start: float, results: list,
            "deadline_ms": item.get("deadline_ms"),
            "deadline_feasible": item.get("deadline_feasible", True),
            "tokens": 0, "status": None, "error": None,
-           "ttft_s": None, "tpot_s": None, "total_s": None}
+           "ttft_s": None, "tpot_s": None, "total_s": None,
+           # path provenance (ISSUE 18): the replica's serve-path
+           # fingerprint — the X-Serve-Path header on plain JSON
+           # responses, the done event's serve_path key on SSE
+           "serve_path": None}
     delay = t_start + item["t"] - time.monotonic()
     if delay > 0:
         time.sleep(delay)
@@ -256,6 +260,7 @@ def _run_one(base: str, item: dict, t_start: float, results: list,
         elif ct.startswith("text/event-stream"):
             _consume_sse(resp, conn, item, rec, t0)
         else:
+            rec["serve_path"] = resp.getheader("X-Serve-Path")
             data = json.loads(resp.read().decode("utf-8"))
             rec["tokens"] = len(data.get("ids") or ())
             rec["ok"] = True
@@ -328,6 +333,7 @@ def _consume_sse(resp, conn, item: dict, rec: dict,
                 rec["tokens"] = (len(event.get("ids") or ())
                                  or rec["tokens"])
                 rec["ok"] = True
+                rec["serve_path"] = event.get("serve_path")
                 if event.get("stop_reason") == "deadline":
                     rec["deadline"] = True   # served, but truncated
                 if (t_first is not None and t_last is not None
@@ -446,6 +452,33 @@ def summarize(replayed: dict, trace: Optional[List[dict]] = None,
     for t in per_tenant.values():
         t["compliance_frac"] = round(
             t["compliant_tokens"] / max(t["tokens"], 1), 4)
+    # per-serve-path latency/error split (ISSUE 18): the client-side
+    # join of the provenance fingerprint — "warm_adopt is slower than
+    # warm" or "every error rode the pull path" falls out of this
+    # table instead of a per-request grep
+    by_path: Dict[str, dict] = {}
+    for r in results:
+        fp = r.get("serve_path")
+        if not fp:
+            continue
+        b = by_path.setdefault(fp, {
+            "requests": 0, "ok": 0, "errors": 0, "deadline_hit": 0,
+            "tokens": 0, "_totals": [], "_ttfts": []})
+        b["requests"] += 1
+        b["ok"] += int(r["ok"])
+        b["errors"] += int(bool(r["error"]))
+        b["deadline_hit"] += int(r["deadline"])
+        b["tokens"] += r["tokens"]
+        if r["total_s"] is not None and r["ok"]:
+            b["_totals"].append(r["total_s"])
+        if r["ttft_s"] is not None:
+            b["_ttfts"].append(r["ttft_s"])
+    for b in by_path.values():
+        totals_fp = sorted(b.pop("_totals"))
+        ttfts_fp = sorted(b.pop("_ttfts"))
+        b["latency_p50_s"] = _percentile(totals_fp, 0.5)
+        b["latency_p99_s"] = _percentile(totals_fp, 0.99)
+        b["ttft_p50_s"] = _percentile(ttfts_fp, 0.5)
     # terminal-outcome accounting (ISSUE 9): a request is STRANDED
     # when it never reached ANY classified outcome — no HTTP status,
     # no deliberate cancel (client-side timeouts and connect failures
@@ -493,6 +526,7 @@ def summarize(replayed: dict, trace: Optional[List[dict]] = None,
         "latency_p50_s": _percentile(totals, 0.5),
         "latency_p99_s": _percentile(totals, 0.99),
         "per_tenant": per_tenant,
+        "by_path": dict(sorted(by_path.items())),
         # per-request client measurements keyed by rid: the stitcher
         # (scripts/trace_stitch.py --client) joins these onto the
         # server-side span timelines, so attribution is against the
@@ -501,7 +535,8 @@ def summarize(replayed: dict, trace: Optional[List[dict]] = None,
             {"rid": r.get("rid"), "tenant": r["tenant"],
              "ok": r["ok"], "shed": r["shed"], "status": r["status"],
              "tokens": r["tokens"], "ttft_s": r["ttft_s"],
-             "total_s": r["total_s"]}
+             "total_s": r["total_s"],
+             "serve_path": r.get("serve_path")}
             for r in sorted(results, key=lambda r: r["i"])],
     }
     if trace is not None:

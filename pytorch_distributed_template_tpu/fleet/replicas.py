@@ -65,6 +65,11 @@ AGGREGATED_COUNTERS = (
     "cancelled_total", "prefix_hit_tokens_total",
     "prefix_hit_requests_total", "prefix_lookups_total",
     "prefix_evictions_total", "slo_breach_total",
+    # token-integrity auditing (ISSUE 18): the fleet-level verdict —
+    # any replica's sampled divergence surfaces in the rollup (and
+    # the dashboard's audit panel) reset-corrected like the rest
+    "audit_sampled_total", "token_divergence_total",
+    "audit_dropped_total",
 )
 
 #: per-replica latency HISTOGRAMS (fixed shared buckets —
@@ -85,11 +90,14 @@ def http_json(url: str, timeout_s: float = 5.0) -> dict:
 
 def http_post(url: str, path: str, body: bytes,
               timeout_s: float = 5.0,
-              content_type: str = "application/json"):
+              content_type: str = "application/json",
+              headers: Optional[dict] = None):
     """POST ``body`` to ``url + path`` -> ``(status, response_bytes)``.
     The peer page-migration helper (export from one replica, admit
     into another); wire failures raise (OSError / socket.timeout /
-    http.client.HTTPException) — the callers own the fallback."""
+    http.client.HTTPException) — the callers own the fallback.
+    ``headers`` merge over the Content-Type (page provenance rides
+    ``X-Page-Origin``, ISSUE 18)."""
     import http.client
     from urllib.parse import urlsplit
 
@@ -98,7 +106,8 @@ def http_post(url: str, path: str, body: bytes,
                                       timeout=timeout_s)
     try:
         conn.request("POST", path, body=body,
-                     headers={"Content-Type": content_type})
+                     headers={"Content-Type": content_type,
+                              **(headers or {})})
         resp = conn.getresponse()
         return resp.status, resp.read()
     finally:
@@ -722,7 +731,12 @@ class FleetManager:
                 raise OSError(f"export answered {status}")
             status, rbody = http_post(
                 dst.url, "/admit_pages", body, timeout_s=timeout_s,
-                content_type="application/octet-stream")
+                content_type="application/octet-stream",
+                # provenance tag (ISSUE 18): pulled pages adopt as
+                # origin="pull", so requests consuming them carry the
+                # flag in their serve-path fingerprint (disagg handoff
+                # imports keep the "ship" default)
+                headers={"X-Page-Origin": "pull"})
             if status != 200:
                 raise OSError(f"admit answered {status}")
             receipt = json.loads(rbody)

@@ -48,7 +48,7 @@ from urllib.parse import parse_qsl, urlsplit
 
 from ..observability.reqtrace import (
     DEADLINE_EXPIRED_HEADER, DEADLINE_HEADER, Deadline,
-    mint_request_id, sanitize_request_id,
+    SERVE_PATH_HEADER, mint_request_id, sanitize_request_id,
 )
 from ..observability.servicedist import GoodputMeter
 from ..resilience import faults
@@ -280,12 +280,14 @@ def make_fleet_handler(manager: FleetManager, admission: FairAdmission,
             self.wfile.write(body)
 
         def _send_raw(self, code: int, body: bytes,
-                      content_type: str) -> None:
+                      content_type: str, headers=()) -> None:
             self.send_response(code)
             self.send_header("Content-Type", content_type)
             self.send_header("Content-Length", str(len(body)))
             if self._rid:
                 self.send_header("X-Request-Id", self._rid)
+            for k, v in headers:
+                self.send_header(k, v)
             self.end_headers()
             self.wfile.write(body)
 
@@ -1046,7 +1048,14 @@ def make_fleet_handler(manager: FleetManager, admission: FairAdmission,
                     # (deadline-truncated 200s count raw, the meter
                     # keeps them out of goodput via the outcome)
                     holder["tokens"] = _response_tokens(data)
-                self._send_raw(resp.status, data, ct)
+                # path provenance (ISSUE 18): the replica's serve-path
+                # fingerprint relays to the client — loadgen joins
+                # per-path latency through the router exactly like
+                # direct traffic (SSE carries it in the done event)
+                sp = resp.getheader(SERVE_PATH_HEADER)
+                self._send_raw(resp.status, data, ct,
+                               headers=([(SERVE_PATH_HEADER, sp)]
+                                        if sp else []))
                 # a replica-marked deadline response (200 + partial
                 # tokens, or its own 504) relays verbatim but is
                 # classified OUT of the served SLO, like cancelled
@@ -1108,6 +1117,9 @@ def make_fleet_handler(manager: FleetManager, admission: FairAdmission,
                     "status": resp.status, "body": data, "ct": ct,
                     "deadline_marked": bool(
                         resp.getheader(DEADLINE_EXPIRED_HEADER)),
+                    # whichever attempt wins the hedging race, its OWN
+                    # replica's fingerprint relays (ISSUE 18)
+                    "serve_path": resp.getheader(SERVE_PATH_HEADER),
                 }
             finally:
                 conn.close()
@@ -1236,7 +1248,11 @@ def make_fleet_handler(manager: FleetManager, admission: FairAdmission,
                         holder["tokens"] = _response_tokens(
                             res["body"])
                     self._send_raw(res["status"], res["body"],
-                                   res["ct"])
+                                   res["ct"], headers=(
+                                       [(SERVE_PATH_HEADER,
+                                         res["serve_path"])]
+                                       if res.get("serve_path")
+                                       else []))
                     if res.get("deadline_marked"):
                         return "deadline"
                     return ("proxied" if v == "done"

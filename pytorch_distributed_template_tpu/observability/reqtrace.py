@@ -152,6 +152,86 @@ class Deadline:
 
 
 # ---------------------------------------------------------------------------
+# serve-path provenance (ISSUE 18)
+# ---------------------------------------------------------------------------
+
+SERVE_PATH_HEADER = "X-Serve-Path"
+
+#: admit modes, mutually exclusive — the first fingerprint token.
+#: ``stream`` is a chunked streaming-prefill admission (paged
+#: underneath, but its correctness surface — per-chunk scatter + ring
+#: slack accounting — is its own path).
+PATH_MODES = ("cold", "warm", "paged", "stream")
+
+#: ordered feature flags; a fingerprint includes the ones that are
+#: truthy in the path dict, in THIS order, so the same feature set
+#: always renders the same string (the string keys a metric family —
+#: ``serve_path_<fp>_total`` — and strings that differ only by token
+#: order would split one path's counts across two series).
+#:
+#:   int8    - pool pages hold quantized KV (kv layout)
+#:   ring    - sliding-window ring layout pool
+#:   wrap    - this request's ring actually wrapped (ring_wrap plan)
+#:   adopt   - admit consumed adopted (radix-shared) pool pages
+#:   promote - admit consumed pages promoted back from a spill tier
+#:   pull    - admit consumed pages pulled from a peer replica's pool
+#:   ship    - admit consumed pages imported from a shipped payload
+#:             (disaggregated prefill→decode handoff)
+#:   spec    - speculative decode produced the tokens
+PATH_FLAGS = ("int8", "ring", "wrap", "adopt", "promote", "pull",
+              "ship", "spec")
+
+_FP_OK = re.compile(r"^[a-z0-9_]{1,96}$")
+
+
+def path_fingerprint(path: dict) -> str:
+    """A request's path dict -> its compact fingerprint string.
+
+    The dict is accumulated by whichever scheduler served the request
+    (``mode`` + the :data:`PATH_FLAGS` booleans + ``tp``/``dp``/
+    ``brownout`` ints); the string is lowercase ``[a-z0-9_]`` only, so
+    it is simultaneously a legal ``X-Serve-Path`` header value and a
+    legal metric-name fragment (``serve_path_<fp>_total`` passes the
+    prometheus charset and the repo's promtext lint)."""
+    mode = str(path.get("mode") or "cold")
+    toks = [mode if mode in PATH_MODES else "cold"]
+    for flag in PATH_FLAGS:
+        if path.get(flag):
+            toks.append(flag)
+    tp = int(path.get("tp") or 1)
+    if tp > 1:
+        toks.append(f"tp{tp}")
+    dp = int(path.get("dp") or 1)
+    if dp > 1:
+        toks.append(f"dp{dp}")
+    level = int(path.get("brownout") or 0)
+    if level > 0:
+        toks.append(f"b{level}")
+    return "_".join(toks)
+
+
+def sanitize_serve_path(value) -> Optional[str]:
+    """A propagated ``X-Serve-Path`` value, validated — or None when
+    absent/hostile. Bounded lowercase charset: the value lands in
+    metric names and loadgen summaries verbatim."""
+    if not value or not isinstance(value, str):
+        return None
+    value = value.strip()
+    return value if _FP_OK.match(value) else None
+
+
+def fingerprint_features(fp: str) -> List[str]:
+    """Fingerprint -> its feature tokens (attribution unit: the audit
+    report ranks these across divergence bundles). The mode token is
+    prefixed ``mode_`` so ``cold`` the mode never collides with a
+    future flag named cold."""
+    toks = [t for t in str(fp).split("_") if t]
+    if not toks:
+        return []
+    return [f"mode_{toks[0]}"] + toks[1:]
+
+
+# ---------------------------------------------------------------------------
 # the per-process tracer
 # ---------------------------------------------------------------------------
 

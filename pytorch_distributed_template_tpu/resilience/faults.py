@@ -109,6 +109,24 @@ kind                 unit  effect at the hook point
                            an optimization, never a dependency
 ===================  ====  =========================================
 
+Token-integrity kind (ISSUE 18) — the shadow auditor's self-test.
+``evt`` here is the pool's page-ADOPTION ordinal (every ``adopt()``
+call that lands at least one block advances it — its own counter,
+independent of the spill tier's operation ordinal above):
+
+===================  ====  =========================================
+kind                 unit  effect at the hook point
+===================  ====  =========================================
+``corrupt_page``     evt   overwrite the first block adopted by the
+                           ``at``-th adoption event with a constant
+                           pattern (applied at the pool's next safe
+                           device point): warm consumers of that
+                           cached page serve WRONG tokens while the
+                           cold no-pool replay stays clean — exactly
+                           the silent divergence the shadow-replay
+                           auditor exists to catch
+===================  ====  =========================================
+
 Attempt gating: each spec fires only on one supervisor attempt
 (default the first), so a ``kill@step:5`` chaos run dies once and the
 restarted attempt — the supervisor exports ``PDT_ATTEMPT=n`` — sails
@@ -161,6 +179,11 @@ KINDS = {
     "corrupt_spill": "evt",
     "tier_exhaust": "evt",
     "peer_pull_timeout": "pull",
+    # token-integrity kind (ISSUE 18): evt = the pool's page-adoption
+    # ordinal (separate counter from the spill tier's operation
+    # ordinal — KINDS maps unit per kind, so the grammar token is the
+    # same while each kind counts its own events)
+    "corrupt_page": "evt",
 }
 
 #: kinds whose optional arg is a duration (validated at parse time)
@@ -320,11 +343,12 @@ def configure(text: Optional[str] = None,
 def reset() -> None:
     """Drop the plan entirely (tests)."""
     global _plan, _attempt, _active, _watched_loader_id, _load_ordinal
-    global _tier_ordinal, _pull_ordinal
+    global _tier_ordinal, _pull_ordinal, _page_ordinal
     _plan, _attempt, _active, _watched_loader_id = None, 1, [], None
     _load_ordinal = 0
     _tier_ordinal = 0
     _pull_ordinal = 0
+    _page_ordinal = 0
 
 
 def watch_loader(loader) -> None:
@@ -538,6 +562,26 @@ def on_tier_event():
         time.sleep(s.duration_s)
     return {"corrupt": _take("corrupt_spill", _tier_ordinal),
             "exhaust": _take("tier_exhaust", _tier_ordinal)}
+
+
+#: pool page-adoption ordinal (1-based) for the ISSUE 18
+#: ``corrupt_page`` kind — every adopt() landing >= 1 block advances it
+_page_ordinal = 0
+
+
+def on_page_adopt():
+    """Pool page-adoption hook (engine/kvcache.PrefixCache.adopt,
+    ISSUE 18): each adoption event advances the page ordinal; returns
+    the fired ``corrupt_page`` spec (the pool owns the overwrite —
+    deferred to its next safe device point so a mid-tick pool donation
+    can never invalidate a live engine cache) or None."""
+    global _page_ordinal
+    if _plan is None:
+        _ensure_configured()
+    _page_ordinal += 1
+    if not _active:
+        return None
+    return _take("corrupt_page", _page_ordinal)
 
 
 def on_peer_pull():

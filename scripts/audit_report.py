@@ -1,0 +1,221 @@
+#!/usr/bin/env python
+"""Token-integrity audit report: divergence attribution by path.
+
+Folds the shadow-replay auditor's artifacts (ISSUE 18) into one
+verdict an operator can act on:
+
+- ``divergence_<rid>.json`` bundles under ``--run-dir`` — each one a
+  confirmed token mismatch with both streams, the first-divergence
+  index, the request's serve-path fingerprint and its event timeline;
+- a ``/metrics?format=json`` snapshot (``--metrics``) carrying the
+  ``serve_path_<fp>_total`` traffic family and the
+  ``audit_path_<fp>_{audited,divergent}_total`` coverage families;
+
+and RANKS fingerprint features (admit mode, kv layout, pool events,
+spec decode — observability/reqtrace.fingerprint_features) by their
+association with divergence: for each feature, the divergence rate
+among audited requests whose path HAS the feature vs those without.
+A stale adopted page shows up as ``adopt``/``pull`` carrying all the
+lift; an int8 dequant bug as ``int8``; a ring-rollover bug as
+``wrap`` — the feature table points at the subsystem before anyone
+opens a bundle.
+
+    python scripts/audit_report.py --run-dir saved/<exp>/serve/<id> \
+        [--metrics metrics.json] [--json]
+
+Exit codes: 0 clean (no divergence anywhere), 1 divergence found,
+2 usage / unreadable input.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from pytorch_distributed_template_tpu.observability.reqtrace import (  # noqa: E402
+    fingerprint_features,
+)
+
+
+def load_bundles(run_dir) -> list:
+    """Every ``divergence_*.json`` under the run dir (sorted, bounded
+    decode: a corrupt bundle is reported, not fatal)."""
+    out = []
+    for path in sorted(Path(run_dir).glob("divergence_*.json")):
+        try:
+            b = json.loads(path.read_text())
+        except (OSError, ValueError) as e:
+            out.append({"file": path.name, "error": str(e)})
+            continue
+        out.append({
+            "file": path.name,
+            "rid": b.get("rid"),
+            "fingerprint": b.get("fingerprint"),
+            "first_divergence": b.get("first_divergence"),
+            "served_tokens": len(b.get("served_ids") or ()),
+            "replay_tokens": len(b.get("replay_ids") or ()),
+        })
+    return out
+
+
+def coverage_from_metrics(metrics: dict) -> dict:
+    """fingerprint -> {seen, audited, divergent} out of the flat
+    /metrics families (replica form; ``fleet_``-prefixed keys from the
+    router's exposition fold in the same way)."""
+    cov: dict = {}
+
+    def slot(fp):
+        return cov.setdefault(fp, {"seen": 0, "audited": 0,
+                                   "divergent": 0})
+
+    for key, val in metrics.items():
+        k = key[len("fleet_"):] if key.startswith("fleet_") else key
+        if k.startswith("serve_path_") and k.endswith("_total"):
+            fp = k[len("serve_path_"):-len("_total")]
+            slot(fp)["seen"] += int(val or 0)
+        elif k.startswith("audit_path_") and k.endswith(
+                "_audited_total"):
+            fp = k[len("audit_path_"):-len("_audited_total")]
+            slot(fp)["audited"] += int(val or 0)
+        elif k.startswith("audit_path_") and k.endswith(
+                "_divergent_total"):
+            fp = k[len("audit_path_"):-len("_divergent_total")]
+            slot(fp)["divergent"] += int(val or 0)
+    return cov
+
+
+def coverage_from_bundles(bundles: list) -> dict:
+    """Degraded coverage when no metrics snapshot is given: bundle
+    counts alone (audited == divergent — rates are meaningless, but
+    the feature RANKING by divergent count still points somewhere)."""
+    cov: dict = {}
+    for b in bundles:
+        fp = b.get("fingerprint")
+        if not fp:
+            continue
+        c = cov.setdefault(fp, {"seen": 0, "audited": 0,
+                                "divergent": 0})
+        c["audited"] += 1
+        c["divergent"] += 1
+    return cov
+
+
+def feature_attribution(coverage: dict) -> list:
+    """Rank fingerprint features by divergence association: the
+    divergence rate among audited requests WITH the feature minus the
+    rate among those without (the lift). Mode tokens rank alongside
+    flags — ``mode_paged`` carrying the lift reads just as directly
+    as ``adopt``."""
+    total_aud = sum(c["audited"] for c in coverage.values())
+    total_div = sum(c["divergent"] for c in coverage.values())
+    feats: dict = {}
+    for fp, cov in coverage.items():
+        for f in fingerprint_features(fp):
+            d = feats.setdefault(f, {"audited": 0, "divergent": 0})
+            d["audited"] += cov["audited"]
+            d["divergent"] += cov["divergent"]
+    rows = []
+    for f, d in feats.items():
+        rate = d["divergent"] / max(d["audited"], 1)
+        rest_aud = total_aud - d["audited"]
+        rest_div = total_div - d["divergent"]
+        baseline = rest_div / max(rest_aud, 1)
+        rows.append({
+            "feature": f,
+            "audited": d["audited"],
+            "divergent": d["divergent"],
+            "divergence_rate": round(rate, 4),
+            "baseline_rate": round(baseline, 4),
+            "lift": round(rate - baseline, 4),
+        })
+    rows.sort(key=lambda r: (-r["lift"], -r["divergent"],
+                             r["feature"]))
+    return rows
+
+
+def build_report(run_dir=None, metrics_path=None) -> dict:
+    bundles = load_bundles(run_dir) if run_dir else []
+    metrics = None
+    if metrics_path:
+        metrics = json.loads(Path(metrics_path).read_text())
+    coverage = (coverage_from_metrics(metrics) if metrics
+                else coverage_from_bundles(bundles))
+    divergent = sum(c["divergent"] for c in coverage.values())
+    divergent = max(divergent,
+                    sum(1 for b in bundles if "error" not in b))
+    return {
+        "verdict": "divergent" if divergent else "clean",
+        "divergent_total": divergent,
+        "audited_total": sum(c["audited"]
+                             for c in coverage.values()),
+        "bundles": bundles,
+        "coverage": {fp: coverage[fp] for fp in sorted(coverage)},
+        "attribution": feature_attribution(coverage),
+    }
+
+
+def to_markdown(report: dict) -> str:
+    lines = ["# Token-integrity audit report", "",
+             f"**Verdict: {report['verdict']}** — "
+             f"{report['divergent_total']} divergent / "
+             f"{report['audited_total']} audited", ""]
+    if report["coverage"]:
+        lines += ["## Coverage by serve-path fingerprint", "",
+                  "| fingerprint | seen | audited | divergent |",
+                  "|---|---|---|---|"]
+        lines += [f"| `{fp}` | {c['seen']} | {c['audited']} | "
+                  f"{c['divergent']} |"
+                  for fp, c in report["coverage"].items()]
+        lines.append("")
+    if report["attribution"]:
+        lines += ["## Feature attribution (ranked by lift)", "",
+                  "| feature | audited | divergent | rate | "
+                  "baseline | lift |", "|---|---|---|---|---|---|"]
+        lines += [f"| `{r['feature']}` | {r['audited']} | "
+                  f"{r['divergent']} | {r['divergence_rate']} | "
+                  f"{r['baseline_rate']} | {r['lift']} |"
+                  for r in report["attribution"]]
+        lines.append("")
+    if report["bundles"]:
+        lines += ["## Divergence bundles", ""]
+        lines += [f"- `{b['file']}`: "
+                  + (f"unreadable ({b['error']})" if "error" in b
+                     else f"rid={b['rid']} fp=`{b['fingerprint']}` "
+                          f"first_divergence={b['first_divergence']} "
+                          f"({b['served_tokens']} served / "
+                          f"{b['replay_tokens']} replayed)")
+                  for b in report["bundles"]]
+        lines.append("")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        description="divergence attribution over shadow-audit "
+                    "artifacts (bundles + /metrics coverage)")
+    p.add_argument("--run-dir", default=None,
+                   help="serving run dir holding divergence_*.json "
+                        "bundles")
+    p.add_argument("--metrics", default=None,
+                   help="a /metrics?format=json snapshot (replica or "
+                        "fleet) for traffic/coverage families")
+    p.add_argument("--json", action="store_true")
+    args = p.parse_args(argv)
+    if not args.run_dir and not args.metrics:
+        p.error("need --run-dir and/or --metrics")
+    try:
+        report = build_report(run_dir=args.run_dir,
+                              metrics_path=args.metrics)
+    except (OSError, ValueError) as e:
+        print(f"unreadable input: {e}", file=sys.stderr)
+        return 2
+    print(json.dumps(report, indent=2) if args.json
+          else to_markdown(report))
+    return 1 if report["divergent_total"] else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -358,9 +358,18 @@ def analyze_fleet(path) -> dict:
     for key in ("routed_prefix_total", "routed_least_loaded_total",
                 "routed_round_robin_total", "dispatch_errors_total",
                 "fleet_requests_total", "fleet_prefix_hit_tokens_total",
-                "fleet_tokens_generated_total"):
+                "fleet_tokens_generated_total",
+                # token-integrity auditing (ISSUE 18): the fleet-level
+                # verdict counters — any nonzero divergence in a run's
+                # last snapshot belongs in the report headline
+                "fleet_audit_sampled_total",
+                "fleet_token_divergence_total",
+                "fleet_audit_dropped_total"):
         if key in last_snapshot:
             out[key] = last_snapshot[key]
+    if last_snapshot.get("fleet_audit_sampled_total"):
+        out["audit_clean"] = not last_snapshot.get(
+            "fleet_token_divergence_total")
     routed = sum(out.get(k, 0) or 0
                  for k in ("routed_prefix_total",
                            "routed_least_loaded_total",

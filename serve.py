@@ -123,9 +123,12 @@ from pytorch_distributed_template_tpu.observability.health import (  # noqa: E40
 from pytorch_distributed_template_tpu.observability.profiler import (  # noqa: E402
     OnDemandProfiler,
 )
+from pytorch_distributed_template_tpu.observability.audit import (  # noqa: E402
+    ShadowAuditor,
+)
 from pytorch_distributed_template_tpu.observability.reqtrace import (  # noqa: E402
     DEADLINE_EXPIRED_HEADER, DEADLINE_HEADER, Deadline, RequestTracer,
-    SloWatcher, mint_request_id, sanitize_request_id,
+    SERVE_PATH_HEADER, SloWatcher, mint_request_id, sanitize_request_id,
 )
 from pytorch_distributed_template_tpu.observability.telemetry import (  # noqa: E402
     compile_cache_stats,
@@ -193,7 +196,28 @@ def _run_request(service: GenerationService, req: dict,
     return service.generate(**kwargs)
 
 
-def service_metrics(service: GenerationService) -> dict:
+def audit_record(req: dict, out: dict) -> dict:
+    """Wire request + finished response -> ShadowAuditor record: the
+    sampling config a replay takes (same defaults as ``_run_request``
+    so the reference decodes the request the server actually ran) plus
+    the served ids / fingerprint / stop_reason the verdict compares."""
+    return {
+        "rid": out.get("request_id"),
+        "serve_path": out.get("serve_path"),
+        "ids": out.get("ids"),
+        "stop_reason": out.get("stop_reason"),
+        "prompt": req.get("prompt"),
+        "prompt_ids": req.get("prompt_ids"),
+        "max_new_tokens": int(req.get("max_new_tokens", 64)),
+        "temperature": float(req.get("temperature", 0.0)),
+        "top_k": int(req.get("top_k", 0)),
+        "top_p": float(req.get("top_p", 0.0)),
+        "seed": int(req.get("seed", 0)),
+        "stop": req.get("stop"),
+    }
+
+
+def service_metrics(service: GenerationService, auditor=None) -> dict:
     """Scheduler-agnostic metrics snapshot for ``GET /metrics``.
 
     Counters come from the service's ``stats`` dict (every scheduler
@@ -446,6 +470,22 @@ def service_metrics(service: GenerationService) -> dict:
             out["decode_step_anatomy"] = anatomy
     if hasattr(service, "slo_stats"):
         out.update(service.slo_stats())
+    # per-request path provenance (ISSUE 18): one flat counter per
+    # serve-path fingerprint — the repo's labeled-family convention
+    # (the label value rides in the name; fingerprints are [a-z0-9_]
+    # by construction, so the series name stays prometheus-legal)
+    if hasattr(service, "path_counts_snapshot"):
+        for fp, n in sorted(service.path_counts_snapshot().items()):
+            out[f"serve_path_{fp}_total"] = int(n)
+    # shadow-replay auditor (ISSUE 18): verdict counters + queue gauge,
+    # and the per-fingerprint coverage split the serve_audit bench rung
+    # and the fleet dashboard read
+    if auditor is not None:
+        out.update(auditor.stats())
+        for fp, cov in auditor.coverage().items():
+            out[f"audit_path_{fp}_audited_total"] = int(cov["audited"])
+            out[f"audit_path_{fp}_divergent_total"] = int(
+                cov["divergent"])
     # resilience-supervisor counters (when supervised / a log exists):
     # restarts_total scrapes as a counter; the cause string is JSON-only
     # (prometheus_text emits numeric fields exclusively)
@@ -484,7 +524,8 @@ class ActiveRequests:
 
 
 def make_handler(service: GenerationService, profiler=None,
-                 active: ActiveRequests | None = None, tracer=None):
+                 active: ActiveRequests | None = None, tracer=None,
+                 auditor=None):
     import itertools
 
     active = active or ActiveRequests()
@@ -520,6 +561,22 @@ def make_handler(service: GenerationService, profiler=None,
             self.end_headers()
             self.wfile.write(body)
 
+        def _offer_audit(self, req: dict, out) -> None:
+            """Enqueue a finished request for shadow replay (ISSUE
+            18). Handler-level on purpose: every scheduler's requests
+            funnel through here, so auditing needs no per-engine
+            plumbing. offer() never blocks (bounded queue, drops
+            counted)."""
+            if auditor is None or not isinstance(out, dict):
+                return
+            if (int(req.get("speculative", 0) or 0)
+                    and float(req.get("temperature", 0.0) or 0.0)):
+                # sampled speculative decode resamples on rejection —
+                # not replayable token-exactly by the plain reference
+                # (greedy speculative IS, and stays auditable)
+                return
+            auditor.offer(audit_record(req, out))
+
         def do_GET(self):  # noqa: N802 (http.server API)
             with active:
                 self._get()
@@ -527,14 +584,20 @@ def make_handler(service: GenerationService, profiler=None,
         def _get(self):
             path, _, query = self.path.partition("?")
             if path == "/metrics":
-                metrics = service_metrics(service)
+                metrics = service_metrics(service, auditor=auditor)
                 if "format=json" in query:
                     return self._send(200, metrics)
                 return self._send_text(200, prometheus_text(metrics))
             if path != "/healthz":
                 return self._send(404, {"error": "unknown path"})
+            # token-integrity verdict (ISSUE 18): a replica whose
+            # shadow replay caught a divergence reports "degraded" —
+            # still serving (the divergence is sampled evidence, not
+            # proof every request is wrong), but the fleet poller
+            # surfaces it for rotation instead of routing blind
+            degraded = auditor is not None and not auditor.healthy()
             payload = {
-                "status": "ok",
+                "status": "degraded" if degraded else "ok",
                 "arch": service.arch,
                 "scheduler": type(service).__name__,
                 "vocab_size": service.vocab,
@@ -548,6 +611,8 @@ def make_handler(service: GenerationService, profiler=None,
             }
             if hasattr(service, "latency_percentiles"):
                 payload["latency"] = service.latency_percentiles()
+            if auditor is not None:
+                payload["audit"] = auditor.stats()
             self._send(200, payload)
 
         def do_POST(self):  # noqa: N802
@@ -605,9 +670,16 @@ def make_handler(service: GenerationService, profiler=None,
                 # a deadline-truncated result is still a 200 (the
                 # budget bought these tokens), but the marker header
                 # lets the router classify it OUT of the served SLO
-                self._send(200, out, headers=(
-                    [(DEADLINE_EXPIRED_HEADER, "1")]
-                    if out.get("stop_reason") == "deadline" else []))
+                hdrs = ([(DEADLINE_EXPIRED_HEADER, "1")]
+                        if out.get("stop_reason") == "deadline" else [])
+                if out.get("serve_path"):
+                    # path provenance (ISSUE 18): the fingerprint rides
+                    # the response so clients/loadgen join latency to
+                    # the path that served them; the router relays it
+                    hdrs.append((SERVE_PATH_HEADER,
+                                 str(out["serve_path"])))
+                self._send(200, out, headers=hdrs)
+                self._offer_audit(req, out)
             except DeadlineExceeded as e:
                 service.stats["deadline_expired"] = (
                     service.stats.get("deadline_expired", 0) + 1)
@@ -751,8 +823,17 @@ def make_handler(service: GenerationService, profiler=None,
             self._rid = rid
             try:
                 n = int(self.headers.get("Content-Length", 0))
+                # path provenance (ISSUE 18): who pushed these pages —
+                # "ship" (disagg prefill handoff, the default) or
+                # "pull" (fleet miss-driven peer pull) — tags the
+                # adopted radix nodes, so requests that later consume
+                # them carry the flag in their serve-path fingerprint
+                origin = (self.headers.get("X-Page-Origin")
+                          or "ship").strip().lower()
+                if origin not in ("ship", "pull"):
+                    origin = "ship"
                 receipt = service.import_remote_pages(
-                    self.rfile.read(n))
+                    self.rfile.read(n), origin=origin)
                 receipt["request_id"] = rid
                 self._send(200, receipt)
             except ValueError as e:
@@ -926,6 +1007,9 @@ def make_handler(service: GenerationService, profiler=None,
                         return
                     else:
                         emit({**out["r"], "done": True})
+                        # streamed completions audit too — serve_path
+                        # rode the result dict into the done event
+                        self._offer_audit(req, out["r"])
                         return
             except (BrokenPipeError, ConnectionError, OSError):
                 if cancel_evt is not None:
@@ -1143,6 +1227,68 @@ def main(args, config):
             spec_draft_layers=spec_draft_layers,
             tracer=tracer, slo=slo, role=args.role)
     logger.info("scheduler: %s", type(service).__name__)
+    # sampled shadow-replay token-integrity auditor (ISSUE 18): replay
+    # completed requests through a cold reference sharing THE serving
+    # model/params and compare token ids exactly. Default reference is
+    # the no-pool probe (exact for f32/bf16 pools and ring layouts —
+    # the contiguous rolling cache is gated token-identical to the
+    # paged ring); an int8-KV pool instead gets a reference with its
+    # OWN private pool, because pool pages and the contiguous cache
+    # quantize at different granularities — an int8 no-pool replay
+    # would false-positive on healthy traffic (tests/test_audit.py
+    # pins the discipline). Config serving.audit block; --audit
+    # on/off overrides.
+    audit_cfg = dict((config.get("serving") or {}).get("audit") or {})
+    if args.audit == "on":
+        audit_cfg["enabled"] = True
+    elif args.audit == "off":
+        audit_cfg["enabled"] = False
+    if args.audit_sample_rate > 0:
+        audit_cfg["sample_rate"] = args.audit_sample_rate
+    if args.audit_floor > 0:
+        audit_cfg["floor"] = args.audit_floor
+    auditor = None
+    if audit_cfg.get("enabled"):
+        if probe is None:
+            # dp>1 loads per-group models inside the facade; there is
+            # no single-model reference to replay through (yet)
+            logger.warning("audit: unavailable with --dp > 1; "
+                           "disabled")
+        else:
+            ref_service = probe
+            kvq = str(getattr(model, "kv_quant", "") or "")
+            if kvq and (prefix_cfg or {}).get("enabled"):
+                # like-for-like: cold through the same quantized pool
+                # layout, in a pool of its own (never shares serving
+                # pages — a corrupted serving page must not leak into
+                # its own reference)
+                ref_service = GenerationService.from_model(
+                    model, params, tok,
+                    prefix_cache=dict(prefix_cfg))
+                logger.info("audit: pooled %s reference (like-for-"
+                            "like quantized replay)", kvq)
+
+            def _reference(rec: dict):
+                resp = ref_service.generate(
+                    prompt=rec.get("prompt"),
+                    prompt_ids=rec.get("prompt_ids"),
+                    max_new_tokens=int(rec.get("max_new_tokens", 64)),
+                    temperature=float(rec.get("temperature", 0.0)),
+                    top_k=int(rec.get("top_k", 0)),
+                    top_p=float(rec.get("top_p", 0.0)),
+                    seed=int(rec.get("seed", 0)),
+                    stop=rec.get("stop"))
+                return resp.get("ids") or []
+
+            auditor = ShadowAuditor(
+                _reference,
+                sample_rate=float(audit_cfg.get("sample_rate", 0.05)),
+                floor=int(audit_cfg.get("floor", 4)),
+                queue_max=int(audit_cfg.get("queue_max", 64)),
+                dump_dir=config.save_dir, tracer=tracer, tsdb=tsdb)
+            logger.info(
+                "audit: shadow replay on (sample_rate=%.3f floor=%d)",
+                auditor.sample_rate, auditor.floor)
     # on-demand profiling (POST /profile): captures land next to the
     # serving run's logs
     profiler = OnDemandProfiler(config.save_dir)
@@ -1150,7 +1296,7 @@ def main(args, config):
     server = ThreadingHTTPServer(
         (args.host, args.port),
         make_handler(service, profiler=profiler, active=active,
-                     tracer=tracer)
+                     tracer=tracer, auditor=auditor)
     )
     # drain on SIGTERM (the preemption path, same contract as the
     # trainer's): stop accepting, let in-flight requests finish
@@ -1184,6 +1330,10 @@ def main(args, config):
         server.serve_forever()
     except KeyboardInterrupt:
         pass
+    if auditor is not None:
+        # stop feeding the replay worker; queued audits are abandoned
+        # (a draining replica's verdicts already rode /metrics)
+        auditor.close()
     if draining.is_set():
         deadline = time.monotonic() + args.drain_grace_s
         while active.count and time.monotonic() < deadline:
@@ -1330,6 +1480,29 @@ if __name__ == "__main__":
     parser.add_argument("--brownout-max-new", default=0, type=int,
                         help="level-3 cap on admitted max_new_tokens "
                              "(0 = config/default 4x decode chunk)")
+    parser.add_argument("--audit", default="auto",
+                        choices=("auto", "on", "off"),
+                        help="sampled shadow-replay token-integrity "
+                             "auditing (ISSUE 18): completed requests "
+                             "are sampled (stratified by serve-path "
+                             "fingerprint) and replayed through the "
+                             "cold no-pool reference on a background "
+                             "worker; any token mismatch bumps "
+                             "token_divergence_total, writes a "
+                             "bounded divergence_<rid>.json bundle "
+                             "and degrades /healthz. auto follows the "
+                             "config's serving.audit block (off when "
+                             "absent); needs --dp 1")
+    parser.add_argument("--audit-sample-rate", default=0.0, type=float,
+                        help="post-floor audited fraction per "
+                             "fingerprint (0 = config serving.audit."
+                             "sample_rate, default 0.05)")
+    parser.add_argument("--audit-floor", default=0, type=int,
+                        help="per-fingerprint coverage floor: the "
+                             "first N completions of EVERY fingerprint "
+                             "audit regardless of sample rate, so rare "
+                             "paths stay covered (0 = config, "
+                             "default 4)")
     parser.add_argument("--drain-grace-s", default=30.0, type=float,
                         help="SIGTERM drain: how long to wait for "
                              "in-flight requests to finish before "

@@ -1,0 +1,316 @@
+"""Token-integrity observatory unit tier (ISSUE 18).
+
+Two halves:
+
+- stdlib-only auditor mechanics against a FAKE reference closure —
+  fingerprint schema, the deterministic stratified sampler (the
+  coverage floor that keeps a 1%-of-traffic ring-wrap path audited),
+  divergence bundles + cooldown bounds, the never-block drop counter,
+  healthy() flipping;
+- the like-for-like layout discipline against REAL tiny services: an
+  int8-KV pool replayed through an int8 cold reference is exact,
+  while the naive f32 reference would FALSE-POSITIVE on healthy
+  traffic (int8-vs-f32 is a documented tolerance, PR 15 — which is
+  exactly why serve.py builds the closure from the serving model).
+"""
+import json
+import threading
+import time
+
+import pytest
+
+from pytorch_distributed_template_tpu.observability.audit import (
+    AUDITABLE_OUTCOMES, ShadowAuditor, first_divergence,
+)
+from pytorch_distributed_template_tpu.observability.reqtrace import (
+    PATH_FLAGS, PATH_MODES, fingerprint_features, path_fingerprint,
+    sanitize_serve_path,
+)
+
+
+def _rec(fp, ids=(1, 2, 3), rid="r1", **over):
+    rec = {"rid": rid, "serve_path": fp, "ids": list(ids),
+           "stop_reason": "length", "prompt_ids": [5, 6, 7],
+           "max_new_tokens": len(ids), "temperature": 0.0,
+           "top_k": 0, "top_p": 0.0, "seed": 0, "stop": None}
+    rec.update(over)
+    return rec
+
+
+# ---------------------------------------------------------------------------
+# fingerprint schema
+# ---------------------------------------------------------------------------
+
+
+def test_path_fingerprint_schema_and_flag_order():
+    # mode first, flags in PATH_FLAGS order regardless of dict order,
+    # geometry/brownout tokens only when nonduplicate-of-default
+    fp = path_fingerprint({"mode": "paged", "wrap": True, "ring": True,
+                           "adopt": True, "tp": 2, "brownout": 1})
+    assert fp == "paged_ring_wrap_adopt_tp2_b1"
+    assert path_fingerprint({"mode": "warm", "tp": 1, "dp": 1,
+                             "brownout": 0}) == "warm"
+    # unknown mode degrades to cold, never an invalid token
+    assert path_fingerprint({"mode": "weird"}) == "cold"
+    assert path_fingerprint({}) == "cold"
+    # every mode and flag is a legal metric-name/header fragment
+    for tok in PATH_MODES + PATH_FLAGS:
+        assert sanitize_serve_path(tok) == tok
+
+
+def test_fingerprint_round_trips_header_sanitizer_and_features():
+    fp = path_fingerprint({"mode": "stream", "int8": True,
+                           "ship": True, "dp": 2})
+    assert sanitize_serve_path(fp) == fp
+    assert sanitize_serve_path(" " + fp + " ") == fp
+    assert sanitize_serve_path("Bad Header!") is None
+    assert sanitize_serve_path("") is None
+    assert sanitize_serve_path(None) is None
+    assert fingerprint_features(fp) == ["mode_stream", "int8", "ship",
+                                        "dp2"]
+    assert fingerprint_features("") == []
+
+
+def test_first_divergence():
+    assert first_divergence([1, 2, 3], [1, 2, 3]) == -1
+    assert first_divergence([1, 9, 3], [1, 2, 3]) == 1
+    assert first_divergence([1, 2], [1, 2, 3]) == 2   # length counts
+    assert first_divergence([], []) == -1
+
+
+# ---------------------------------------------------------------------------
+# stratified sampling: floors for rare paths
+# ---------------------------------------------------------------------------
+
+
+def test_stratified_floor_covers_one_percent_path():
+    """A fingerprint carrying 1% of traffic (the ring-wrap path) must
+    reach its audit quota even at a sample rate that would give it
+    ~0.05 expected samples — the floor, not luck, covers rare paths."""
+    aud = ShadowAuditor(lambda rec: rec["ids"], sample_rate=0.01,
+                        floor=4, queue_max=4096, dump_dir=None)
+    try:
+        # 500 completions: 495 uniform warm_adopt, 5 rare ring wraps
+        n_rare = 0
+        for i in range(500):
+            rare = i % 100 == 7
+            n_rare += rare
+            fp = "paged_ring_wrap" if rare else "warm_adopt"
+            aud.offer(_rec(fp, rid=f"r{i}"))
+        assert aud.drain(timeout_s=30.0)
+        cov = aud.coverage()
+        assert n_rare == 5
+        rare_cov = cov["paged_ring_wrap"]
+        assert rare_cov["seen"] == 5
+        # floor=4 with 5 seen: at least 4 audited, zero divergent
+        assert rare_cov["audited"] >= 4
+        assert rare_cov["divergent"] == 0
+        # the uniform path audits its floor + systematic 1-in-100
+        uni = cov["warm_adopt"]
+        assert uni["seen"] == 495
+        assert uni["audited"] == 4 + (495 - 4 + 99) // 100
+        assert aud.stats()["token_divergence_total"] == 0
+        assert aud.healthy()
+    finally:
+        aud.close()
+
+
+def test_sampler_is_deterministic_not_random():
+    aud = ShadowAuditor(lambda rec: rec["ids"], sample_rate=0.5,
+                        floor=2, queue_max=4096, dump_dir=None)
+    try:
+        picks = [aud._take(n) for n in range(8)]
+        # floor (n=0,1), then systematic 1-in-2 starting at n=2
+        assert picks == [True, True, True, False, True, False, True,
+                         False]
+    finally:
+        aud.close()
+
+
+def test_skips_non_auditable_outcomes_and_missing_fingerprint():
+    aud = ShadowAuditor(lambda rec: rec["ids"], sample_rate=1.0,
+                        floor=4, queue_max=64, dump_dir=None)
+    try:
+        assert "deadline" not in AUDITABLE_OUTCOMES
+        assert not aud.offer(_rec("warm", stop_reason="deadline"))
+        assert not aud.offer(_rec("warm", stop_reason="cancelled"))
+        assert not aud.offer(_rec(None))
+        assert aud.stats()["audit_skipped_total"] == 3
+        assert aud.stats()["audit_sampled_total"] == 0
+    finally:
+        aud.close()
+
+
+# ---------------------------------------------------------------------------
+# divergence: counters, bundle, cooldown, health
+# ---------------------------------------------------------------------------
+
+
+def test_divergence_writes_bounded_bundle_and_flips_health(tmp_path):
+    # reference disagrees at index 2 — a "corrupted page" in miniature
+    aud = ShadowAuditor(lambda rec: [1, 2, 99], sample_rate=1.0,
+                        floor=4, queue_max=64, dump_dir=tmp_path,
+                        max_dumps=1, cooldown_s=0.0)
+    try:
+        assert aud.healthy()
+        aud.offer(_rec("warm_ship", ids=[1, 2, 3], rid="bad-1"))
+        aud.offer(_rec("warm_ship", ids=[1, 2, 3], rid="bad-2"))
+        assert aud.drain(timeout_s=30.0)
+        st = aud.stats()
+        assert st["token_divergence_total"] == 2
+        assert not aud.healthy()
+        cov = aud.coverage()["warm_ship"]
+        assert cov["divergent"] == 2 and cov["audited"] == 2
+        # max_dumps=1 bounds the forensics: ONE bundle, not one per
+        # divergence
+        bundles = sorted(tmp_path.glob("divergence_*.json"))
+        assert len(bundles) == 1
+        assert st["audit_dumps_written"] == 1
+        b = json.loads(bundles[0].read_text())
+        assert b["rid"] == "bad-1"
+        assert b["fingerprint"] == "warm_ship"
+        assert b["first_divergence"] == 2
+        assert b["served_ids"] == [1, 2, 3]
+        assert b["replay_ids"] == [1, 2, 99]
+        assert b["sampling"]["max_new_tokens"] == 3
+    finally:
+        aud.close()
+
+
+def test_dump_cooldown_spaces_bundles(tmp_path):
+    aud = ShadowAuditor(lambda rec: [99], sample_rate=1.0, floor=8,
+                        queue_max=64, dump_dir=tmp_path, max_dumps=8,
+                        cooldown_s=3600.0)
+    try:
+        for i in range(3):
+            aud.offer(_rec("warm", ids=[1], rid=f"bad-{i}"))
+        assert aud.drain(timeout_s=30.0)
+        # divergences all counted; the cooldown held dumps to the first
+        assert aud.stats()["token_divergence_total"] == 3
+        assert len(list(tmp_path.glob("divergence_*.json"))) == 1
+    finally:
+        aud.close()
+
+
+def test_full_queue_drops_counted_never_blocks():
+    gate = threading.Event()
+
+    def stuck_reference(rec):
+        gate.wait(30.0)
+        return rec["ids"]
+
+    aud = ShadowAuditor(stuck_reference, sample_rate=1.0, floor=64,
+                        queue_max=1, dump_dir=None)
+    try:
+        t0 = time.monotonic()
+        for i in range(8):
+            aud.offer(_rec("warm", rid=f"r{i}"))
+        # never blocked on the stuck worker (the hot-path contract)
+        assert time.monotonic() - t0 < 5.0
+        assert aud.stats()["audit_dropped_total"] >= 5
+        gate.set()
+        assert aud.drain(timeout_s=30.0)
+        assert aud.stats()["token_divergence_total"] == 0
+    finally:
+        gate.set()
+        aud.close()
+
+
+def test_reference_error_counted_not_fatal():
+    def broken(rec):
+        raise RuntimeError("reference died")
+
+    aud = ShadowAuditor(broken, sample_rate=1.0, floor=4,
+                        queue_max=64, dump_dir=None)
+    try:
+        aud.offer(_rec("warm"))
+        assert aud.drain(timeout_s=30.0)
+        st = aud.stats()
+        assert st["audit_error_total"] == 1
+        assert st["token_divergence_total"] == 0
+        assert aud.healthy()        # an errored replay is not a verdict
+    finally:
+        aud.close()
+
+
+# ---------------------------------------------------------------------------
+# like-for-like layout discipline (real services)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_int8_pool_replays_like_for_like_not_f32(tmp_path):
+    """The layout discipline the auditor documents: an int8-KV POOL
+    replica must replay against a cold reference carrying the SAME
+    quantized pool layout — a private fresh pool, exactly what
+    serve.py builds. Like-for-like is exact (zero divergence on
+    healthy traffic); the naive f32 no-pool reference false-positives
+    — int8-vs-f32 greedy ids genuinely differ (the documented PR 15
+    tolerance), which would page an operator for healthy traffic.
+    (An int8 NO-POOL reference is wrong too: pool pages and the
+    contiguous cache quantize at different granularities — which is
+    why the reference must be pool-cold, not merely int8.)"""
+    jax = pytest.importorskip("jax")
+    import jax.numpy as jnp
+
+    import pytorch_distributed_template_tpu.models  # noqa: F401
+    from pytorch_distributed_template_tpu.config.registry import MODELS
+    from pytorch_distributed_template_tpu.engine.serving import (
+        GenerationService,
+    )
+
+    import numpy as np
+
+    kw = dict(vocab_size=512, n_layer=2, n_head=4, n_kv_head=2,
+              d_model=64, max_len=256)
+    m8 = MODELS.get("Llama")(kv_quant="int8", **kw)
+    mf = MODELS.get("Llama")(**kw)
+    params = m8.init(jax.random.key(0),
+                     jnp.zeros((1, 8), jnp.int32))["params"]
+    pcfg = {"enabled": True, "block_tokens": 16, "pool_blocks": 32}
+    pool = GenerationService.from_model(m8, params,
+                                        prefix_cache=dict(pcfg))
+    # like-for-like: int8 pool of its OWN (cold for every replay)
+    ref8 = GenerationService.from_model(m8, params,
+                                        prefix_cache=dict(pcfg))
+    reff = GenerationService.from_model(mf, params)    # f32 no pool
+
+    rng = np.random.default_rng(0)
+    prefix = [int(x) for x in rng.integers(1, 512, 48)]
+    recs = []
+    for i in range(3):
+        ids = prefix + [int(x) for x in rng.integers(1, 512, 5)]
+        resp = pool.generate(prompt_ids=ids, max_new_tokens=24)
+        assert "int8" in str(resp.get("serve_path"))
+        recs.append(_rec(resp["serve_path"], ids=resp["ids"],
+                         rid=f"q{i}", prompt_ids=ids,
+                         max_new_tokens=24))
+
+    def replay_through(svc):
+        return lambda rec: svc.generate(
+            prompt_ids=rec["prompt_ids"],
+            max_new_tokens=rec["max_new_tokens"],
+            temperature=0.0)["ids"]
+
+    like = ShadowAuditor(replay_through(ref8), sample_rate=1.0,
+                         floor=8, queue_max=64, dump_dir=None)
+    cross = ShadowAuditor(replay_through(reff), sample_rate=1.0,
+                          floor=8, queue_max=64,
+                          dump_dir=tmp_path / "cross")
+    try:
+        for rec in recs:
+            like.offer(dict(rec))
+            cross.offer(dict(rec))
+        assert like.drain(timeout_s=120.0)
+        assert cross.drain(timeout_s=120.0)
+        # like-for-like: the pooled int8 path IS its cold int8
+        # reference, token for token
+        assert like.stats()["token_divergence_total"] == 0
+        assert like.stats()["audit_sampled_total"] == len(recs)
+        assert like.healthy()
+        # the wrong-layout reference cries wolf on healthy traffic
+        assert cross.stats()["token_divergence_total"] >= 1
+        assert not cross.healthy()
+    finally:
+        like.close()
+        cross.close()

@@ -304,8 +304,11 @@ def test_continuous_engine_handoff_token_identical(stack):
         receipt = dec.import_remote_pages(
             serialize_pages(payload))
         assert receipt["imported_blocks"] > 0
-        assert dec.generate(prompt_ids=ids, max_new_tokens=6,
-                            seed=i)["ids"] == g_ref
+        g = dec.generate(prompt_ids=ids, max_new_tokens=6, seed=i)
+        assert g["ids"] == g_ref
+        # provenance (ISSUE 18): the decode's fingerprint records
+        # that its warm pages arrived via the disagg handoff
+        assert "ship" in str(g["serve_path"]).split("_"), g
         assert dec.generate(prompt_ids=ids, max_new_tokens=6,
                             temperature=0.8, top_k=8,
                             seed=i)["ids"] == s_ref

@@ -216,8 +216,12 @@ class FakeReplica:
     optional per-request delay, SSE when asked."""
 
     def __init__(self, slots=4, delay_s=0.0, sse_deltas=2, port=0,
-                 sse_delay_s=0.01, error_code=None, sse_die_after=0):
+                 sse_delay_s=0.01, error_code=None, sse_die_after=0,
+                 serve_path=None):
         self.slots = slots
+        # ISSUE 18 provenance: stamped as X-Serve-Path on buffered
+        # responses and as the done event's serve_path key on SSE
+        self.serve_path = serve_path
         self.delay_s = delay_s
         self.sse_deltas = sse_deltas
         self.sse_delay_s = sse_delay_s
@@ -240,11 +244,13 @@ class FakeReplica:
             def log_message(self, *a):
                 pass
 
-            def _json(self, code, payload):
+            def _json(self, code, payload, headers=()):
                 body = json.dumps(payload).encode()
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(body)))
+                for k, v in headers:
+                    self.send_header(k, v)
                 self.end_headers()
                 self.wfile.write(body)
 
@@ -305,7 +311,10 @@ class FakeReplica:
                                 self.connection.close()
                                 return
                             time.sleep(fake.sse_delay_s)
-                        fin = json.dumps({"ids": ids, "done": True})
+                        done = {"ids": ids, "done": True}
+                        if fake.serve_path:
+                            done["serve_path"] = fake.serve_path
+                        fin = json.dumps(done)
                         self.wfile.write(
                             b"data: " + fin.encode() + b"\n\n")
                     except (BrokenPipeError, ConnectionError,
@@ -314,7 +323,10 @@ class FakeReplica:
                             fake.broken_pipes += 1
                 else:
                     self._json(200, {"ids": ids, "stop_reason":
-                                     "length"})
+                                     "length"},
+                               headers=([("X-Serve-Path",
+                                          fake.serve_path)]
+                                        if fake.serve_path else ()))
 
         self.server = ThreadingHTTPServer(("127.0.0.1", port), Handler)
         self.port = self.server.server_address[1]
@@ -1360,6 +1372,94 @@ def test_hedge_auto_delay_needs_histogram_samples():
     d = hp.delay_s(hist)
     assert d is not None and d >= 0.02   # p95-based once warmed
     assert HedgePolicy(enabled=False).delay_s(hist) is None
+
+
+# ---------------------------------------------------------------------------
+# serve-path provenance through the router (ISSUE 18)
+# ---------------------------------------------------------------------------
+
+
+def test_router_relays_serve_path_header_round_trip(tmp_path):
+    """Path provenance satellite: the replica's X-Serve-Path
+    fingerprint relays through the buffered proxy to the client, and a
+    replica that stamps none relays none — the router never invents
+    provenance."""
+    for want in ("paged_ring_wrap", None):
+        fake = FakeReplica(serve_path=want)
+        run_dir = tmp_path / (want or "bare")
+        run_dir.mkdir()
+        manager = _mk_fleet(run_dir, [fake])
+        server, _, url = _router(manager)
+        try:
+            req = urllib.request.Request(
+                url + "/generate",
+                data=json.dumps({"prompt_ids": [1] * 8,
+                                 "max_new_tokens": 2}).encode(),
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                assert resp.status == 200
+                assert resp.headers.get("X-Serve-Path") == want
+            assert len(fake.requests) == 1
+        finally:
+            server.shutdown()
+            fake.stop()
+
+
+def test_hedge_winner_relays_its_own_serve_path(
+        tmp_path, _clean_faults):
+    """Whichever attempt wins the hedging race relays its OWN
+    replica's fingerprint. The primary attempt is blackholed so
+    exactly one replica executes — the hedge — and the client's
+    X-Serve-Path must be that replica's, not the primary target's."""
+    faults.configure("proxy_blackhole@req:1")
+    fakes = [FakeReplica(serve_path="warm_adopt"),
+             FakeReplica(serve_path="paged_ship")]
+    manager = _mk_fleet(tmp_path, fakes)
+    server, _, url = _router(
+        manager, hedge=HedgePolicy(enabled=True, frac=1.0,
+                                   delay_ms=50))
+    try:
+        req = urllib.request.Request(
+            url + "/generate",
+            data=json.dumps({"prompt_ids": [1] * 8,
+                             "max_new_tokens": 2}).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            assert resp.status == 200
+            got = resp.headers.get("X-Serve-Path")
+        ran = [f for f in fakes if f.requests]
+        assert len(ran) == 1          # blackhole: only the hedge ran
+        assert got == ran[0].serve_path
+        m = _get_json(url, "/metrics?format=json")
+        assert m["hedge_fired_total"] == 1
+    finally:
+        server.shutdown()
+        for f in fakes:
+            f.stop()
+
+
+def test_loadgen_by_path_joins_router_relayed_fingerprints(tmp_path):
+    """Disagg-flavoured round trip: a decode replica stamping the
+    shipped-import fingerprint relays through the router on BOTH wire
+    forms — response header on buffered JSON, done-event key on SSE —
+    and loadgen's per-path summary joins them into one row."""
+    fakes = [FakeReplica(serve_path="paged_ship")]
+    manager = _mk_fleet(tmp_path, fakes)
+    server, _, url = _router(manager)
+    try:
+        trace = build_trace(6, seed=7, rate_rps=100.0,
+                            stream_frac=0.5, prefix_len=8,
+                            suffix_len=4, max_new_tokens=4)
+        summary = summarize(replay(url, trace, timeout_s=30), trace)
+        assert summary["ok"] == 6, summary
+        bp = summary["by_path"]
+        assert set(bp) == {"paged_ship"}
+        assert bp["paged_ship"]["requests"] == 6
+        assert bp["paged_ship"]["errors"] == 0
+        assert bp["paged_ship"]["latency_p50_s"] is not None
+    finally:
+        server.shutdown()
+        fakes[0].stop()
 
 
 def test_admission_brownout_level4_tightens_tenant_slice():

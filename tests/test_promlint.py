@@ -142,6 +142,40 @@ def test_serve_metrics_exposition_lints_clean(live_service):
     assert promtext.lint_exposition(text) == []
 
 
+def test_serve_metrics_with_auditor_lints_clean(live_service,
+                                                tmp_path):
+    """The token-integrity families (ISSUE 18) ride service_metrics:
+    a live auditor that has matched AND diverged emits serve_path_*,
+    audit_path_* and the audit verdict counters — all lint-clean (the
+    fingerprint embeds in the metric NAME, so a malformed fingerprint
+    would fail the lint, not just look odd)."""
+    import serve
+    from pytorch_distributed_template_tpu.observability.audit import (
+        ShadowAuditor,
+    )
+
+    aud = ShadowAuditor(lambda rec: [1, 2, 3], sample_rate=1.0,
+                        floor=4, dump_dir=tmp_path, cooldown_s=0.0)
+    base = {"stop_reason": "length", "prompt_ids": [5],
+            "max_new_tokens": 3, "temperature": 0.0, "top_k": 0,
+            "top_p": 0.0, "seed": 0, "stop": None}
+    aud.offer(dict(base, rid="m1", serve_path="warm_adopt",
+                   ids=[1, 2, 3]))
+    aud.offer(dict(base, rid="d1", serve_path="paged_ship",
+                   ids=[1, 9, 3]))
+    assert aud.drain(timeout_s=30.0)
+    try:
+        metrics = serve.service_metrics(live_service, auditor=aud)
+        text = serve.prometheus_text(metrics)
+        assert promtext.lint_exposition(text) == []
+        for family in ("token_divergence_total",
+                       "audit_sampled_total",
+                       "serve_path_", "audit_path_paged_ship"):
+            assert family in text, family
+    finally:
+        aud.close()
+
+
 def test_router_metrics_exposition_lints_clean(tmp_path):
     # an UNPOLLED manager: counter keys are static (zeros), which is
     # exactly what the lint needs — names, not values

@@ -195,6 +195,45 @@ def test_streaming_sse_deltas_match_final(llama_server):
     assert plain["ids"] == final["ids"]
 
 
+def test_serve_path_provenance_header_and_sse_done_event(llama_server):
+    """Path provenance (ISSUE 18): a buffered response carries the
+    serve-path fingerprint both as the X-Serve-Path header and the
+    body's serve_path key (mode first, sanitizer-clean — it embeds in
+    metric names); a streaming request carries the same shape in the
+    SSE done event, the form the fleet router relays."""
+    from pytorch_distributed_template_tpu.observability.reqtrace import (
+        PATH_MODES, sanitize_serve_path,
+    )
+
+    body = json.dumps({"prompt_ids": [3, 5, 7, 9],
+                       "max_new_tokens": 4}).encode()
+    req = urllib.request.Request(
+        llama_server + "/generate", data=body,
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=300) as r:
+        fp = r.headers.get("X-Serve-Path")
+        payload = json.loads(r.read())
+    assert fp and sanitize_serve_path(fp) == fp
+    assert payload.get("serve_path") == fp
+    assert fp.split("_")[0] in PATH_MODES
+    req = urllib.request.Request(
+        llama_server + "/generate",
+        data=json.dumps({"prompt_ids": [3, 5, 7, 9],
+                         "max_new_tokens": 4,
+                         "stream": True}).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=300) as r:
+        raw = r.read().decode("utf-8")
+    events = [json.loads(line[len("data: "):])
+              for line in raw.splitlines()
+              if line.startswith("data: ")]
+    done = events[-1]
+    assert done.get("done") is True
+    sfp = done.get("serve_path")
+    assert sfp and sanitize_serve_path(sfp) == sfp
+    assert sfp.split("_")[0] in PATH_MODES
+
+
 def test_stream_disconnect_cancels_generation(llama_server):
     """Closing a streaming connection mid-generation cancels the row
     on the slot engine: /healthz's cancelled counter advances and the
