@@ -4047,6 +4047,369 @@ def bench_serve_fleet(replicas: int = 3, n_requests: int = 24,
     }
 
 
+def bench_serve_autoscale(peak_replicas: int = 2, n_requests: int = 240,
+                          prefix_groups: int = 4, prefix_len: int = 48,
+                          suffix_len: int = 12, new_tokens: int = 6,
+                          block_tokens: int = 16, peak_rps: float = 6.0,
+                          period_s: float = 90.0, floor: float = 0.03,
+                          sharpness: int = 8, live: bool = True,
+                          sweep_requests: int = 400,
+                          platform: str = "cpu",
+                          slo_ttft_s: float = 30.0,
+                          slo_e2e_s: float = 120.0) -> dict:
+    """Fleet autoscaler rung (ISSUE 19 tentpole): ONE policy class,
+    two worlds, gated against each other.
+
+    - **Virtual-time policy sweep** (always runs): the SAME diurnal
+      trace replayed through the discrete-event simulator under the
+      static peak-provisioned control vs the autoscale policy — the
+      policy must hold the SLO with zero shed/failed while burning
+      >= 30% fewer replica-seconds (``replica_seconds_saving``, the
+      headline the autoscale-smoke CI job asserts). The sweep uses the
+      LIVE arm's measured ``service_model.json`` when ``live`` (the
+      synthetic model otherwise), and the same policy knob values the
+      live fleet runs.
+    - **Live two-arm comparison** (``live=True``): a diurnal trace
+      replayed against a static ``peak_replicas`` fleet and against a
+      1..peak autoscaled fleet (scripts/serve_fleet.py --autoscale
+      on). Gates: zero errors + zero shed in BOTH arms (scale events
+      drop nothing), >= 1 scale-down AND >= 1 scale-up actually fired,
+      and the autoscaled arm burns >= 20% fewer replica-seconds over
+      the replay window (measured as the router's
+      ``replica_seconds_total`` delta — membership-seconds, spawn lag
+      included). The live gate sits below the virtual-time 30%
+      because the live window is only ~3 diurnal periods on a CPU
+      fleet whose spawn latency is a real fraction of the period; the
+      saving converges to the sweep's figure as windows lengthen.
+    - **Sim-vs-live validation** (``live=True``): the simulator
+      replays the SAME trace against the static arm's exported
+      service model and must land within 15% of the live fleet's
+      TTFT/TPOT p99 (``fleet/simulator.validate``) — the contract
+      that makes the virtual-time saving transferable.
+
+    The static arm doubles as the live 2-replica validation fleet, so
+    the rung spawns exactly two fleets. CPU children like the other
+    serving rungs (routing + policy mechanics are platform-
+    independent)."""
+    import signal as signal_mod
+    import subprocess
+    import tempfile
+    import urllib.request
+
+    from pytorch_distributed_template_tpu.fleet import loadgen
+    from pytorch_distributed_template_tpu.fleet.autoscaler import (
+        AutoscaleConfig, AutoscalePolicy, StaticPolicy,
+    )
+    from pytorch_distributed_template_tpu.fleet import simulator
+    from pytorch_distributed_template_tpu.fleet.replicas import (
+        http_json,
+    )
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    env = dict(os.environ, JAX_PLATFORMS=platform)
+    # STOCK AutoscaleConfig values, passed explicitly so the rung
+    # reads as the contract: the sweep and the live fleet run the
+    # SAME policy knobs — one policy, not two tunings. (Aggressive
+    # low-watermark values misbehave on tiny live replicas: at 2
+    # slots a single inflight request is already pressure 0.5, so
+    # up_pressure must sit above it and down_pressure above the
+    # valley's transient blips or the fleet flaps / never drains.)
+    knobs = dict(up_pressure=0.85, down_pressure=0.40,
+                 up_cooldown_s=5.0, down_cooldown_s=20.0,
+                 down_dwell_s=10.0, horizon_s=20.0)
+
+    trace = loadgen.diurnal_trace(
+        n_requests, seed=19, peak_rps=peak_rps, period_s=period_s,
+        floor=floor, sharpness=sharpness, prefix_groups=prefix_groups,
+        prefix_len=prefix_len, suffix_len=suffix_len,
+        max_new_tokens=new_tokens, stream_frac=0.6, group_tag="as")
+
+    def get_json(url, path, timeout=10.0):
+        return http_json(url + path, timeout)
+
+    def healthy_count(url) -> int:
+        try:
+            hz = get_json(url, "/healthz", timeout=5.0)
+        except (OSError, ValueError):
+            return -1
+        return sum(1 for r in hz["replicas"]
+                   if r["state"] == "healthy")
+
+    model = None
+    live_out: dict = {}
+    if live:
+        with tempfile.TemporaryDirectory(prefix="bench-as-") as d:
+            art = os.path.join(d, "artifact")
+            subprocess.run(
+                [sys.executable,
+                 os.path.join(repo, "scripts",
+                              "make_serving_artifact.py"),
+                 "-o", art, "--max-len", "256",
+                 "--block-tokens", str(block_tokens),
+                 "--compile-cache-dir", os.path.join(d, "xla-cache")],
+                check=True, env=env, timeout=600, cwd=repo,
+                stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+
+            def run_arm(tag, autoscale: bool) -> dict:
+                run_dir = os.path.join(d, f"fleet-{tag}")
+                log_path = os.path.join(d, f"fleet-{tag}.log")
+                # the autoscaled arm STARTS at min_replicas — an
+                # autoscaled fleet runs at the policy's target, not
+                # the peak; the zero-drop + SLO gates keep it honest
+                n0 = 1 if autoscale else peak_replicas
+                cmd = [sys.executable,
+                       os.path.join(repo, "scripts", "serve_fleet.py"),
+                       "-r", os.path.join(art, "model"),
+                       "--replicas", str(n0), "--port", "0",
+                       "--run-dir", run_dir, "--poll-s", "0.3",
+                       "--readmit-after", "1",
+                       "--restart-delay", "0.5",
+                       "--block-tokens", str(block_tokens),
+                       "--slo-ttft-s", str(slo_ttft_s),
+                       "--slo-e2e-s", str(slo_e2e_s)]
+                if autoscale:
+                    cmd += ["--autoscale", "on",
+                            "--min-replicas", "1",
+                            "--max-replicas", str(peak_replicas),
+                            "--autoscale-interval-s", "0.5",
+                            "--scale-up-pressure",
+                            str(knobs["up_pressure"]),
+                            "--scale-down-pressure",
+                            str(knobs["down_pressure"]),
+                            "--scale-up-cooldown-s",
+                            str(knobs["up_cooldown_s"]),
+                            "--scale-down-cooldown-s",
+                            str(knobs["down_cooldown_s"]),
+                            "--scale-down-dwell-s",
+                            str(knobs["down_dwell_s"]),
+                            "--scale-horizon-s",
+                            str(knobs["horizon_s"])]
+                # 2 slots/replica makes the diurnal peak a REAL
+                # pressure signal on a tiny CPU fleet; warm-buckets +
+                # the artifact's shared persistent compile cache make
+                # a mid-run spawn land warm instead of paying a cold
+                # ladder while membership-seconds burn
+                cmd += ["--", "--max-batch", "2", "--decode-chunk",
+                        "4", "--warm-buckets", "64"]
+                with open(log_path, "w") as log_f:
+                    proc = subprocess.Popen(
+                        cmd, stdout=log_f, stderr=subprocess.STDOUT,
+                        env=env, cwd=repo)
+                _CHILD_PROCS.add(proc)
+                try:
+                    url = None
+                    deadline = time.time() + 420
+                    while time.time() < deadline:
+                        try:
+                            with open(log_path) as f:
+                                for line in f:
+                                    if line.startswith("READY "):
+                                        url = line.split()[1].strip()
+                                        break
+                        except OSError:
+                            pass
+                        if url or proc.poll() is not None:
+                            break
+                        time.sleep(0.5)
+                    if url is None or proc.poll() is not None:
+                        with open(log_path) as f:
+                            raise RuntimeError(
+                                f"{tag} fleet never READY: "
+                                + f.read()[-1500:])
+                    while (healthy_count(url) != n0
+                           and time.time() < deadline):
+                        time.sleep(1.0)
+                    if healthy_count(url) != n0:
+                        raise RuntimeError(
+                            f"{tag} fleet never all healthy")
+                    # unmeasured warmup, GENTLE on purpose: one
+                    # request at a time so the autoscaled arm's
+                    # policy never sees warmup pressure and spends
+                    # the measured window scaled up for it
+                    loadgen.replay(url, loadgen.build_trace(
+                        3, seed=23, prefix_groups=1,
+                        group_tag=f"w{tag}", prefix_len=prefix_len,
+                        suffix_len=suffix_len, max_new_tokens=2,
+                        rate_rps=1.0, stream_frac=0.5),
+                        timeout_s=120)
+                    rs0 = float(get_json(url, "/metrics?format=json")
+                                .get("replica_seconds_total", 0.0))
+                    t0 = time.monotonic()
+                    summary = loadgen.summarize(
+                        loadgen.replay(url, trace, timeout_s=600),
+                        trace)
+                    window_s = time.monotonic() - t0
+                    m = get_json(url, "/metrics?format=json")
+                    arm = {
+                        "summary": summary,
+                        "window_s": round(window_s, 3),
+                        "replica_seconds": round(
+                            float(m.get("replica_seconds_total", 0.0))
+                            - rs0, 3),
+                        "scale_ups": int(
+                            m.get("autoscale_scale_up_total", 0)),
+                        "scale_downs": int(
+                            m.get("autoscale_scale_down_total", 0)),
+                        "slo_breach_total": int(
+                            m.get("slo_breach_total", 0)),
+                    }
+                    proc.send_signal(signal_mod.SIGTERM)
+                    rc = proc.wait(timeout=120)
+                    if rc != 0:
+                        with open(log_path) as f:
+                            raise RuntimeError(
+                                f"{tag} fleet drain rc={rc}: "
+                                + f.read()[-1500:])
+                    if summary["errors"] or summary["shed"]:
+                        raise RuntimeError(
+                            f"{tag} arm dropped requests: "
+                            f"errors={summary['errors']} "
+                            f"shed={summary['shed']}")
+                    arm["run_dir"] = run_dir
+                    return arm
+                finally:
+                    _CHILD_PROCS.discard(proc)
+                    if proc.poll() is None:
+                        proc.kill()
+                        proc.wait(timeout=30)
+
+            static_arm = run_arm("static", autoscale=False)
+            auto_arm = run_arm("auto", autoscale=True)
+
+            # the scale events actually happened — the zero-error gate
+            # above was across them, not around them
+            if auto_arm["scale_downs"] < 1 or auto_arm["scale_ups"] < 1:
+                raise RuntimeError(
+                    f"autoscale arm never walked the envelope: "
+                    f"ups={auto_arm['scale_ups']} "
+                    f"downs={auto_arm['scale_downs']}")
+            live_saving = 1.0 - (auto_arm["replica_seconds"]
+                                 / max(static_arm["replica_seconds"],
+                                       1e-9))
+            if live_saving < 0.2:
+                raise RuntimeError(
+                    f"live replica-seconds saving {live_saving:.3f} "
+                    f"< 0.2: static={static_arm['replica_seconds']} "
+                    f"auto={auto_arm['replica_seconds']}")
+
+            # service model from the static arm's spans (drained, so
+            # every process has flushed), for the sim validation +
+            # the anchored sweep
+            from pytorch_distributed_template_tpu.observability import (
+                reqtrace, servicedist,
+            )
+            client_e2e = {
+                row["rid"]: row["total_s"]
+                for row in static_arm["summary"].get("by_request", ())
+                if (row.get("rid") and row.get("ok")
+                    and row.get("total_s") is not None)}
+            spans = reqtrace.load_spans(reqtrace.discover_span_files(
+                static_arm["run_dir"]))
+            model = servicedist.build_service_model(
+                spans, client_e2e_by_rid=client_e2e)
+            if not model["segments"]:
+                raise RuntimeError(
+                    "static arm exported an empty service model")
+
+            # sim-vs-live: the SAME trace through the DES against the
+            # measured model must land within 15% of the live static
+            # fleet on TTFT/TPOT p99. The 5 ms absolute floor covers
+            # metrics whose live value sits at sub-millisecond scale
+            # on this CPU fleet (TPOT over 6 tokens), where 15% is
+            # below timer jitter — see simulator.validate().
+            sim_static = simulator.simulate(
+                trace, StaticPolicy(),
+                model=model,
+                cfg=simulator.SimConfig(
+                    slots_per_replica=2, tick_s=0.5,
+                    slo_ttft_s=slo_ttft_s, slo_e2e_s=slo_e2e_s),
+                initial_replicas=peak_replicas, seed=0)["summary"]
+            validation = simulator.validate(
+                sim_static, static_arm["summary"], tol=0.15,
+                abs_floor_s=0.005)
+            if validation["compared"] and not validation["ok"]:
+                raise RuntimeError(
+                    f"sim-vs-live validation failed: {validation}")
+
+            live_out = {
+                "live_saving": round(live_saving, 4),
+                "live_static_replica_seconds":
+                    static_arm["replica_seconds"],
+                "live_auto_replica_seconds":
+                    auto_arm["replica_seconds"],
+                "live_scale_ups": auto_arm["scale_ups"],
+                "live_scale_downs": auto_arm["scale_downs"],
+                "live_failed_requests": 0,
+                "live_ttft_p99_static_s":
+                    static_arm["summary"]["ttft_p99_s"],
+                "live_ttft_p99_auto_s":
+                    auto_arm["summary"]["ttft_p99_s"],
+                "sim_ttft_p99_s": sim_static["ttft_p99_s"],
+                "sim_validation_ok": bool(validation["ok"]),
+                "sim_validation_compared": validation["compared"],
+                "sim_validation_rel_err": {
+                    k: v["rel_err"]
+                    for k, v in validation["metrics"].items()
+                    if v.get("rel_err") is not None},
+            }
+
+    # virtual-time policy sweep — the headline the CI job gates. The
+    # measured model (when live) anchors the sampler; the trace is
+    # long enough that spawn latency amortizes
+    sweep_trace = loadgen.diurnal_trace(
+        sweep_requests, seed=4, peak_rps=6.0, period_s=60.0,
+        floor=0.08, max_new_tokens=24, stream_frac=0.6)
+    sweep_cfg = simulator.SimConfig(slots_per_replica=4, tick_s=1.0,
+                                    slo_ttft_s=5.0, slo_e2e_s=30.0)
+    sweep_static = simulator.simulate(
+        sweep_trace, StaticPolicy(), model=model, cfg=sweep_cfg,
+        initial_replicas=4, seed=0)["summary"]
+    sweep_auto = simulator.simulate(
+        sweep_trace,
+        AutoscalePolicy(AutoscaleConfig(min_replicas=1,
+                                        max_replicas=4, **knobs)),
+        model=model, cfg=sweep_cfg, initial_replicas=1,
+        seed=0)["summary"]
+    for arm_name, arm in (("static", sweep_static),
+                          ("auto", sweep_auto)):
+        if arm["failed"] or arm["shed"]:
+            raise RuntimeError(
+                f"sweep {arm_name} arm dropped requests: {arm}")
+        if arm["slo_compliant_frac"] < 0.99:
+            raise RuntimeError(
+                f"sweep {arm_name} arm broke the SLO: {arm}")
+    saving = 1.0 - (sweep_auto["replica_seconds"]
+                    / max(sweep_static["replica_seconds"], 1e-9))
+    if saving < 0.30:
+        raise RuntimeError(
+            f"virtual-time replica-seconds saving {saving:.3f} < "
+            f"0.30: static={sweep_static['replica_seconds']} "
+            f"auto={sweep_auto['replica_seconds']}")
+
+    out = {
+        "replica_seconds_saving": round(saving, 4),
+        "sweep_static_replica_seconds":
+            sweep_static["replica_seconds"],
+        "sweep_auto_replica_seconds": sweep_auto["replica_seconds"],
+        "sweep_scale_ups": sweep_auto["scale_ups"],
+        "sweep_scale_downs": sweep_auto["scale_downs"],
+        "sweep_peak_replicas": sweep_auto["peak_replicas"],
+        "sweep_floor_replicas": sweep_auto["floor_replicas"],
+        "sweep_slo_compliant_frac": sweep_auto["slo_compliant_frac"],
+        "model_measured": model is not None,
+        "live": bool(live),
+        "platform": platform,
+    }
+    out.update(live_out)
+    try:
+        os.makedirs("artifacts", exist_ok=True)
+        with open("artifacts/autoscale_latest.json", "w") as f:
+            json.dump(out, f, indent=2, default=repr)
+    except OSError:
+        pass
+    return out
+
+
 def bench_serve_chaos(replicas: int = 2, block_tokens: int = 16,
                       wedge_deadline_ms: int = 60000,
                       feasible_deadline_ms: int = 30000,
@@ -5244,6 +5607,17 @@ _SUMMARY_KEYS = {
                     "service_model_segments", "goodput_tok_s",
                     "served_tokens_total", "dashboard_ok",
                     "fleet_timeline_points"),
+    # fleet autoscaler (ISSUE 19): the virtual-time saving headline
+    # the autoscale-smoke CI job asserts, the live two-arm saving +
+    # scale-event counts (zero-drop gate is raise-on-fail inside the
+    # rung), and the sim-vs-live validation verdict
+    "serve_autoscale": ("replica_seconds_saving",
+                        "sweep_slo_compliant_frac",
+                        "sweep_scale_ups", "sweep_scale_downs",
+                        "live_saving", "live_scale_ups",
+                        "live_scale_downs", "live_failed_requests",
+                        "sim_validation_ok",
+                        "sim_validation_compared", "model_measured"),
     # disaggregated serving (ISSUE 12): the tail-latency gate pair
     # (colocated collapses >= 2x, disaggregated holds <= 1.25x), the
     # ship volume, the copy-bytes honesty value, and the DP×TP parity
@@ -5711,6 +6085,20 @@ _LADDER = [
         # cheapest configuration that still proves routing + shed)
         (bench_serve_fleet, {"replicas": 2, "n_requests": 12,
                              "prefix_groups": 4, "kill": False}),
+    ]),
+    # fleet autoscaler (ISSUE 19): ONE policy class gated in two
+    # worlds — a live static-vs-autoscaled two-arm diurnal replay
+    # (zero dropped requests across scale events, >= 20% fewer live
+    # replica-seconds) anchored by a sim-vs-live validation within
+    # 15% on TTFT/TPOT p99, plus the virtual-time policy sweep whose
+    # >= 30% replica-seconds saving is the CI-asserted headline.
+    # Multi-minute (two fleets); CI runs it via --only serve_autoscale
+    ("serve_autoscale", [
+        (bench_serve_autoscale, {}),
+        # fallback arm: pure virtual time — the policy sweep alone,
+        # seconds-cheap, still gates the >= 30% saving + zero-drop +
+        # SLO contract on the synthetic service model
+        (bench_serve_autoscale, {"live": False}),
     ]),
     # serving-path chaos (ISSUE 9): the fault grammar walked against a
     # live fleet — wedge detection + restart, deadline propagation

@@ -1,0 +1,595 @@
+"""Fleet autoscaler: one deterministic policy, two worlds (ISSUE 19).
+
+The control plane the measurement substrate (PR 14) was built for.
+This module owns the POLICY — a pure, deterministic state machine from
+scraped fleet signals to scale actions — and the LIVE actuator that
+runs it against a real :class:`fleet.replicas.FleetManager`. The
+offline twin, :mod:`fleet.simulator`, runs the *same policy class*
+against virtual replicas at time compression; that shared interface is
+the point: a policy validated in the simulator at request scales this
+container can't run live is the policy the live fleet executes.
+
+Design notes:
+
+- **Signals** (:class:`FleetSignals`) are exactly what the poller
+  already scrapes: queue depth, brownout level, SLO-breach and
+  deadline-miss rates, plus the arrival-rate trend the tracker
+  derives. The policy never reaches into a manager — both worlds
+  build the same dataclass.
+- **Never flap**: scale-ups are immediate under pressure but gated by
+  an up-cooldown; scale-downs require the pressure to stay below the
+  low watermark for a dwell AND a separate (longer) down-cooldown —
+  the :class:`utils.brownout.BrownoutController` hysteresis idiom
+  (enter fast, exit slow, strictly separated watermarks).
+- **Predictive scale-ahead**: Little's law on the projected arrival
+  rate (EWMA + trend x horizon) x the measured mean service time
+  gives the concurrency the fleet is ABOUT to need; the policy scales
+  on ``max(reactive, predicted)`` so the spawn cost is paid before
+  the queue builds, not after.
+- **Beyond replica count**: the policy flips prefill<->decode roles as
+  the traffic mixture shifts (PR 12's geometry, now actuated), and
+  the live actuator pre-loads every spawning replica's re-warm plan
+  with the fleet's hottest prefixes (PR 13's pull path) so scale-ups
+  join warm.
+
+Stdlib-only, like the rest of ``fleet/``.
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+import threading
+import time
+from typing import Dict, List, Optional
+
+from .replicas import HEALTHY
+
+__all__ = ["AutoscaleConfig", "FleetSignals", "SignalTracker",
+           "AutoscalePolicy", "StaticPolicy", "Autoscaler"]
+
+
+@dataclasses.dataclass(frozen=True)
+class AutoscaleConfig:
+    """Policy knobs (docs/FLEET.md has the reference table)."""
+    min_replicas: int = 1
+    max_replicas: int = 4
+    #: scale up when effective pressure >= this (pressure ~1.0 means
+    #: demand equals healthy serving slots)
+    up_pressure: float = 0.85
+    #: scale down only while pressure <= this — strictly below
+    #: up_pressure, the hysteresis gap that prevents flapping
+    down_pressure: float = 0.40
+    #: minimum seconds between consecutive scale actions (up / down)
+    up_cooldown_s: float = 5.0
+    down_cooldown_s: float = 20.0
+    #: pressure must stay <= down_pressure this long before a drain
+    down_dwell_s: float = 10.0
+    #: predictive scale-ahead: project the arrival rate this far out
+    horizon_s: float = 20.0
+    #: fallback mean service time before the fleet has measured one
+    service_s_hint: float = 0.5
+    #: brownout level that counts as full pressure (level/this)
+    brownout_full_level: int = 2
+    #: SLO-breach fraction (breaches/arrivals) that counts as full
+    #: pressure on its own
+    slo_full_frac: float = 0.25
+    #: predictive projection cap: the trend term may at most multiply
+    #: the CURRENT arrival rate by this. A derivative over sparse
+    #: arrivals is noise — uncapped, one request after a quiet spell
+    #: projects phantom rps that flap a small fleet up and reset the
+    #: scale-down dwell all through a valley. A genuine ramp carries
+    #: its own rising rate, so the cap never blocks real scale-ahead.
+    predict_max_factor: float = 3.0
+    #: prefill<->decode role flips (off by default — an all-"both"
+    #: fleet stays all-"both")
+    role_flip: bool = False
+    #: flip a replica TO prefill when the prefill share of arriving
+    #: work exceeds this...
+    prefill_share_high: float = 0.55
+    #: ...and back to "both" when it falls below this
+    prefill_share_low: float = 0.25
+    role_cooldown_s: float = 30.0
+
+    def __post_init__(self):
+        if self.down_pressure >= self.up_pressure:
+            raise ValueError("down_pressure must be strictly below "
+                             "up_pressure (the hysteresis gap)")
+        if self.min_replicas < 1 or self.max_replicas < self.min_replicas:
+            raise ValueError("need 1 <= min_replicas <= max_replicas")
+
+
+@dataclasses.dataclass
+class FleetSignals:
+    """One policy tick's worth of scraped state. Both worlds build
+    exactly this — the live tracker from poller counters, the
+    simulator from virtual state."""
+    t: float                       #: seconds (monotonic or virtual)
+    replicas: int                  #: current membership (incl. starting)
+    healthy: int
+    slots: float                   #: healthy serving slots, fleet-wide
+    queue_depth: float = 0.0       #: accepted-but-unslotted requests
+    inflight: float = 0.0
+    brownout_level: int = 0
+    slo_breach_rate: float = 0.0   #: breaches/s (EWMA)
+    deadline_miss_rate: float = 0.0
+    arrival_rate: float = 0.0      #: requests/s (EWMA)
+    arrival_trend: float = 0.0     #: d(arrival_rate)/dt
+    avg_service_s: float = 0.0     #: measured mean request service time
+    prefill_share: float = 0.0     #: fraction of arriving work that is
+                                   #: prefill-heavy (0 = unknown)
+    replica_loads: Dict[str, float] = dataclasses.field(
+        default_factory=dict)
+    replica_roles: Dict[str, str] = dataclasses.field(
+        default_factory=dict)
+
+
+class SignalTracker:
+    """Derives the rate/trend signals the policy wants from raw
+    monotonic counters — shared by the live actuator and the
+    simulator so the two worlds see the same smoothing."""
+
+    def __init__(self, alpha: float = 0.35):
+        #: PER-SECOND smoothing coefficient. The effective per-update
+        #: weight is 1-(1-alpha)^dt, so a 0.5 s live tick and a 1 s
+        #: simulator tick converge to the SAME smoothed signal — a
+        #: fixed per-update alpha at a faster cadence would smooth
+        #: less, and sparse single arrivals would spike the rate (and
+        #: its trend) into phantom pressure.
+        self.alpha = float(alpha)
+        self._last_t: Optional[float] = None
+        self._last_counts: Dict[str, float] = {}
+        self.rates: Dict[str, float] = {}
+        self._last_rates: Dict[str, float] = {}
+        self.trends: Dict[str, float] = {}
+
+    def update(self, t: float, counts: Dict[str, float]) -> None:
+        """Feed one observation of monotonic counters at time ``t``;
+        EWMA rates and rate trends update in place."""
+        if self._last_t is None or t <= self._last_t:
+            self._last_t = t
+            self._last_counts = dict(counts)
+            return
+        dt = t - self._last_t
+        a = (1.0 if self.alpha >= 1.0
+             else 1.0 - (1.0 - self.alpha) ** dt)
+        for key, val in counts.items():
+            delta = max(val - self._last_counts.get(key, 0.0), 0.0)
+            inst = delta / dt
+            prev = self.rates.get(key)
+            new = (inst if prev is None
+                   else prev + a * (inst - prev))
+            self.rates[key] = new
+            if prev is not None:
+                inst_tr = (new - prev) / dt
+                ptr = self.trends.get(key, 0.0)
+                self.trends[key] = ptr + a * (inst_tr - ptr)
+            self._last_counts[key] = val
+        self._last_t = t
+
+    def rate(self, key: str) -> float:
+        return float(self.rates.get(key, 0.0))
+
+    def trend(self, key: str) -> float:
+        return float(self.trends.get(key, 0.0))
+
+
+def pick_drain_victim(loads: Dict[str, float],
+                      roles: Optional[Dict[str, str]] = None
+                      ) -> Optional[str]:
+    """The emptiest replica, deterministically (load, then rid).
+    Dedicated prefill replicas are spared when any "both"/decode
+    candidate exists — shrinking should not silently undo a role
+    split the mixture still wants."""
+    if not loads:
+        return None
+    roles = roles or {}
+    pool = {rid: ld for rid, ld in loads.items()
+            if roles.get(rid, "both") != "prefill"}
+    if not pool:
+        pool = dict(loads)
+    return min(pool.items(), key=lambda kv: (kv[1], kv[0]))[0]
+
+
+class AutoscalePolicy:
+    """The deterministic scaling state machine. ``decide()`` maps one
+    :class:`FleetSignals` tick to a list of action dicts:
+
+    - ``{"op": "scale_up", "n": 1, "reason": ...}``
+    - ``{"op": "scale_down", "rid": ..., "reason": ...}``
+    - ``{"op": "role_flip", "rid": ..., "role": ..., "reason": ...}``
+
+    Same signal sequence => same action sequence, byte for byte —
+    that is what lets the simulator validate the exact policy the
+    live fleet runs (tests/test_autoscale.py pins it).
+    """
+
+    def __init__(self, cfg: AutoscaleConfig = AutoscaleConfig()):
+        self.cfg = cfg
+        self._last_scale_t: Optional[float] = None
+        self._last_flip_t: Optional[float] = None
+        self._low_since: Optional[float] = None
+        self.last_pressure = 0.0
+        self.last_predicted = 0.0
+        self.last_target = 0
+
+    # -- pressure model ------------------------------------------------------
+
+    def pressure(self, sig: FleetSignals) -> float:
+        """Reactive pressure: demand over capacity, on whichever
+        signal screams loudest. ~1.0 = the healthy slots are exactly
+        consumed."""
+        cfg = self.cfg
+        slots = max(sig.slots, 1.0)
+        util = (sig.queue_depth + sig.inflight) / slots
+        brown = (sig.brownout_level
+                 / max(cfg.brownout_full_level, 1))
+        breach_frac = ((sig.slo_breach_rate + sig.deadline_miss_rate)
+                       / max(sig.arrival_rate, 1e-9)
+                       if sig.arrival_rate > 0 else 0.0)
+        slo = breach_frac / max(cfg.slo_full_frac, 1e-9)
+        return max(util, brown, slo)
+
+    def predicted_pressure(self, sig: FleetSignals) -> float:
+        """Scale-ahead pressure: Little's law on the projected
+        arrival rate at the horizon. Trends only push UP — a falling
+        trend must not mask real present load (scale-down has its own
+        dwell) — and the projection is capped at
+        ``predict_max_factor`` x the current rate so derivative noise
+        from sparse arrivals cannot invent demand."""
+        cfg = self.cfg
+        proj = sig.arrival_rate + max(sig.arrival_trend, 0.0) \
+            * cfg.horizon_s
+        proj = min(proj, cfg.predict_max_factor * sig.arrival_rate)
+        service = sig.avg_service_s or cfg.service_s_hint
+        demand = proj * service          # concurrent requests needed
+        return demand / max(sig.slots, 1.0)
+
+    # -- the decision --------------------------------------------------------
+
+    def decide(self, sig: FleetSignals) -> List[dict]:
+        cfg = self.cfg
+        actions: List[dict] = []
+        pressure = self.pressure(sig)
+        predicted = self.predicted_pressure(sig)
+        eff = max(pressure, predicted)
+        self.last_pressure = round(pressure, 4)
+        self.last_predicted = round(predicted, 4)
+        since_scale = (math.inf if self._last_scale_t is None
+                       else sig.t - self._last_scale_t)
+
+        if eff >= cfg.up_pressure:
+            self._low_since = None
+            if (sig.replicas < cfg.max_replicas
+                    and since_scale >= cfg.up_cooldown_s):
+                # jump more than one step when demand is far past
+                # capacity — predictive ticks during a steep ramp
+                # should not pay one cooldown per replica
+                want = math.ceil(sig.replicas * eff / cfg.up_pressure)
+                n = max(1, min(want - sig.replicas,
+                               cfg.max_replicas - sig.replicas))
+                actions.append({
+                    "op": "scale_up", "n": int(n),
+                    "reason": ("predicted" if predicted > pressure
+                               else "pressure"),
+                    "pressure": round(eff, 4)})
+                self._last_scale_t = sig.t
+        elif eff <= cfg.down_pressure and sig.replicas > cfg.min_replicas:
+            if self._low_since is None:
+                self._low_since = sig.t
+            elif (sig.t - self._low_since >= cfg.down_dwell_s
+                    and since_scale >= cfg.down_cooldown_s):
+                victim = pick_drain_victim(sig.replica_loads,
+                                           sig.replica_roles)
+                if victim is not None:
+                    actions.append({
+                        "op": "scale_down", "rid": victim,
+                        "reason": "idle",
+                        "pressure": round(eff, 4)})
+                    self._last_scale_t = sig.t
+                    self._low_since = sig.t
+        else:
+            # mid-band: neither watermark — reset the low dwell so a
+            # brief dip never banks toward a drain
+            self._low_since = None
+
+        if cfg.role_flip:
+            actions.extend(self._decide_roles(sig))
+        self.last_target = sig.replicas + sum(
+            a.get("n", 0) for a in actions if a["op"] == "scale_up"
+        ) - sum(1 for a in actions if a["op"] == "scale_down")
+        return actions
+
+    def _decide_roles(self, sig: FleetSignals) -> List[dict]:
+        """Mixture tracking (PR 12's geometry as an actuator): when
+        the arriving work turns prefill-heavy, dedicate a replica to
+        prefill; when it turns decode-heavy again, fold it back to
+        "both". Never flips below 2 healthy (a 1-replica fleet must
+        stay role-complete) and respects its own cooldown."""
+        cfg = self.cfg
+        since_flip = (math.inf if self._last_flip_t is None
+                      else sig.t - self._last_flip_t)
+        if sig.healthy < 2 or since_flip < cfg.role_cooldown_s:
+            return []
+        roles = sig.replica_roles
+        prefills = [rid for rid, role in roles.items()
+                    if role == "prefill"]
+        if sig.prefill_share >= cfg.prefill_share_high and not prefills:
+            flex = {rid: sig.replica_loads.get(rid, 0.0)
+                    for rid, role in roles.items() if role == "both"}
+            rid = pick_drain_victim(flex)
+            if rid is not None:
+                self._last_flip_t = sig.t
+                return [{"op": "role_flip", "rid": rid,
+                         "role": "prefill",
+                         "reason": "prefill_heavy",
+                         "share": round(sig.prefill_share, 4)}]
+        elif sig.prefill_share <= cfg.prefill_share_low and prefills:
+            rid = min(prefills)
+            self._last_flip_t = sig.t
+            return [{"op": "role_flip", "rid": rid, "role": "both",
+                     "reason": "decode_heavy",
+                     "share": round(sig.prefill_share, 4)}]
+        return []
+
+
+class StaticPolicy:
+    """The peak-provisioned control arm: never scales. Shares the
+    interface so the simulator/bench run both arms through one code
+    path."""
+
+    def __init__(self, cfg: AutoscaleConfig = AutoscaleConfig()):
+        self.cfg = cfg
+        self.last_pressure = 0.0
+        self.last_predicted = 0.0
+        self.last_target = 0
+
+    def decide(self, sig: FleetSignals) -> List[dict]:
+        self.last_target = sig.replicas
+        return []
+
+
+class Autoscaler:
+    """The LIVE actuator: ticks the policy against a running
+    :class:`FleetManager` and actuates through the first-class
+    membership API — spawn via ``make_replica`` + ``add_replica``
+    (supervised start + warm-signature ladder, PR 9), drain via
+    ``remove_replica`` (drain-on-SIGTERM, zero dropped requests),
+    role flips as replace-then-retire, and every spawn pre-loaded
+    with the fleet's hot prefixes (PR 13's re-warm pull path) so it
+    joins warm."""
+
+    def __init__(self, manager, policy, make_replica,
+                 interval_s: float = 1.0,
+                 prefill_share_fn=None,
+                 rewarm_top_k: int = 8,
+                 drain_grace_s: float = 30.0):
+        self.manager = manager
+        self.policy = policy
+        self.make_replica = make_replica
+        self.interval_s = float(interval_s)
+        self.prefill_share_fn = prefill_share_fn
+        self.rewarm_top_k = int(rewarm_top_k)
+        self.drain_grace_s = float(drain_grace_s)
+        self.tracker = SignalTracker()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._next_idx = 0
+        #: (new_rid, old_rid) role replacements waiting on the new
+        #: replica's health before the old one retires
+        self._pending_flips: List[tuple] = []
+        self._lock = threading.Lock()
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="fleet-autoscale")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.tick()
+            except Exception:  # noqa: BLE001 — the control loop must
+                pass           # survive any one bad tick
+            self._stop.wait(self.interval_s)
+
+    # -- one tick ------------------------------------------------------------
+
+    def signals(self, t: Optional[float] = None) -> FleetSignals:
+        """Scrape the live fleet into the policy's input dataclass.
+        Everything here is what the poller already collects — the
+        autoscaler adds no new probes."""
+        m = self.manager
+        t = time.monotonic() if t is None else t
+        with m._lock:
+            reps = list(m.replicas.values())
+            counts = {
+                "arrivals": float(sum(r.cum["requests_total"]
+                                      for r in reps)),
+                "breaches": float(sum(r.cum["slo_breach_total"]
+                                      for r in reps)),
+                "misses": float(m.stats.get("deadline_expired_total",
+                                            0)),
+            }
+            healthy = [r for r in reps if r.state == HEALTHY]
+            queue_depth = sum(
+                float(r.polled.get("queue_depth", 0) or 0)
+                for r in healthy)
+            inflight = sum(r.inflight for r in reps)
+            slots = sum(r.slots(m.slots_hint) for r in healthy)
+            brown = m._brownout_level_locked()
+            loads = {r.rid: r.load_estimate() for r in healthy}
+            roles = {r.rid: r.role for r in healthy}
+            n = len(reps)
+        self.tracker.update(t, counts)
+        share = 0.0
+        if self.prefill_share_fn is not None:
+            try:
+                share = float(self.prefill_share_fn() or 0.0)
+            except Exception:  # noqa: BLE001
+                share = 0.0
+        arrival = self.tracker.rate("arrivals")
+        return FleetSignals(
+            t=t, replicas=n, healthy=len(healthy), slots=float(slots),
+            queue_depth=queue_depth, inflight=float(inflight),
+            brownout_level=int(brown),
+            slo_breach_rate=self.tracker.rate("breaches"),
+            deadline_miss_rate=self.tracker.rate("misses"),
+            arrival_rate=arrival,
+            arrival_trend=self.tracker.trend("arrivals"),
+            avg_service_s=0.0,
+            prefill_share=share,
+            replica_loads=loads, replica_roles=roles)
+
+    def tick(self) -> List[dict]:
+        self._settle_flips()
+        sig = self.signals()
+        actions = self.policy.decide(sig)
+        for act in actions:
+            self._apply(act)
+        return actions
+
+    # -- actuation -----------------------------------------------------------
+
+    def _fresh_rid(self) -> str:
+        with self._lock:
+            while True:
+                rid = f"as{self._next_idx}"
+                self._next_idx += 1
+                if rid not in self.manager.replicas:
+                    return rid
+
+    def _spawn(self, role: str = "both") -> Optional[str]:
+        rid = self._fresh_rid()
+        replica = self.make_replica(rid, role)
+        if replica is None:
+            return None
+        # proactive hot-prefix replication (ISSUE 19 via PR 13): the
+        # spawn's re-warm plan is the FLEET's hottest chains, pulled
+        # from peers before the poller readmits it — first request
+        # lands warm, not cold
+        with self.manager._lock:
+            plan = self.manager.radix.hot_prefixes(self.rewarm_top_k)
+        if plan:
+            replica.rewarm_prefixes = plan
+            replica.rewarm_state = "pending"
+        if not self.manager.add_replica(replica):
+            return None
+        return rid
+
+    def _apply(self, act: dict) -> None:
+        m = self.manager
+        op = act.get("op")
+        if op == "scale_up":
+            spawned = []
+            for _ in range(int(act.get("n", 1))):
+                rid = self._spawn()
+                if rid is not None:
+                    spawned.append(rid)
+            if spawned:
+                with m._lock:
+                    m.stats["autoscale_scale_up_total"] += len(spawned)
+                m.events.log("scale_up", replicas=spawned,
+                             reason=act.get("reason"),
+                             pressure=act.get("pressure"))
+        elif op == "scale_down":
+            rid = act.get("rid")
+            if rid is not None and m.remove_replica(
+                    rid, grace_s=self.drain_grace_s):
+                with m._lock:
+                    m.stats["autoscale_scale_down_total"] += 1
+                m.events.log("scale_down", replica=rid,
+                             reason=act.get("reason"),
+                             pressure=act.get("pressure"))
+        elif op == "role_flip":
+            # replace-then-retire: spawn the new-role replica first,
+            # retire the old one only once the spawn is HEALTHY — the
+            # fleet never dips below its serving capacity mid-flip
+            old = act.get("rid")
+            new_rid = self._spawn(role=act.get("role", "both"))
+            if new_rid is not None:
+                with self._lock:
+                    self._pending_flips.append((new_rid, old))
+                m.events.log("role_flip", replica=old,
+                             replacement=new_rid,
+                             role=act.get("role"),
+                             reason=act.get("reason"),
+                             share=act.get("share"))
+
+    def scale_to(self, n: int) -> dict:
+        """Operator override (``POST /admin/scale?replicas=N``): walk
+        the fleet to ``n`` replicas through the SAME actuators the
+        policy uses — supervised spawns with hot-prefix re-warm plans,
+        emptiest-first drains — clamped to the policy's bounds."""
+        cfg = getattr(self.policy, "cfg", None)
+        lo = getattr(cfg, "min_replicas", 1)
+        hi = getattr(cfg, "max_replicas", 64)
+        n = max(lo, min(int(n), hi))
+        sig = self.signals()
+        delta = n - sig.replicas
+        if delta > 0:
+            self._apply({"op": "scale_up", "n": delta,
+                         "reason": "admin"})
+        else:
+            loads = dict(sig.replica_loads)
+            roles = dict(sig.replica_roles)
+            for _ in range(-delta):
+                rid = pick_drain_victim(loads, roles)
+                if rid is None:
+                    break
+                self._apply({"op": "scale_down", "rid": rid,
+                             "reason": "admin"})
+                loads.pop(rid, None)
+                roles.pop(rid, None)
+        return {"target": n, "was": sig.replicas,
+                "delta": delta}
+
+    def _settle_flips(self) -> None:
+        """Retire the old half of any role flip whose replacement has
+        come up healthy."""
+        m = self.manager
+        with self._lock:
+            pending = list(self._pending_flips)
+        for new_rid, old_rid in pending:
+            rep = m.replicas.get(new_rid)
+            if rep is None:
+                # replacement died permanently: abandon the flip, the
+                # old replica stays
+                with self._lock:
+                    self._pending_flips.remove((new_rid, old_rid))
+                continue
+            if rep.state == HEALTHY:
+                m.remove_replica(old_rid, grace_s=self.drain_grace_s)
+                with m._lock:
+                    m.stats["autoscale_role_flip_total"] += 1
+                with self._lock:
+                    self._pending_flips.remove((new_rid, old_rid))
+
+    # -- observability -------------------------------------------------------
+
+    def stats(self) -> dict:
+        """Flat gauges merged onto the router's /metrics via the
+        manager's ``extra_counters_fn`` hook (promlint: gauges carry
+        no ``_total`` suffix)."""
+        with self.manager._lock:
+            n = len(self.manager.replicas)
+            healthy = sum(1 for r in self.manager.replicas.values()
+                          if r.state == HEALTHY)
+        return {
+            "autoscale_target_replicas": int(
+                getattr(self.policy, "last_target", 0) or n),
+            "autoscale_actual_replicas": n,
+            "autoscale_healthy_replicas": healthy,
+            "autoscale_pressure": float(
+                getattr(self.policy, "last_pressure", 0.0)),
+            "autoscale_predicted_pressure": float(
+                getattr(self.policy, "last_predicted", 0.0)),
+            "autoscale_arrival_rate": round(
+                self.tracker.rate("arrivals"), 4),
+        }

@@ -178,6 +178,31 @@ def render_dashboard(manager, admission, stats, slo=None,
     parts += _table(rows or [("(no traffic yet)", "-")],
                     ("counter", "value"))
 
+    # -- autoscaling (ISSUE 19) --------------------------------------------
+    # gauges ride the manager counter snapshot via extra_counters_fn;
+    # a fleet without a running autoscaler renders one muted line
+    parts.append("<h2>Autoscaling</h2>")
+    if "autoscale_actual_replicas" in counters:
+        parts.append(
+            f'<p class="muted">target='
+            f'{counters.get("autoscale_target_replicas")} · actual='
+            f'{counters.get("autoscale_actual_replicas")} · healthy='
+            f'{counters.get("autoscale_healthy_replicas")} · '
+            f'pressure={counters.get("autoscale_pressure")} '
+            f'(predicted='
+            f'{counters.get("autoscale_predicted_pressure")}) · '
+            f'arrival_rate='
+            f'{counters.get("autoscale_arrival_rate")}/s</p>')
+        rows = _counter_rows(counters, (
+            "autoscale_scale_up_total", "autoscale_scale_down_total",
+            "autoscale_role_flip_total", "replica_seconds_total",
+        ))
+        parts += _table(rows or [("(no scale events yet)", "-")],
+                        ("counter", "value"))
+    else:
+        parts.append('<p class="muted">autoscaler off '
+                     '(serve_fleet --autoscale on)</p>')
+
     # -- token integrity (ISSUE 18) ----------------------------------------
     # fleet-level shadow-audit verdict + per-replica coverage split by
     # serve-path fingerprint, read from the poller's stored /metrics

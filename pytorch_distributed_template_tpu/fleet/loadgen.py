@@ -30,6 +30,7 @@ from __future__ import annotations
 
 import http.client
 import json
+import math
 import random
 import socket
 import threading
@@ -38,6 +39,53 @@ from typing import Dict, List, Optional
 from urllib.parse import urlsplit
 
 from ..utils.promtext import percentile as _percentile
+
+
+def _diurnal_rate(phase: float, floor: float, sharpness: int) -> float:
+    """Unit-peak diurnal envelope at ``phase`` ∈ [0, 1) of the period:
+    ``floor + (1-floor)·sin^(2·sharpness)(π·phase)`` — peak 1.0
+    mid-period, valley ``floor`` at the edges; higher ``sharpness``
+    narrows the peak (more of the period is valley, the shape that
+    makes static peak provisioning wasteful)."""
+    s = math.sin(math.pi * phase)
+    return floor + (1.0 - floor) * (s * s) ** max(int(sharpness), 1)
+
+
+def _diurnal_cum(floor: float, sharpness: int,
+                 n: int = 2048) -> List[float]:
+    """Cumulative trapezoid integral of the unit-peak envelope over one
+    UNIT period (n+1 knots). Pure arithmetic on fixed inputs — the
+    same (floor, sharpness) always yields the same table, so diurnal
+    traces stay deterministic without a closed-form ∫sin^2p."""
+    cum = [0.0]
+    prev = _diurnal_rate(0.0, floor, sharpness)
+    for k in range(1, n + 1):
+        cur = _diurnal_rate(k / n, floor, sharpness)
+        cum.append(cum[-1] + 0.5 * (prev + cur) / n)
+        prev = cur
+    return cum
+
+
+def _diurnal_invert(u: float, rate_rps: float, period_s: float,
+                    cum: List[float]) -> float:
+    """Map a unit-rate Poisson epoch ``u`` to wall time via the inverse
+    cumulative envelope Λ⁻¹ (inhomogeneous-Poisson time rescaling):
+    whole periods divide out, the remainder binary-searches the table
+    and interpolates linearly inside a knot interval."""
+    per_period = rate_rps * period_s * cum[-1]
+    full, rem = divmod(u, per_period)
+    target = rem / (rate_rps * period_s)
+    lo, hi = 0, len(cum) - 1
+    while lo + 1 < hi:
+        mid = (lo + hi) // 2
+        if cum[mid] < target:
+            lo = mid
+        else:
+            hi = mid
+    seg = cum[hi] - cum[lo]
+    frac = (lo + ((target - cum[lo]) / seg if seg > 0 else 0.0)) \
+        / (len(cum) - 1)
+    return (full + frac) * period_s
 
 
 def build_trace(n_requests: int, seed: int = 0,
@@ -49,6 +97,9 @@ def build_trace(n_requests: int, seed: int = 0,
                 arrival: str = "poisson", rate_rps: float = 8.0,
                 burst_duty: float = 0.25, burst_factor: float = 6.0,
                 burst_period_s: float = 2.0,
+                diurnal_period_s: float = 60.0,
+                diurnal_floor: float = 0.1,
+                diurnal_sharpness: int = 3,
                 stream_frac: float = 0.5, cancel_frac: float = 0.0,
                 cancel_after_s: float = 0.5,
                 deadline_ms: Optional[int] = None,
@@ -105,10 +156,17 @@ def build_trace(n_requests: int, seed: int = 0,
     weights = [float((tenant_weights or {}).get(t, 1.0))
                for t in tenants]
     # arrival times: a Poisson stream, optionally duty-cycle gated into
-    # bursts (the gated stream keeps Poisson statistics INSIDE a burst)
+    # bursts (the gated stream keeps Poisson statistics INSIDE a burst),
+    # or rescaled through a deterministic diurnal envelope (ISSUE 19:
+    # an inhomogeneous Poisson process whose rate peaks at rate_rps
+    # mid-period and idles at diurnal_floor·rate_rps — the traffic
+    # shape an autoscaler exists for). Each mode draws ONLY from its
+    # own branch, so adding a mode never perturbs another mode's seed
+    # stream (the draw-order-neutrality contract).
     times: List[float] = []
     t = 0.0
     burst_rate = rate_rps * burst_factor
+    diurnal_u, diurnal_table = 0.0, None
     while len(times) < n_requests:
         if arrival == "poisson":
             t += rng.expovariate(rate_rps)
@@ -117,9 +175,16 @@ def build_trace(n_requests: int, seed: int = 0,
             t += rng.expovariate(burst_rate)
             if (t % burst_period_s) < burst_duty * burst_period_s:
                 times.append(t)
+        elif arrival == "diurnal":
+            if diurnal_table is None:
+                diurnal_table = _diurnal_cum(diurnal_floor,
+                                             diurnal_sharpness)
+            diurnal_u += rng.expovariate(1.0)
+            times.append(_diurnal_invert(
+                diurnal_u, rate_rps, diurnal_period_s, diurnal_table))
         else:
             raise ValueError(f"unknown arrival {arrival!r} "
-                             "(poisson|bursty)")
+                             "(poisson|bursty|diurnal)")
     trace = []
     for i, at in enumerate(times):
         g = rng.randrange(prefix_groups)
@@ -197,6 +262,28 @@ def longctx_trace(n_requests: int, seed: int = 0,
         group_stream=([False] * int(n_docs)
                       + [True] * int(background_groups)),
         vocab=vocab, **kw)
+
+
+def diurnal_trace(n_requests: int, seed: int = 0,
+                  peak_rps: float = 6.0, period_s: float = 60.0,
+                  floor: float = 0.1, sharpness: int = 3,
+                  prefix_groups: int = 4, stream_frac: float = 0.6,
+                  group_tag: str = "dn", **kw) -> List[dict]:
+    """The ``serve_autoscale`` diurnal/bursty preset (ISSUE 19
+    satellite): arrivals follow a deterministic rate envelope that
+    peaks at ``peak_rps`` once per ``period_s`` and idles at
+    ``floor``·peak between peaks (``sharpness`` narrows the peaks, so
+    most of the period is valley — the millions-of-users daily cycle
+    compressed to a benchable period). Shared-prefix groups and a
+    streaming mixture ride along unchanged so warm/cold and TPOT
+    telemetry stay meaningful. Pure parameterization of
+    :func:`build_trace` — the draw-order-neutrality contract holds by
+    construction, pinned by tests/test_autoscale.py."""
+    return build_trace(
+        n_requests, seed=seed, arrival="diurnal", rate_rps=peak_rps,
+        diurnal_period_s=period_s, diurnal_floor=floor,
+        diurnal_sharpness=sharpness, prefix_groups=prefix_groups,
+        stream_frac=stream_frac, group_tag=group_tag, **kw)
 
 
 def prompt_tokens(trace: List[dict]) -> int:
@@ -555,7 +642,7 @@ def main(argv=None) -> int:
     p.add_argument("--n", type=int, default=32)
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--arrival", default="poisson",
-                   choices=("poisson", "bursty"))
+                   choices=("poisson", "bursty", "diurnal"))
     p.add_argument("--rate", type=float, default=8.0, metavar="RPS")
     p.add_argument("--tenants", default="t0,t1,t2")
     p.add_argument("--prefix-groups", type=int, default=4)
@@ -575,17 +662,26 @@ def main(argv=None) -> int:
                    help="X-Fleet-Policy override (cache_aware|"
                         "least_loaded|round_robin)")
     p.add_argument("--timeout-s", type=float, default=120.0)
-    p.add_argument("--preset", default=None, choices=("longctx",),
+    p.add_argument("--preset", default=None,
+                   choices=("longctx", "diurnal"),
                    help="named trace preset: 'longctx' = the "
                         "serve_longctx long-document QA mixture "
                         "(shared --long-prefix-len document prefixes "
                         "+ short questions vs a decode-heavy "
-                        "streaming background, ISSUE 15)")
+                        "streaming background, ISSUE 15); 'diurnal' = "
+                        "the serve_autoscale diurnal/bursty envelope "
+                        "(--rate is the PEAK rps, ISSUE 19)")
     p.add_argument("--doc-len", type=int, default=8192,
                    help="longctx preset: shared document prefix "
                         "length in tokens")
     p.add_argument("--n-docs", type=int, default=2,
                    help="longctx preset: distinct shared documents")
+    p.add_argument("--diurnal-period-s", type=float, default=60.0,
+                   help="diurnal: seconds per peak-to-peak cycle")
+    p.add_argument("--diurnal-floor", type=float, default=0.1,
+                   help="diurnal: valley rate as a fraction of peak")
+    p.add_argument("--diurnal-sharpness", type=int, default=3,
+                   help="diurnal: peak narrowness exponent (sin^2p)")
     args = p.parse_args(argv)
     if args.preset == "longctx":
         trace = longctx_trace(
@@ -593,6 +689,17 @@ def main(argv=None) -> int:
             n_docs=args.n_docs, group_tag=args.group_tag,
             tenants=[t for t in args.tenants.split(",") if t],
             arrival=args.arrival, rate_rps=args.rate)
+    elif args.preset == "diurnal":
+        trace = diurnal_trace(
+            args.n, seed=args.seed, peak_rps=args.rate,
+            period_s=args.diurnal_period_s, floor=args.diurnal_floor,
+            sharpness=args.diurnal_sharpness,
+            prefix_groups=args.prefix_groups,
+            group_tag=args.group_tag, prefix_len=args.prefix_len,
+            suffix_len=args.suffix_len,
+            max_new_tokens=args.max_new_tokens,
+            stream_frac=args.stream_frac,
+            tenants=[t for t in args.tenants.split(",") if t])
     else:
         trace = build_trace(
             args.n, seed=args.seed,

@@ -185,6 +185,24 @@ class FleetRadix:
         out.sort(key=lambda t: -t[0])
         return [ids for _, ids in out[:max(int(top_k), 0)]]
 
+    def hot_prefixes(self, top_k: int = 8) -> List[list]:
+        """The FLEET's hottest deepest id-chains regardless of owner —
+        the proactive spawn re-warm plan (ISSUE 19). A scale-up
+        replica has no eviction history to replay (the ISSUE 13 plan
+        is per-dead-replica), so it pre-warms with whatever the whole
+        fleet is serving hottest right now; each chain is pulled from
+        whichever healthy peer holds it via the same peer-pull path."""
+        out: List[tuple] = []
+        stack: List[tuple] = [(self.root, [])]
+        while stack:
+            node, ids = stack.pop()
+            for child in node["children"].values():
+                stack.append((child, ids + list(child["chunk"])))
+            if node is not self.root and not node["children"]:
+                out.append((node["last_use"], ids))
+        out.sort(key=lambda t: -t[0])
+        return [ids for _, ids in out[:max(int(top_k), 0)]]
+
     def drop_replica(self, replica_id: str) -> int:
         """A replica died or restarted: its pool is empty, so every
         prediction naming it is stale. Removes it everywhere and prunes

@@ -353,7 +353,24 @@ class FleetManager:
             "peer_pull_timeouts_total": 0,
             "rewarm_events_total": 0, "rewarm_pulls_total": 0,
             "rewarm_blocks_total": 0, "rewarm_failures_total": 0,
+            # autoscaling actuations (ISSUE 19): incremented by the
+            # Autoscaler (fleet/autoscaler.py) after a successful
+            # scale action so they ride /metrics + the snapshot events
+            # like every other fleet counter
+            "autoscale_scale_up_total": 0,
+            "autoscale_scale_down_total": 0,
+            "autoscale_role_flip_total": 0,
         }
+        # replica-seconds ledger (ISSUE 19): the autoscaler's cost
+        # objective — ∫ membership dt, accrued on every poll/snapshot
+        # boundary. Membership (not health): a starting or draining
+        # process still burns its machine.
+        self.replica_seconds_total = 0.0
+        self._rs_last: Optional[float] = None
+        # extra flat counters merged into snapshot_counters() OUTSIDE
+        # the lock (the autoscaler contributes target/actual gauges;
+        # the fn may read manager state, so it must not deadlock)
+        self.extra_counters_fn = None
         # peer page migration knobs (ISSUE 13); both off by default —
         # a pre-tier fleet routes byte-identically
         self.peer_pull = bool(peer_pull)
@@ -379,9 +396,21 @@ class FleetManager:
 
     # -- lifecycle ----------------------------------------------------------
 
+    def _accrue_replica_seconds_locked(self) -> None:
+        """Advance the replica-seconds integral to now (caller holds
+        the lock). Called at every membership change and observation
+        point, so the ledger is exact at the boundaries that matter."""
+        now = time.monotonic()
+        if self._rs_last is not None:
+            self.replica_seconds_total += ((now - self._rs_last)
+                                           * len(self.replicas))
+        self._rs_last = now
+
     def start(self) -> None:
         self.events.log("start", replicas=len(self.replicas),
                         policy=self.policy)
+        with self._lock:
+            self._rs_last = time.monotonic()
         for r in self.replicas.values():
             if r.managed:
                 r.thread = threading.Thread(
@@ -392,6 +421,75 @@ class FleetManager:
                                         daemon=True, name="fleet-poll")
         self._poller.start()
 
+    # -- fleet membership (ISSUE 19) ----------------------------------------
+
+    def add_replica(self, replica: Replica) -> bool:
+        """First-class scale-up: join ``replica`` to the live fleet
+        and (managed mode) start its supervisor thread. The ONE owner
+        for membership growth — the autoscaler, ``/admin/scale``, and
+        tests all come through here, so the radix, the poller, the
+        replica-seconds ledger, and admission kicks stay consistent.
+        Returns False on a duplicate rid."""
+        with self._lock:
+            if replica.rid in self.replicas:
+                return False
+            self._accrue_replica_seconds_locked()
+            self.replicas[replica.rid] = replica
+        if replica.managed and replica.thread is None:
+            replica.thread = threading.Thread(
+                target=replica.supervisor.run, daemon=True,
+                name=f"fleet-sup-{replica.rid}")
+            replica.thread.start()
+        self.events.log("add_replica", replica=replica.rid,
+                        role=replica.role, managed=replica.managed)
+        if self.on_capacity_change is not None:
+            self.on_capacity_change()
+        return True
+
+    def remove_replica(self, rid: str, grace_s: float = 30.0) -> bool:
+        """First-class scale-down: TERMINAL drain. Stop routing to the
+        replica, wait (bounded) for its in-flight requests, then
+        ``request_drain()`` its supervisor — the child SIGTERM-drains
+        through serve.py's preemption path and the run loop exits
+        WITHOUT restarting (unlike :meth:`drain_replica`, which is a
+        rolling restart) — and finally forget the replica entirely.
+        Async like drain_replica; returns immediately."""
+        with self._lock:
+            r = self.replicas.get(rid)
+            if r is None or r.state == DRAINING:
+                return False
+            r.state = DRAINING
+            self.stats["drains_total"] += 1
+        self.events.log("remove_replica", replica=rid)
+        if self.on_capacity_change is not None:
+            self.on_capacity_change()
+
+        def _finish():
+            deadline = time.monotonic() + grace_s
+            while time.monotonic() < deadline:
+                with self._lock:
+                    if r.inflight == 0:
+                        break
+                time.sleep(0.05)
+            if r.managed and r.supervisor is not None:
+                r.supervisor.request_drain()
+                if r.thread is not None:
+                    r.thread.join(timeout=max(grace_s, 10.0))
+            with self._lock:
+                self._accrue_replica_seconds_locked()
+                self.replicas.pop(rid, None)
+                self.radix.drop_replica(rid)
+            self.events.log(
+                "removed_replica", replica=rid,
+                orphan=bool(r.thread is not None
+                            and r.thread.is_alive()))
+            if self.on_capacity_change is not None:
+                self.on_capacity_change()
+
+        threading.Thread(target=_finish, daemon=True,
+                         name=f"fleet-rm-{rid}").start()
+        return True
+
     def stop(self, timeout_s: float = 60.0) -> None:
         """Drain the whole fleet: every supervisor SIGTERM-drains its
         replica (serve.py finishes in-flight requests and exits via the
@@ -399,11 +497,13 @@ class FleetManager:
         supervisor threads exit (no orphan processes) or timeout."""
         self._stop.set()
         self.events.log("drain_fleet")
-        for r in self.replicas.values():
+        with self._lock:
+            reps = list(self.replicas.values())
+        for r in reps:
             if r.managed and r.supervisor is not None:
                 r.supervisor.request_drain()
         deadline = time.monotonic() + timeout_s
-        for r in self.replicas.values():
+        for r in reps:
             if r.thread is not None:
                 r.thread.join(max(deadline - time.monotonic(), 0.1))
         if self._poller is not None:
@@ -414,7 +514,7 @@ class FleetManager:
         # telemetry_report --fleet with no routing/shed counters at all
         self.events.log("snapshot", **self.snapshot_counters())
         self.events.log("stopped", orphans=sum(
-            1 for r in self.replicas.values()
+            1 for r in reps
             if r.thread is not None and r.thread.is_alive()))
         self.events.close()
         if self.tsdb is not None:
@@ -443,6 +543,11 @@ class FleetManager:
         dead replica — otherwise ejection/recovery latency would scale
         with how broken the fleet already is."""
         scraped: Dict[str, Optional[dict]] = {}
+        # membership is dynamic now (ISSUE 19): sweep a snapshot so
+        # concurrent add/remove_replica never invalidates the iterator
+        with self._lock:
+            self._accrue_replica_seconds_locked()
+            sweep = list(self.replicas.values())
 
         def scrape(rep: Replica) -> None:
             url = rep.discover_url()
@@ -457,13 +562,13 @@ class FleetManager:
 
         threads = [threading.Thread(target=scrape, args=(r,),
                                     daemon=True)
-                   for r in self.replicas.values()]
+                   for r in sweep]
         for t in threads:
             t.start()
         for t in threads:
             t.join(timeout=self.poll_timeout_s + 2.0)
         capacity_changed = False
-        for r in self.replicas.values():
+        for r in sweep:
             url = r.url
             polled = scraped.get(r.rid)
             with self._lock:
@@ -537,7 +642,7 @@ class FleetManager:
                         r.ok_streak = 0
                     else:
                         r.ok_streak += 1
-                    if (self.rewarm and r.state == EJECTED
+                    if (r.state in (STARTING, EJECTED)
                             and r.ok_streak >= self.readmit_after
                             and r.rewarm_state == "pending"):
                         # restart re-warm (ISSUE 13): replay the dead
@@ -545,6 +650,12 @@ class FleetManager:
                         # readmission — the replica rejoins warm, not
                         # cold. Runs off-thread (pulls are HTTP);
                         # readmission waits below until it finishes.
+                        # STARTING joins the club for ISSUE 19: the
+                        # autoscaler pre-loads a SPAWNING replica's
+                        # plan with the fleet's hot prefixes, so it
+                        # admits warm before its first miss
+                        # (rewarm_state is only ever "pending" when a
+                        # plan was explicitly captured).
                         r.rewarm_state = "running"
                         threading.Thread(
                             target=self._rewarm_worker, args=(r,),
@@ -977,7 +1088,10 @@ class FleetManager:
         """Flat fleet-level counters (router /metrics + the periodic
         ``snapshot`` event in router.jsonl)."""
         with self._lock:
+            self._accrue_replica_seconds_locked()
             out = dict(self.stats)
+            out["replica_seconds_total"] = round(
+                self.replica_seconds_total, 3)
             for key in AGGREGATED_COUNTERS:
                 out[f"fleet_{key}"] = int(sum(
                     r.cum[key] for r in self.replicas.values()))
@@ -1035,6 +1149,13 @@ class FleetManager:
             out["radix_nodes"] = self.radix.nodes
             if self.recoveries_s:
                 out["last_recovery_s"] = self.recoveries_s[-1]
+        # autoscaler gauges (ISSUE 19) merge OUTSIDE the lock — the fn
+        # reads manager state through locked accessors of its own
+        if self.extra_counters_fn is not None:
+            try:
+                out.update(self.extra_counters_fn() or {})
+            except Exception:  # noqa: BLE001
+                pass
         return out
 
     def snapshot(self) -> dict:

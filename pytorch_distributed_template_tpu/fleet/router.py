@@ -249,7 +249,8 @@ def make_fleet_handler(manager: FleetManager, admission: FairAdmission,
                        read_timeout_s: float = 600.0,
                        tracer=None, slo=None, hedge=None,
                        prefill_admission=None,
-                       disagg_min_ids: int = 32, tsdb=None):
+                       disagg_min_ids: int = 32, tsdb=None,
+                       autoscaler=None):
     stats = stats or RouterStats()
     hedge = hedge or HedgePolicy(enabled=False)
     if slo is not None:
@@ -362,6 +363,22 @@ def make_fleet_handler(manager: FleetManager, admission: FairAdmission,
                 ok = manager.drain_replica(rid)
                 return self._send(200 if ok else 404,
                                   {"draining": ok, "replica": rid})
+            if path == "/admin/scale":
+                # manual scale override (ISSUE 19): walks the fleet
+                # to N through the autoscaler's own actuators —
+                # supervised spawns with re-warm plans, emptiest-first
+                # drains — so an operator nudge and a policy decision
+                # are indistinguishable downstream
+                if autoscaler is None:
+                    return self._send(400, {
+                        "error": "no autoscaler "
+                                 "(serve_fleet --autoscale on)"})
+                try:
+                    n = int(params.get("replicas", ""))
+                except ValueError:
+                    return self._send(400, {
+                        "error": "replicas=N required"})
+                return self._send(200, autoscaler.scale_to(n))
             self._send(404, {"error": "unknown admin path"})
 
         # -- the request path -----------------------------------------------
@@ -1419,7 +1436,7 @@ def build_router(manager: FleetManager, admission: FairAdmission,
                  hedge: Optional[HedgePolicy] = None,
                  prefill_admission=None,
                  disagg_min_ids: int = 32,
-                 tsdb=None) -> ThreadingHTTPServer:
+                 tsdb=None, autoscaler=None) -> ThreadingHTTPServer:
     """Bind the front-door server (``port`` 0 picks a free one; the
     bound address is ``server.server_address``). ``tracer``/``slo``
     attach the request-scoped tracing + SLO layer
@@ -1428,10 +1445,12 @@ def build_router(manager: FleetManager, admission: FairAdmission,
     ``prefill_admission`` attaches the prefill-stage gate (two-queue
     disaggregated scheduling, ISSUE 12 — ``admission.staged_gates``);
     ``disagg_min_ids`` is the smallest affinity-id count worth a
-    handoff."""
+    handoff. ``autoscaler`` (ISSUE 19) enables ``POST /admin/scale``
+    manual overrides through the policy's own actuators."""
     handler = make_fleet_handler(
         manager, admission, stats=stats, allow_admin=allow_admin,
         read_timeout_s=read_timeout_s, tracer=tracer, slo=slo,
         hedge=hedge, prefill_admission=prefill_admission,
-        disagg_min_ids=disagg_min_ids, tsdb=tsdb)
+        disagg_min_ids=disagg_min_ids, tsdb=tsdb,
+        autoscaler=autoscaler)
     return ThreadingHTTPServer((host, port), handler)

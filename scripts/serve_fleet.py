@@ -228,6 +228,49 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--slo-e2e-s", type=float, default=0.0,
                    help="router-observed end-to-end SLO threshold "
                         "(0 = off)")
+    # autoscaler (ISSUE 19)
+    p.add_argument("--autoscale", default="off", choices=("on", "off"),
+                   help="run the fleet autoscaler: the poller-scraped "
+                        "pressure signals (queue depth, brownout "
+                        "level, SLO-breach EWMA, arrival-rate trend) "
+                        "drive replica spawn/drain through the SAME "
+                        "policy the simulator replays offline "
+                        "(fleet/autoscaler.py); spawned replicas are "
+                        "built by the exact construction path the "
+                        "launch replicas used")
+    p.add_argument("--min-replicas", type=int, default=1,
+                   help="autoscaler floor (never drains below)")
+    p.add_argument("--max-replicas", type=int, default=0,
+                   help="autoscaler ceiling (0 = 2x --replicas)")
+    p.add_argument("--autoscale-interval-s", type=float, default=1.0,
+                   help="policy tick period")
+    p.add_argument("--scale-up-pressure", type=float, default=0.85,
+                   help="effective pressure above this spawns "
+                        "ceil(replicas*pressure/threshold) - replicas "
+                        "more replicas (multi-step, capped)")
+    p.add_argument("--scale-down-pressure", type=float, default=0.40,
+                   help="pressure must sit at or below this for "
+                        "--scale-down-dwell-s before a drain")
+    p.add_argument("--scale-up-cooldown-s", type=float, default=5.0)
+    p.add_argument("--scale-down-cooldown-s", type=float, default=20.0)
+    p.add_argument("--scale-down-dwell-s", type=float, default=10.0,
+                   help="hysteresis dwell: low pressure must HOLD "
+                        "this long (plus the cooldown) — scale-down "
+                        "never flaps on a transient dip")
+    p.add_argument("--scale-horizon-s", type=float, default=20.0,
+                   help="predictive scale-ahead: provision for the "
+                        "arrival rate this far ahead on the current "
+                        "trend (0 disables prediction)")
+    p.add_argument("--autoscale-roles", default="off",
+                   choices=("on", "off"),
+                   help="let the policy flip replica roles "
+                        "(both<->prefill) on request-mixture shift; "
+                        "flips are replace-then-retire: the old role "
+                        "drains only after its replacement is healthy")
+    p.add_argument("--autoscale-rewarm-top-k", type=int, default=8,
+                   help="fleet-hot prefixes proactively replayed into "
+                        "a scaled-up replica via the re-warm path "
+                        "before it takes traffic (0 = spawn cold)")
     # fleet timeline store (ISSUE 14)
     p.add_argument("--timeline", default="on", choices=("on", "off"),
                    help="fleet time-series store: the poller folds "
@@ -276,6 +319,7 @@ def main(argv=None) -> int:
         # would ALSO be inherited by every replica child, so the CLI
         # flags are the per-process way to aim faults.
         faults.configure(args.router_faults)
+    make_replica = None
     if args.attach:
         urls = [u.strip() for u in args.attach.split(",") if u.strip()]
         replicas = [Replica(f"r{i}", url=u)
@@ -293,10 +337,11 @@ def main(argv=None) -> int:
                 print(f"serve_fleet: unknown role {role!r} in --roles",
                       file=sys.stderr)
                 return 2
-        replicas = []
-        for i in range(max(args.replicas, 1)):
-            rid = f"r{i}"
-            role = roles[i % len(roles)] if roles else "both"
+
+        def make_replica(rid: str, role: str = "both") -> Replica:
+            """ONE construction path for every replica, initial or
+            scaled-up (ISSUE 19): the autoscaler's spawns are built
+            from exactly the flags the launch replicas got."""
             cmd = [sys.executable, str(serve_py), "-r", args.resume,
                    "--host", "127.0.0.1", "--port", "0",
                    "-s", str(run_dir / rid / "save")]
@@ -323,14 +368,19 @@ def main(argv=None) -> int:
             # every child at once
             child_env = {"PDT_FAULTS": replica_faults.get(rid, "")} \
                 if replica_faults else None
-            replicas.append(Replica(
+            return Replica(
                 rid, cmd=cmd, run_dir=run_dir, role=role,
                 sup_cfg=SupervisorConfig(
                     max_restarts=args.max_restarts,
                     restart_delay_s=args.restart_delay,
                     max_delay_s=30.0, poll_s=0.2,
                     stable_runtime_s=120.0,
-                    child_env=child_env)))
+                    child_env=child_env))
+
+        replicas = [
+            make_replica(f"r{i}",
+                         roles[i % len(roles)] if roles else "both")
+            for i in range(max(args.replicas, 1))]
     # fleet timeline store (ISSUE 14): one rate/gauge point per poll
     # sweep into <run-dir>/timeseries.jsonl — the /dashboard
     # sparklines and the autoscaling substrate read it. Registered as
@@ -411,6 +461,33 @@ def main(argv=None) -> int:
     hedge = HedgePolicy(enabled=args.hedge == "on",
                         frac=args.hedge_frac,
                         delay_ms=args.hedge_delay_ms)
+
+    # autoscaler (ISSUE 19): the live half of the sim/live policy
+    # pair. Only meaningful when WE own replica construction — attach
+    # mode has no way to spawn more of someone else's servers.
+    autoscaler = None
+    if args.autoscale == "on" and make_replica is not None:
+        from pytorch_distributed_template_tpu.fleet.autoscaler import (
+            Autoscaler, AutoscaleConfig, AutoscalePolicy)
+        as_cfg = AutoscaleConfig(
+            min_replicas=max(args.min_replicas, 1),
+            max_replicas=(args.max_replicas
+                          or 2 * max(args.replicas, 1)),
+            up_pressure=args.scale_up_pressure,
+            down_pressure=args.scale_down_pressure,
+            up_cooldown_s=args.scale_up_cooldown_s,
+            down_cooldown_s=args.scale_down_cooldown_s,
+            down_dwell_s=args.scale_down_dwell_s,
+            horizon_s=args.scale_horizon_s,
+            role_flip=args.autoscale_roles == "on")
+        autoscaler = Autoscaler(
+            manager, AutoscalePolicy(as_cfg), make_replica,
+            interval_s=args.autoscale_interval_s,
+            rewarm_top_k=args.autoscale_rewarm_top_k)
+        # the autoscaler's gauges ride the manager's counter snapshot
+        # onto the router's /metrics (merged outside the fleet lock)
+        manager.extra_counters_fn = autoscaler.stats
+
     server = build_router(manager, admission, host=args.host,
                           port=args.port, stats=stats,
                           allow_admin=args.admin,
@@ -418,7 +495,7 @@ def main(argv=None) -> int:
                           tracer=tracer, slo=slo, hedge=hedge,
                           prefill_admission=prefill_admission,
                           disagg_min_ids=args.disagg_min_ids,
-                          tsdb=tsdb)
+                          tsdb=tsdb, autoscaler=autoscaler)
 
     draining = threading.Event()
 
@@ -431,6 +508,8 @@ def main(argv=None) -> int:
     signal.signal(signal.SIGTERM, _on_signal)
     signal.signal(signal.SIGINT, _on_signal)
     manager.start()
+    if autoscaler is not None:
+        autoscaler.start()
     host, port = server.server_address[:2]
     print(f"READY http://{host}:{port}", flush=True)
     try:
@@ -439,6 +518,8 @@ def main(argv=None) -> int:
         pass
     # drain: every supervisor SIGTERMs its replica (serve.py finishes
     # in-flight work, exits rc 75), threads join, no orphans
+    if autoscaler is not None:
+        autoscaler.stop()
     manager.stop()
     server.server_close()
     print("DRAINED", flush=True)

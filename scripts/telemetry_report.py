@@ -83,6 +83,11 @@ _BENCH_METRIC_FALLBACK = {
                            "warm_ttft_speedup"),
     "serve_longctx_decode_hold": ("summary", "serve_longctx",
                                   "chunk_separation"),
+    # autoscaling gate (ISSUE 19): replica-seconds saved by the
+    # policy vs the static peak-provisioned control arm on the same
+    # diurnal trace — higher-is-better for the one-sided floor gate
+    "serve_autoscale_saving": ("summary", "serve_autoscale",
+                               "replica_seconds_saving"),
 }
 
 
@@ -424,6 +429,53 @@ def analyze_disagg(path) -> dict:
     return out
 
 
+def analyze_autoscale(path) -> dict:
+    """Autoscaling section (ISSUE 19) from the router's
+    ``router.jsonl``: scale_up/scale_down/role_flip events folded
+    with the last snapshot's autoscale counters and gauges —
+    replica-seconds burned, the final target/actual split, and the
+    membership envelope the policy walked (peak/floor of the actual
+    replica gauge across snapshots). Empty when the autoscaler never
+    ran — the section only renders for fleets that scaled."""
+    counts: dict = {}
+    last_snapshot: dict = {}
+    peak = floor = None
+    for rec in load_jsonl(path):
+        ev = rec.get("event")
+        counts[ev] = counts.get(ev, 0) + 1
+        if ev == "snapshot":
+            last_snapshot = rec
+            n = rec.get("autoscale_actual_replicas")
+            if isinstance(n, (int, float)):
+                peak = n if peak is None else max(peak, n)
+                floor = n if floor is None else min(floor, n)
+    ran = (counts.get("scale_up", 0) or counts.get("scale_down", 0)
+           or counts.get("role_flip", 0)
+           or "autoscale_actual_replicas" in last_snapshot)
+    if not ran:
+        return {}
+    out: dict = {
+        "scale_ups": counts.get("scale_up", 0),
+        "scale_downs": counts.get("scale_down", 0),
+        "role_flips": counts.get("role_flip", 0),
+        "replicas_added": counts.get("add_replica", 0),
+        "replicas_removed": counts.get("remove_replica", 0),
+        "peak_replicas": peak,
+        "floor_replicas": floor,
+    }
+    for key in ("autoscale_scale_up_total",
+                "autoscale_scale_down_total",
+                "autoscale_role_flip_total", "replica_seconds_total",
+                "autoscale_target_replicas",
+                "autoscale_actual_replicas",
+                "autoscale_healthy_replicas", "autoscale_pressure",
+                "autoscale_predicted_pressure",
+                "autoscale_arrival_rate"):
+        if key in last_snapshot:
+            out[key] = last_snapshot[key]
+    return {k: v for k, v in out.items() if v is not None}
+
+
 def analyze_kvtier(records: list, fleet_path=None) -> dict:
     """KV tiers (serving) section (ISSUE 13). Engine side, from the
     slot engine's per-chunk ``serve_chunk`` records: demote/promote
@@ -698,6 +750,7 @@ def to_markdown(report: dict) -> str:
     table("Supervisor", report.get("supervisor", {}))
     table("Fleet (router)", report.get("fleet", {}))
     table("Disaggregation (serving)", report.get("disagg", {}))
+    table("Autoscaling", report.get("autoscale", {}))
     table("KV tiers (serving)", report.get("kvtier", {}))
     table("Fleet timeline (time series)",
           report.get("timeseries", {}))
@@ -875,6 +928,9 @@ def main(argv=None) -> int:
             disagg = analyze_disagg(fleet_path)
             if disagg:
                 report["disagg"] = disagg
+            autoscale = analyze_autoscale(fleet_path)
+            if autoscale:
+                report["autoscale"] = autoscale
         kvtier = analyze_kvtier(records, fleet_path=fleet_path)
         if kvtier:
             report["kvtier"] = kvtier

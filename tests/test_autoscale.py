@@ -1,0 +1,705 @@
+"""Fleet autoscaler + discrete-event simulator (ISSUE 19).
+
+Fast tier: diurnal loadgen shape/determinism, the policy state
+machine as pure units (scale-up under pressure, hysteresis/cooldown
+never flaps, emptiest-first drains, role flips on mixture shift), the
+simulator's determinism contract (same trace + model + seed ⇒
+byte-identical event log), the pure-sim policy sweep that gates the
+≥30% replica-seconds saving, and the FleetManager membership API
+(add/remove + /admin/scale) against fake in-process replicas.
+
+Slow tier: the real thing — serve_fleet --autoscale on over one
+serve.py replica, bursty traffic pushes a supervised spawn, idleness
+drains it back, zero failed requests across both scale events.
+"""
+import json
+import os
+import random
+import signal
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+from pathlib import Path
+
+import pytest
+
+from pytorch_distributed_template_tpu.fleet.autoscaler import (
+    Autoscaler, AutoscaleConfig, AutoscalePolicy, FleetSignals,
+    SignalTracker, StaticPolicy, pick_drain_victim,
+)
+from pytorch_distributed_template_tpu.fleet.loadgen import (
+    build_trace, diurnal_trace, replay, summarize,
+)
+from pytorch_distributed_template_tpu.fleet.replicas import (
+    HEALTHY, FleetManager, Replica,
+)
+from pytorch_distributed_template_tpu.fleet.simulator import (
+    FleetSimulator, SimConfig, simulate, synthetic_model, validate,
+)
+
+from tests.test_fleet import (  # the fake-replica harness (ISSUE 7)
+    FakeReplica, _get_json, _mk_fleet, _router, _wait_ready,
+    _healthy_count,
+)
+
+REPO = Path(__file__).parent.parent
+
+
+# ---------------------------------------------------------------------------
+# loadgen: the diurnal arrival preset
+# ---------------------------------------------------------------------------
+
+
+def test_diurnal_trace_deterministic():
+    a = diurnal_trace(80, seed=5, peak_rps=6.0, period_s=40.0)
+    b = diurnal_trace(80, seed=5, peak_rps=6.0, period_s=40.0)
+    assert a == b
+    c = diurnal_trace(80, seed=6, peak_rps=6.0, period_s=40.0)
+    assert a != c
+
+
+def test_diurnal_times_monotone_and_peaked():
+    period = 40.0
+    trace = diurnal_trace(240, seed=3, peak_rps=6.0,
+                          period_s=period, floor=0.08)
+    times = [r["t"] for r in trace]
+    assert times == sorted(times)
+    assert times[0] >= 0.0
+    # the envelope peaks mid-period: arrivals in phase [0.25, 0.75)
+    # must dominate the trough tails by a wide margin
+    mid = sum(1 for t in times if 0.25 <= (t % period) / period < 0.75)
+    edge = len(times) - mid
+    assert mid > 2 * edge, (mid, edge)
+
+
+def test_diurnal_knobs_are_draw_order_neutral():
+    """The new kwargs must not perturb pre-existing arrival modes:
+    a poisson trace is byte-identical whatever the diurnal knobs say
+    (each mode draws only from its own rng branch)."""
+    base = build_trace(40, seed=11, rate_rps=3.0, arrival="poisson")
+    knobbed = build_trace(40, seed=11, rate_rps=3.0,
+                          arrival="poisson", diurnal_period_s=7.0,
+                          diurnal_floor=0.5, diurnal_sharpness=9)
+    assert base == knobbed
+
+
+def test_diurnal_floor_keeps_trough_traffic():
+    # floor=1.0 degenerates to a constant rate: the envelope is flat,
+    # so phase coverage is roughly uniform (no empty deciles)
+    period = 20.0
+    trace = diurnal_trace(300, seed=2, peak_rps=8.0,
+                          period_s=period, floor=1.0)
+    deciles = [0] * 10
+    for r in trace:
+        deciles[min(int((r["t"] % period) / period * 10), 9)] += 1
+    assert min(deciles) > 0, deciles
+
+
+# ---------------------------------------------------------------------------
+# policy units: the deterministic state machine
+# ---------------------------------------------------------------------------
+
+
+def _sig(t=0.0, replicas=1, healthy=None, slots=4.0, **kw):
+    healthy = replicas if healthy is None else healthy
+    return FleetSignals(t=t, replicas=replicas, healthy=healthy,
+                        slots=slots, **kw)
+
+
+def test_policy_scales_up_on_queue_pressure():
+    pol = AutoscalePolicy(AutoscaleConfig(max_replicas=4))
+    acts = pol.decide(_sig(queue_depth=8.0, inflight=2.0))
+    assert acts and acts[0]["op"] == "scale_up"
+    assert acts[0]["reason"] == "pressure"
+    # pressure 2.5 at 1 replica wants ceil(2.5/0.85)=3 → +2 in ONE
+    # step (a steep ramp must not pay one cooldown per replica)
+    assert acts[0]["n"] == 2
+
+
+def test_policy_scales_up_on_slo_pressure_alone():
+    pol = AutoscalePolicy(AutoscaleConfig())
+    acts = pol.decide(_sig(slo_breach_rate=1.0, arrival_rate=2.0))
+    assert acts and acts[0]["op"] == "scale_up"
+
+
+def test_policy_predictive_scale_ahead():
+    pol = AutoscalePolicy(AutoscaleConfig(horizon_s=20.0,
+                                          service_s_hint=0.5))
+    # idle NOW, but the arrival trend projects 28 rps against 4 slots
+    acts = pol.decide(_sig(arrival_rate=8.0, arrival_trend=1.0))
+    assert acts and acts[0]["op"] == "scale_up"
+    assert acts[0]["reason"] == "predicted"
+
+
+def test_policy_up_cooldown_blocks_flap():
+    pol = AutoscalePolicy(AutoscaleConfig(up_cooldown_s=5.0))
+    hot = dict(queue_depth=8.0, inflight=2.0)
+    assert pol.decide(_sig(t=0.0, **hot))
+    assert pol.decide(_sig(t=1.0, replicas=3, **hot)) == []
+    assert pol.decide(_sig(t=6.0, replicas=3, **hot))
+
+
+def test_policy_scale_down_needs_dwell_and_cooldown():
+    pol = AutoscalePolicy(AutoscaleConfig(
+        down_pressure=0.40, down_dwell_s=10.0, down_cooldown_s=20.0))
+    idle = dict(replicas=3, slots=12.0,
+                replica_loads={"r0": 1.0, "r1": 0.0, "r2": 2.0})
+    # first low tick only STARTS the dwell
+    assert pol.decide(_sig(t=100.0, **idle)) == []
+    # dwell not yet served
+    assert pol.decide(_sig(t=105.0, **idle)) == []
+    acts = pol.decide(_sig(t=112.0, **idle))
+    assert acts and acts[0]["op"] == "scale_down"
+    assert acts[0]["rid"] == "r1"           # the emptiest
+    # a mid-band excursion resets the dwell
+    pol2 = AutoscalePolicy(AutoscaleConfig(down_dwell_s=10.0))
+    assert pol2.decide(_sig(t=0.0, **idle)) == []
+    pol2.decide(_sig(t=5.0, replicas=3, slots=12.0,
+                     queue_depth=7.0))      # mid-band blip
+    assert pol2.decide(_sig(t=11.0, **idle)) == []
+
+
+def test_policy_respects_min_and_max():
+    pol = AutoscalePolicy(AutoscaleConfig(min_replicas=1,
+                                          max_replicas=2))
+    # at the ceiling: pressure cannot push past max_replicas
+    assert pol.decide(_sig(replicas=2, queue_depth=50.0)) == []
+    # at the floor: idleness cannot drain below min_replicas
+    pol2 = AutoscalePolicy(AutoscaleConfig(min_replicas=1))
+    assert pol2.decide(_sig(t=0.0, replicas=1,
+                            replica_loads={"r0": 0.0})) == []
+    assert pol2.decide(_sig(t=100.0, replicas=1,
+                            replica_loads={"r0": 0.0})) == []
+
+
+def test_hysteresis_gap_is_validated():
+    with pytest.raises(ValueError):
+        AutoscaleConfig(up_pressure=0.5, down_pressure=0.5)
+    with pytest.raises(ValueError):
+        AutoscaleConfig(min_replicas=3, max_replicas=2)
+
+
+def test_pick_drain_victim_emptiest_and_spares_prefill():
+    assert pick_drain_victim({"r0": 2.0, "r1": 0.5}) == "r1"
+    # deterministic tie-break on rid
+    assert pick_drain_victim({"b": 1.0, "a": 1.0}) == "a"
+    # a dedicated prefill replica is spared while a "both" exists
+    assert pick_drain_victim(
+        {"p0": 0.0, "r0": 3.0},
+        {"p0": "prefill", "r0": "both"}) == "r0"
+    # ...but an all-prefill pool still drains
+    assert pick_drain_victim({"p0": 0.0}, {"p0": "prefill"}) == "p0"
+    assert pick_drain_victim({}) is None
+
+
+def test_policy_role_flip_on_mixture_shift():
+    pol = AutoscalePolicy(AutoscaleConfig(
+        role_flip=True, prefill_share_high=0.55,
+        prefill_share_low=0.25, role_cooldown_s=30.0))
+    roles = {"r0": "both", "r1": "both"}
+    loads = {"r0": 2.0, "r1": 0.0}
+    # prefill-heavy mixture dedicates the EMPTIEST "both" replica
+    acts = pol.decide(_sig(t=0.0, replicas=2, slots=8.0,
+                           prefill_share=0.7, replica_roles=roles,
+                           replica_loads=loads))
+    flips = [a for a in acts if a["op"] == "role_flip"]
+    assert flips == [{"op": "role_flip", "rid": "r1",
+                      "role": "prefill", "reason": "prefill_heavy",
+                      "share": 0.7}]
+    # the flip cooldown gates the reverse flip...
+    roles2 = {"r0": "both", "r1": "prefill"}
+    acts = pol.decide(_sig(t=5.0, replicas=2, slots=8.0,
+                           prefill_share=0.1, replica_roles=roles2,
+                           replica_loads=loads))
+    assert not [a for a in acts if a["op"] == "role_flip"]
+    # ...and decode-heavy traffic folds it back once it expires
+    acts = pol.decide(_sig(t=40.0, replicas=2, slots=8.0,
+                           prefill_share=0.1, replica_roles=roles2,
+                           replica_loads=loads))
+    flips = [a for a in acts if a["op"] == "role_flip"]
+    assert flips and flips[0]["rid"] == "r1"
+    assert flips[0]["role"] == "both"
+
+
+def test_policy_role_flip_never_below_two_healthy():
+    pol = AutoscalePolicy(AutoscaleConfig(role_flip=True))
+    acts = pol.decide(_sig(replicas=2, healthy=1, slots=4.0,
+                           prefill_share=0.9,
+                           replica_roles={"r0": "both"},
+                           replica_loads={"r0": 0.0}))
+    assert not [a for a in acts if a["op"] == "role_flip"]
+
+
+def test_signal_tracker_rates_and_trend():
+    tr = SignalTracker(alpha=1.0)      # no smoothing: exact rates
+    tr.update(0.0, {"arrivals": 0.0})
+    tr.update(1.0, {"arrivals": 4.0})
+    assert tr.rate("arrivals") == pytest.approx(4.0)
+    tr.update(2.0, {"arrivals": 12.0})
+    assert tr.rate("arrivals") == pytest.approx(8.0)
+    assert tr.trend("arrivals") == pytest.approx(4.0)
+    # counter resets clamp to zero instead of going negative
+    tr.update(3.0, {"arrivals": 1.0})
+    assert tr.rate("arrivals") >= 0.0
+
+
+def test_signal_tracker_alpha_is_per_second():
+    """alpha is a PER-SECOND coefficient: a 0.5 s cadence applies
+    1-(1-alpha)^0.5 per update, so two 0.5 s updates carrying the
+    same instantaneous rate land exactly where one 1 s update does —
+    the live 0.5 s tick and the simulator's 1 s tick see the same
+    smoothing."""
+    fast, slow = SignalTracker(alpha=0.5), SignalTracker(alpha=0.5)
+    slow.update(0.0, {"a": 0.0})
+    slow.update(1.0, {"a": 6.0})       # 6/s over one 1 s step
+    fast.update(0.0, {"a": 0.0})
+    fast.update(0.5, {"a": 3.0})       # 6/s over two 0.5 s steps
+    fast.update(1.0, {"a": 6.0})
+    assert fast.rate("a") == pytest.approx(slow.rate("a"))
+
+
+def test_predicted_pressure_trend_noise_is_capped():
+    """One arrival after a quiet spell spikes the rate derivative;
+    uncapped, trend x horizon projected phantom rps that flapped a
+    small live fleet up and reset the scale-down dwell all through a
+    valley. The projection is capped at predict_max_factor x the
+    current rate, so near-zero rates project near-zero demand while
+    a genuine ramp (high rate AND high trend) still scales ahead."""
+    pol = AutoscalePolicy(AutoscaleConfig(horizon_s=20.0,
+                                          service_s_hint=0.5))
+    # valley blip: rate 0.4 rps but a violent transient trend
+    quiet = _sig(replicas=2, slots=4.0, arrival_rate=0.4,
+                 arrival_trend=2.0)
+    assert pol.predicted_pressure(quiet) == pytest.approx(
+        3.0 * 0.4 * 0.5 / 4.0)         # capped, well under up_pressure
+    assert pol.decide(quiet) == []
+    # genuine ramp: the rising rate carries the projection
+    ramp = _sig(replicas=2, slots=4.0, arrival_rate=4.0,
+                arrival_trend=1.0)
+    assert pol.predicted_pressure(ramp) >= 1.0
+    assert pol.decide(ramp)[0]["op"] == "scale_up"
+
+
+# ---------------------------------------------------------------------------
+# simulator: determinism + the policy sweep the CI job gates
+# ---------------------------------------------------------------------------
+
+
+def _sim_args(n=250, seed=4):
+    trace = diurnal_trace(n, seed=seed, peak_rps=6.0, period_s=60.0,
+                          floor=0.08, max_new_tokens=24,
+                          stream_frac=0.6)
+    cfg = SimConfig(slots_per_replica=4, tick_s=1.0,
+                    slo_ttft_s=5.0, slo_e2e_s=30.0)
+    return trace, cfg
+
+
+def test_simulator_deterministic_event_log():
+    trace, cfg = _sim_args()
+    runs = []
+    for _ in range(2):
+        pol = AutoscalePolicy(AutoscaleConfig(min_replicas=1,
+                                              max_replicas=4))
+        runs.append(simulate(trace, pol, cfg=cfg,
+                             initial_replicas=1, seed=9))
+    assert json.dumps(runs[0]["events"], sort_keys=True) == \
+        json.dumps(runs[1]["events"], sort_keys=True)
+    assert json.dumps(runs[0]["requests"], sort_keys=True) == \
+        json.dumps(runs[1]["requests"], sort_keys=True)
+    assert runs[0]["summary"] == runs[1]["summary"]
+    # a different seed produces a different run (same event COUNT is
+    # fine; byte-identity would mean the seed is dead)
+    pol = AutoscalePolicy(AutoscaleConfig(min_replicas=1,
+                                          max_replicas=4))
+    other = simulate(trace, pol, cfg=cfg, initial_replicas=1, seed=10)
+    assert json.dumps(other["requests"]) != \
+        json.dumps(runs[0]["requests"])
+
+
+def test_simulator_autoscales_and_serves_clean():
+    trace, cfg = _sim_args()
+    pol = AutoscalePolicy(AutoscaleConfig(min_replicas=1,
+                                          max_replicas=4))
+    s = simulate(trace, pol, cfg=cfg, initial_replicas=1,
+                 seed=0)["summary"]
+    assert s["failed"] == 0 and s["shed"] == 0
+    assert s["scale_ups"] >= 1 and s["scale_downs"] >= 1
+    assert 1 <= s["floor_replicas"] <= s["peak_replicas"] <= 4
+    assert s["replica_seconds"] > 0
+    assert s["ttft_p99_s"] is not None
+
+
+def test_simulator_policy_sweep_saves_replica_seconds():
+    """The CI gate (autoscale-smoke): the SAME diurnal trace under the
+    static peak-provisioned control vs the autoscale policy — the
+    policy must hold the SLO while burning ≥30% fewer
+    replica-seconds."""
+    trace, cfg = _sim_args(n=400)
+    static = simulate(trace, StaticPolicy(), cfg=cfg,
+                      initial_replicas=4, seed=0)["summary"]
+    auto = simulate(
+        trace, AutoscalePolicy(AutoscaleConfig(min_replicas=1,
+                                               max_replicas=4)),
+        cfg=cfg, initial_replicas=1, seed=0)["summary"]
+    for arm in (static, auto):
+        assert arm["failed"] == 0 and arm["shed"] == 0, arm
+        assert arm["slo_compliant_frac"] >= 0.99, arm
+    saving = 1.0 - auto["replica_seconds"] / static["replica_seconds"]
+    assert saving >= 0.30, (saving, static["replica_seconds"],
+                            auto["replica_seconds"])
+
+
+def test_simulator_role_flip_under_prefill_heavy_mixture():
+    # long prompts + tiny decodes make the arriving mixture
+    # prefill-heavy; the policy should dedicate a prefill replica
+    trace = build_trace(160, seed=8, rate_rps=8.0, prefix_len=480,
+                        suffix_len=64, max_new_tokens=2,
+                        stream_frac=0.0)
+    pol = AutoscalePolicy(AutoscaleConfig(
+        min_replicas=2, max_replicas=4, role_flip=True,
+        prefill_share_high=0.55, role_cooldown_s=5.0))
+    out = simulate(trace, pol, cfg=SimConfig(), initial_replicas=2,
+                   seed=1)
+    assert out["summary"]["role_flips"] >= 1, out["summary"]
+
+
+def test_validate_contract():
+    v = validate({"ttft_p99_s": 1.0, "tpot_p99_s": 0.10},
+                 {"ttft_p99_s": 1.10, "tpot_p99_s": 0.105})
+    assert v["ok"] and v["compared"] == 2
+    assert v["metrics"]["ttft_p99_s"]["rel_err"] == \
+        pytest.approx(0.1 / 1.1, abs=1e-3)   # |sim - live| / live
+    v = validate({"ttft_p99_s": 2.0}, {"ttft_p99_s": 1.0})
+    assert not v["ok"]
+    # a missing side is reported but never gated
+    v = validate({"ttft_p99_s": 1.0, "tpot_p99_s": None},
+                 {"ttft_p99_s": 1.05})
+    assert v["ok"] and v["compared"] == 1
+    # the absolute floor: a sub-floor gap passes even when the
+    # relative band is blown (sub-ms TPOT on a CPU dev fleet), but a
+    # real-scale miss is still a miss — the floor never rescues it
+    v = validate({"tpot_p99_s": 0.0012}, {"tpot_p99_s": 0.0008},
+                 abs_floor_s=0.005)
+    assert v["ok"] and v["compared"] == 1
+    assert v["abs_floor_s"] == 0.005
+    assert v["metrics"]["tpot_p99_s"]["abs_err_s"] == \
+        pytest.approx(0.0004)
+    v = validate({"ttft_p99_s": 2.0}, {"ttft_p99_s": 1.0},
+                 abs_floor_s=0.005)
+    assert not v["ok"]
+
+
+def test_sampler_preflight_includes_scheduler_cadence():
+    # the replica engine's batching-tick cadence (scheduler_queue) is
+    # a dispatch floor every request pays even idle — measured models
+    # that carry it must feed it into pre-first-token overhead, while
+    # admission_wait (the fleet-level queue the sim models itself)
+    # stays out
+    from pytorch_distributed_template_tpu.fleet.simulator import (
+        PREFLIGHT_SEGMENTS, ServiceSampler,
+    )
+    assert "scheduler_queue" in PREFLIGHT_SEGMENTS
+    assert "admission_wait" not in PREFLIGHT_SEGMENTS
+    base = synthetic_model()
+    bare = ServiceSampler(base, rng=random.Random(0)).overhead_s()
+    from pytorch_distributed_template_tpu.observability.servicedist \
+        import _seg_stats
+    entry = _seg_stats([0.025] * 32)
+    entry["classes"] = {}
+    with_cadence = dict(base)
+    with_cadence["segments"] = dict(
+        base["segments"], scheduler_queue=entry)
+    loaded = ServiceSampler(
+        with_cadence, rng=random.Random(0)).overhead_s()
+    assert loaded > bare + 0.02
+
+
+def test_synthetic_model_shapes_like_measured():
+    m = synthetic_model()
+    assert "segments" in m and "decode" in m["segments"]
+    entry = m["segments"]["admit"]
+    assert entry["classes"], entry
+    sim = FleetSimulator([], StaticPolicy(), model=m)
+    assert sim.sampler.decode_s(16) > sim.sampler.decode_s(1)
+    warm = sim.sampler.admit_s(True, 64, True)
+    cold = sim.sampler.admit_s(False, 64, True)
+    assert cold > warm
+
+
+# ---------------------------------------------------------------------------
+# manager membership API + the live actuator, against fake replicas
+# ---------------------------------------------------------------------------
+
+
+def _wait_until(cond, timeout_s=10.0, every_s=0.05):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(every_s)
+    return False
+
+
+def test_manager_add_remove_replica(tmp_path):
+    fakes = [FakeReplica(slots=2), FakeReplica(slots=2)]
+    manager = _mk_fleet(tmp_path, fakes[:1])
+    try:
+        assert manager.capacity() == 4          # 2 slots x factor 2
+        assert manager.add_replica(
+            Replica("r1", url=fakes[1].url)) is True
+        # a duplicate rid is refused
+        assert manager.add_replica(
+            Replica("r1", url=fakes[1].url)) is False
+        manager.poll_once()
+        assert manager.replicas["r1"].state == HEALTHY
+        assert manager.capacity() == 8
+        assert manager.remove_replica("r1") is True
+        assert manager.remove_replica("nope") is False
+        assert _wait_until(lambda: "r1" not in manager.replicas)
+        assert manager.capacity() == 4
+
+        def events():
+            return [json.loads(line)["event"] for line in
+                    (tmp_path /
+                     "router.jsonl").read_text().splitlines()]
+        assert "add_replica" in events()
+        # the removed_replica marker lands just after the pop
+        assert _wait_until(lambda: "removed_replica" in events())
+    finally:
+        manager.stop()
+        for f in fakes:
+            f.stop()
+
+
+def test_replica_seconds_accrue_with_membership(tmp_path):
+    fakes = [FakeReplica(), FakeReplica()]
+    manager = _mk_fleet(tmp_path, fakes[:1])
+    try:
+        manager._rs_last = time.monotonic() - 1.0   # pretend 1s ago
+        one = manager.snapshot_counters()["replica_seconds_total"]
+        assert one >= 1.0
+        manager.add_replica(Replica("r1", url=fakes[1].url))
+        manager._rs_last = time.monotonic() - 1.0
+        two = manager.snapshot_counters()["replica_seconds_total"]
+        # two members burn ~2 replica-seconds per wall second
+        assert two - one >= 1.9, (one, two)
+    finally:
+        manager.stop()
+        for f in fakes:
+            f.stop()
+
+
+def test_autoscaler_live_actuation_and_admin_scale(tmp_path):
+    fakes = [FakeReplica(slots=2)]
+    spawned = []
+
+    def make_replica(rid, role="both"):
+        fake = FakeReplica(slots=2)
+        fakes.append(fake)
+        spawned.append(rid)
+        return Replica(rid, url=fake.url, role=role)
+
+    manager = _mk_fleet(tmp_path, fakes[:1])
+    autoscaler = Autoscaler(
+        manager,
+        AutoscalePolicy(AutoscaleConfig(min_replicas=1,
+                                        max_replicas=3)),
+        make_replica, interval_s=0.2)
+    manager.extra_counters_fn = autoscaler.stats
+    server, _, url = _router(manager, allow_admin=True,
+                             autoscaler=autoscaler)
+    try:
+        # the autoscaler's gauges ride the manager snapshot onto
+        # /metrics (promlint: *_total counters, suffixless gauges)
+        m = _get_json(url, "/metrics?format=json")
+        assert m["autoscale_actual_replicas"] == 1
+        assert m["autoscale_scale_up_total"] == 0
+
+        # manual override: walk the fleet up through the policy's
+        # own actuators
+        req = urllib.request.Request(url + "/admin/scale?replicas=3",
+                                     data=b"", method="POST")
+        out = json.loads(urllib.request.urlopen(req, timeout=10).read())
+        assert out["target"] == 3 and out["was"] == 1
+        assert spawned == ["as0", "as1"]
+        manager.poll_once()
+        assert sum(1 for r in manager.replicas.values()
+                   if r.state == HEALTHY) == 3
+        m = _get_json(url, "/metrics?format=json")
+        assert m["autoscale_scale_up_total"] == 2
+        assert m["autoscale_actual_replicas"] == 3
+
+        # ...and back down: emptiest-first supervised drains
+        req = urllib.request.Request(url + "/admin/scale?replicas=1",
+                                     data=b"", method="POST")
+        json.loads(urllib.request.urlopen(req, timeout=10).read())
+        assert _wait_until(lambda: len(manager.replicas) == 1)
+        m = _get_json(url, "/metrics?format=json")
+        assert m["autoscale_scale_down_total"] == 2
+        events = [json.loads(line)["event"] for line in
+                  (tmp_path / "router.jsonl").read_text().splitlines()]
+        assert "scale_up" in events and "scale_down" in events
+    finally:
+        server.shutdown()
+        manager.stop()
+        for f in fakes:
+            f.stop()
+
+
+def test_admin_scale_without_autoscaler_is_400(tmp_path):
+    fakes = [FakeReplica()]
+    manager = _mk_fleet(tmp_path, fakes)
+    server, _, url = _router(manager, allow_admin=True)
+    try:
+        req = urllib.request.Request(url + "/admin/scale?replicas=2",
+                                     data=b"", method="POST")
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(req, timeout=10)
+        assert e.value.code == 400
+    finally:
+        server.shutdown()
+        manager.stop()
+        for f in fakes:
+            f.stop()
+
+
+def test_autoscaler_spawn_preloads_hot_prefix_rewarm_plan(tmp_path):
+    fakes = [FakeReplica()]
+    made = []
+
+    def make_replica(rid, role="both"):
+        fake = FakeReplica()
+        fakes.append(fake)
+        rep = Replica(rid, url=fake.url, role=role)
+        made.append(rep)
+        return rep
+
+    manager = _mk_fleet(tmp_path, fakes[:1])
+    try:
+        # seed fleet-hot prefixes into the placement radix
+        manager.radix.record(list(range(64)), "r0")
+        manager.radix.record(list(range(100, 132)), "r0")
+        autoscaler = Autoscaler(
+            manager, AutoscalePolicy(AutoscaleConfig(max_replicas=2)),
+            make_replica, rewarm_top_k=4)
+        autoscaler._apply({"op": "scale_up", "n": 1})
+        assert made and made[0].rewarm_prefixes
+        assert made[0].rewarm_state == "pending"
+        # the plan is id-chains, the re-warm pull path's input shape
+        assert all(isinstance(c, list) for c in
+                   made[0].rewarm_prefixes)
+    finally:
+        manager.stop()
+        for f in fakes:
+            f.stop()
+
+
+# ---------------------------------------------------------------------------
+# slow tier: spawn/drain under real traffic, zero failed requests
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_autoscale_end_to_end_spawn_drain_under_traffic(tmp_path):
+    """serve_fleet --autoscale on over ONE replica: bursty traffic
+    pushes pressure past the up watermark → a supervised spawn joins
+    and takes traffic; idleness after the burst serves the dwell →
+    the spare drains back out. Zero failed requests across both scale
+    events, clean fleet drain, scale events in router.jsonl."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    art = tmp_path / "artifact"
+    subprocess.run(
+        [sys.executable, str(REPO / "scripts" /
+                             "make_serving_artifact.py"),
+         "-o", str(art), "--max-len", "256", "--block-tokens", "16",
+         "--compile-cache-dir", str(tmp_path / "xla-cache")],
+        check=True, env=env, timeout=600, cwd=REPO)
+    run_dir = tmp_path / "fleet"
+    log = tmp_path / "fleet.log"
+    with open(log, "w") as log_f:
+        proc = subprocess.Popen(
+            [sys.executable, str(REPO / "scripts" / "serve_fleet.py"),
+             "-r", str(art / "model"), "--replicas", "1", "--port",
+             "0", "--run-dir", str(run_dir), "--admin",
+             "--poll-s", "0.3", "--readmit-after", "1",
+             "--restart-delay", "0.5", "--block-tokens", "16",
+             "--autoscale", "on", "--min-replicas", "1",
+             "--max-replicas", "2", "--autoscale-interval-s", "0.5",
+             "--scale-up-pressure", "0.5",
+             "--scale-down-pressure", "0.2",
+             "--scale-up-cooldown-s", "1",
+             "--scale-down-cooldown-s", "3",
+             "--scale-down-dwell-s", "2",
+             "--", "--max-batch", "1", "--decode-chunk", "4"],
+            stdout=log_f, stderr=subprocess.STDOUT, env=env, cwd=REPO)
+    total_errors = 0
+    try:
+        url = _wait_ready(log, proc)
+        deadline = time.time() + 420
+        while _healthy_count(url) < 1 and time.time() < deadline:
+            time.sleep(1.0)
+        assert _healthy_count(url) >= 1, log.read_text()[-3000:]
+
+        # burst: 1-slot replica + 4 rps ⇒ queue builds ⇒ pressure
+        trace = build_trace(12, seed=7, rate_rps=4.0,
+                            prefix_groups=2, prefix_len=32,
+                            suffix_len=8, max_new_tokens=4,
+                            stream_frac=0.5)
+        summary = summarize(replay(url, trace, timeout_s=300), trace)
+        total_errors += summary["errors"]
+        assert summary["errors"] == 0, summary
+
+        # the spawn lands: as0 joins and goes healthy
+        deadline = time.time() + 420
+        while time.time() < deadline:
+            m = _get_json(url, "/metrics?format=json")
+            if (m.get("autoscale_scale_up_total", 0) >= 1
+                    and _healthy_count(url) >= 2):
+                break
+            time.sleep(1.0)
+        m = _get_json(url, "/metrics?format=json")
+        assert m.get("autoscale_scale_up_total", 0) >= 1, \
+            log.read_text()[-3000:]
+        assert _healthy_count(url) == 2
+
+        # traffic lands cleanly on the scaled-up fleet
+        trace2 = build_trace(6, seed=8, rate_rps=2.0,
+                             prefix_groups=2, prefix_len=32,
+                             suffix_len=8, max_new_tokens=4,
+                             stream_frac=0.5)
+        summary2 = summarize(replay(url, trace2, timeout_s=300),
+                             trace2)
+        total_errors += summary2["errors"]
+        assert summary2["errors"] == 0, summary2
+
+        # idle: the dwell + cooldown serve, the spare drains out
+        deadline = time.time() + 300
+        while time.time() < deadline:
+            m = _get_json(url, "/metrics?format=json")
+            if (m.get("autoscale_scale_down_total", 0) >= 1
+                    and _healthy_count(url) == 1):
+                break
+            time.sleep(1.0)
+        m = _get_json(url, "/metrics?format=json")
+        assert m.get("autoscale_scale_down_total", 0) >= 1, \
+            log.read_text()[-3000:]
+        assert _healthy_count(url) == 1
+        assert m.get("replica_seconds_total", 0) > 0
+
+        # the whole dance dropped nothing
+        assert total_errors == 0
+
+        proc.send_signal(signal.SIGTERM)
+        rc = proc.wait(timeout=120)
+        assert rc == 0, log.read_text()[-3000:]
+        assert "DRAINED" in log.read_text()
+        events = [json.loads(line).get("event") for line in
+                  (run_dir / "router.jsonl").read_text().splitlines()]
+        assert "scale_up" in events and "scale_down" in events
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=30)
